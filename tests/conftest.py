@@ -1,0 +1,26 @@
+"""Test harness: force JAX onto a simulated 8-device CPU host (SURVEY §4).
+
+Must run before anything imports jax, hence module-level env mutation in
+conftest. Bench runs (bench.py) use the real TPU; tests never do.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def jax_cpu_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) == 8, f"expected 8 simulated devices, got {devices}"
+    return devices

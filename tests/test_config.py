@@ -1,0 +1,46 @@
+from tpubench.config import MB, BenchConfig, preset
+
+
+def test_defaults_match_reference():
+    # Reference constants preserved (SURVEY §5.6): main.go:30-42,36-38,123-125.
+    cfg = BenchConfig()
+    assert cfg.workload.workers == 48
+    assert cfg.workload.granule_bytes == 2 * MB
+    assert cfg.transport.max_conns_per_host == 100
+    assert cfg.transport.max_idle_conns_per_host == 100
+    assert cfg.transport.grpc_conn_pool_size == 1
+    assert cfg.transport.http2 is False
+    assert cfg.transport.retry.max_backoff_s == 30.0
+    assert cfg.transport.retry.multiplier == 2.0
+    assert cfg.transport.retry.policy == "always"
+
+
+def test_json_roundtrip():
+    cfg = BenchConfig()
+    cfg.workload.workers = 7
+    cfg.transport.protocol = "grpc"
+    cfg.transport.retry.max_backoff_s = 12.5
+    cfg2 = BenchConfig.from_json(cfg.to_json())
+    assert cfg2.workload.workers == 7
+    assert cfg2.transport.protocol == "grpc"
+    assert cfg2.transport.retry.max_backoff_s == 12.5
+    assert cfg2.to_dict() == cfg.to_dict()
+
+
+def test_presets_mirror_shell_sweep():
+    # read_operations.sh:8-14: 256KB/1MB/100MB/1GB with counts 1000/100/10/1.
+    for name, size, count in (
+        ("256kb", 256 * 1024, 1000),
+        ("1mb", 1 * MB, 100),
+        ("100mb", 100 * MB, 10),
+        ("1gb", 1024 * MB, 1),
+    ):
+        cfg = preset(name)
+        assert cfg.workload.object_size == size
+        assert cfg.workload.read_count == count
+
+
+def test_smoke_preset_is_hermetic():
+    cfg = preset("smoke")
+    assert cfg.transport.protocol == "fake"
+    assert cfg.workload.object_size <= 8 * MB

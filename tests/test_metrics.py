@@ -1,0 +1,121 @@
+import threading
+
+import numpy as np
+import pytest
+
+from tpubench.metrics import (
+    ByteCounter,
+    LatencyRecorder,
+    MetricSet,
+    format_summary,
+    merge_recorders,
+    summarize,
+)
+from tpubench.metrics.percentiles import summarize_ns
+from tpubench.metrics.report import RunResult, write_result
+
+
+def test_percentile_index_convention():
+    # ssd_test/main.go:157-163: index-based sorted[p*n/100], p50 = sorted[n/2].
+    data = list(range(100))  # sorted 0..99
+    s = summarize(data)
+    assert s.p50_ms == 50.0  # sorted[100*50//100] = sorted[50]
+    assert s.p20_ms == 20.0
+    assert s.p90_ms == 90.0
+    assert s.p99_ms == 99.0
+    assert s.min_ms == 0.0
+    assert s.max_ms == 99.0
+    assert s.avg_ms == pytest.approx(49.5)
+    assert s.count == 100
+
+
+def test_percentile_small_sample_clamped():
+    s = summarize([5.0])
+    assert s.p99_ms == 5.0 and s.p50_ms == 5.0 and s.count == 1
+
+
+def test_percentile_unsorted_input():
+    s = summarize([3.0, 1.0, 2.0, 4.0])
+    assert s.min_ms == 1.0 and s.max_ms == 4.0
+    assert s.p50_ms == 3.0  # sorted[4*50//100] = sorted[2]
+
+
+def test_empty_raises():
+    with pytest.raises(ValueError):
+        summarize([])
+
+
+def test_summarize_ns_converts_to_ms():
+    s = summarize_ns([2_000_000, 4_000_000])
+    assert s.min_ms == 2.0 and s.max_ms == 4.0
+
+
+def test_recorder_merge_threaded():
+    """Per-worker recorders merged post-join: the fix for ssd_test's data race
+    (ssd_test/main.go:80). Each thread owns its recorder; totals must be exact."""
+    n_threads, n_each = 8, 500
+    recs = [LatencyRecorder(f"w{i}") for i in range(n_threads)]
+
+    def work(rec, base):
+        for j in range(n_each):
+            rec.record_ns(base + j)
+
+    threads = [
+        threading.Thread(target=work, args=(recs[i], i * 10_000)) for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    merged = merge_recorders(recs)
+    assert merged.size == n_threads * n_each
+    expected = sorted(i * 10_000 + j for i in range(n_threads) for j in range(n_each))
+    assert np.array_equal(np.sort(merged), np.array(expected))
+
+
+def test_recorder_timer():
+    rec = LatencyRecorder("t")
+    with rec.time():
+        pass
+    assert len(rec) == 1 and rec.as_ns_array()[0] >= 0
+
+
+def test_byte_counter_gbps():
+    bc = ByteCounter()
+    bc.start()
+    bc.add(500)
+    bc.add(500)
+    bc.stop()
+    assert bc.bytes == 1000
+    assert bc.gbps() > 0
+
+
+def test_metric_set_summaries():
+    ms = MetricSet()
+    r, fb = ms.new_worker("w0")
+    r.record_ns(1_000_000)
+    fb.record_ns(500_000)
+    out = ms.summaries()
+    assert out["read"].count == 1
+    assert out["first_byte"].p50_ms == 0.5
+    assert "stage" not in out  # no samples → omitted
+
+
+def test_format_summary_block():
+    s = summarize([1.0, 2.0, 3.0])
+    block = format_summary("read", s)
+    for key in ("Average:", "P20:", "P50:", "P90:", "p99:", "Min:", "Max:"):
+        assert key in block  # ssd_test stdout shape
+
+
+def test_run_result_roundtrip(tmp_path):
+    res = RunResult(workload="read", config={"workers": 2})
+    res.summaries["read"] = summarize([1.0, 2.0])
+    path = write_result(res, str(tmp_path))
+    import json
+
+    with open(path) as f:
+        d = json.load(f)
+    assert d["workload"] == "read"
+    assert d["summaries"]["read"]["count"] == 2
+    assert "GB/s" in res.format()

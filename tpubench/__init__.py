@@ -1,0 +1,30 @@
+"""tpubench — a TPU-native storage-ingest benchmark framework.
+
+Reproduces the capabilities of ``tritone/custom-go-client-benchmark`` (a Go
+GCS-client + gcsfuse benchmark suite, see SURVEY.md) re-designed TPU-first:
+
+* concurrent worker fan-out per host × multi-host ``jax.distributed`` processes
+  (reference: errgroup goroutines, ``main.go:200-212``);
+* object bytes staged GCS→HBM via ``jax.device_put`` / Pallas, not host RAM
+  (reference lands bytes in host RAM and discards them, ``main.go:140``);
+* object-range shards reassembled across the pod with an ICI all-gather under
+  ``shard_map`` so the pod is the unit under test;
+* metrics: GB/s/chip ingest bandwidth + first-byte/full-read latency
+  percentiles in the reference's ssd_test report format
+  (``benchmark-script/ssd_test/main.go:157-163``).
+
+Layout mirrors SURVEY.md §7: config / metrics / storage / native / staging /
+dist / workloads / cli.
+"""
+
+__version__ = "0.1.0"
+
+from tpubench.config import (  # noqa: F401
+    BenchConfig,
+    DistConfig,
+    ObservabilityConfig,
+    RetryConfig,
+    StagingConfig,
+    TransportConfig,
+    WorkloadConfig,
+)

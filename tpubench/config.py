@@ -1,0 +1,219 @@
+"""Unified configuration for tpubench.
+
+The reference scatters its knobs across per-binary ``flag`` globals and
+hardcoded constants (SURVEY.md §5.6): e.g. ``GrpcConnPoolSize``,
+``MaxConnsPerHost``, ``MaxIdleConnsPerHost`` and the retry params are consts
+(``main.go:30-42``), and the object name prefix is a "change me in source"
+constant (``main.go:50-53``, ``README.md:9``). Here every one of those is a
+first-class config field, grouped by subsystem, with the reference defaults
+preserved so a reference user finds the same dials.
+
+All sizes are bytes unless the field name says otherwise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+KB = 1024
+MB = 1024 * 1024
+GB = 1024 * 1024 * 1024
+
+
+@dataclass
+class RetryConfig:
+    """Request-retry policy.
+
+    Mirrors the reference's gax policy: exponential backoff capped at 30 s,
+    multiplier 2.0, retry-always (``main.go:40-42,179-184``).
+    """
+
+    initial_backoff_s: float = 1.0
+    max_backoff_s: float = 30.0  # main.go:41 (RetryMaxAttempt... actually backoff cap)
+    multiplier: float = 2.0  # main.go:42
+    policy: str = "always"  # "always" | "idempotent" | "never"; main.go:182
+    # The reference retries without an attempt cap; 0 means unbounded here.
+    max_attempts: int = 0
+    # Total per-op deadline (0 = none). Not in the reference; a safety valve so
+    # hermetic tests and fault-injection runs terminate.
+    deadline_s: float = 0.0
+    jitter: bool = True  # gax randomizes within [1, delay]; we keep that shape
+
+
+@dataclass
+class TransportConfig:
+    """L1 client construction knobs (reference ``main.go:30-42,62-117``)."""
+
+    protocol: str = "http"  # "http" | "grpc" | "local" | "fake"; main.go:44-46
+    # HTTP path (CreateHttpClient, main.go:62-104):
+    max_conns_per_host: int = 100  # main.go:31
+    max_idle_conns_per_host: int = 100  # main.go:32
+    http2: bool = False  # reference disables HTTP/2 for perf (main.go:64-72)
+    user_agent: str = "tpubench"  # reference: "prince" (main.go:100)
+    # gRPC path (CreateGrpcClient, main.go:106-117):
+    grpc_conn_pool_size: int = 1  # main.go:30
+    directpath: bool = True  # GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS (main.go:107)
+    # Auth (auth.go): path to a service-account key file; empty = ADC.
+    key_file: str = ""  # auth.go:55-68
+    # Endpoint override so the same client drives the hermetic fake GCS server.
+    endpoint: str = ""  # empty = https://storage.googleapis.com
+    retry: RetryConfig = field(default_factory=RetryConfig)
+
+
+@dataclass
+class WorkloadConfig:
+    """L4 driver knobs: the union of every benchmark binary's flag surface.
+
+    Root bench (``main.go:36-57``), read_operation (``:18-29``),
+    write_operations (``:18-32``), list/open, ssd_test (``:19-37``).
+    """
+
+    # --- root read bench (main.go) ---
+    workers: int = 48  # --worker, main.go:36
+    read_calls_per_worker: int = 1000  # --read-call-per-worker (ref: 1e6), main.go:37
+    bucket: str = ""  # --bucket, main.go:44
+    project: str = ""  # --project, main.go:45
+    object_name_prefix: str = "tpubench/file_"  # main.go:50-53 (was hardcoded)
+    # Transfer granule: the reference streams via a 2 MB copy buffer tuned to
+    # the gRPC server's 2 MB message chunking (comment main.go:123-125).
+    granule_bytes: int = 2 * MB
+    # --- filesystem-path drivers (benchmark-script/*) ---
+    dir: str = ""  # --dir: gcsfuse mount / local dir
+    threads: int = 4  # --threads
+    read_count: int = 1  # --read-count: passes per file
+    block_size_kb: int = 1024  # --block-size (KB), read_operation/main.go:20
+    file_size_mb: int = 64  # --file-size-mb
+    write_count: int = 1  # write_operations --write-count
+    fsync_every_block: bool = True  # write_operations fsyncs per block (:63-71)
+    open_files: int = 64  # open_file --open-files
+    hold_seconds: float = 0.0  # open_file FD-hold (ref: 180 s, :52-55)
+    read_type: str = "seq"  # ssd_test --read-type: "seq" | "random" (:118-128)
+    seed: int = 0  # offset-shuffle seed (ssd_test uses global rand)
+    # Object/file sizes for data generation in hermetic/fake runs.
+    object_size: int = 100 * MB  # reference objects are ~100 MB-class (main.go:52)
+
+
+@dataclass
+class StagingConfig:
+    """GCS→HBM staging (no reference analog; the north-star delta)."""
+
+    mode: str = "device_put"  # "none" (host RAM, reference parity) |
+    # "device_put" | "pallas"
+    double_buffer: bool = True  # overlap fetch with host→HBM DMA
+    # Shape landed arrays as (granule//lane, lane) uint8 so XLA tiles them;
+    # lane=128 matches the TPU lane width.
+    lane: int = 128
+    validate_checksum: bool = False  # on-device checksum of landed bytes
+
+
+@dataclass
+class DistConfig:
+    """Multi-host / multi-chip fan-out (replaces "run on more VMs by hand")."""
+
+    # jax.distributed bring-up; 0/empty = single-process.
+    num_processes: int = 1
+    process_id: int = 0
+    coordinator_address: str = ""
+    mesh_axis: str = "pod"  # 1-D mesh over all chips
+    # Shard a single logical object's byte-range across the pod (the CP-analog,
+    # SURVEY §5.7) and reassemble with an ICI all-gather.
+    shard_object: bool = False
+
+
+@dataclass
+class ObservabilityConfig:
+    """L2 metrics/tracing (metrics_exporter.go, trace_exporter.go)."""
+
+    enable_tracing: bool = False  # --enable-tracing, main.go:56
+    trace_sample_rate: float = 1.0  # --trace-sample-rate, main.go:57
+    metrics_interval_s: float = 30.0  # Stackdriver reporting interval (:44)
+    metric_prefix: str = "custom.googleapis.com/tpubench/"  # (:41)
+    # "none" | "json" | "otel" | "cloud" (cloud requires GCP creds; gated)
+    export: str = "json"
+    results_dir: str = "results"
+
+
+@dataclass
+class BenchConfig:
+    """Top-level config: one object covers every knob of every workload."""
+
+    workload: WorkloadConfig = field(default_factory=WorkloadConfig)
+    transport: TransportConfig = field(default_factory=TransportConfig)
+    staging: StagingConfig = field(default_factory=StagingConfig)
+    dist: DistConfig = field(default_factory=DistConfig)
+    obs: ObservabilityConfig = field(default_factory=ObservabilityConfig)
+
+    # ------------------------------------------------------------------ io --
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "BenchConfig":
+        def build(tp, val):
+            if not dataclasses.is_dataclass(tp) or not isinstance(val, dict):
+                return val
+            kwargs = {}
+            for f in dataclasses.fields(tp):
+                if f.name in val:
+                    ftype = f.type
+                    sub = _SUBTYPES.get(f.name)
+                    kwargs[f.name] = build(sub, val[f.name]) if sub else val[f.name]
+            return tp(**kwargs)
+
+        return build(cls, d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "BenchConfig":
+        return cls.from_dict(json.loads(s))
+
+
+_SUBTYPES = {
+    "workload": WorkloadConfig,
+    "transport": TransportConfig,
+    "staging": StagingConfig,
+    "dist": DistConfig,
+    "obs": ObservabilityConfig,
+    "retry": RetryConfig,
+}
+
+
+# --------------------------------------------------------------- presets ----
+def preset(name: str) -> BenchConfig:
+    """Named workload presets replacing the reference's shell sweeps.
+
+    ``read_operations.sh:8-14`` sweeps file sizes 256KB/1MB/100MB/1GB with
+    per-size read counts 1000/100/10/1.
+    """
+    cfg = BenchConfig()
+    sweeps = {
+        "256kb": (256 * KB, 1000),
+        "1mb": (1 * MB, 100),
+        "100mb": (100 * MB, 10),
+        "1gb": (1 * GB, 1),
+    }
+    key = name.lower()
+    if key in sweeps:
+        size, count = sweeps[key]
+        cfg.workload.object_size = size
+        cfg.workload.file_size_mb = max(1, size // MB)
+        cfg.workload.read_count = count
+        cfg.workload.read_calls_per_worker = count
+        return cfg
+    if key == "smoke":  # tiny hermetic run for CI / laptops
+        cfg.workload.workers = 2
+        cfg.workload.threads = 2
+        cfg.workload.read_calls_per_worker = 2
+        cfg.workload.object_size = 4 * MB
+        cfg.workload.file_size_mb = 4
+        cfg.transport.protocol = "fake"
+        return cfg
+    raise KeyError(f"unknown preset {name!r}; have 256kb/1mb/100mb/1gb/smoke")
+
+
+PRESET_NAMES = ("256kb", "1mb", "100mb", "1gb", "smoke")

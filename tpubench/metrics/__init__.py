@@ -1,0 +1,11 @@
+"""Metrics core: race-free per-worker recorders, ssd_test-format percentiles,
+throughput accounting, and result reporting (SURVEY.md §5.5)."""
+
+from tpubench.metrics.percentiles import LatencySummary, format_summary, summarize  # noqa: F401
+from tpubench.metrics.recorder import (  # noqa: F401
+    ByteCounter,
+    LatencyRecorder,
+    MetricSet,
+    merge_recorders,
+)
+from tpubench.metrics.report import RunResult, write_result  # noqa: F401

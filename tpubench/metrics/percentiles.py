@@ -1,0 +1,78 @@
+"""Latency percentile math and report formatting.
+
+Reproduces the reference ssd_test driver's in-process percentile block —
+the only place the reference computes statistics itself
+(``benchmark-script/ssd_test/main.go:144-163``): sort ascending, then
+index-based percentiles ``sorted[p*n/100]`` (p50 = ``sorted[n/2]``,
+p99 = ``sorted[99n/100]``), reported as
+``Average/P20/P50/P90/p99/Min/Max`` in milliseconds. BASELINE.md adopts this
+exact shape for the new framework's latency reporting, so we keep the index
+convention bit-for-bit (NOT numpy's interpolated percentile).
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass
+from typing import Sequence
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class LatencySummary:
+    """All values in milliseconds; count is the sample count."""
+
+    count: int
+    avg_ms: float
+    p20_ms: float
+    p50_ms: float
+    p90_ms: float
+    p99_ms: float
+    min_ms: float
+    max_ms: float
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+def _index_percentile(sorted_ms: np.ndarray, p: int) -> float:
+    # ssd_test/main.go:157-163 convention: sorted[p*n/100], clamped to n-1 so
+    # p=100-ish indices on tiny samples stay in range.
+    n = len(sorted_ms)
+    idx = min((p * n) // 100, n - 1)
+    return float(sorted_ms[idx])
+
+
+def summarize(latencies_ms: Sequence[float] | np.ndarray) -> LatencySummary:
+    arr = np.asarray(latencies_ms, dtype=np.float64)
+    if arr.size == 0:
+        raise ValueError("summarize() needs at least one sample")
+    s = np.sort(arr)
+    return LatencySummary(
+        count=int(s.size),
+        avg_ms=float(s.mean()),
+        p20_ms=_index_percentile(s, 20),
+        p50_ms=_index_percentile(s, 50),
+        p90_ms=_index_percentile(s, 90),
+        p99_ms=_index_percentile(s, 99),
+        min_ms=float(s[0]),
+        max_ms=float(s[-1]),
+    )
+
+
+def summarize_ns(latencies_ns: Sequence[int] | np.ndarray) -> LatencySummary:
+    return summarize(np.asarray(latencies_ns, dtype=np.float64) / 1e6)
+
+
+def format_summary(label: str, s: LatencySummary) -> str:
+    """Human block in the ssd_test stdout shape (``ssd_test/main.go:157-163``)."""
+    return (
+        f"[{label}] n={s.count}\n"
+        f"Average: {s.avg_ms:.3f} ms\n"
+        f"P20: {s.p20_ms:.3f} ms\n"
+        f"P50: {s.p50_ms:.3f} ms\n"
+        f"P90: {s.p90_ms:.3f} ms\n"
+        f"p99: {s.p99_ms:.3f} ms\n"
+        f"Min: {s.min_ms:.3f} ms\n"
+        f"Max: {s.max_ms:.3f} ms"
+    )
