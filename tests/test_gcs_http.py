@@ -1,0 +1,144 @@
+"""Hermetic end-to-end tests of the HTTP JSON client against the fake GCS
+server (SURVEY §4: integration without cloud)."""
+
+import numpy as np
+import pytest
+
+from tpubench.config import RetryConfig, TransportConfig
+from tpubench.storage import FakeBackend, FaultPlan, StorageError
+from tpubench.storage.base import deterministic_bytes, read_object_through
+from tpubench.storage.fake_server import FakeGcsServer
+from tpubench.storage.gcs_http import GcsHttpBackend
+
+
+@pytest.fixture(scope="module")
+def server():
+    be = FakeBackend.prepopulated("bench/file_", count=4, size=1_000_000)
+    with FakeGcsServer(be) as srv:
+        yield srv
+
+
+def _client(server, **retry_kw) -> GcsHttpBackend:
+    t = TransportConfig(
+        endpoint=server.endpoint,
+        retry=RetryConfig(
+            jitter=False,
+            initial_backoff_s=0.001,
+            max_backoff_s=0.01,
+            max_attempts=5,
+            **retry_kw,
+        ),
+    )
+    return GcsHttpBackend(bucket="testbucket", transport=t)
+
+
+def test_full_read_matches_content(server):
+    c = _client(server)
+    expected = deterministic_bytes("bench/file_0", 1_000_000).tobytes()
+    granule = memoryview(bytearray(128 * 1024))
+    got = bytearray()
+    total, fb = read_object_through(
+        c.open_read("bench/file_0"), granule, sink=lambda mv: got.extend(mv)
+    )
+    assert total == 1_000_000
+    assert bytes(got) == expected
+    assert fb is not None
+    c.close()
+
+
+def test_range_read(server):
+    c = _client(server)
+    expected = deterministic_bytes("bench/file_1", 1_000_000)[1000:3000].tobytes()
+    r = c.open_read("bench/file_1", start=1000, length=2000)
+    buf = bytearray(4096)
+    got = bytearray()
+    while True:
+        n = r.readinto(memoryview(buf))
+        if n == 0:
+            break
+        got.extend(buf[:n])
+    r.close()
+    assert bytes(got) == expected
+    c.close()
+
+
+def test_stat_list_write_delete(server):
+    c = _client(server)
+    assert c.stat("bench/file_2").size == 1_000_000
+    names = [m.name for m in c.list("bench/file_")]
+    assert "bench/file_3" in names and len(names) >= 4
+    meta = c.write("uploads/a", b"payload-bytes")
+    assert meta.size == 13
+    assert c.stat("uploads/a").size == 13
+    c.delete("uploads/a")
+    with pytest.raises(StorageError) as ei:
+        c.stat("uploads/a")
+    assert ei.value.code == 404
+    c.close()
+
+
+def test_not_found_is_permanent(server):
+    c = _client(server)
+    with pytest.raises(StorageError) as ei:
+        c.open_read("bench/missing")
+    assert ei.value.code == 404 and not ei.value.transient
+    c.close()
+
+
+def test_retry_through_injected_503s():
+    """Client-side gax retry rides out server-side 503 bursts (SURVEY §5.3)."""
+    be = FakeBackend.prepopulated(
+        "bench/file_", count=1, size=10_000, fault=FaultPlan(error_rate=0.5, seed=7)
+    )
+    with FakeGcsServer(be) as srv:
+        c = _client(srv)
+        c.transport.retry.max_attempts = 50
+        for _ in range(5):
+            granule = memoryview(bytearray(4096))
+            total, _ = read_object_through(c.open_read("bench/file_0"), granule)
+            assert total == 10_000
+        assert be.injected_errors > 0  # faults actually fired
+        c.close()
+
+
+def test_connection_reuse(server):
+    """Keep-alive pool: repeated reads should not open a conn per request."""
+    c = _client(server)
+    for _ in range(8):
+        granule = memoryview(bytearray(64 * 1024))
+        read_object_through(c.open_read("bench/file_0"), granule)
+    pool = c._pool
+    assert len(pool._idle) <= c.transport.max_idle_conns_per_host
+    assert len(pool._idle) >= 1  # something was actually reused/parked
+    c.close()
+
+
+def test_user_agent_and_http2_rejected(server):
+    t = TransportConfig(endpoint=server.endpoint, http2=True)
+    with pytest.raises(NotImplementedError):
+        GcsHttpBackend(bucket="b", transport=t)
+
+
+def test_concurrent_readers(server):
+    """Many workers share one backend (main.go:200-203 shares one client)."""
+    import threading
+
+    c = _client(server)
+    errors = []
+
+    def worker(i):
+        try:
+            name = f"bench/file_{i % 4}"
+            granule = memoryview(bytearray(256 * 1024))
+            total, _ = read_object_through(c.open_read(name), granule)
+            assert total == 1_000_000
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors
+    c.close()

@@ -1,0 +1,106 @@
+import random
+
+import pytest
+
+from tpubench.config import RetryConfig
+from tpubench.storage import StorageError
+from tpubench.storage.retry import Backoff, retry_call
+
+
+def test_backoff_gax_shape_no_jitter():
+    # main.go:40-42: initial grows x2 capped at 30s.
+    cfg = RetryConfig(initial_backoff_s=1.0, max_backoff_s=30.0, multiplier=2.0, jitter=False)
+    b = Backoff(cfg)
+    assert [b.pause() for _ in range(7)] == [1, 2, 4, 8, 16, 30, 30]
+
+
+def test_backoff_jitter_bounded():
+    cfg = RetryConfig(initial_backoff_s=4.0, max_backoff_s=30.0, multiplier=2.0, jitter=True)
+    b = Backoff(cfg, rng=random.Random(0))
+    p1 = b.pause()
+    assert 0 <= p1 <= 4.0
+    p2 = b.pause()
+    assert 0 <= p2 <= 8.0
+
+
+def test_retry_always_retries_transient_and_nontransient():
+    # RetryAlways (main.go:182): retry regardless of idempotency classification.
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise StorageError("boom", transient=(len(calls) == 1))
+        return "ok"
+
+    sleeps = []
+    cfg = RetryConfig(policy="always", jitter=False, initial_backoff_s=0.5)
+    assert retry_call(flaky, cfg, sleep=sleeps.append) == "ok"
+    assert len(calls) == 3
+    assert sleeps == [0.5, 1.0]
+
+
+def test_retry_idempotent_only_transient():
+    cfg = RetryConfig(policy="idempotent", jitter=False)
+
+    def fail_permanent():
+        raise StorageError("gone", transient=False, code=404)
+
+    with pytest.raises(StorageError):
+        retry_call(fail_permanent, cfg, sleep=lambda s: None)
+
+    calls = []
+
+    def fail_then_ok():
+        calls.append(1)
+        if len(calls) == 1:
+            raise StorageError("503", transient=True, code=503)
+        return 42
+
+    assert retry_call(fail_then_ok, cfg, sleep=lambda s: None) == 42
+
+
+def test_retry_never():
+    cfg = RetryConfig(policy="never")
+    with pytest.raises(StorageError):
+        retry_call(lambda: (_ for _ in ()).throw(StorageError("x", transient=True)), cfg)
+
+
+def test_retry_max_attempts():
+    cfg = RetryConfig(policy="always", max_attempts=3, jitter=False, initial_backoff_s=0)
+    calls = []
+
+    def always_fail():
+        calls.append(1)
+        raise StorageError("x", transient=True)
+
+    with pytest.raises(StorageError):
+        retry_call(always_fail, cfg, sleep=lambda s: None)
+    assert len(calls) == 3
+
+
+def test_retry_deadline():
+    cfg = RetryConfig(policy="always", jitter=False, initial_backoff_s=10.0, deadline_s=5.0)
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    def sleep(s):
+        t[0] += s
+
+    calls = []
+
+    def always_fail():
+        calls.append(1)
+        raise StorageError("x", transient=True)
+
+    with pytest.raises(StorageError):
+        retry_call(always_fail, cfg, sleep=sleep, clock=clock)
+    assert len(calls) == 1  # first pause (10s) would blow the 5s deadline
+
+
+def test_non_storage_error_not_retried_under_always():
+    cfg = RetryConfig(policy="always")
+    with pytest.raises(ValueError):
+        retry_call(lambda: (_ for _ in ()).throw(ValueError("logic bug")), cfg)

@@ -1,0 +1,88 @@
+import numpy as np
+import pytest
+
+from tpubench.storage import FakeBackend, FaultPlan, StorageError
+from tpubench.storage.base import deterministic_bytes, iter_ranges, read_object_through
+
+
+def test_deterministic_bytes_reproducible():
+    a = deterministic_bytes("obj/1", 4096)
+    b = deterministic_bytes("obj/1", 4096)
+    c = deterministic_bytes("obj/2", 4096)
+    assert np.array_equal(a, b)
+    assert not np.array_equal(a, c)
+    # Prefix property: regenerating a longer object agrees on the prefix —
+    # what lets hosts verify byte-range shards independently.
+    long = deterministic_bytes("obj/1", 8192)
+    assert np.array_equal(long[:4096], a)
+
+
+def test_fake_read_full_and_range():
+    be = FakeBackend.prepopulated("f/", count=2, size=10_000)
+    data = deterministic_bytes("f/0", 10_000)
+
+    r = be.open_read("f/0")
+    buf = bytearray(4096)
+    got = bytearray()
+    while True:
+        n = r.readinto(memoryview(buf))
+        if n == 0:
+            break
+        got += buf[:n]
+    assert bytes(got) == data.tobytes()
+    assert r.first_byte_ns is not None
+
+    r = be.open_read("f/0", start=100, length=50)
+    n = r.readinto(memoryview(bytearray(4096))[:4096])
+    assert n == 50
+
+
+def test_fake_range_content():
+    be = FakeBackend.prepopulated("f/", count=1, size=1000)
+    data = deterministic_bytes("f/0", 1000)
+    r = be.open_read("f/0", start=200, length=300)
+    buf = bytearray(300)
+    assert r.readinto(memoryview(buf)) == 300
+    assert bytes(buf) == data[200:500].tobytes()
+
+
+def test_fake_not_found_and_stat_list_delete():
+    be = FakeBackend.prepopulated("f/", count=3, size=10)
+    with pytest.raises(StorageError) as ei:
+        be.open_read("missing")
+    assert ei.value.code == 404 and not ei.value.transient
+    assert be.stat("f/1").size == 10
+    assert [m.name for m in be.list("f/")] == ["f/0", "f/1", "f/2"]
+    be.write("g/0", b"hello")
+    assert be.stat("g/0").size == 5
+    be.delete("g/0")
+    with pytest.raises(StorageError):
+        be.stat("g/0")
+
+
+def test_fault_injection_open_errors():
+    be = FakeBackend.prepopulated(
+        "f/", count=1, size=10, fault=FaultPlan(error_rate=1.0, seed=1)
+    )
+    with pytest.raises(StorageError) as ei:
+        be.open_read("f/0")
+    assert ei.value.transient and ei.value.code == 503
+    assert be.injected_errors == 1
+
+
+def test_read_object_through_granules():
+    be = FakeBackend.prepopulated("f/", count=1, size=10_000)
+    granule = memoryview(bytearray(4096))
+    chunks = []
+    total, fb = read_object_through(
+        be.open_read("f/0"), granule, sink=lambda mv: chunks.append(bytes(mv))
+    )
+    assert total == 10_000
+    assert [len(c) for c in chunks] == [4096, 4096, 1808]
+    assert b"".join(chunks) == deterministic_bytes("f/0", 10_000).tobytes()
+    assert fb is not None
+
+
+def test_iter_ranges():
+    assert list(iter_ranges(10, 4)) == [(0, 4), (4, 4), (8, 2)]
+    assert list(iter_ranges(0, 4)) == []

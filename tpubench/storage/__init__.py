@@ -1,0 +1,52 @@
+"""Storage backends (L1/L0 of SURVEY §1).
+
+``base`` defines the backend protocol; implementations:
+
+* ``fake``      — in-process deterministic object store with fault injection
+                  (SURVEY §5.3 prescription); the hermetic test target.
+* ``fake_server`` — a real HTTP server speaking the GCS JSON surface, so the
+                  http client path is exercised end-to-end without cloud.
+* ``gcs_http``  — HTTP/1.1 JSON-API client (reference ``main.go:62-104``).
+* ``gcs_grpc``  — gRPC client (reference ``main.go:106-117``), gated.
+* ``local_fs``  — O_DIRECT filesystem path (reference ``benchmark-script/``).
+"""
+
+from tpubench.storage.base import (  # noqa: F401
+    ObjectMeta,
+    ObjectReader,
+    StorageBackend,
+    StorageError,
+    deterministic_bytes,
+)
+from tpubench.storage.fake import FakeBackend, FaultPlan  # noqa: F401
+from tpubench.storage.retry import Backoff, retry_call  # noqa: F401
+
+
+def open_backend(cfg) -> StorageBackend:
+    """Factory from a BenchConfig (reference: main.go:169-177 protocol switch,
+    minus its ignored-error bug)."""
+    proto = cfg.transport.protocol
+    if proto == "fake":
+        from tpubench.storage.fake import FakeBackend
+
+        return FakeBackend.prepopulated(
+            prefix=cfg.workload.object_name_prefix,
+            count=max(cfg.workload.workers, cfg.workload.threads),
+            size=cfg.workload.object_size,
+        )
+    if proto == "http":
+        from tpubench.storage.gcs_http import GcsHttpBackend
+
+        return GcsHttpBackend(
+            bucket=cfg.workload.bucket,
+            transport=cfg.transport,
+        )
+    if proto == "grpc":
+        from tpubench.storage.gcs_grpc import GcsGrpcBackend
+
+        return GcsGrpcBackend(bucket=cfg.workload.bucket, transport=cfg.transport)
+    if proto == "local":
+        from tpubench.storage.local_fs import LocalFsBackend
+
+        return LocalFsBackend(root=cfg.workload.dir)
+    raise ValueError(f"unknown protocol {proto!r} (http|grpc|local|fake)")

@@ -1,0 +1,73 @@
+"""Token sources (reference ``auth.go``).
+
+Reference order: service-account key file if given
+(``newTokenSourceFromPath``, auth.go:28-51), else Application Default
+Credentials (``google.DefaultTokenSource``, auth.go:55-68), with the
+full-control GCS scope (auth.go:60). Here: the same two sources via
+``google.auth`` (gated — hermetic runs against the fake server need no
+auth), exposed through one ``TokenSource`` protocol.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Protocol
+
+GCS_SCOPE = "https://www.googleapis.com/auth/devstorage.full_control"  # auth.go:60
+
+
+class TokenSource(Protocol):
+    def token(self) -> Optional[str]:
+        """Returns a bearer token, or None for unauthenticated transports."""
+        ...
+
+
+class AnonymousTokenSource:
+    """For the fake server / local paths — no Authorization header."""
+
+    def token(self) -> Optional[str]:
+        return None
+
+
+class GoogleTokenSource:
+    """ADC or service-account-file source with refresh-ahead caching."""
+
+    def __init__(self, key_file: str = ""):
+        import google.auth  # gated import: only needed for real GCS
+
+        if key_file:
+            from google.oauth2 import service_account
+
+            self._creds = service_account.Credentials.from_service_account_file(
+                key_file, scopes=[GCS_SCOPE]
+            )
+        else:
+            self._creds, _ = google.auth.default(scopes=[GCS_SCOPE])
+        self._lock = threading.Lock()
+
+    def token(self) -> Optional[str]:
+        with self._lock:
+            if not self._creds.valid:
+                import google.auth.transport.requests
+
+                self._creds.refresh(google.auth.transport.requests.Request())
+            return self._creds.token
+
+
+def make_token_source(key_file: str, endpoint: str) -> TokenSource:
+    """Endpoint override to a non-Google server ⇒ anonymous (hermetic runs)."""
+    if endpoint and "googleapis.com" not in endpoint:
+        return AnonymousTokenSource()
+    return GoogleTokenSource(key_file)
+
+
+class StaticTokenSource:
+    """Test helper."""
+
+    def __init__(self, tok: str, ttl_s: float = 3600.0):
+        self._tok = tok
+        self._exp = time.monotonic() + ttl_s
+
+    def token(self) -> Optional[str]:
+        return self._tok if time.monotonic() < self._exp else None

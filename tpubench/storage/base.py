@@ -1,0 +1,122 @@
+"""Backend protocol and shared primitives.
+
+The reference's L1 surface is ``*storage.Client`` with
+``bucket.Object(name).NewReader(ctx)`` streamed through a reused 2 MB buffer
+(``main.go:134-140``). The protocol here keeps that shape — a streaming
+reader filled into a caller-owned buffer — because (a) it reproduces the
+reference's copy-buffer semantics and (b) a caller-owned buffer is what the
+host→HBM staging path needs (fill a pinned granule, DMA it, reuse it).
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+from typing import Iterator, Optional, Protocol, runtime_checkable
+
+import numpy as np
+
+
+class StorageError(Exception):
+    """Backend error; ``transient`` drives the retry policy (SURVEY §5.3)."""
+
+    def __init__(self, msg: str, *, transient: bool = False, code: int = 0):
+        super().__init__(msg)
+        self.transient = transient
+        self.code = code
+
+
+@dataclass(frozen=True)
+class ObjectMeta:
+    name: str
+    size: int
+    generation: int = 0
+
+
+@runtime_checkable
+class ObjectReader(Protocol):
+    """Streaming reader for one object (or byte range).
+
+    ``readinto`` fills as much of ``buf`` as available and returns the byte
+    count (0 = EOF). Implementations set ``first_byte_ns`` to a
+    ``time.perf_counter_ns`` stamp when the first payload byte arrives — the
+    observability the reference lacks (its ``NewReader``+``CopyBuffer`` hides
+    time-to-first-byte inside full-read latency, ``main.go:135-140``).
+    """
+
+    first_byte_ns: Optional[int]
+
+    def readinto(self, buf: memoryview) -> int: ...
+
+    def close(self) -> None: ...
+
+
+@runtime_checkable
+class StorageBackend(Protocol):
+    """L1 backend. One instance may be shared by many workers (the reference
+    shares one ``*storage.Client`` across all goroutines, ``main.go:200-203``),
+    so implementations must be thread-safe."""
+
+    def open_read(
+        self, name: str, start: int = 0, length: Optional[int] = None
+    ) -> ObjectReader: ...
+
+    def write(self, name: str, data: bytes) -> ObjectMeta: ...
+
+    def list(self, prefix: str = "") -> list[ObjectMeta]: ...
+
+    def stat(self, name: str) -> ObjectMeta: ...
+
+    def delete(self, name: str) -> None: ...
+
+    def close(self) -> None: ...
+
+
+# ------------------------------------------------------------ helpers -------
+
+
+def deterministic_bytes(name: str, size: int) -> np.ndarray:
+    """Content of a synthetic object, reproducible from its name alone.
+
+    Any host (or test) can regenerate any byte range without coordination —
+    this is what lets the multi-host reassembly tests assert the gathered pod
+    array equals the concatenated object bytes (SURVEY §4) without shipping
+    data around.
+    """
+    seed = zlib.crc32(name.encode()) & 0xFFFFFFFF
+    rng = np.random.Generator(np.random.Philox(seed))
+    return rng.integers(0, 256, size=size, dtype=np.uint8)
+
+
+def read_object_through(
+    reader: ObjectReader, granule: memoryview, sink=None
+) -> tuple[int, Optional[int]]:
+    """The hot-loop copy: stream ``reader`` through the reused ``granule``
+    buffer (reference: ``io.CopyBuffer(io.Discard, rc, 2MB)``, main.go:140).
+
+    ``sink(filled_memoryview)`` is called per filled granule — ``None``
+    discards (reference behavior); the staging path passes the HBM enqueue.
+    Closes the reader (reference closes ``rc`` per read, main.go:148).
+    Returns (total_bytes, first_byte_ns).
+    """
+    total = 0
+    try:
+        while True:
+            n = reader.readinto(granule)
+            if n <= 0:
+                break
+            total += n
+            if sink is not None:
+                sink(granule[:n])
+    finally:
+        reader.close()
+    return total, reader.first_byte_ns
+
+
+def iter_ranges(size: int, granule: int) -> Iterator[tuple[int, int]]:
+    """(start, length) granule decomposition of a byte range."""
+    off = 0
+    while off < size:
+        n = min(granule, size - off)
+        yield off, n
+        off += n

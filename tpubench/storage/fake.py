@@ -1,0 +1,157 @@
+"""In-process fake object store with deterministic content and fault injection.
+
+SURVEY §4 prescribes this as the hermetic integration target (the reference
+validates only against real GCS); §5.3 prescribes fault injection (error %,
+latency) which the reference has nowhere.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from tpubench.storage.base import (
+    ObjectMeta,
+    StorageError,
+    deterministic_bytes,
+)
+
+
+@dataclass
+class FaultPlan:
+    """Deterministic fault injection for tests and resilience benchmarks."""
+
+    error_rate: float = 0.0  # probability a read-open raises transient 503
+    read_error_rate: float = 0.0  # probability a granule read raises mid-stream
+    latency_s: float = 0.0  # fixed added latency per open (first byte)
+    per_read_latency_s: float = 0.0  # added latency per granule read
+    seed: int = 0
+
+    def rng(self) -> random.Random:
+        return random.Random(self.seed)
+
+
+class _FakeReader:
+    """Streams a (possibly range-limited) view of an in-memory object."""
+
+    def __init__(self, data: memoryview, fault: FaultPlan, rng: random.Random):
+        self._data = data
+        self._pos = 0
+        self._fault = fault
+        self._rng = rng
+        self.first_byte_ns: Optional[int] = None
+        self._closed = False
+
+    def readinto(self, buf: memoryview) -> int:
+        if self._closed:
+            raise StorageError("reader closed", transient=False)
+        if self._pos >= len(self._data):
+            return 0
+        if self._fault.per_read_latency_s:
+            time.sleep(self._fault.per_read_latency_s)
+        if self._fault.read_error_rate and self._rng.random() < self._fault.read_error_rate:
+            raise StorageError("injected mid-stream failure", transient=True, code=503)
+        n = min(len(buf), len(self._data) - self._pos)
+        buf[:n] = self._data[self._pos : self._pos + n]
+        self._pos += n
+        if self.first_byte_ns is None:
+            self.first_byte_ns = time.perf_counter_ns()
+        return n
+
+    def close(self) -> None:
+        self._closed = True
+
+
+class FakeBackend:
+    """Thread-safe in-memory store. Objects created explicitly via ``write``
+    or lazily from :func:`deterministic_bytes` via ``prepopulated``."""
+
+    def __init__(self, fault: Optional[FaultPlan] = None):
+        self._objects: dict[str, np.ndarray] = {}
+        self._generation: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.fault = fault or FaultPlan()
+        self._rng = self.fault.rng()
+        self._rng_lock = threading.Lock()
+        # Observability for tests: how many opens/reads/faults happened.
+        self.open_count = 0
+        self.injected_errors = 0
+
+    # ------------------------------------------------------------- setup --
+    @classmethod
+    def prepopulated(
+        cls,
+        prefix: str,
+        count: int,
+        size: int,
+        fault: Optional[FaultPlan] = None,
+    ) -> "FakeBackend":
+        """Objects named ``<prefix><i>`` (reference naming: object of worker i
+        is ``ObjectNamePrefix + strconv.Itoa(workerId)``, main.go:121)."""
+        be = cls(fault=fault)
+        for i in range(count):
+            name = f"{prefix}{i}"
+            be._objects[name] = deterministic_bytes(name, size)
+            be._generation[name] = 1
+        return be
+
+    # ----------------------------------------------------------- backend --
+    def open_read(self, name: str, start: int = 0, length: Optional[int] = None):
+        with self._rng_lock:
+            r = self._rng.random()
+            reader_rng = random.Random(self._rng.getrandbits(64))
+        if self.fault.latency_s:
+            time.sleep(self.fault.latency_s)
+        if self.fault.error_rate and r < self.fault.error_rate:
+            self.injected_errors += 1
+            raise StorageError("injected open failure", transient=True, code=503)
+        with self._lock:
+            obj = self._objects.get(name)
+            self.open_count += 1
+        if obj is None:
+            raise StorageError(f"object not found: {name}", transient=False, code=404)
+        end = len(obj) if length is None else min(start + length, len(obj))
+        if start > len(obj):
+            raise StorageError(
+                f"range start {start} > size {len(obj)}", transient=False, code=416
+            )
+        return _FakeReader(memoryview(obj.data)[start:end], self.fault, reader_rng)
+
+    def write(self, name: str, data: bytes) -> ObjectMeta:
+        arr = np.frombuffer(bytes(data), dtype=np.uint8).copy()
+        with self._lock:
+            self._objects[name] = arr
+            self._generation[name] = self._generation.get(name, 0) + 1
+            return ObjectMeta(name, len(arr), self._generation[name])
+
+    def list(self, prefix: str = "") -> list[ObjectMeta]:
+        with self._lock:
+            return sorted(
+                (
+                    ObjectMeta(n, len(o), self._generation.get(n, 1))
+                    for n, o in self._objects.items()
+                    if n.startswith(prefix)
+                ),
+                key=lambda m: m.name,
+            )
+
+    def stat(self, name: str) -> ObjectMeta:
+        with self._lock:
+            obj = self._objects.get(name)
+            if obj is None:
+                raise StorageError(f"object not found: {name}", transient=False, code=404)
+            return ObjectMeta(name, len(obj), self._generation.get(name, 1))
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            if name not in self._objects:
+                raise StorageError(f"object not found: {name}", transient=False, code=404)
+            del self._objects[name]
+
+    def close(self) -> None:
+        pass

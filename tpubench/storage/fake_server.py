@@ -1,0 +1,215 @@
+"""A real HTTP server speaking the GCS JSON-API object surface.
+
+Backs the hermetic integration tests for the http client path
+(SURVEY §4: "in-process HTTP server implementing the JSON object-get
+surface"). Endpoints mirror what ``cloud.google.com/go/storage``'s HTTP
+transport uses under the reference's read loop:
+
+* ``GET /storage/v1/b/<bucket>/o/<object>?alt=media`` — media download,
+  honoring ``Range: bytes=a-b`` (the ranged-read path our shard fetches use);
+* ``GET /storage/v1/b/<bucket>/o/<object>`` — metadata;
+* ``GET /storage/v1/b/<bucket>/o?prefix=`` — list;
+* ``POST /upload/storage/v1/b/<bucket>/o?uploadType=media&name=`` — upload;
+* ``DELETE /storage/v1/b/<bucket>/o/<object>``.
+
+Fault injection (503s, latency) comes from the backing
+:class:`~tpubench.storage.fake.FakeBackend`'s :class:`FaultPlan`, giving the
+client-side retry policy something real to chew on.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from tpubench.storage.base import StorageError
+from tpubench.storage.fake import FakeBackend
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"  # keep-alive: reference tunes idle conns (main.go:31-32)
+    server_version = "fake-gcs/0.1"
+
+    # Quiet by default; tests can flip this.
+    verbose = False
+
+    def log_message(self, fmt, *args):  # noqa: D102
+        if self.verbose:
+            super().log_message(fmt, *args)
+
+    # ------------------------------------------------------------ helpers --
+    @property
+    def backend(self) -> FakeBackend:
+        return self.server.backend  # type: ignore[attr-defined]
+
+    def _send_json(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json; charset=UTF-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error_json(self, code: int, message: str) -> None:
+        self._send_json(code, {"error": {"code": code, "message": message}})
+
+    def _maybe_inject_fault(self) -> bool:
+        fault = self.backend.fault
+        if fault.latency_s:
+            time.sleep(fault.latency_s)
+        if fault.error_rate:
+            with self.backend._rng_lock:
+                r = self.backend._rng.random()
+            if r < fault.error_rate:
+                self.backend.injected_errors += 1
+                self._send_error_json(503, "injected unavailability")
+                return True
+        return False
+
+    def _parse(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        query = urllib.parse.parse_qs(parsed.query)
+        parts = parsed.path.split("/")
+        return parsed.path, parts, query
+
+    def _object_name(self, parts) -> Optional[str]:
+        # /storage/v1/b/<bucket>/o/<object> — object may be %2F-encoded.
+        if len(parts) >= 7 and parts[1] == "storage" and parts[3] == "b" and parts[5] == "o":
+            return urllib.parse.unquote("/".join(parts[6:]))
+        return None
+
+    def _range(self) -> Optional[tuple[int, Optional[int]]]:
+        hdr = self.headers.get("Range")
+        if not hdr or not hdr.startswith("bytes="):
+            return None
+        spec = hdr[len("bytes=") :]
+        start_s, _, end_s = spec.partition("-")
+        start = int(start_s)
+        end = int(end_s) if end_s else None
+        return start, end
+
+    # ------------------------------------------------------------- verbs --
+    def do_GET(self):  # noqa: N802
+        path, parts, query = self._parse()
+        if self._maybe_inject_fault():
+            return
+        try:
+            name = self._object_name(parts)
+            if name:  # object media or metadata
+                if query.get("alt", [""])[0] == "media":
+                    return self._get_media(name)
+                meta = self.backend.stat(name)
+                return self._send_json(
+                    200,
+                    {
+                        "kind": "storage#object",
+                        "name": meta.name,
+                        "size": str(meta.size),
+                        "generation": str(meta.generation),
+                    },
+                )
+            if len(parts) >= 6 and parts[3] == "b" and parts[5] == "o":  # list
+                prefix = query.get("prefix", [""])[0]
+                items = [
+                    {"kind": "storage#object", "name": m.name, "size": str(m.size)}
+                    for m in self.backend.list(prefix)
+                ]
+                return self._send_json(200, {"kind": "storage#objects", "items": items})
+            self._send_error_json(404, f"no route: {path}")
+        except StorageError as e:
+            self._send_error_json(e.code or 500, str(e))
+
+    def _get_media(self, name: str) -> None:
+        rng = self._range()
+        meta = self.backend.stat(name)
+        start, end = 0, meta.size - 1
+        code = 200
+        if rng is not None:
+            start = rng[0]
+            end = meta.size - 1 if rng[1] is None else min(rng[1], meta.size - 1)
+            code = 206
+        length = max(0, end - start + 1)
+        reader = self.backend.open_read(name, start=start, length=length)
+        self.send_response(code)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(length))
+        if code == 206:
+            self.send_header("Content-Range", f"bytes {start}-{end}/{meta.size}")
+        self.end_headers()
+        # Stream in 256 KB chunks — the server is not the component under
+        # test; the client's granule size governs the benchmark.
+        buf = bytearray(256 * 1024)
+        mv = memoryview(buf)
+        while True:
+            n = reader.readinto(mv)
+            if n <= 0:
+                break
+            self.wfile.write(mv[:n])
+        reader.close()
+
+    def do_POST(self):  # noqa: N802
+        path, parts, query = self._parse()
+        if self._maybe_inject_fault():
+            return
+        if len(parts) >= 6 and parts[1] == "upload" and query.get("uploadType", [""])[0] == "media":
+            name = query.get("name", [""])[0]
+            if not name:
+                return self._send_error_json(400, "missing name")
+            n = int(self.headers.get("Content-Length", "0"))
+            data = self.rfile.read(n)
+            meta = self.backend.write(name, data)
+            return self._send_json(
+                200,
+                {"kind": "storage#object", "name": meta.name, "size": str(meta.size)},
+            )
+        self._send_error_json(404, f"no route: {path}")
+
+    def do_DELETE(self):  # noqa: N802
+        _, parts, _ = self._parse()
+        name = self._object_name(parts)
+        if not name:
+            return self._send_error_json(404, "no route")
+        try:
+            self.backend.delete(name)
+        except StorageError as e:
+            return self._send_error_json(e.code or 500, str(e))
+        self.send_response(204)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class FakeGcsServer:
+    """Threaded fake-GCS server; use as a context manager in tests."""
+
+    def __init__(self, backend: Optional[FakeBackend] = None, port: int = 0):
+        self.backend = backend or FakeBackend()
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd.backend = self.backend  # type: ignore[attr-defined]
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FakeGcsServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "FakeGcsServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
