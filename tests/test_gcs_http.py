@@ -90,9 +90,14 @@ def test_retry_through_injected_503s():
     be = FakeBackend.prepopulated(
         "bench/file_", count=1, size=10_000, fault=FaultPlan(error_rate=0.5, seed=7)
     )
+    from tpubench.storage.retrying import RetryingBackend
+
     with FakeGcsServer(be) as srv:
-        c = _client(srv)
-        c.transport.retry.max_attempts = 50
+        raw = _client(srv)
+        retry_cfg = RetryConfig(
+            jitter=False, initial_backoff_s=0.001, max_backoff_s=0.01, max_attempts=50
+        )
+        c = RetryingBackend(raw, retry_cfg)
         for _ in range(5):
             granule = memoryview(bytearray(4096))
             total, _ = read_object_through(c.open_read("bench/file_0"), granule)

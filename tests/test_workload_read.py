@@ -1,0 +1,129 @@
+"""End-to-end read workload against fake backend and fake HTTP server
+(reference §3.1 parity point, SURVEY §7 step 3)."""
+
+import pytest
+
+from tpubench.config import BenchConfig, RetryConfig, TransportConfig, preset
+from tpubench.obs.tracing import RecordingTracer
+from tpubench.storage import FakeBackend, FaultPlan, RetryingBackend
+from tpubench.storage.base import deterministic_bytes
+from tpubench.storage.fake_server import FakeGcsServer
+from tpubench.workloads import WorkerError
+from tpubench.workloads.read import run_read
+
+
+def smoke_cfg(workers=3, calls=2, size=300_000) -> BenchConfig:
+    cfg = BenchConfig()
+    cfg.workload.workers = workers
+    cfg.workload.read_calls_per_worker = calls
+    cfg.workload.object_size = size
+    cfg.workload.granule_bytes = 64 * 1024
+    cfg.transport.protocol = "fake"
+    return cfg
+
+
+def test_read_workload_fake_backend():
+    cfg = smoke_cfg()
+    res = run_read(cfg)
+    assert res.bytes_total == 3 * 2 * 300_000
+    assert res.errors == 0
+    assert res.summaries["read"].count == 6
+    assert res.summaries["first_byte"].count == 6
+    assert res.gbps > 0
+    # first-byte is within full-read latency
+    assert res.summaries["first_byte"].p50_ms <= res.summaries["read"].max_ms
+
+
+def test_read_workload_span_per_read():
+    cfg = smoke_cfg(workers=2, calls=3)
+    tracer = RecordingTracer()
+    res = run_read(cfg, tracer=tracer)
+    assert res.errors == 0
+    spans = [s for s in tracer.spans if s.name == "ReadObject"]
+    assert len(spans) == 6  # one span per read (main.go:129-132)
+    assert all(s.attrs["object"].startswith("tpubench/file_") for s in spans)
+    assert all(any(e[0] == "first_byte" for e in s.events) for s in spans)
+
+
+def test_read_workload_abort_on_error():
+    # errgroup semantics: missing object for one worker aborts the run.
+    cfg = smoke_cfg(workers=3, calls=1)
+    cfg.transport.retry = RetryConfig(policy="never")
+    backend = FakeBackend.prepopulated(
+        cfg.workload.object_name_prefix, count=2, size=1000  # worker 2 has no object
+    )
+    with pytest.raises(WorkerError):
+        run_read(cfg, backend=backend)
+
+
+def test_read_workload_failure_domains():
+    # SURVEY §5.3: abort_on_error=False → holes, not pod-wide abort.
+    cfg = smoke_cfg(workers=3, calls=2, size=1000)
+    cfg.workload.abort_on_error = False
+    cfg.transport.retry = RetryConfig(policy="never")
+    backend = FakeBackend.prepopulated(
+        cfg.workload.object_name_prefix, count=2, size=1000
+    )
+    res = run_read(cfg, backend=backend)
+    assert res.errors == 1
+    assert res.bytes_total == 2 * 2 * 1000  # the two healthy workers completed
+
+
+def test_read_workload_through_http_server():
+    cfg = smoke_cfg(workers=2, calls=2, size=250_000)
+    be = FakeBackend.prepopulated(
+        cfg.workload.object_name_prefix, count=2, size=250_000
+    )
+    with FakeGcsServer(be) as srv:
+        cfg.transport = TransportConfig(
+            protocol="http",
+            endpoint=srv.endpoint,
+            retry=RetryConfig(jitter=False, initial_backoff_s=0.001, max_backoff_s=0.01),
+        )
+        cfg.workload.bucket = "b"
+        from tpubench.storage import open_backend
+
+        res = run_read(cfg, backend=open_backend(cfg))
+    assert res.bytes_total == 2 * 2 * 250_000
+    assert res.errors == 0
+
+
+def test_read_workload_rides_out_faults():
+    cfg = smoke_cfg(workers=2, calls=3, size=100_000)
+    fault = FaultPlan(error_rate=0.3, read_error_rate=0.05, seed=13)
+    backend = RetryingBackend(
+        FakeBackend.prepopulated(cfg.workload.object_name_prefix, count=2, size=100_000, fault=fault),
+        RetryConfig(jitter=False, initial_backoff_s=0.0, max_backoff_s=0.0, max_attempts=200),
+    )
+    res = run_read(cfg, backend=backend)
+    assert res.bytes_total == 2 * 3 * 100_000
+    assert res.errors == 0
+
+
+def test_read_workload_sink_receives_all_bytes():
+    """The staging hook sees every granule in order (per worker)."""
+    cfg = smoke_cfg(workers=2, calls=1, size=200_000)
+
+    received: dict[int, bytearray] = {}
+
+    class CollectSink:
+        def __init__(self, i):
+            self.i = i
+            received[i] = bytearray()
+
+        def submit(self, mv):
+            received[self.i].extend(bytes(mv))
+
+        def finish(self):
+            return {"staged_bytes": len(received[self.i])}
+
+    res = run_read(cfg, sink_factory=CollectSink)
+    assert res.extra["staged_bytes"] == 2 * 200_000
+    for i in range(2):
+        expected = deterministic_bytes(f"{cfg.workload.object_name_prefix}{i}", 200_000)
+        assert bytes(received[i]) == expected.tobytes()
+
+
+def test_smoke_preset_runs():
+    res = run_read(preset("smoke"))
+    assert res.errors == 0 and res.bytes_total > 0

@@ -94,6 +94,10 @@ class WorkloadConfig:
     seed: int = 0  # offset-shuffle seed (ssd_test uses global rand)
     # Object/file sizes for data generation in hermetic/fake runs.
     object_size: int = 100 * MB  # reference objects are ~100 MB-class (main.go:52)
+    # errgroup semantics: first worker error aborts the run (main.go:200-219).
+    # False = per-worker failure domains; failures become holes in the result
+    # (SURVEY §5.3 prescription) instead of a pod-wide abort.
+    abort_on_error: bool = True
 
 
 @dataclass

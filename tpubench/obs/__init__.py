@@ -1,0 +1,8 @@
+"""Observability: tracing spans and metric export (SURVEY §5.1, §5.5)."""
+
+from tpubench.obs.tracing import (  # noqa: F401
+    NoopTracer,
+    RecordingTracer,
+    Tracer,
+    make_tracer,
+)

@@ -1,0 +1,134 @@
+"""Span-per-read tracing (reference ``trace_exporter.go`` + main.go:129-132).
+
+The reference opens an OTel span per read with a bucket attribute and bridges
+OpenCensus spans from inside the storage library. Here the workload code
+talks to a tiny ``Tracer`` protocol; implementations:
+
+* ``NoopTracer`` — default, zero overhead;
+* ``RecordingTracer`` — in-process, for tests and local span dumps;
+* OTel-backed tracer via :func:`make_tracer` when ``enable_tracing`` is set
+  and ``opentelemetry`` is importable (sampling via ``trace_sample_rate``,
+  trace_exporter.go:44).
+
+Beyond the reference: spans get ``first_byte`` and ``stage`` (HBM-landing)
+events — the north-star observability split (SURVEY §5.1).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Optional, Protocol
+
+
+class Span(Protocol):
+    def event(self, name: str, **attrs) -> None: ...
+
+
+class Tracer(Protocol):
+    def span(self, name: str, **attrs) -> contextlib.AbstractContextManager[Span]: ...
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def event(self, name: str, **attrs) -> None:
+        pass
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class NoopTracer:
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[Span]:
+        yield _NOOP_SPAN
+
+
+@dataclass
+class RecordedSpan:
+    name: str
+    attrs: dict
+    start_ns: int
+    end_ns: int = 0
+    events: list = field(default_factory=list)
+
+    def event(self, name: str, **attrs) -> None:
+        self.events.append((name, time.perf_counter_ns(), attrs))
+
+
+class RecordingTracer:
+    """Thread-safe in-process tracer; sampling mirrors TraceIDRatioBased."""
+
+    def __init__(self, sample_rate: float = 1.0, seed: int = 0):
+        self.sample_rate = sample_rate
+        self.spans: list[RecordedSpan] = []
+        self._lock = threading.Lock()
+        self._rng = random.Random(seed)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[Span]:
+        with self._lock:
+            sampled = self._rng.random() < self.sample_rate
+        if not sampled:
+            yield _NOOP_SPAN
+            return
+        sp = RecordedSpan(name=name, attrs=attrs, start_ns=time.perf_counter_ns())
+        try:
+            yield sp
+        finally:
+            sp.end_ns = time.perf_counter_ns()
+            with self._lock:
+                self.spans.append(sp)
+
+
+class OtelTracer:
+    """OTel SDK-backed tracer (gated; reference trace_exporter.go:18-61)."""
+
+    def __init__(self, sample_rate: float, service_name: str, transport: str):
+        from opentelemetry import trace
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.sampling import TraceIdRatioBased
+
+        resource = Resource.create(
+            {"service.name": service_name, "transport": transport}
+        )
+        self._provider = TracerProvider(
+            sampler=TraceIdRatioBased(sample_rate), resource=resource
+        )
+        self._tracer = self._provider.get_tracer("tpubench")
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[Span]:
+        with self._tracer.start_as_current_span(name) as otel_span:
+            for k, v in attrs.items():
+                otel_span.set_attribute(k, v)
+
+            class _Wrap:
+                def event(self, ename: str, **eattrs) -> None:
+                    otel_span.add_event(ename, eattrs)
+
+            yield _Wrap()
+
+    def shutdown(self) -> None:
+        self._provider.shutdown()
+
+
+def make_tracer(cfg) -> Tracer:
+    """From an ObservabilityConfig (+TransportConfig context)."""
+    if not cfg.obs.enable_tracing:
+        return NoopTracer()
+    try:
+        return OtelTracer(
+            sample_rate=cfg.obs.trace_sample_rate,
+            service_name="tpubench",
+            transport=cfg.transport.protocol,
+        )
+    except Exception:
+        # OTel SDK missing/broken: degrade to in-process recording rather
+        # than failing the benchmark run.
+        return RecordingTracer(sample_rate=cfg.obs.trace_sample_rate)

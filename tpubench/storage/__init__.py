@@ -20,33 +20,37 @@ from tpubench.storage.base import (  # noqa: F401
 )
 from tpubench.storage.fake import FakeBackend, FaultPlan  # noqa: F401
 from tpubench.storage.retry import Backoff, retry_call  # noqa: F401
+from tpubench.storage.retrying import RetryingBackend  # noqa: F401
 
 
-def open_backend(cfg) -> StorageBackend:
+def open_backend(cfg, fault=None) -> StorageBackend:
     """Factory from a BenchConfig (reference: main.go:169-177 protocol switch,
-    minus its ignored-error bug)."""
+    minus its ignored-error bug). Every backend is wrapped with the
+    client-level retry policy (main.go:179-184)."""
     proto = cfg.transport.protocol
     if proto == "fake":
         from tpubench.storage.fake import FakeBackend
 
-        return FakeBackend.prepopulated(
+        inner = FakeBackend.prepopulated(
             prefix=cfg.workload.object_name_prefix,
             count=max(cfg.workload.workers, cfg.workload.threads),
             size=cfg.workload.object_size,
+            fault=fault,
         )
-    if proto == "http":
+    elif proto == "http":
         from tpubench.storage.gcs_http import GcsHttpBackend
 
-        return GcsHttpBackend(
-            bucket=cfg.workload.bucket,
-            transport=cfg.transport,
-        )
-    if proto == "grpc":
+        inner = GcsHttpBackend(bucket=cfg.workload.bucket, transport=cfg.transport)
+    elif proto == "grpc":
         from tpubench.storage.gcs_grpc import GcsGrpcBackend
 
-        return GcsGrpcBackend(bucket=cfg.workload.bucket, transport=cfg.transport)
-    if proto == "local":
+        inner = GcsGrpcBackend(bucket=cfg.workload.bucket, transport=cfg.transport)
+    elif proto == "local":
         from tpubench.storage.local_fs import LocalFsBackend
 
-        return LocalFsBackend(root=cfg.workload.dir)
-    raise ValueError(f"unknown protocol {proto!r} (http|grpc|local|fake)")
+        inner = LocalFsBackend(root=cfg.workload.dir)
+    else:
+        raise ValueError(f"unknown protocol {proto!r} (http|grpc|local|fake)")
+    if cfg.transport.retry.policy == "never":
+        return inner
+    return RetryingBackend(inner, cfg.transport.retry)

@@ -14,8 +14,12 @@ Reference parity (``CreateHttpClient``, main.go:62-104):
   (``user_agent_round_tripper.go:22-30``).
 * **Token source** — Authorization: Bearer from ``auth.py``
   (oauth2.Transport wrap, main.go:89-95).
-* **Retry** — gax semantics applied around connection/open errors
-  (main.go:179-184); mid-stream errors surface to the caller's retry.
+* **Retry** — NOT here. The reference attaches retry at the client level
+  (``client.SetRetry``, main.go:179-184); the uniform equivalent is
+  :class:`tpubench.storage.retrying.RetryingBackend`, which wraps this
+  backend (and every other) with gax-policy retry + mid-stream resume.
+  This module raises classified ``StorageError``s (transient for 408/429/5xx
+  and socket errors) and nothing more.
 
 The reader streams the response body straight into the caller's granule
 buffer via ``HTTPResponse.readinto`` — no intermediate bytes objects — and
@@ -37,7 +41,6 @@ import time
 from tpubench.config import TransportConfig
 from tpubench.storage.auth import TokenSource, make_token_source
 from tpubench.storage.base import ObjectMeta, StorageError
-from tpubench.storage.retry import retry_call
 
 DEFAULT_ENDPOINT = "https://storage.googleapis.com"
 
@@ -204,11 +207,6 @@ class GcsHttpBackend:
             self._pool.release(conn, reusable=False)
             raise StorageError(f"{method} {path}: {e}", transient=True) from e
 
-    def _request_retry(self, method: str, path: str, **kw):
-        return retry_call(
-            lambda: self._checked(method, path, **kw), self.transport.retry
-        )
-
     def _checked(self, method: str, path: str, headers=None, body=b"", ok=(200, 206)):
         conn, resp = self._request(method, path, headers, body)
         if resp.status in ok:
@@ -238,7 +236,7 @@ class GcsHttpBackend:
         if start or length is not None:
             end = "" if length is None else str(start + length - 1)
             headers["Range"] = f"bytes={start}-{end}"
-        conn, resp = self._request_retry(
+        conn, resp = self._checked(
             "GET", self._opath(name) + "?alt=media", headers=headers
         )
         clen = int(resp.headers.get("Content-Length", "0"))
@@ -249,7 +247,7 @@ class GcsHttpBackend:
             f"/upload/storage/v1/b/{urllib.parse.quote(self.bucket, safe='')}/o"
             f"?uploadType=media&name={urllib.parse.quote(name, safe='')}"
         )
-        conn, resp = self._request_retry(
+        conn, resp = self._checked(
             "POST",
             path,
             headers={"Content-Type": "application/octet-stream"},
@@ -266,7 +264,7 @@ class GcsHttpBackend:
             f"/storage/v1/b/{urllib.parse.quote(self.bucket, safe='')}/o"
             f"?prefix={urllib.parse.quote(prefix, safe='')}"
         )
-        conn, resp = self._request_retry("GET", path)
+        conn, resp = self._checked("GET", path)
         try:
             payload = json.loads(resp.read())
         finally:
@@ -276,7 +274,7 @@ class GcsHttpBackend:
         ]
 
     def stat(self, name: str) -> ObjectMeta:
-        conn, resp = self._request_retry("GET", self._opath(name))
+        conn, resp = self._checked("GET", self._opath(name))
         try:
             meta = json.loads(resp.read())
         finally:
@@ -286,7 +284,7 @@ class GcsHttpBackend:
         )
 
     def delete(self, name: str) -> None:
-        conn, resp = self._request_retry("DELETE", self._opath(name), ok=(200, 204))
+        conn, resp = self._checked("DELETE", self._opath(name), ok=(200, 204))
         try:
             resp.read()
         finally:

@@ -1,0 +1,101 @@
+"""Client-level retry decorator for any backend.
+
+The reference attaches retry at the *client* (``client.SetRetry``,
+main.go:179-184) and the Go storage library transparently restarts an
+interrupted download from the current offset. We reproduce both behaviors
+uniformly for every backend via this wrapper:
+
+* ``open_read``/metadata ops are retried under the gax policy;
+* a reader hit by a transient mid-stream error is re-opened at
+  ``start + bytes_already_delivered`` (ranged read) and continues, so the
+  caller sees one uninterrupted stream.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tpubench.config import RetryConfig
+from tpubench.storage.base import ObjectMeta, StorageBackend, StorageError
+from tpubench.storage.retry import _is_retryable, retry_call
+
+
+class _ResumingReader:
+    def __init__(
+        self,
+        backend: StorageBackend,
+        name: str,
+        start: int,
+        length: Optional[int],
+        retry: RetryConfig,
+    ):
+        self._backend = backend
+        self._name = name
+        self._start = start
+        self._length = length
+        self._retry = retry
+        self._delivered = 0
+        self.first_byte_ns: Optional[int] = None
+        self._inner = retry_call(lambda: backend.open_read(name, start, length), retry)
+        self.reopen_count = 0
+
+    def _reopen(self) -> None:
+        try:
+            self._inner.close()
+        except Exception:
+            pass
+        new_start = self._start + self._delivered
+        new_length = None if self._length is None else self._length - self._delivered
+        self._inner = retry_call(
+            lambda: self._backend.open_read(self._name, new_start, new_length),
+            self._retry,
+        )
+        self.reopen_count += 1
+
+    def readinto(self, buf: memoryview) -> int:
+        attempts = 0
+        while True:
+            try:
+                n = self._inner.readinto(buf)
+            except BaseException as exc:  # noqa: BLE001 — classified below
+                attempts += 1
+                if not _is_retryable(exc, self._retry.policy):
+                    raise
+                if self._retry.max_attempts and attempts >= self._retry.max_attempts:
+                    raise
+                self._reopen()
+                continue
+            if n > 0 and self.first_byte_ns is None:
+                self.first_byte_ns = self._inner.first_byte_ns
+            if n > 0:
+                self._delivered += n
+            return n
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+class RetryingBackend:
+    """Wraps any StorageBackend with the reference's client-level retry."""
+
+    def __init__(self, inner: StorageBackend, retry: Optional[RetryConfig] = None):
+        self.inner = inner
+        self.retry = retry or RetryConfig()
+
+    def open_read(self, name: str, start: int = 0, length: Optional[int] = None):
+        return _ResumingReader(self.inner, name, start, length, self.retry)
+
+    def write(self, name: str, data: bytes) -> ObjectMeta:
+        return retry_call(lambda: self.inner.write(name, data), self.retry)
+
+    def list(self, prefix: str = "") -> list[ObjectMeta]:
+        return retry_call(lambda: self.inner.list(prefix), self.retry)
+
+    def stat(self, name: str) -> ObjectMeta:
+        return retry_call(lambda: self.inner.stat(name), self.retry)
+
+    def delete(self, name: str) -> None:
+        return retry_call(lambda: self.inner.delete(name), self.retry)
+
+    def close(self) -> None:
+        self.inner.close()

@@ -1,0 +1,12 @@
+"""Workload drivers (L4 of SURVEY §1): one module per reference binary.
+
+* ``read``        — root GCS read bench (``main.go``), the flagship.
+* ``read_fs``     — sequential FS read (``benchmark-script/read_operation``).
+* ``write``       — durable write (``benchmark-script/write_operations``).
+* ``listing``     — list bench (``benchmark-script/list_operation``).
+* ``open_file``   — FD-hold bench (``benchmark-script/open_file``).
+* ``ssd_compare`` — block-latency percentile bench (``benchmark-script/ssd_test``).
+"""
+
+from tpubench.workloads.common import WorkerGroup, WorkerError  # noqa: F401
+from tpubench.workloads.read import ReadWorkload, run_read  # noqa: F401

@@ -1,0 +1,146 @@
+"""Root read benchmark — the reference's flagship (``main.go``).
+
+Reproduces the §3.1 call stack TPU-first:
+
+* ``--worker`` threads, worker ``i`` owns object ``<prefix><i>``
+  (``main.go:121``), each doing ``--read-call-per-worker`` full-object reads;
+* per read: span → open reader → stream through a reused granule buffer
+  (2 MB default, tuned to the gRPC server's message chunking,
+  ``main.go:123-125``) → record full-read latency (``main.go:133,145-146``)
+  → close (``main.go:148``);
+* errgroup join semantics (``main.go:200-219``) via :class:`WorkerGroup`.
+
+Deltas over the reference (the north star):
+
+* bytes can be *staged to TPU HBM* per granule via a ``sink_factory`` hook
+  (see :mod:`tpubench.staging`) instead of discarded into host RAM
+  (``io.Discard``, main.go:140);
+* first-byte latency is recorded as its own histogram;
+* per-worker byte counts and latency buffers — no shared mutable hot-loop
+  state (the reference's ssd_test races on exactly this).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Protocol
+
+from tpubench.config import BenchConfig
+from tpubench.metrics import MetricSet
+from tpubench.metrics.report import RunResult
+from tpubench.obs.tracing import NoopTracer, Tracer
+from tpubench.storage import open_backend
+from tpubench.storage.base import StorageBackend, read_object_through
+from tpubench.workloads.common import WorkerGroup
+
+
+class Sink(Protocol):
+    """Per-worker granule consumer (the staging hook)."""
+
+    def submit(self, mv: memoryview) -> None: ...
+
+    def finish(self) -> dict: ...
+
+
+SinkFactory = Callable[[int], Sink]
+
+
+@dataclass
+class ReadWorkload:
+    cfg: BenchConfig
+    backend: StorageBackend
+    tracer: Tracer
+    sink_factory: Optional[SinkFactory] = None
+
+    def run(self) -> RunResult:
+        w = self.cfg.workload
+        n = w.workers
+        metrics = MetricSet()
+        recorders = [metrics.new_worker(f"w{i}") for i in range(n)]
+        worker_bytes = [0] * n
+        sink_stats: list[dict] = [{} for _ in range(n)]
+
+        def worker(i: int, cancel) -> None:
+            read_rec, fb_rec = recorders[i]
+            name = f"{w.object_name_prefix}{i}"  # main.go:121
+            granule = memoryview(bytearray(w.granule_bytes))  # one per worker, main.go:125
+            sink = self.sink_factory(i) if self.sink_factory else None
+            submit = sink.submit if sink else None
+            total_local = 0
+            try:
+                for _ in range(w.read_calls_per_worker):
+                    if cancel.is_set():
+                        break
+                    with self.tracer.span(
+                        "ReadObject", bucket=w.bucket, object=name
+                    ) as span:
+                        t0 = time.perf_counter_ns()
+                        reader = self.backend.open_read(name)
+                        nbytes, fb_ns = read_object_through(reader, granule, submit)
+                        t1 = time.perf_counter_ns()
+                        read_rec.record_ns(t1 - t0)
+                        if fb_ns is not None:
+                            fb_rec.record_ns(fb_ns - t0)
+                            span.event("first_byte")
+                        total_local += nbytes
+            finally:
+                if sink is not None:
+                    sink_stats[i] = sink.finish() or {}
+                worker_bytes[i] = total_local
+
+        metrics.ingest.start()
+        group = WorkerGroup(abort_on_error=w.abort_on_error)
+        result_errors = 0
+        try:
+            gres = group.run(n, worker, name="read")
+            result_errors = gres.error_count
+        finally:
+            metrics.ingest.stop()
+            metrics.ingest.bytes = sum(worker_bytes)
+
+        # Stage-latency recorders created by sinks live in their stats.
+        for st in sink_stats:
+            rec = st.get("stage_recorder")
+            if rec is not None:
+                metrics.stage_latency.append(rec)
+
+        wall = metrics.ingest.seconds
+        gbps = metrics.ingest.gbps()
+        n_chips = max(1, int(sink_stats[0].get("n_chips", 1))) if sink_stats else 1
+        staged = sum(int(st.get("staged_bytes", 0)) for st in sink_stats)
+        res = RunResult(
+            workload="read",
+            config=self.cfg.to_dict(),
+            bytes_total=metrics.ingest.bytes,
+            wall_seconds=wall,
+            gbps=gbps,
+            gbps_per_chip=gbps / n_chips,
+            n_chips=n_chips,
+            summaries=metrics.summaries(),
+            errors=result_errors,
+        )
+        if staged:
+            res.extra["staged_bytes"] = staged
+            res.extra["staged_gbps"] = (staged / 1e9) / wall if wall > 0 else 0.0
+        return res
+
+
+def run_read(
+    cfg: BenchConfig,
+    backend: Optional[StorageBackend] = None,
+    tracer: Optional[Tracer] = None,
+    sink_factory: Optional[SinkFactory] = None,
+) -> RunResult:
+    owns_backend = backend is None
+    backend = backend or open_backend(cfg)
+    try:
+        return ReadWorkload(
+            cfg=cfg,
+            backend=backend,
+            tracer=tracer or NoopTracer(),
+            sink_factory=sink_factory,
+        ).run()
+    finally:
+        if owns_backend:
+            backend.close()
