@@ -6,7 +6,7 @@ conftest. Bench runs (bench.py) use the real TPU; tests never do.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # override (env may pin the real TPU platform)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -14,13 +14,17 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+import jax  # noqa: E402
+
+# The TPU plugin in this image rewrites JAX_PLATFORMS at import time, so the
+# env var alone is not enough — pin the platform via config too.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def jax_cpu_devices():
-    import jax
-
     devices = jax.devices()
     assert len(devices) == 8, f"expected 8 simulated devices, got {devices}"
     return devices

@@ -1,0 +1,13 @@
+"""GCS→HBM staging (SURVEY §2.5.4, §7 step 4 — the north-star delta).
+
+The reference discards downloaded bytes into host RAM (``io.Discard``,
+``main.go:140``). Here each filled granule is landed in TPU HBM:
+
+* ``device_put`` path — async host→HBM DMA via ``jax.device_put`` over a
+  ring of host slots (double-buffered so fetch overlaps DMA — the I/O analog
+  of pipeline parallelism, SURVEY §2.6 PP row);
+* ``pallas`` path — a Pallas copy kernel as the alternative landing proof
+  (:mod:`tpubench.staging.pallas_stage`).
+"""
+
+from tpubench.staging.device import DevicePutStager, make_sink_factory  # noqa: F401

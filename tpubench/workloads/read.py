@@ -123,6 +123,10 @@ class ReadWorkload:
         if staged:
             res.extra["staged_bytes"] = staged
             res.extra["staged_gbps"] = (staged / 1e9) / wall if wall > 0 else 0.0
+            res.extra["staged_gbps_per_chip"] = res.extra["staged_gbps"] / n_chips
+        checks = [st["checksum_ok"] for st in sink_stats if "checksum_ok" in st]
+        if checks:
+            res.extra["checksum_ok"] = all(checks)
         return res
 
 
