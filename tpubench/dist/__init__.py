@@ -1,0 +1,20 @@
+"""Distributed fan-out and pod-level reassembly (SURVEY §2.6, §5.7, §5.8).
+
+The reference's only parallelism is single-host goroutine fan-out
+(``main.go:200-212``); multi-node = "run the binary on more VMs by hand".
+Here the fan-out axes are first-class:
+
+* ``bringup``   — ``jax.distributed`` process bring-up over DCN;
+* ``shard``     — host×worker→object and object→byte-range shard tables
+                  (the CP-analog: one logical object split across the pod);
+* ``reassemble``— ICI all-gather of byte-range shards under ``shard_map``
+                  (XLA-native and explicit ppermute-ring variants), the
+                  TPU-native replacement for a NCCL/MPI backend.
+"""
+
+from tpubench.dist.shard import ShardTable, worker_object_index  # noqa: F401
+from tpubench.dist.reassemble import (  # noqa: F401
+    make_mesh,
+    make_reassemble,
+    make_ring_reassemble,
+)

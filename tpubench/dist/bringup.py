@@ -1,0 +1,28 @@
+"""Multi-host bring-up over DCN (SURVEY §5.8).
+
+``jax.distributed.initialize`` is the control plane (coordinator over DCN);
+data-plane collectives ride ICI via the jitted reassembly. Single-process
+runs (tests, single-VM benches) skip initialization entirely.
+"""
+
+from __future__ import annotations
+
+from tpubench.config import DistConfig
+
+
+def initialize(cfg: DistConfig) -> dict:
+    """Idempotent bring-up; returns topology facts for the run report."""
+    import jax
+
+    if cfg.num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator_address or None,
+            num_processes=cfg.num_processes,
+            process_id=cfg.process_id,
+        )
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

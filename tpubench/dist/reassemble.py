@@ -1,0 +1,123 @@
+"""Pod-level reassembly of byte-range shards over ICI.
+
+The transport-level cousin of ring attention (SURVEY §5.7): each chip holds
+one lane-aligned byte-range shard of a logical object in HBM; an all-gather
+under ``shard_map`` over a 1-D mesh reassembles the full object on every
+chip, riding ICI with XLA-scheduled collectives — the TPU-native replacement
+for the NCCL/MPI backend the reference never had (§5.8; its closest ancestor
+is gRPC DirectPath, ``main.go:106-117``).
+
+Two implementations, both jitted:
+
+* :func:`make_reassemble` — ``jax.lax.all_gather``: XLA picks the collective
+  schedule (in practice a ring over ICI). The production path.
+* :func:`make_ring_reassemble` — explicit ``ppermute`` ring: n-1 neighbor
+  hops, each step overlapping a send with a buffer write. The
+  ring-attention-style transport demonstrated at the byte level, and a
+  cross-check that the XLA collective is beaten/matched by hand-rolling.
+
+Both also emit a per-chip mod-2³² checksum (``psum``-reduced) so integrity
+of the gathered bytes is validated on-device without a host round-trip.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(devices: Optional[Sequence] = None, axis: str = "pod") -> Mesh:
+    """1-D mesh over all (or given) devices — the fan-out axis."""
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis,))
+
+
+def shard_to_device_array(
+    host_shards: Sequence[np.ndarray], mesh: Mesh, axis: str = "pod", lane: int = 128
+):
+    """Stage per-chip shard buffers into one global array sharded over the
+    mesh: shape (n, rows, lane) uint8, dimension 0 split across chips.
+
+    Each host calls this with *its* chips' shards (single-controller: all of
+    them); ``jax.make_array_from_single_device_arrays`` assembles the global
+    view without any cross-host data movement — fetch stays local.
+    """
+    n = len(mesh.devices.reshape(-1))
+    assert len(host_shards) == n, f"need {n} shards, got {len(host_shards)}"
+    rows = host_shards[0].size // lane
+    sharding = NamedSharding(mesh, P(axis, None, None))
+    singles = [
+        jax.device_put(s.reshape(1, rows, lane), d)
+        for s, d in zip(host_shards, mesh.devices.reshape(-1))
+    ]
+    return jax.make_array_from_single_device_arrays(
+        (n, rows, lane), sharding, singles
+    )
+
+
+def make_reassemble(mesh: Mesh, axis: str = "pod"):
+    """jitted: sharded (n, rows, lane) → (replicated gathered array,
+    replicated checksum). XLA inserts the ICI all-gather."""
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(axis, None, None),
+        out_specs=(P(), P()),
+        # all_gather output IS replicated but the static VMA checker can't
+        # prove it; the equality tests below prove it dynamically.
+        check_vma=False,
+    )
+    def fn(local):  # local: (1, rows, lane) on each chip
+        gathered = jax.lax.all_gather(local[0], axis)  # (n, rows, lane)
+        csum = jax.lax.psum(jnp.sum(local.astype(jnp.uint32)), axis)
+        return gathered, csum
+
+    return fn
+
+
+def make_ring_reassemble(mesh: Mesh, axis: str = "pod"):
+    """jitted explicit ring all-gather via ``ppermute`` (n-1 neighbor hops).
+
+    Static Python loop (n is a compile-time mesh constant) so XLA can
+    pipeline the hops; no data-dependent control flow under jit.
+    """
+    n = mesh.devices.size
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(axis, None, None),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def fn(local):
+        block = local[0]  # (rows, lane)
+        idx = jax.lax.axis_index(axis)
+        out = jnp.zeros((n,) + block.shape, block.dtype)
+        out = jax.lax.dynamic_update_index_in_dim(out, block, idx, 0)
+        buf = block
+        for step in range(n - 1):
+            buf = jax.lax.ppermute(buf, axis, perm)
+            src = (idx - step - 1) % n
+            out = jax.lax.dynamic_update_index_in_dim(out, buf, src, 0)
+        csum = jax.lax.psum(jnp.sum(block.astype(jnp.uint32)), axis)
+        return out, csum
+
+    return fn
+
+
+def gathered_to_bytes(gathered: jax.Array, object_size: int) -> bytes:
+    """Trim the padded gather back to the true object bytes (host-side)."""
+    flat = np.asarray(jax.device_get(gathered)).reshape(-1)
+    return flat[:object_size].tobytes()
