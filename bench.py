@@ -1,0 +1,103 @@
+"""Headline benchmark: storage→HBM staged ingest bandwidth per chip.
+
+Runs the flagship read workload (reference ``main.go`` hot loop) with the
+staging pipeline landing every granule in TPU HBM, against the hermetic
+in-process backend (zero-egress environments can't reach real GCS; the
+backend serves deterministic bytes from host RAM, so the measured path is
+exactly the framework's host→HBM ingest pipeline — the capability the
+reference never had: its bytes stop in host RAM, ``main.go:140``).
+
+Both staging configs are measured — double-buffered async (fetch ∥ DMA
+overlap) and synchronous single-buffered — and the best staged GB/s/chip is
+reported, since transport quirks can favor either. Repetitions are
+interleaved and medians taken: the host→HBM path here is a rate-limited
+tunnel with burst credit (~5× sustained), so single measurements lie.
+
+``vs_baseline`` follows BASELINE.md's definition: staged (→HBM) bandwidth
+relative to the reference-parity run — same fetch hot loop with bytes
+dropped in host RAM (``io.Discard``, main.go:140), i.e. the go-client→DRAM
+capability. 1.0 means landing bytes in HBM costs nothing over the
+reference's host-RAM endpoint.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _staged_run(double_buffer: bool, cfg_base):
+    from tpubench.config import BenchConfig
+    from tpubench.staging.device import make_sink_factory
+    from tpubench.workloads.read import run_read
+
+    cfg = BenchConfig.from_dict(cfg_base.to_dict())
+    cfg.staging.double_buffer = double_buffer
+    res = run_read(cfg, sink_factory=make_sink_factory(cfg))
+    if res.errors:
+        raise RuntimeError(f"bench run had {res.errors} worker errors")
+    return res.extra["staged_gbps_per_chip"]
+
+
+def _host_ram_run(cfg_base) -> float:
+    """Reference-parity run: fetch loop, bytes discarded in host RAM."""
+    from tpubench.config import BenchConfig
+    from tpubench.workloads.read import run_read
+
+    cfg = BenchConfig.from_dict(cfg_base.to_dict())
+    cfg.staging.mode = "none"
+    res = run_read(cfg)
+    if res.errors:
+        raise RuntimeError(f"baseline run had {res.errors} worker errors")
+    return res.gbps
+
+
+def main() -> int:
+    from tpubench.config import MB, BenchConfig
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 2
+    cfg.workload.read_calls_per_worker = 2
+    cfg.workload.object_size = 32 * MB
+    cfg.workload.granule_bytes = 2 * MB  # reference granule (main.go:123-125)
+    cfg.staging.mode = "device_put"
+    cfg.staging.validate_checksum = False
+
+    # Warmup compiles/initializes the transfer path.
+    warm = BenchConfig.from_dict(cfg.to_dict())
+    warm.workload.workers = 1
+    warm.workload.read_calls_per_worker = 1
+    warm.workload.object_size = 4 * MB
+    _staged_run(True, warm)
+
+    # The transfer path's bandwidth is bursty (shared tunnel); interleave
+    # A/B/raw repetitions and aggregate so one burst doesn't skew the ratio.
+    import statistics
+
+    pipelined, sync, host = [], [], []
+    for _ in range(3):
+        pipelined.append(_staged_run(True, cfg))
+        sync.append(_staged_run(False, cfg))
+        host.append(_host_ram_run(cfg))
+    best = max(statistics.median(pipelined), statistics.median(sync))
+    ceiling = statistics.median(host)
+
+    print(
+        json.dumps(
+            {
+                "metric": "staged_ingest_bandwidth_per_chip",
+                "value": round(best, 4),
+                "unit": "GB/s/chip",
+                "vs_baseline": round(best / ceiling, 4) if ceiling > 0 else 0.0,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

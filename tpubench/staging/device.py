@@ -108,6 +108,11 @@ class DevicePutStager:
         self._true_bytes[k] = n
         self.granules += 1
         self._k = (k + 1) % self.depth
+        if self.depth == 1:
+            # Single-buffered = fully synchronous staging: complete the
+            # transfer before returning. (Also the faster path on transports
+            # where the sync route beats queued async dispatch.)
+            self._drain_slot(k)
 
     def finish(self) -> dict:
         for k in range(self.depth):
