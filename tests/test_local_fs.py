@@ -1,0 +1,67 @@
+import pytest
+
+from tpubench.config import BenchConfig
+from tpubench.storage import StorageError
+from tpubench.storage.base import deterministic_bytes, read_object_through
+from tpubench.storage.local_fs import LocalFsBackend
+from tpubench.workloads.read import run_read
+
+
+@pytest.fixture()
+def root(tmp_path):
+    for i in range(3):
+        name = f"bench/file_{i}"
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(deterministic_bytes(name, 50_000).tobytes())
+    return str(tmp_path)
+
+
+def test_read_full_and_range(root):
+    be = LocalFsBackend(root)
+    expected = deterministic_bytes("bench/file_0", 50_000).tobytes()
+    got = bytearray()
+    total, fb = read_object_through(
+        be.open_read("bench/file_0"), memoryview(bytearray(8192)), got.extend
+    )
+    assert total == 50_000 and bytes(got) == expected and fb is not None
+
+    r = be.open_read("bench/file_1", start=100, length=200)
+    buf = bytearray(4096)
+    n = r.readinto(memoryview(buf))
+    r.close()
+    assert n == 200
+    assert bytes(buf[:200]) == deterministic_bytes("bench/file_1", 50_000)[100:300].tobytes()
+
+
+def test_stat_list_write_delete(root):
+    be = LocalFsBackend(root)
+    assert be.stat("bench/file_2").size == 50_000
+    assert [m.name for m in be.list("bench/")] == [f"bench/file_{i}" for i in range(3)]
+    be.write("new/obj", b"abc")
+    assert be.stat("new/obj").size == 3
+    be.delete("new/obj")
+    with pytest.raises(StorageError):
+        be.stat("new/obj")
+
+
+def test_not_found_and_escape(root):
+    be = LocalFsBackend(root)
+    with pytest.raises(StorageError) as ei:
+        be.open_read("missing")
+    assert ei.value.code == 404
+    with pytest.raises(StorageError):
+        be.open_read("../../etc/passwd")
+
+
+def test_read_workload_over_local_fs(root):
+    cfg = BenchConfig()
+    cfg.transport.protocol = "local"
+    cfg.workload.dir = root
+    cfg.workload.object_name_prefix = "bench/file_"
+    cfg.workload.workers = 3
+    cfg.workload.read_calls_per_worker = 2
+    cfg.workload.granule_bytes = 8192
+    res = run_read(cfg)
+    assert res.errors == 0
+    assert res.bytes_total == 3 * 2 * 50_000

@@ -1,0 +1,181 @@
+"""Native engine: build, aligned buffers, O_DIRECT block I/O, timed hot
+loops, durable writes, HTTP receive path (SURVEY §2.5 ledger)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpubench.native import get_engine
+from tpubench.storage.base import deterministic_bytes
+from tpubench.storage.fake import FakeBackend
+from tpubench.storage.fake_server import FakeGcsServer
+
+
+@pytest.fixture(scope="module")
+def engine():
+    e = get_engine()
+    if e is None:
+        pytest.skip("native toolchain unavailable")
+    return e
+
+
+@pytest.fixture()
+def datafile(tmp_path):
+    data = deterministic_bytes("native/file", 64 * 1024).tobytes()
+    p = tmp_path / "f"
+    p.write_bytes(data)
+    return str(p), data
+
+
+def test_clock_monotonic(engine):
+    a = engine.now_ns()
+    b = engine.now_ns()
+    assert b >= a > 0
+
+
+def test_aligned_buffer(engine):
+    buf = engine.alloc(8192, align=4096)
+    assert buf.address % 4096 == 0
+    buf.array[:] = 7
+    assert bytes(buf.view(4)) == b"\x07\x07\x07\x07"
+    buf.free()
+    buf.free()  # idempotent
+
+
+def test_pread_blocks_content_and_latency(engine, datafile):
+    path, data = datafile
+    fd, _ = engine.open(path, direct=False)
+    buf = engine.alloc(4096)
+    offsets = np.array([4096 * 3, 0, 4096 * 7], dtype=np.int64)
+    total, lat = engine.pread_blocks(fd, buf, 4096, offsets)
+    engine.close(fd)
+    assert total == 3 * 4096
+    assert (lat > 0).all()
+    # Buffer holds the LAST block (reference reuse semantics, main.go:125).
+    assert bytes(buf.view()) == data[4096 * 7 : 4096 * 8]
+
+
+def test_pread_short_final_block(engine, tmp_path):
+    p = tmp_path / "short"
+    p.write_bytes(b"x" * 5000)
+    fd, _ = engine.open(str(p))
+    buf = engine.alloc(4096)
+    total, _ = engine.pread_blocks(fd, buf, 4096, np.array([0, 4096]))
+    engine.close(fd)
+    assert total == 5000  # 4096 + 904 (EOF short read is legal)
+
+
+def test_read_file_seq_rereads_from_zero(engine, datafile):
+    """Repeat passes re-read from offset 0 — the deliberate fix for the
+    reference's re-read-at-EOF bug (read_operation/main.go:46, SURVEY §3.3)."""
+    path, data = datafile
+    fd, _ = engine.open(path)
+    buf = engine.alloc(16 * 1024)
+    total, lats = engine.read_file_seq(fd, buf, passes=3)
+    engine.close(fd)
+    assert total == 3 * len(data)
+    assert len(lats) == 3 and (lats > 0).all()
+
+
+def test_pwrite_blocks_fsync_roundtrip(engine, tmp_path):
+    p = str(tmp_path / "w")
+    src = engine.alloc(4096)
+    engine.fill_random(src, seed=99)
+    fd, _ = engine.open(p, write=True, create=True, direct=False)
+    total, lat = engine.pwrite_blocks(
+        fd, src, 4096, np.array([0, 4096, 8192]), fsync_each=True
+    )
+    engine.close(fd)
+    assert total == 3 * 4096
+    assert (lat > 0).all()
+    with open(p, "rb") as f:
+        ondisk = f.read()
+    assert ondisk == bytes(src.view()) * 3
+
+
+def test_o_direct_applied_or_reported(engine, tmp_path):
+    """O_DIRECT engages where supported; gracefully downgrades (reported)
+    where not (tmpfs)."""
+    p = str(tmp_path / "d")
+    with open(p, "wb") as f:
+        f.write(b"\0" * 8192)
+    fd, applied = engine.open(p, direct=True)
+    buf = engine.alloc(4096)
+    total, _ = engine.pread_blocks(fd, buf, 4096, np.array([0]))
+    engine.close(fd)
+    assert total == 4096
+    assert isinstance(applied, bool)
+
+
+def test_fill_random_deterministic(engine):
+    a = engine.alloc(1024)
+    b = engine.alloc(1024)
+    engine.fill_random(a, seed=5)
+    engine.fill_random(b, seed=5)
+    assert bytes(a.view()) == bytes(b.view())
+    engine.fill_random(b, seed=6)
+    assert bytes(a.view()) != bytes(b.view())
+
+
+def test_file_size(engine, datafile):
+    path, data = datafile
+    assert engine.file_size(path) == len(data)
+    from tpubench.native.engine import NativeError
+
+    with pytest.raises(NativeError):
+        engine.file_size(path + ".missing")
+
+
+def test_native_http_get(engine):
+    """The C++ receive path streams a GCS media GET into a pre-registered
+    buffer with first-byte observability (SURVEY §2.5.1/.4)."""
+    be = FakeBackend.prepopulated("o/", count=1, size=150_000)
+    with FakeGcsServer(be) as srv:
+        host, port = srv.endpoint.removeprefix("http://").split(":")
+        buf = engine.alloc(200_000)
+        r = engine.http_get(host, int(port), "/storage/v1/b/b/o/o%2F0?alt=media", buf)
+        assert r["status"] == 200
+        assert r["length"] == 150_000
+        assert 0 < r["first_byte_ns"] <= engine.now_ns()
+        assert r["total_ns"] > 0
+        assert bytes(buf.view(150_000)) == deterministic_bytes("o/0", 150_000).tobytes()
+
+
+def test_native_http_get_range(engine):
+    be = FakeBackend.prepopulated("o/", count=1, size=100_000)
+    with FakeGcsServer(be) as srv:
+        host, port = srv.endpoint.removeprefix("http://").split(":")
+        buf = engine.alloc(10_000)
+        r = engine.http_get(
+            host,
+            int(port),
+            "/storage/v1/b/b/o/o%2F0?alt=media",
+            buf,
+            headers="Range: bytes=1000-4999\r\n",
+        )
+        assert r["status"] == 206
+        assert r["length"] == 4000
+        assert (
+            bytes(buf.view(4000))
+            == deterministic_bytes("o/0", 100_000)[1000:5000].tobytes()
+        )
+
+
+def test_native_http_error_buffer_too_small(engine):
+    from tpubench.native.engine import NativeError
+
+    be = FakeBackend.prepopulated("o/", count=1, size=100_000)
+    with FakeGcsServer(be) as srv:
+        host, port = srv.endpoint.removeprefix("http://").split(":")
+        buf = engine.alloc(1024)
+        with pytest.raises(NativeError):
+            engine.http_get(host, int(port), "/storage/v1/b/b/o/o%2F0?alt=media", buf)
+
+
+def test_native_http_connection_refused(engine):
+    from tpubench.native.engine import NativeError
+
+    buf = engine.alloc(64)
+    with pytest.raises(NativeError):
+        engine.http_get("127.0.0.1", 1, "/", buf)

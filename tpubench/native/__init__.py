@@ -1,0 +1,16 @@
+"""ctypes bindings for the native data-path engine (SURVEY §2.5).
+
+Build-on-first-import with mtime caching: ``engine.cc`` → ``libtpubench.so``
+via g++ (no pybind11 in this image; the C ABI + ctypes keeps the boundary
+thin and releases the GIL for every blocking call). If the toolchain is
+unavailable the import still succeeds and ``available()`` returns False —
+pure-Python fallbacks keep the framework functional, just slower.
+"""
+
+from tpubench.native.build import build_library, library_path  # noqa: F401
+from tpubench.native.engine import (  # noqa: F401
+    AlignedBuffer,
+    NativeEngine,
+    NativeError,
+    get_engine,
+)

@@ -1,0 +1,41 @@
+"""Compile the native engine, cached by source mtime."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "engine.cc")
+_LIB = os.path.join(_DIR, "libtpubench.so")
+_lock = threading.Lock()
+
+
+def library_path() -> str:
+    return _LIB
+
+
+def build_library(force: bool = False) -> str:
+    """Returns the .so path; raises on compile failure."""
+    with _lock:
+        if (
+            not force
+            and os.path.exists(_LIB)
+            and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
+        ):
+            return _LIB
+        cmd = [
+            "g++",
+            "-O3",
+            "-std=c++17",
+            "-shared",
+            "-fPIC",
+            "-Wall",
+            "-o",
+            _LIB + ".tmp",
+            _SRC,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(_LIB + ".tmp", _LIB)
+        return _LIB

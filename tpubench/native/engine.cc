@@ -1,0 +1,334 @@
+// tpubench native data-path engine.
+//
+// The reference's entire data path is native (Go compiles to machine code);
+// SURVEY §2.5 ledgers the components that must therefore be native here:
+//
+//   1. O_DIRECT aligned block I/O (reference: read_operation/main.go:34,
+//      write_operations/main.go:36, ssd_test/main.go:42 — Go got alignment
+//      only incidentally; we handle it explicitly).
+//   2. Per-op high-resolution timing in the hot loop, written into
+//      caller-owned (per-thread) latency arrays — fixing the reference's
+//      shared-slice data race (ssd_test/main.go:80).
+//   3. fsync-per-block durable write path (write_operations/main.go:63-71).
+//   4. A streaming HTTP/1.1 receive path that lands response bodies directly
+//      in pre-registered buffers (reference granule loop main.go:125,140),
+//      with a first-byte timestamp the Go code never measured.
+//
+// Plain C ABI; Python binds via ctypes (no pybind11 in this image). All
+// blocking calls run without the GIL (ctypes releases it), so Python worker
+// threads get real I/O concurrency.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ----------------------------------------------------------------- clock --
+int64_t tb_now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000000LL + ts.tv_nsec;
+}
+
+// --------------------------------------------------------------- buffers --
+// Aligned allocation: O_DIRECT requires buffer, offset and length aligned to
+// the logical block size (typically 512; 4096 is safe for both).
+void* tb_alloc_aligned(size_t size, size_t align) {
+  void* p = nullptr;
+  if (posix_memalign(&p, align, size) != 0) return nullptr;
+  return p;
+}
+
+void tb_free_aligned(void* p) { free(p); }
+
+// ------------------------------------------------------------------ open --
+// flags: bit0 write (else read), bit1 create+trunc, bit2 O_DIRECT wanted.
+// Returns fd >= 0; *direct_applied set to 1 if O_DIRECT actually engaged
+// (tmpfs and some FUSE configs reject it — we fall back and report, rather
+// than failing the benchmark).
+int tb_open(const char* path, int flags, int* direct_applied) {
+  int oflags = (flags & 1) ? O_WRONLY : O_RDONLY;
+  if (flags & 2) oflags |= O_CREAT | O_TRUNC;
+  int want_direct = (flags & 4) ? 1 : 0;
+  if (direct_applied) *direct_applied = 0;
+#ifdef O_DIRECT
+  if (want_direct) {
+    int fd = open(path, oflags | O_DIRECT, 0644);
+    if (fd >= 0) {
+      if (direct_applied) *direct_applied = 1;
+      return fd;
+    }
+    if (errno != EINVAL && errno != ENOTSUP && errno != EOPNOTSUPP)
+      return -errno;
+  }
+#endif
+  int fd = open(path, oflags, 0644);
+  return fd >= 0 ? fd : -errno;
+}
+
+int tb_close(int fd) { return close(fd) == 0 ? 0 : -errno; }
+
+int64_t tb_file_size(const char* path) {
+  struct stat st;
+  if (stat(path, &st) != 0) return -errno;
+  return static_cast<int64_t>(st.st_size);
+}
+
+// ----------------------------------------------------------- block reads --
+// The ssd_test hot loop (ssd_test/main.go:65-89): for each offset, one timed
+// pread of block_size bytes into `buf`. Latencies (ns) land in lat_ns[i] —
+// the caller passes a private per-thread array, so there is no shared
+// mutable state (the reference raced here). Returns total bytes read, or
+// -errno on the first failure.
+int64_t tb_pread_blocks(int fd, void* buf, int64_t block_size,
+                        const int64_t* offsets, int64_t n, int64_t* lat_ns) {
+  int64_t total = 0;
+  char* p = static_cast<char*>(buf);
+  for (int64_t i = 0; i < n; i++) {
+    int64_t t0 = tb_now_ns();
+    int64_t got = 0;
+    while (got < block_size) {
+      ssize_t k = pread(fd, p + got, block_size - got, offsets[i] + got);
+      if (k < 0) {
+        if (errno == EINTR) continue;
+        return -errno;
+      }
+      if (k == 0) break;  // EOF: short final block is legal
+      got += k;
+    }
+    if (lat_ns) lat_ns[i] = tb_now_ns() - t0;
+    total += got;
+  }
+  return total;
+}
+
+// Sequential whole-file streaming (read_operation/main.go:45-53 semantics,
+// minus its re-read-at-EOF bug: we always pread from explicit offsets).
+// Repeat passes re-read from offset 0 deliberately (SURVEY §3.3 note).
+int64_t tb_read_file_seq(int fd, void* buf, int64_t buf_size, int64_t passes,
+                         int64_t* pass_lat_ns) {
+  int64_t total = 0;
+  char* p = static_cast<char*>(buf);
+  for (int64_t pass = 0; pass < passes; pass++) {
+    int64_t t0 = tb_now_ns();
+    int64_t off = 0;
+    for (;;) {
+      ssize_t k = pread(fd, p, buf_size, off);
+      if (k < 0) {
+        if (errno == EINTR) continue;
+        return -errno;
+      }
+      if (k == 0) break;
+      off += k;
+      total += k;
+    }
+    if (pass_lat_ns) pass_lat_ns[pass] = tb_now_ns() - t0;
+  }
+  return total;
+}
+
+// ----------------------------------------------------------- block writes --
+// write_operations/main.go:46-76 semantics: per block seek+write and
+// (optionally) fsync-per-block. Data comes from the caller-filled buffer.
+// Latency per block includes the fsync when enabled (that IS the measured
+// durable-write cost). Returns total bytes written or -errno.
+int64_t tb_pwrite_blocks(int fd, const void* buf, int64_t block_size,
+                         const int64_t* offsets, int64_t n, int fsync_each,
+                         int64_t* lat_ns) {
+  int64_t total = 0;
+  const char* p = static_cast<const char*>(buf);
+  for (int64_t i = 0; i < n; i++) {
+    int64_t t0 = tb_now_ns();
+    int64_t put = 0;
+    while (put < block_size) {
+      ssize_t k = pwrite(fd, p + put, block_size - put, offsets[i] + put);
+      if (k < 0) {
+        if (errno == EINTR) continue;
+        return -errno;
+      }
+      put += k;
+    }
+    if (fsync_each && fsync(fd) != 0) return -errno;
+    if (lat_ns) lat_ns[i] = tb_now_ns() - t0;
+    total += put;
+  }
+  return total;
+}
+
+// xorshift64* fill — fast deterministic "random" payload for write benches
+// (reference uses crypto/rand per block, write_operations/main.go:46; the
+// bench measures the I/O path, not the RNG, so a cheap PRNG is the right
+// trade and is reproducible).
+void tb_fill_random(void* buf, int64_t n, uint64_t seed) {
+  uint64_t x = seed ? seed : 0x9E3779B97F4A7C15ULL;
+  uint64_t* p64 = static_cast<uint64_t*>(buf);
+  int64_t words = n / 8;
+  for (int64_t i = 0; i < words; i++) {
+    x ^= x >> 12;
+    x ^= x << 25;
+    x ^= x >> 27;
+    p64[i] = x * 0x2545F4914F6CDD1DULL;
+  }
+  char* tail = static_cast<char*>(buf) + words * 8;
+  for (int64_t i = 0; i < n % 8; i++) tail[i] = static_cast<char>(x >> (8 * i));
+}
+
+// ------------------------------------------------------- HTTP/1.1 client --
+// Minimal plain-TCP GET: connect, send request, parse headers, stream the
+// body into the caller's pre-registered buffer. Out-params: HTTP status,
+// first-byte timestamp (ns, CLOCK_MONOTONIC — comparable with tb_now_ns),
+// and total body bytes. Supports Content-Length bodies (what the fake GCS
+// server and GCS JSON media GETs produce). Returns body length, or -errno /
+// -1000-series protocol errors.
+//
+// TLS is deliberately out of scope: the native receive path exists to
+// measure the receive loop itself against localhost servers; real-GCS https
+// traffic uses the Python client (SURVEY hard-part (b)).
+enum {
+  TB_EPROTO = -1001,    // malformed response
+  TB_ETOOBIG = -1002,   // body exceeds buffer
+  TB_ERESOLVE = -1003,  // getaddrinfo failure
+};
+
+int64_t tb_http_get(const char* host, int port, const char* path,
+                    const char* extra_headers,  // "K: V\r\n..." or ""
+                    void* buf, int64_t buf_len, int* status_out,
+                    int64_t* first_byte_ns_out, int64_t* total_ns_out) {
+  int64_t t_start = tb_now_ns();
+  char portstr[16];
+  snprintf(portstr, sizeof portstr, "%d", port);
+  struct addrinfo hints, *res = nullptr;
+  memset(&hints, 0, sizeof hints);
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(host, portstr, &hints, &res) != 0) return TB_ERESOLVE;
+  int fd = -1;
+  for (struct addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) return -ECONNREFUSED;
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+
+  char req[4096];
+  int m = snprintf(req, sizeof req,
+                   "GET %s HTTP/1.1\r\nHost: %s:%d\r\nUser-Agent: tpubench-native\r\n"
+                   "%sConnection: close\r\n\r\n",
+                   path, host, port, extra_headers ? extra_headers : "");
+  if (m <= 0 || m >= static_cast<int>(sizeof req)) {
+    close(fd);
+    return TB_EPROTO;
+  }
+  for (int sent = 0; sent < m;) {
+    ssize_t k = send(fd, req + sent, m - sent, 0);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      int e = errno;
+      close(fd);
+      return -e;
+    }
+    sent += k;
+  }
+
+  // Read headers (into a bounded scratch), find \r\n\r\n.
+  char hdr[16384];
+  int hlen = 0;
+  char* body_start = nullptr;
+  int body_in_hdr = 0;
+  int64_t first_byte_ns = 0;
+  while (hlen < static_cast<int>(sizeof hdr)) {
+    ssize_t k = recv(fd, hdr + hlen, sizeof hdr - hlen, 0);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      int e = errno;
+      close(fd);
+      return -e;
+    }
+    if (k == 0) break;
+    if (first_byte_ns == 0) first_byte_ns = tb_now_ns();
+    hlen += k;
+    hdr[hlen < static_cast<int>(sizeof hdr) ? hlen : hlen - 1] = 0;
+    char* p = static_cast<char*>(memmem(hdr, hlen, "\r\n\r\n", 4));
+    if (p) {
+      body_start = p + 4;
+      body_in_hdr = hlen - static_cast<int>(body_start - hdr);
+      break;
+    }
+  }
+  if (!body_start) {
+    close(fd);
+    return TB_EPROTO;
+  }
+
+  int status = 0;
+  if (sscanf(hdr, "HTTP/1.%*d %d", &status) != 1) {
+    close(fd);
+    return TB_EPROTO;
+  }
+  if (status_out) *status_out = status;
+
+  int64_t content_len = -1;
+  // Case-insensitive Content-Length scan over the header block.
+  for (char* line = hdr; line < body_start;) {
+    char* eol = static_cast<char*>(memmem(line, body_start - line, "\r\n", 2));
+    if (!eol) break;
+    if (strncasecmp(line, "Content-Length:", 15) == 0)
+      content_len = strtoll(line + 15, nullptr, 10);
+    line = eol + 2;
+  }
+
+  char* out = static_cast<char*>(buf);
+  int64_t got = 0;
+  if (body_in_hdr > 0) {
+    if (body_in_hdr > buf_len) {
+      close(fd);
+      return TB_ETOOBIG;
+    }
+    memcpy(out, body_start, body_in_hdr);
+    got = body_in_hdr;
+  }
+  for (;;) {
+    if (content_len >= 0 && got >= content_len) break;
+    if (got >= buf_len) {
+      // Buffer full: with known length this is an error; with unknown
+      // length (close-delimited) it's also an error for our use.
+      close(fd);
+      return TB_ETOOBIG;
+    }
+    ssize_t k = recv(fd, out + got, buf_len - got, 0);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      int e = errno;
+      close(fd);
+      return -e;
+    }
+    if (k == 0) break;
+    if (first_byte_ns == 0) first_byte_ns = tb_now_ns();
+    got += k;
+  }
+  close(fd);
+  if (content_len >= 0 && got != content_len) return TB_EPROTO;
+  if (first_byte_ns_out) *first_byte_ns_out = first_byte_ns;
+  if (total_ns_out) *total_ns_out = tb_now_ns() - t_start;
+  return got;
+}
+
+}  // extern "C"

@@ -1,0 +1,238 @@
+"""ctypes wrapper over libtpubench.so."""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Optional
+
+import numpy as np
+
+from tpubench.native.build import build_library
+
+
+class NativeError(OSError):
+    pass
+
+
+_PROTO_ERRORS = {
+    -1001: "malformed HTTP response",
+    -1002: "body exceeds buffer",
+    -1003: "hostname resolution failed",
+}
+
+
+def _check(rc: int, what: str) -> int:
+    if rc < 0:
+        if rc in _PROTO_ERRORS:
+            raise NativeError(f"{what}: {_PROTO_ERRORS[rc]}")
+        import os
+
+        raise NativeError(f"{what}: {os.strerror(-rc)} (errno {-rc})")
+    return rc
+
+
+class AlignedBuffer:
+    """posix_memalign'd buffer exposed as numpy/memoryview, zero-copy.
+
+    O_DIRECT needs buffer alignment the Go reference never arranged
+    explicitly (SURVEY hard-part (e)); 4096 covers all common logical block
+    sizes. Also serves as the pre-registered receive buffer for the native
+    HTTP path.
+    """
+
+    def __init__(self, engine: "NativeEngine", size: int, align: int = 4096):
+        self._engine = engine
+        self.size = size
+        ptr = engine.lib.tb_alloc_aligned(size, align)
+        if not ptr:
+            raise MemoryError(f"aligned alloc of {size} failed")
+        self._ptr = ptr
+        self.array = np.ctypeslib.as_array(
+            ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)), shape=(size,)
+        )
+
+    @property
+    def address(self) -> int:
+        return self._ptr
+
+    def view(self, n: Optional[int] = None) -> memoryview:
+        return memoryview(self.array)[: self.size if n is None else n]
+
+    def free(self) -> None:
+        if self._ptr:
+            self._engine.lib.tb_free_aligned(self._ptr)
+            self._ptr = 0
+
+    def __del__(self):
+        try:
+            self.free()
+        except Exception:
+            pass
+
+
+class NativeEngine:
+    def __init__(self):
+        path = build_library()
+        lib = ctypes.CDLL(path)
+        c = ctypes
+        lib.tb_now_ns.restype = c.c_int64
+        lib.tb_alloc_aligned.restype = c.c_void_p
+        lib.tb_alloc_aligned.argtypes = [c.c_size_t, c.c_size_t]
+        lib.tb_free_aligned.argtypes = [c.c_void_p]
+        lib.tb_open.restype = c.c_int
+        lib.tb_open.argtypes = [c.c_char_p, c.c_int, c.POINTER(c.c_int)]
+        lib.tb_close.argtypes = [c.c_int]
+        lib.tb_file_size.restype = c.c_int64
+        lib.tb_file_size.argtypes = [c.c_char_p]
+        lib.tb_pread_blocks.restype = c.c_int64
+        lib.tb_pread_blocks.argtypes = [
+            c.c_int, c.c_void_p, c.c_int64,
+            c.POINTER(c.c_int64), c.c_int64, c.POINTER(c.c_int64),
+        ]
+        lib.tb_read_file_seq.restype = c.c_int64
+        lib.tb_read_file_seq.argtypes = [
+            c.c_int, c.c_void_p, c.c_int64, c.c_int64, c.POINTER(c.c_int64),
+        ]
+        lib.tb_pwrite_blocks.restype = c.c_int64
+        lib.tb_pwrite_blocks.argtypes = [
+            c.c_int, c.c_void_p, c.c_int64,
+            c.POINTER(c.c_int64), c.c_int64, c.c_int, c.POINTER(c.c_int64),
+        ]
+        lib.tb_fill_random.argtypes = [c.c_void_p, c.c_int64, c.c_uint64]
+        lib.tb_http_get.restype = c.c_int64
+        lib.tb_http_get.argtypes = [
+            c.c_char_p, c.c_int, c.c_char_p, c.c_char_p,
+            c.c_void_p, c.c_int64, c.POINTER(c.c_int),
+            c.POINTER(c.c_int64), c.POINTER(c.c_int64),
+        ]
+        self.lib = lib
+
+    # ------------------------------------------------------------ helpers --
+    def now_ns(self) -> int:
+        return self.lib.tb_now_ns()
+
+    def alloc(self, size: int, align: int = 4096) -> AlignedBuffer:
+        return AlignedBuffer(self, size, align)
+
+    def open(
+        self, path: str, write: bool = False, create: bool = False, direct: bool = False
+    ) -> tuple[int, bool]:
+        """Returns (fd, direct_applied). Falls back transparently when the
+        filesystem rejects O_DIRECT (tmpfs does), reporting the downgrade."""
+        flags = (1 if write else 0) | (2 if create else 0) | (4 if direct else 0)
+        applied = ctypes.c_int(0)
+        fd = self.lib.tb_open(path.encode(), flags, ctypes.byref(applied))
+        _check(fd, f"open {path}")
+        return fd, bool(applied.value)
+
+    def close(self, fd: int) -> None:
+        _check(self.lib.tb_close(fd), "close")
+
+    def file_size(self, path: str) -> int:
+        return _check(self.lib.tb_file_size(path.encode()), f"stat {path}")
+
+    def pread_blocks(
+        self, fd: int, buf: AlignedBuffer, block_size: int, offsets: np.ndarray
+    ) -> tuple[int, np.ndarray]:
+        """Timed block reads; returns (total_bytes, per-block ns latencies)."""
+        offs = np.ascontiguousarray(offsets, dtype=np.int64)
+        lat = np.zeros(len(offs), dtype=np.int64)
+        total = self.lib.tb_pread_blocks(
+            fd,
+            buf.address,
+            block_size,
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(offs),
+            lat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        _check(total, "pread_blocks")
+        return total, lat
+
+    def read_file_seq(
+        self, fd: int, buf: AlignedBuffer, passes: int = 1
+    ) -> tuple[int, np.ndarray]:
+        lat = np.zeros(passes, dtype=np.int64)
+        total = self.lib.tb_read_file_seq(
+            fd,
+            buf.address,
+            buf.size,
+            passes,
+            lat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        _check(total, "read_file_seq")
+        return total, lat
+
+    def pwrite_blocks(
+        self,
+        fd: int,
+        buf: AlignedBuffer,
+        block_size: int,
+        offsets: np.ndarray,
+        fsync_each: bool = True,
+    ) -> tuple[int, np.ndarray]:
+        offs = np.ascontiguousarray(offsets, dtype=np.int64)
+        lat = np.zeros(len(offs), dtype=np.int64)
+        total = self.lib.tb_pwrite_blocks(
+            fd,
+            buf.address,
+            block_size,
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(offs),
+            1 if fsync_each else 0,
+            lat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        _check(total, "pwrite_blocks")
+        return total, lat
+
+    def fill_random(self, buf: AlignedBuffer, n: Optional[int] = None, seed: int = 1):
+        self.lib.tb_fill_random(buf.address, buf.size if n is None else n, seed)
+
+    def http_get(
+        self,
+        host: str,
+        port: int,
+        path: str,
+        buf: AlignedBuffer,
+        headers: str = "",
+    ) -> dict:
+        """Native receive path: body streamed into ``buf``; returns status,
+        body length, first-byte and total ns."""
+        status = ctypes.c_int(0)
+        fb = ctypes.c_int64(0)
+        total_ns = ctypes.c_int64(0)
+        n = self.lib.tb_http_get(
+            host.encode(),
+            port,
+            path.encode(),
+            headers.encode(),
+            buf.address,
+            buf.size,
+            ctypes.byref(status),
+            ctypes.byref(fb),
+            ctypes.byref(total_ns),
+        )
+        _check(n, f"http_get {host}:{port}{path}")
+        return {
+            "status": status.value,
+            "length": n,
+            "first_byte_ns": fb.value,
+            "total_ns": total_ns.value,
+        }
+
+
+_engine: Optional[NativeEngine] = None
+_engine_error: Optional[BaseException] = None
+_engine_lock = threading.Lock()
+
+
+def get_engine() -> Optional[NativeEngine]:
+    """Singleton; None if the toolchain/build is unavailable."""
+    global _engine, _engine_error
+    with _engine_lock:
+        if _engine is None and _engine_error is None:
+            try:
+                _engine = NativeEngine()
+            except BaseException as e:  # noqa: BLE001
+                _engine_error = e
+        return _engine

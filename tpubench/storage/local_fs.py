@@ -1,0 +1,111 @@
+"""Local/FUSE filesystem backend (reference ``benchmark-script/`` L0 path).
+
+The reference's five FS drivers exercise a gcsfuse mount or local SSD
+through ``os.OpenFile`` + O_DIRECT. Here:
+
+* this backend implements the generic :class:`StorageBackend` protocol over
+  a directory root (objects = relative file paths) via ``pread`` — usable
+  anywhere the protocol is (read workload, pod ingest, staging);
+* the O_DIRECT *block-level* benchmarks (read_fs / write / ssd_compare
+  workloads) use :mod:`tpubench.native` directly, because O_DIRECT needs
+  aligned buffers the protocol's caller-owned granules can't guarantee
+  (SURVEY hard-part (e)).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from tpubench.storage.base import ObjectMeta, StorageError
+
+
+class _FileReader:
+    def __init__(self, fd: int, start: int, length: int):
+        self._fd = fd
+        self._pos = start
+        self._end = start + length
+        self.first_byte_ns: Optional[int] = None
+
+    def readinto(self, buf: memoryview) -> int:
+        import time
+
+        want = min(len(buf), self._end - self._pos)
+        if want <= 0:
+            return 0
+        try:
+            data = os.pread(self._fd, want, self._pos)
+        except OSError as e:
+            raise StorageError(f"pread failed: {e}", transient=False) from e
+        n = len(data)
+        if n == 0:
+            return 0
+        buf[:n] = data
+        if self.first_byte_ns is None:
+            self.first_byte_ns = time.perf_counter_ns()
+        self._pos += n
+        return n
+
+    def close(self) -> None:
+        os.close(self._fd)
+        self._fd = -1
+
+
+class LocalFsBackend:
+    def __init__(self, root: str):
+        if not root:
+            raise ValueError("local backend needs workload.dir")
+        self.root = root
+
+    def _path(self, name: str) -> str:
+        p = os.path.normpath(os.path.join(self.root, name))
+        if not p.startswith(os.path.normpath(self.root)):
+            raise StorageError(f"path escapes root: {name}", transient=False)
+        return p
+
+    def open_read(self, name: str, start: int = 0, length: Optional[int] = None):
+        path = self._path(name)
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except FileNotFoundError:
+            raise StorageError(f"object not found: {name}", transient=False, code=404)
+        except OSError as e:
+            raise StorageError(f"open failed: {e}", transient=False) from e
+        size = os.fstat(fd).st_size
+        end = size if length is None else min(start + length, size)
+        return _FileReader(fd, start, max(0, end - start))
+
+    def write(self, name: str, data: bytes) -> ObjectMeta:
+        path = self._path(name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        return ObjectMeta(name, len(data))
+
+    def list(self, prefix: str = "") -> list[ObjectMeta]:
+        out = []
+        for dirpath, _, files in os.walk(self.root):
+            for fname in files:
+                full = os.path.join(dirpath, fname)
+                rel = os.path.relpath(full, self.root)
+                if rel.startswith(prefix):
+                    out.append(ObjectMeta(rel, os.path.getsize(full)))
+        return sorted(out, key=lambda m: m.name)
+
+    def stat(self, name: str) -> ObjectMeta:
+        path = self._path(name)
+        try:
+            return ObjectMeta(name, os.path.getsize(path))
+        except FileNotFoundError:
+            raise StorageError(f"object not found: {name}", transient=False, code=404)
+
+    def delete(self, name: str) -> None:
+        try:
+            os.remove(self._path(name))
+        except FileNotFoundError:
+            raise StorageError(f"object not found: {name}", transient=False, code=404)
+
+    def close(self) -> None:
+        pass
