@@ -1,0 +1,131 @@
+"""FS-path workloads against tmp dirs (hermetic stand-in for the gcsfuse
+mount / local SSD the reference requires)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpubench.config import BenchConfig
+from tpubench.native import get_engine
+from tpubench.storage.base import deterministic_bytes
+from tpubench.workloads.fsbench import (
+    prepare_files,
+    run_listing,
+    run_open_file,
+    run_read_fs,
+    run_ssd_compare,
+    run_write,
+)
+
+pytestmark = pytest.mark.skipif(
+    get_engine() is None, reason="native engine unavailable"
+)
+
+
+def base_cfg(tmp_path, threads=2) -> BenchConfig:
+    cfg = BenchConfig()
+    cfg.workload.dir = str(tmp_path)
+    cfg.workload.threads = threads
+    cfg.workload.block_size_kb = 4
+    cfg.workload.file_size_mb = 1
+    return cfg
+
+
+def test_read_fs(tmp_path):
+    cfg = base_cfg(tmp_path)
+    cfg.workload.read_count = 3
+    prepare_files(str(tmp_path), 2, 1024 * 1024)
+    res = run_read_fs(cfg, direct=False)
+    assert res.bytes_total == 2 * 3 * 1024 * 1024  # re-reads actually re-read
+    assert res.summaries["pass"].count == 6
+    assert res.gbps > 0
+
+
+def test_write_durable(tmp_path):
+    cfg = base_cfg(tmp_path)
+    cfg.workload.write_count = 2
+    cfg.workload.fsync_every_block = True
+    res = run_write(cfg, direct=False)
+    blocks = (1024 * 1024) // 4096
+    assert res.bytes_total == 2 * 2 * 1024 * 1024
+    assert res.summaries["block_write"].count == 2 * 2 * blocks
+    for i in range(2):
+        assert os.path.getsize(tmp_path / f"file_{i}") == 1024 * 1024
+
+
+def test_write_no_fsync_faster(tmp_path):
+    cfg = base_cfg(tmp_path, threads=1)
+    cfg.workload.write_count = 1
+    cfg.workload.fsync_every_block = True
+    durable = run_write(cfg, direct=False).summaries["block_write"].p50_ms
+    cfg.workload.fsync_every_block = False
+    fast = run_write(cfg, direct=False).summaries["block_write"].p50_ms
+    assert fast <= durable * 1.5 + 0.05  # fsync path must not be cheaper
+
+
+def test_listing(tmp_path):
+    prepare_files(str(tmp_path), 10, 1000)
+    cfg = base_cfg(tmp_path)
+    res = run_listing(cfg, rounds=3)
+    assert res.extra["entries"] == 10
+    assert res.summaries["list"].count == 3
+
+
+def test_open_file_hold(tmp_path):
+    prepare_files(str(tmp_path), 5, 1000)
+    cfg = base_cfg(tmp_path)
+    cfg.workload.open_files = 5
+    cfg.workload.hold_seconds = 0.05
+    res = run_open_file(cfg, direct=False)
+    assert res.extra["open_files"] == 5
+    assert res.summaries["open"].count == 5
+    assert res.wall_seconds >= 0.05
+
+
+@pytest.mark.parametrize("read_type", ["seq", "random"])
+def test_ssd_compare(tmp_path, read_type):
+    cfg = base_cfg(tmp_path)
+    cfg.workload.read_type = read_type
+    cfg.workload.read_count = 2
+    fsize = 1024 * 1024
+    for i in range(2):
+        d = tmp_path / f"Workload.{i}"
+        d.mkdir()
+        (d / "0").write_bytes(deterministic_bytes(f"ssd/{i}", fsize).tobytes())
+    res = run_ssd_compare(cfg, direct=False)
+    blocks = fsize // 4096
+    assert res.bytes_total == 2 * 2 * fsize
+    assert res.summaries["block_read"].count == 2 * 2 * blocks
+    assert res.extra["read_type"] == read_type
+    # ssd_test report block shape (main.go:157-163)
+    block = res.format()
+    for key in ("P20:", "P50:", "P90:", "p99:"):
+        assert key in block
+
+
+def test_ssd_compare_size_validation(tmp_path):
+    cfg = base_cfg(tmp_path)
+    d = tmp_path / "Workload.0"
+    d.mkdir()
+    (d / "0").write_bytes(b"short")
+    cfg.workload.threads = 1
+    from tpubench.workloads.common import WorkerError
+
+    with pytest.raises(WorkerError):
+        run_ssd_compare(cfg, direct=False)
+
+
+def test_ssd_random_pattern_deterministic(tmp_path):
+    """Same seed → same shared offset pattern (reference used global rand
+    with no seed control)."""
+    cfg = base_cfg(tmp_path, threads=1)
+    cfg.workload.read_type = "random"
+    cfg.workload.read_count = 1
+    fsize = 1024 * 1024
+    d = tmp_path / "Workload.0"
+    d.mkdir()
+    (d / "0").write_bytes(deterministic_bytes("ssd/0", fsize).tobytes())
+    r1 = run_ssd_compare(cfg, direct=False)
+    r2 = run_ssd_compare(cfg, direct=False)
+    assert r1.bytes_total == r2.bytes_total
