@@ -250,12 +250,13 @@ int64_t tb_http_get(const char* host, int port, const char* path,
 
   // Read headers (into a bounded scratch), find \r\n\r\n.
   char hdr[16384];
+  const int hdr_cap = static_cast<int>(sizeof hdr) - 1;  // reserve NUL slot
   int hlen = 0;
   char* body_start = nullptr;
   int body_in_hdr = 0;
   int64_t first_byte_ns = 0;
-  while (hlen < static_cast<int>(sizeof hdr)) {
-    ssize_t k = recv(fd, hdr + hlen, sizeof hdr - hlen, 0);
+  while (hlen < hdr_cap) {
+    ssize_t k = recv(fd, hdr + hlen, hdr_cap - hlen, 0);
     if (k < 0) {
       if (errno == EINTR) continue;
       int e = errno;
@@ -265,7 +266,7 @@ int64_t tb_http_get(const char* host, int port, const char* path,
     if (k == 0) break;
     if (first_byte_ns == 0) first_byte_ns = tb_now_ns();
     hlen += k;
-    hdr[hlen < static_cast<int>(sizeof hdr) ? hlen : hlen - 1] = 0;
+    hdr[hlen] = 0;
     char* p = static_cast<char*>(memmem(hdr, hlen, "\r\n\r\n", 4));
     if (p) {
       body_start = p + 4;
