@@ -1,0 +1,65 @@
+"""Pallas landing kernels in interpret mode on CPU (compiled on TPU)."""
+
+import numpy as np
+import pytest
+
+from tpubench.config import BenchConfig
+from tpubench.storage.base import deterministic_bytes
+
+
+@pytest.fixture(autouse=True)
+def _need_devices(jax_cpu_devices):
+    pass
+
+
+def test_pallas_checksum_matches_numpy():
+    from tpubench.staging.pallas_stage import pallas_checksum
+
+    x = deterministic_bytes("pallas/a", 512 * 128 * 3).reshape(-1, 128)
+    import jax
+
+    got = int(pallas_checksum(jax.device_put(x)))
+    assert got == int(x.astype(np.uint32).sum()) % (1 << 32)
+
+
+def test_pallas_land_copy_and_checksum():
+    import jax
+
+    from tpubench.staging.pallas_stage import pallas_land
+
+    x = deterministic_bytes("pallas/b", 512 * 128 * 2).reshape(-1, 128)
+    landed, csum = pallas_land(jax.device_put(x))
+    assert np.array_equal(np.asarray(landed), x)
+    assert int(csum) == int(x.astype(np.uint32).sum()) % (1 << 32)
+
+
+def test_pallas_stager_roundtrip():
+    from tpubench.staging.pallas_stage import PallasStager
+
+    data = deterministic_bytes("pallas/c", 300_000)
+    st = PallasStager(0, granule_bytes=64 * 1024)
+    mv = memoryview(data.tobytes())
+    off = 0
+    while off < len(mv):
+        st.submit(mv[off : off + 64 * 1024])
+        off += 64 * 1024
+    stats = st.finish()
+    assert stats["staged_bytes"] == 300_000
+    assert stats["checksum_ok"], stats
+
+
+def test_read_workload_with_pallas_staging():
+    from tpubench.staging.device import make_sink_factory
+    from tpubench.workloads.read import run_read
+
+    cfg = BenchConfig()
+    cfg.workload.workers = 2
+    cfg.workload.read_calls_per_worker = 1
+    cfg.workload.object_size = 150_000
+    cfg.workload.granule_bytes = 64 * 1024
+    cfg.transport.protocol = "fake"
+    cfg.staging.mode = "pallas"
+    res = run_read(cfg, sink_factory=make_sink_factory(cfg))
+    assert res.errors == 0
+    assert res.extra["staged_bytes"] == 2 * 150_000
+    assert res.extra["checksum_ok"] is True
