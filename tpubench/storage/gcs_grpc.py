@@ -1,0 +1,281 @@
+"""GCS gRPC (storage v2) backend.
+
+Reference parity (``CreateGrpcClient``, main.go:106-117):
+
+* **DirectPath**: ``GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS=true`` is set for
+  the duration of channel creation and then restored (main.go:107-113); the
+  xds bootstrap happens inside grpc-core exactly as the Go rls/xds blank
+  imports arrange it (main.go:24-26).
+* **Single-connection pool**: ``GrpcConnPoolSize = 1`` (main.go:30,111) —
+  one shared channel by default; >1 round-robins.
+* **2 MB chunking**: the gRPC server streams ``ReadObjectResponse`` messages
+  of ≤2 MiB — the documented reason the reference sized its copy buffer at
+  2 MB (comment main.go:123-125). The reader hands each message's bytes out
+  through ``readinto`` without re-buffering whole objects.
+
+Built on the raw generated stubs (``google.cloud._storage_v2.types``) over a
+bare channel rather than the GAPIC client, so the hermetic fake server
+(:mod:`fake_grpc_server`) and the benchmark share one code path and the
+hot loop has no client-library overhead in it.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Optional
+
+import grpc
+
+from tpubench.config import TransportConfig
+from tpubench.storage.base import ObjectMeta, StorageError
+
+from google.cloud._storage_v2 import types as s2
+
+_SVC = "/google.storage.v2.Storage"
+
+_TRANSIENT_CODES = {
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+    grpc.StatusCode.ABORTED,
+    grpc.StatusCode.INTERNAL,
+}
+
+# gRPC server chunk ceiling (storage v2 ServiceConstants.MAX_READ_CHUNK_BYTES
+# is 2 MiB) — mirrored by the fake server.
+MAX_READ_CHUNK = 2 * 1024 * 1024
+
+
+def _wrap_rpc_error(e: grpc.RpcError, what: str) -> StorageError:
+    code = e.code() if hasattr(e, "code") else None
+    transient = code in _TRANSIENT_CODES
+    http_ish = {
+        grpc.StatusCode.NOT_FOUND: 404,
+        grpc.StatusCode.UNAVAILABLE: 503,
+        grpc.StatusCode.OUT_OF_RANGE: 416,
+    }.get(code, 0)
+    return StorageError(
+        f"{what}: {code} {e.details() if hasattr(e, 'details') else e}",
+        transient=transient,
+        code=http_ish,
+    )
+
+
+class _GrpcReader:
+    """Streams ReadObjectResponse messages; leftover message bytes are
+    carried between ``readinto`` calls (no whole-object buffering)."""
+
+    def __init__(self, stream):
+        self._stream = stream
+        self._pending = memoryview(b"")
+        self.first_byte_ns: Optional[int] = None
+        self._done = False
+
+    def readinto(self, buf: memoryview) -> int:
+        if self._done and not self._pending:
+            return 0
+        if not self._pending:
+            try:
+                msg = next(self._stream, None)
+            except grpc.RpcError as e:
+                self._done = True
+                raise _wrap_rpc_error(e, "ReadObject stream") from e
+            if msg is None:
+                self._done = True
+                return 0
+            content = bytes(msg.checksummed_data.content)
+            if self.first_byte_ns is None:
+                self.first_byte_ns = time.perf_counter_ns()
+            self._pending = memoryview(content)
+            if not content:
+                return self.readinto(buf)
+        n = min(len(buf), len(self._pending))
+        buf[:n] = self._pending[:n]
+        self._pending = self._pending[n:]
+        return n
+
+    def close(self) -> None:
+        try:
+            self._stream.cancel()
+        except Exception:
+            pass
+        self._done = True
+
+
+class GcsGrpcBackend:
+    def __init__(
+        self,
+        bucket: str,
+        transport: Optional[TransportConfig] = None,
+        channel: Optional[grpc.Channel] = None,
+    ):
+        self.bucket = bucket
+        self.transport = transport or TransportConfig()
+        n = max(1, self.transport.grpc_conn_pool_size)
+        if channel is not None:
+            self._channels = [channel]
+            self._owns_channels = False
+        else:
+            self._channels = [self._make_channel() for _ in range(n)]
+            self._owns_channels = True
+        self._rr = itertools.cycle(range(len(self._channels)))
+        self._rr_lock = threading.Lock()
+        self._stubs = [self._make_stubs(ch) for ch in self._channels]
+
+    # ----------------------------------------------------------- channel --
+    def _make_channel(self) -> grpc.Channel:
+        endpoint = self.transport.endpoint or "storage.googleapis.com:443"
+        opts = [
+            ("grpc.max_receive_message_length", 16 * 1024 * 1024),
+            ("grpc.keepalive_time_ms", 30000),
+        ]
+        saved = os.environ.get("GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS")
+        try:
+            if self.transport.directpath:
+                # main.go:107: set only around client creation.
+                os.environ["GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS"] = "true"
+            if endpoint.startswith("insecure://"):
+                return grpc.insecure_channel(endpoint[len("insecure://"):], opts)
+            creds = grpc.ssl_channel_credentials()
+            if "googleapis.com" in endpoint:
+                import google.auth
+                import google.auth.transport.grpc
+                import google.auth.transport.requests
+
+                from tpubench.storage.auth import GCS_SCOPE
+
+                gcreds, _ = google.auth.default(scopes=[GCS_SCOPE])
+                call_creds = grpc.metadata_call_credentials(
+                    google.auth.transport.grpc.AuthMetadataPlugin(
+                        gcreds, google.auth.transport.requests.Request()
+                    )
+                )
+                creds = grpc.composite_channel_credentials(creds, call_creds)
+            return grpc.secure_channel(endpoint, creds, opts)
+        finally:
+            if self.transport.directpath:
+                if saved is None:
+                    os.environ.pop("GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS", None)
+                else:
+                    os.environ["GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS"] = saved
+
+    def _make_stubs(self, ch: grpc.Channel) -> dict:
+        return {
+            "read": ch.unary_stream(
+                f"{_SVC}/ReadObject",
+                request_serializer=s2.ReadObjectRequest.serialize,
+                response_deserializer=s2.ReadObjectResponse.deserialize,
+            ),
+            "get": ch.unary_unary(
+                f"{_SVC}/GetObject",
+                request_serializer=s2.GetObjectRequest.serialize,
+                response_deserializer=s2.Object.deserialize,
+            ),
+            "list": ch.unary_unary(
+                f"{_SVC}/ListObjects",
+                request_serializer=s2.ListObjectsRequest.serialize,
+                response_deserializer=s2.ListObjectsResponse.deserialize,
+            ),
+            "delete": ch.unary_unary(
+                f"{_SVC}/DeleteObject",
+                request_serializer=s2.DeleteObjectRequest.serialize,
+                response_deserializer=_empty_deserializer,
+            ),
+            "write": ch.stream_unary(
+                f"{_SVC}/WriteObject",
+                request_serializer=s2.WriteObjectRequest.serialize,
+                response_deserializer=s2.WriteObjectResponse.deserialize,
+            ),
+        }
+
+    def _stub(self) -> dict:
+        with self._rr_lock:
+            return self._stubs[next(self._rr)]
+
+    @property
+    def _bucket_path(self) -> str:
+        return f"projects/_/buckets/{self.bucket}"
+
+    # ----------------------------------------------------------- backend --
+    def open_read(self, name: str, start: int = 0, length: Optional[int] = None):
+        req = s2.ReadObjectRequest(
+            bucket=self._bucket_path,
+            object_=name,
+            read_offset=start,
+            read_limit=length or 0,
+        )
+        try:
+            stream = self._stub()["read"](req)
+        except grpc.RpcError as e:  # pragma: no cover - connect-time failure
+            raise _wrap_rpc_error(e, f"ReadObject {name}") from e
+        return _GrpcReader(stream)
+
+    def write(self, name: str, data: bytes) -> ObjectMeta:
+        def requests():
+            spec = s2.WriteObjectSpec(
+                resource=s2.Object(name=name, bucket=self._bucket_path)
+            )
+            data_mv = memoryview(bytes(data))
+            if not data_mv:
+                yield s2.WriteObjectRequest(
+                    write_object_spec=spec, write_offset=0, finish_write=True
+                )
+                return
+            off = 0
+            first = True
+            while off < len(data_mv):
+                chunk = data_mv[off : off + MAX_READ_CHUNK]
+                last = off + len(chunk) >= len(data_mv)
+                req = s2.WriteObjectRequest(
+                    write_offset=off,
+                    checksummed_data=s2.ChecksummedData(content=bytes(chunk)),
+                    finish_write=last,
+                )
+                if first:
+                    req.write_object_spec = spec
+                    first = False
+                off += len(chunk)
+                yield req
+
+        try:
+            resp = self._stub()["write"](requests())
+        except grpc.RpcError as e:
+            raise _wrap_rpc_error(e, f"WriteObject {name}") from e
+        return ObjectMeta(resp.resource.name, int(resp.resource.size))
+
+    def list(self, prefix: str = "") -> list[ObjectMeta]:
+        req = s2.ListObjectsRequest(parent=self._bucket_path, prefix=prefix)
+        try:
+            resp = self._stub()["list"](req)
+        except grpc.RpcError as e:
+            raise _wrap_rpc_error(e, "ListObjects") from e
+        return [
+            ObjectMeta(o.name, int(o.size), int(o.generation)) for o in resp.objects
+        ]
+
+    def stat(self, name: str) -> ObjectMeta:
+        req = s2.GetObjectRequest(bucket=self._bucket_path, object_=name)
+        try:
+            o = self._stub()["get"](req)
+        except grpc.RpcError as e:
+            raise _wrap_rpc_error(e, f"GetObject {name}") from e
+        return ObjectMeta(o.name, int(o.size), int(o.generation))
+
+    def delete(self, name: str) -> None:
+        req = s2.DeleteObjectRequest(bucket=self._bucket_path, object_=name)
+        try:
+            self._stub()["delete"](req)
+        except grpc.RpcError as e:
+            raise _wrap_rpc_error(e, f"DeleteObject {name}") from e
+
+    def close(self) -> None:
+        if self._owns_channels:
+            for ch in self._channels:
+                ch.close()
+
+
+def _empty_deserializer(b: bytes):
+    return b
