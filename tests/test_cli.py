@@ -1,0 +1,109 @@
+import json
+import os
+
+import pytest
+
+from tpubench.cli import main
+
+
+def test_cli_read_smoke(tmp_path, capsys):
+    rc = main(
+        [
+            "read",
+            "--preset",
+            "smoke",
+            "--staging",
+            "none",
+            "--results-dir",
+            str(tmp_path),
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "tpubench read" in out and "P50:" in out
+    files = os.listdir(tmp_path)
+    assert len(files) == 1
+    with open(tmp_path / files[0]) as f:
+        data = json.load(f)
+    assert data["workload"] == "read" and data["errors"] == 0
+
+
+def test_cli_fs_workloads(tmp_path, capsys):
+    d = tmp_path / "data"
+    rc = main(
+        ["prepare", "--dir", str(d), "--threads", "2", "--file-size-mb", "1",
+         "--open-files", "2"]
+    )
+    assert rc == 0
+    for cmd in ("read-fs", "open", "list"):
+        rc = main(
+            [cmd, "--dir", str(d), "--threads", "2", "--file-size-mb", "1",
+             "--block-size", "4", "--read-count", "1", "--open-files", "2",
+             "--no-direct", "--results-dir", str(tmp_path / "res")]
+        )
+        assert rc == 0, cmd
+    rc = main(
+        ["write", "--dir", str(tmp_path / "w"), "--threads", "1",
+         "--file-size-mb", "1", "--block-size", "64", "--no-direct",
+         "--results-dir", str(tmp_path / "res")]
+    )
+    assert rc == 0
+    os.makedirs(tmp_path / "w", exist_ok=True)
+
+
+def test_cli_ssd(tmp_path, capsys):
+    d = tmp_path / "ssd"
+    rc = main(
+        ["prepare", "--layout", "ssd", "--dir", str(d), "--threads", "2",
+         "--file-size-mb", "1"]
+    )
+    assert rc == 0
+    rc = main(
+        ["ssd", "--dir", str(d), "--threads", "2", "--file-size-mb", "1",
+         "--block-size", "4", "--read-count", "1", "--read-type", "random",
+         "--no-direct", "--results-dir", str(tmp_path / "res")]
+    )
+    assert rc == 0
+    assert "p99:" in capsys.readouterr().out
+
+
+def test_cli_pod_ingest(tmp_path, capsys, jax_cpu_devices):
+    rc = main(
+        ["pod-ingest", "--protocol", "fake", "--object-size", "100000",
+         "--workers", "1", "--results-dir", str(tmp_path)]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "pod_ingest" in out
+
+
+def test_cli_save_and_load_config(tmp_path, capsys):
+    cfgfile = str(tmp_path / "cfg.json")
+    rc = main(["read", "--preset", "smoke", "--workers", "3", "--save-config", cfgfile])
+    assert rc == 0
+    rc = main(
+        ["read", "--config", cfgfile, "--staging", "none",
+         "--results-dir", str(tmp_path / "res")]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "tpubench read" in out
+
+
+def test_cli_info(capsys):
+    rc = main(["info", "--preset", "smoke"])
+    assert rc == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["transport"]["protocol"] == "fake"
+
+
+def test_cli_sweep_fake(tmp_path, capsys):
+    rc = main(
+        ["sweep", "--protocol", "fake", "--sweep-protocols", "fake",
+         "--sweep-sizes", "256kb", "--workers", "2",
+         "--read-call-per-worker", "2", "--staging", "none",
+         "--results-dir", str(tmp_path)]
+    )
+    assert rc == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert rows[0]["protocol"] == "fake" and rows[0]["gbps"] > 0

@@ -1,0 +1,66 @@
+import json
+import time
+
+from tpubench.obs.exporters import (
+    DEFAULT_LATENCY_BUCKETS_MS,
+    CloudMonitoringExporter,
+    LatencyDistribution,
+    PeriodicExporter,
+    SnapshotWriter,
+)
+
+
+def test_latency_distribution_buckets():
+    d = LatencyDistribution()
+    d.record_many_ms([0.5, 1.5, 7, 9999, 1e6])
+    assert d.count == 5
+    # 0.5 → bucket 0 (<1), 1.5 → bucket 1 (1..2), 1e6 → overflow bucket
+    assert d.counts[0] == 1
+    assert d.counts[1] == 1
+    assert d.counts[-1] == 1
+    assert d.mean_ms > 0
+    assert len(d.counts) == len(DEFAULT_LATENCY_BUCKETS_MS) + 1
+
+
+def test_cloud_monitoring_dry_run():
+    ex = CloudMonitoringExporter("proj", "custom.googleapis.com/tpubench/", dry_run=True)
+    ex.export_point("read_gbps", 1.5, {"proto": "http"})
+    d = LatencyDistribution()
+    d.record_many_ms([5, 10])
+    ex.export_distribution("read_latency", d)
+    assert len(ex.exported) == 2
+    assert ex.exported[0]["type"] == "custom.googleapis.com/tpubench/read_gbps"
+    assert ex.exported[1]["distribution"]["count"] == 2
+
+
+def test_periodic_exporter_final_flush():
+    """The reference's shadowed-exporter bug skipped the final flush
+    (metrics_exporter.go:37); ours must always flush on close."""
+    calls = []
+    p = PeriodicExporter(lambda: calls.append(time.time()), interval_s=3600)
+    p.start()
+    p.close()
+    assert len(calls) == 1  # no interval fired; final flush did
+
+
+def test_periodic_exporter_interval():
+    calls = []
+    with PeriodicExporter(lambda: calls.append(1), interval_s=0.05):
+        time.sleep(0.18)
+    assert len(calls) >= 3
+
+
+def test_snapshot_writer_atomic(tmp_path):
+    path = str(tmp_path / "snap.json")
+    state = {"n": 0}
+
+    def snap():
+        state["n"] += 1
+        return {"latencies": state["n"]}
+
+    with SnapshotWriter(snap, path, interval_s=0.05):
+        time.sleep(0.12)
+    with open(path) as f:
+        data = json.load(f)
+    assert data["latencies"] >= 2
+    assert "time" in data and data["process_index"] == 0

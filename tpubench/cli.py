@@ -1,0 +1,273 @@
+"""tpubench CLI — replaces the reference's shell layer (SURVEY L5).
+
+The reference drives everything through per-binary flags and hardcoded
+shell launchers (``execute_pb.sh``, ``benchmark-script/*/*.sh``) that mount
+gcsfuse, sweep file sizes and A/B the two protocols by redirecting stdout.
+Here one CLI owns all of it:
+
+* every workload is a subcommand (``read``, ``pod-ingest``, ``read-fs``,
+  ``write``, ``list``, ``open``, ``ssd``);
+* ``sweep`` reproduces the protocol A/B pairing of ``execute_pb.sh`` and the
+  256KB/1MB/100MB/1GB file-size sweep of ``read_operations.sh:8-14`` with
+  first-class JSON results instead of ``tr``-munged stdout;
+* ``prepare`` generates worker-indexed data files (the reference assumes
+  they already exist in the bucket/mount, README.md:9);
+* ``--config`` loads/saves the full BenchConfig as JSON — no editing source
+  to change the object prefix (main.go:50-53).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpubench.config import KB, MB, BenchConfig, preset
+from tpubench.metrics.report import RunResult, write_result
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", help="JSON config file (BenchConfig.to_json)")
+    p.add_argument("--preset", choices=("256kb", "1mb", "100mb", "1gb", "smoke"))
+    p.add_argument("--protocol", choices=("http", "grpc", "local", "fake"))
+    p.add_argument("--bucket")
+    p.add_argument("--project")
+    p.add_argument("--endpoint", help="override API endpoint (fake servers)")
+    p.add_argument("--dir", help="directory for local/FS workloads")
+    p.add_argument("--workers", type=int)
+    p.add_argument("--read-call-per-worker", type=int, dest="read_calls")
+    p.add_argument("--threads", type=int)
+    p.add_argument("--read-count", type=int)
+    p.add_argument("--write-count", type=int)
+    p.add_argument("--block-size", type=int, help="KB", dest="block_size_kb")
+    p.add_argument("--file-size-mb", type=int)
+    p.add_argument("--object-size", type=int, help="bytes (fake backend)")
+    p.add_argument("--object-name-prefix")
+    p.add_argument("--read-type", choices=("seq", "random"))
+    p.add_argument("--open-files", type=int)
+    p.add_argument("--staging", choices=("none", "device_put", "pallas"))
+    p.add_argument("--no-double-buffer", action="store_true")
+    p.add_argument("--validate", action="store_true", help="on-device checksum")
+    p.add_argument("--enable-tracing", action="store_true")
+    p.add_argument("--trace-sample-rate", type=float)
+    p.add_argument("--results-dir")
+    p.add_argument("--no-abort-on-error", action="store_true",
+                   help="per-worker failure domains instead of errgroup abort")
+    p.add_argument("--no-direct", action="store_true", help="skip O_DIRECT")
+    p.add_argument("--ring", action="store_true",
+                   help="pod-ingest: explicit ppermute ring instead of all_gather")
+    p.add_argument("--save-config", help="write effective config JSON and exit")
+
+
+def build_config(args) -> BenchConfig:
+    if args.config:
+        with open(args.config) as f:
+            cfg = BenchConfig.from_json(f.read())
+    elif args.preset:
+        cfg = preset(args.preset)
+    else:
+        cfg = BenchConfig()
+    w, t, s, o = cfg.workload, cfg.transport, cfg.staging, cfg.obs
+    if args.preset and args.config:
+        raise SystemExit("--preset and --config are mutually exclusive")
+    for attr, dest in (
+        ("bucket", "bucket"), ("project", "project"), ("dir", "dir"),
+        ("workers", "workers"), ("read_calls", "read_calls_per_worker"),
+        ("threads", "threads"), ("read_count", "read_count"),
+        ("write_count", "write_count"), ("block_size_kb", "block_size_kb"),
+        ("file_size_mb", "file_size_mb"), ("object_size", "object_size"),
+        ("object_name_prefix", "object_name_prefix"), ("read_type", "read_type"),
+        ("open_files", "open_files"),
+    ):
+        v = getattr(args, attr, None)
+        if v is not None:
+            setattr(w, dest, v)
+    if args.protocol:
+        t.protocol = args.protocol
+    if args.endpoint:
+        t.endpoint = args.endpoint
+    if args.staging:
+        s.mode = args.staging
+    if args.no_double_buffer:
+        s.double_buffer = False
+    if args.validate:
+        s.validate_checksum = True
+    if args.enable_tracing:
+        o.enable_tracing = True
+    if args.trace_sample_rate is not None:
+        o.trace_sample_rate = args.trace_sample_rate
+    if args.results_dir:
+        o.results_dir = args.results_dir
+    if args.no_abort_on_error:
+        w.abort_on_error = False
+    return cfg
+
+
+def _finish(res: RunResult, cfg: BenchConfig, quiet: bool = False) -> None:
+    path = write_result(res, cfg.obs.results_dir)
+    if not quiet:
+        print(res.format())
+        print(f"result: {path}")
+
+
+def cmd_read(cfg: BenchConfig, args) -> RunResult:
+    from tpubench.obs.tracing import make_tracer
+    from tpubench.staging.device import make_sink_factory
+    from tpubench.workloads.read import run_read
+
+    return run_read(
+        cfg, tracer=make_tracer(cfg), sink_factory=make_sink_factory(cfg)
+    )
+
+
+def cmd_pod_ingest(cfg: BenchConfig, args) -> RunResult:
+    from tpubench.workloads.pod_ingest import run_pod_ingest
+
+    return run_pod_ingest(cfg, ring=args.ring)
+
+
+def cmd_prepare(cfg: BenchConfig, args) -> None:
+    from tpubench.workloads.fsbench import prepare_files
+
+    w = cfg.workload
+    if args.layout == "flat":
+        prepare_files(w.dir, max(w.threads, w.open_files), w.file_size_mb * MB)
+    else:  # ssd_test layout: Workload.<i>/0
+        import os
+
+        from tpubench.storage.base import deterministic_bytes
+
+        for i in range(w.threads):
+            d = os.path.join(w.dir, f"Workload.{i}")
+            os.makedirs(d, exist_ok=True)
+            p = os.path.join(d, "0")
+            size = w.file_size_mb * MB
+            if not (os.path.exists(p) and os.path.getsize(p) == size):
+                with open(p, "wb") as f:
+                    f.write(deterministic_bytes(f"Workload.{i}/0", size).tobytes())
+    print(f"prepared files under {w.dir}")
+
+
+def cmd_sweep(cfg: BenchConfig, args) -> None:
+    """Protocol A/B × size sweep (execute_pb.sh + read_operations.sh:8-14)."""
+    from tpubench.workloads.read import run_read
+
+    protocols = args.sweep_protocols.split(",")
+    sizes = {
+        "256kb": (256 * KB, 1000),
+        "1mb": (1 * MB, 100),
+        "100mb": (100 * MB, 10),
+        "1gb": (1024 * MB, 1),
+    }
+    chosen = args.sweep_sizes.split(",") if args.sweep_sizes else list(sizes)
+    rows = []
+    for proto in protocols:
+        for sz in chosen:
+            size, count = sizes[sz]
+            c = BenchConfig.from_dict(cfg.to_dict())
+            c.transport.protocol = proto
+            c.workload.object_size = size
+            c.workload.read_calls_per_worker = min(
+                count, c.workload.read_calls_per_worker
+            )
+            res = cmd_read(c, args)
+            res.extra["sweep"] = {"protocol": proto, "size": sz}
+            path = write_result(res, cfg.obs.results_dir)
+            rows.append(
+                {
+                    "protocol": proto,
+                    "size": sz,
+                    "gbps": res.gbps,
+                    "p50_ms": res.summaries["read"].p50_ms,
+                    "p99_ms": res.summaries["read"].p99_ms,
+                    "result": path,
+                }
+            )
+    print(json.dumps(rows, indent=2))
+
+
+def main(argv=None) -> int:
+    top = argparse.ArgumentParser(prog="tpubench", description=__doc__)
+    sub = top.add_subparsers(dest="cmd", required=True)
+
+    def add(name, help_):
+        p = sub.add_parser(name, help=help_)
+        _add_common(p)
+        return p
+
+    add("read", "root GCS read bench (reference main.go)")
+    add("pod-ingest", "sharded object → pod HBM with ICI all-gather")
+    fs = {
+        "read-fs": "sequential FS read (read_operation)",
+        "write": "durable write (write_operations)",
+        "list": "listing bench (list_operation)",
+        "open": "open/FD-hold bench (open_file)",
+        "ssd": "block-latency percentiles (ssd_test)",
+    }
+    for name, help_ in fs.items():
+        add(name, help_)
+    prep = add("prepare", "generate worker-indexed data files")
+    prep.add_argument("--layout", choices=("flat", "ssd"), default="flat")
+    sweep = add("sweep", "protocol A/B × size sweep (execute_pb.sh)")
+    sweep.add_argument("--sweep-protocols", default="http,grpc")
+    sweep.add_argument("--sweep-sizes", default="")
+    add("info", "print effective config and environment")
+
+    args = top.parse_args(argv)
+    cfg = build_config(args)
+
+    if args.save_config:
+        with open(args.save_config, "w") as f:
+            f.write(cfg.to_json())
+        print(f"config written: {args.save_config}")
+        return 0
+
+    if args.cmd == "info":
+        print(cfg.to_json())
+        try:
+            import jax
+
+            print(f"devices: {jax.devices()}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"jax unavailable: {e}", file=sys.stderr)
+        return 0
+    if args.cmd == "prepare":
+        cmd_prepare(cfg, args)
+        return 0
+    if args.cmd == "sweep":
+        cmd_sweep(cfg, args)
+        return 0
+
+    direct = not args.no_direct
+    if args.cmd == "read":
+        res = cmd_read(cfg, args)
+    elif args.cmd == "pod-ingest":
+        res = cmd_pod_ingest(cfg, args)
+    elif args.cmd == "read-fs":
+        from tpubench.workloads.fsbench import run_read_fs
+
+        res = run_read_fs(cfg, direct=direct)
+    elif args.cmd == "write":
+        from tpubench.workloads.fsbench import run_write
+
+        res = run_write(cfg, direct=direct)
+    elif args.cmd == "list":
+        from tpubench.workloads.fsbench import run_listing
+
+        res = run_listing(cfg)
+    elif args.cmd == "open":
+        from tpubench.workloads.fsbench import run_open_file
+
+        res = run_open_file(cfg, direct=direct)
+    elif args.cmd == "ssd":
+        from tpubench.workloads.fsbench import run_ssd_compare
+
+        res = run_ssd_compare(cfg, direct=direct)
+    else:  # pragma: no cover
+        raise SystemExit(f"unknown cmd {args.cmd}")
+    _finish(res, cfg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -114,6 +114,7 @@ def run_write(cfg: BenchConfig, direct: bool = True) -> RunResult:
     (O_TRUNC reopen each round, :36)."""
     w = cfg.workload
     eng = _engine_or_raise()
+    os.makedirs(w.dir, exist_ok=True)
     n = w.threads
     block = w.block_size_kb * KB
     fsize = w.file_size_mb * 1024 * KB
