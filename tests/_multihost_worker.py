@@ -1,0 +1,78 @@
+"""Worker process for the 2-process jax.distributed localhost test.
+
+Each process simulates one pod host with 4 virtual CPU chips (8 global).
+Asserts (SURVEY §4 'multi-host without a pod'):
+
+1. the low-level path: locally-staged byte-range shards, gathered over the
+   global mesh, reassemble to exactly the object bytes on every process;
+2. the pod_ingest workload end-to-end with only-local fetches.
+"""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=nproc, process_id=pid
+)
+assert jax.process_count() == nproc
+assert len(jax.devices()) == 4 * nproc, jax.devices()
+
+import numpy as np  # noqa: E402
+
+from tpubench.config import BenchConfig  # noqa: E402
+from tpubench.dist.reassemble import (  # noqa: E402
+    gathered_to_bytes,
+    local_mesh_devices,
+    make_mesh,
+    make_reassemble,
+    shard_to_device_array,
+)
+from tpubench.dist.shard import ShardTable  # noqa: E402
+from tpubench.storage.base import deterministic_bytes  # noqa: E402
+from tpubench.storage.fake import FakeBackend  # noqa: E402
+from tpubench.workloads.pod_ingest import run_pod_ingest  # noqa: E402
+
+SIZE = 100_000
+mesh = make_mesh()
+n = int(mesh.devices.size)
+table = ShardTable.build(SIZE, n, align=128)
+data = deterministic_bytes("mh/object", SIZE)
+
+# 1. Low-level: stage ONLY local shards, gather, compare to full content.
+local = local_mesh_devices(mesh)
+all_devices = list(mesh.devices.reshape(-1))
+local_idx = [i for i, d in enumerate(all_devices) if d.process_index == pid]
+assert len(local) == 4
+shards = []
+for i in local_idx:
+    sh = table.shard(i)
+    buf = np.zeros(table.shard_bytes, dtype=np.uint8)
+    buf[: sh.length] = data[sh.start : sh.start + sh.length]
+    shards.append(buf)
+arr = shard_to_device_array(shards, mesh)
+gathered, csum = make_reassemble(mesh)(arr)
+jax.block_until_ready(gathered)
+assert gathered_to_bytes(gathered, SIZE) == data.tobytes(), "gather != object bytes"
+assert int(jax.device_get(csum)) == int(data.astype(np.uint32).sum()) % (1 << 32)
+
+# 2. Workload end-to-end (fake backend regenerates the same deterministic
+# object on every host — no cross-host data sharing needed).
+cfg = BenchConfig()
+cfg.workload.object_size = SIZE
+cfg.transport.protocol = "fake"
+backend = FakeBackend.prepopulated(cfg.workload.object_name_prefix, count=1, size=SIZE)
+res = run_pod_ingest(cfg, backend=backend, verify=True)
+assert res.errors == 0, res.extra
+assert res.n_chips == 4 * nproc
+
+print(f"multihost-ok process={pid}")

@@ -39,23 +39,42 @@ def make_mesh(devices: Optional[Sequence] = None, axis: str = "pod") -> Mesh:
     return Mesh(np.array(devices), (axis,))
 
 
+def local_mesh_devices(mesh: Mesh) -> list:
+    """This process's devices, in mesh order — the chips whose shards this
+    host must fetch and stage."""
+    pid = jax.process_index()
+    return [d for d in mesh.devices.reshape(-1) if d.process_index == pid]
+
+
 def shard_to_device_array(
     host_shards: Sequence[np.ndarray], mesh: Mesh, axis: str = "pod", lane: int = 128
 ):
     """Stage per-chip shard buffers into one global array sharded over the
     mesh: shape (n, rows, lane) uint8, dimension 0 split across chips.
 
-    Each host calls this with *its* chips' shards (single-controller: all of
-    them); ``jax.make_array_from_single_device_arrays`` assembles the global
-    view without any cross-host data movement — fetch stays local.
+    Multi-host (SPMD, one process per host): each process passes shards for
+    its LOCAL chips only — ``jax.make_array_from_single_device_arrays``
+    assembles the global view from per-process locals with zero cross-host
+    data movement, so the fetch stays on the host that owns the chip.
+    Single-process callers may instead pass all ``n`` shards.
     """
-    n = len(mesh.devices.reshape(-1))
-    assert len(host_shards) == n, f"need {n} shards, got {len(host_shards)}"
+    all_devices = list(mesh.devices.reshape(-1))
+    n = len(all_devices)
+    local = local_mesh_devices(mesh)
+    if len(host_shards) == len(local):
+        devices = local
+    elif len(host_shards) == n and jax.process_count() == 1:
+        devices = all_devices
+    else:
+        raise ValueError(
+            f"pass {len(local)} local shards (or {n} on single process); "
+            f"got {len(host_shards)}"
+        )
     rows = host_shards[0].size // lane
     sharding = NamedSharding(mesh, P(axis, None, None))
     singles = [
         jax.device_put(s.reshape(1, rows, lane), d)
-        for s, d in zip(host_shards, mesh.devices.reshape(-1))
+        for s, d in zip(host_shards, devices)
     ]
     return jax.make_array_from_single_device_arrays(
         (n, rows, lane), sharding, singles

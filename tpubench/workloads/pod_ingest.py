@@ -26,6 +26,7 @@ import jax
 from tpubench.config import BenchConfig
 from tpubench.dist.reassemble import (
     gathered_to_bytes,
+    local_mesh_devices,
     make_mesh,
     make_reassemble,
     make_ring_reassemble,
@@ -54,29 +55,37 @@ class PodIngestWorkload:
         size = self.backend.stat(name).size
         table = ShardTable.build(size, n, align=lane)
 
-        # ---- fetch: each shard's byte range, concurrent workers ----------
-        buffers = [np.zeros(table.shard_bytes, dtype=np.uint8) for _ in range(n)]
+        # ---- fetch: this host fetches ONLY its local chips' byte ranges --
+        # (multi-host SPMD: fetch stays on the host that owns the chip; the
+        # only cross-host byte movement is the ICI all-gather below).
+        all_devices = list(mesh.devices.reshape(-1))
+        pid = jax.process_index()
+        local_idx = [i for i, d in enumerate(all_devices) if d.process_index == pid]
+        buffers = [np.zeros(table.shard_bytes, dtype=np.uint8) for _ in local_idx]
 
-        def fetch(i: int, cancel) -> None:
+        def fetch(k: int, cancel) -> None:
+            i = local_idx[k]
             sh = table.shard(i)
             if sh.length == 0:
                 return
             reader = self.backend.open_read(name, start=sh.start, length=sh.length)
-            mv = memoryview(buffers[i])[: sh.length]
+            mv = memoryview(buffers[k])[: sh.length]
             got = 0
             try:
                 while got < sh.length:
-                    k = reader.readinto(mv[got:])
-                    if k <= 0:
+                    r = reader.readinto(mv[got:])
+                    if r <= 0:
                         break
-                    got += k
+                    got += r
             finally:
                 reader.close()
             if got != sh.length:
                 raise IOError(f"shard {i}: short fetch {got} != {sh.length}")
 
         t0 = time.perf_counter()
-        WorkerGroup(abort_on_error=w.abort_on_error).run(n, fetch, name="fetch")
+        WorkerGroup(abort_on_error=w.abort_on_error).run(
+            len(local_idx), fetch, name="fetch"
+        )
         t_fetch = time.perf_counter() - t0
 
         # ---- stage: host shard buffers → per-chip HBM --------------------
@@ -100,14 +109,25 @@ class PodIngestWorkload:
         # ---- verify ------------------------------------------------------
         ok = True
         if self.verify:
-            host_sum = sum(int(b.astype(np.uint32).sum()) for b in buffers) % (1 << 32)
-            ok = int(jax.device_get(csum)) % (1 << 32) == host_sum
-            got = gathered_to_bytes(gathered, size)
-            expected = bytearray()
-            for i, b in enumerate(buffers):
-                sh = table.shard(i)
-                expected += b.tobytes()[: sh.padded_length]
-            ok = ok and got == bytes(expected[:size])
+            if jax.process_count() == 1:
+                # Single controller: full equality + global checksum.
+                host_sum = sum(
+                    int(b.astype(np.uint32).sum()) for b in buffers
+                ) % (1 << 32)
+                ok = int(jax.device_get(csum)) % (1 << 32) == host_sum
+                got = gathered_to_bytes(gathered, size)
+                expected = b"".join(b.tobytes() for b in buffers)
+                ok = ok and got == expected[:size]
+            else:
+                # Multi-host: each process checks that its fetched shards
+                # appear at the right offsets of the (replicated) gather;
+                # the dedicated multihost test does full-content equality
+                # via deterministic objects.
+                garr = np.asarray(jax.device_get(gathered)).reshape(n, -1)
+                ok = all(
+                    bytes(garr[i].tobytes()) == buffers[k].tobytes()
+                    for k, i in enumerate(local_idx)
+                )
 
         wall = t_fetch + t_stage + t_gather
         res = RunResult(
