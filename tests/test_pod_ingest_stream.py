@@ -1,0 +1,45 @@
+import json
+import os
+
+from tpubench.config import BenchConfig
+from tpubench.storage import FakeBackend, FaultPlan
+from tpubench.workloads.pod_ingest_stream import run_pod_ingest_stream
+
+
+def _cfg(size=120_000, workers=2) -> BenchConfig:
+    cfg = BenchConfig()
+    cfg.workload.object_size = size
+    cfg.workload.workers = workers
+    cfg.transport.protocol = "fake"
+    return cfg
+
+
+def test_stream_ingests_all_objects(jax_cpu_devices):
+    cfg = _cfg()
+    backend = FakeBackend.prepopulated(cfg.workload.object_name_prefix, 2, 120_000)
+    res = run_pod_ingest_stream(cfg, n_objects=5, backend=backend, verify=True)
+    assert res.errors == 0
+    assert res.extra["verified"] is True
+    assert res.bytes_total == 5 * 120_000
+    assert res.extra["objects"] == 5
+    assert res.extra["overlap_efficiency"] > 0
+    assert res.n_chips == 8
+
+
+def test_stream_snapshots(jax_cpu_devices, tmp_path):
+    cfg = _cfg()
+    # Slow the fetch so the 5s-interval final flush captures real progress.
+    backend = FakeBackend.prepopulated(
+        cfg.workload.object_name_prefix, 2, 120_000,
+        fault=FaultPlan(per_read_latency_s=0.001),
+    )
+    path = str(tmp_path / "snap.json")
+    res = run_pod_ingest_stream(
+        cfg, n_objects=3, backend=backend, snapshot_path=path
+    )
+    assert res.errors == 0
+    assert os.path.exists(path)
+    with open(path) as f:
+        snap = json.load(f)
+    assert snap["objects_done"] == 3
+    assert snap["bytes"] == 3 * 120_000

@@ -197,6 +197,9 @@ def main(argv=None) -> int:
 
     add("read", "root GCS read bench (reference main.go)")
     add("pod-ingest", "sharded object → pod HBM with ICI all-gather")
+    stream = add("stream", "pipelined multi-object pod ingest (fetch ∥ stage+gather)")
+    stream.add_argument("--objects", type=int, default=8)
+    stream.add_argument("--snapshot", help="periodic progress snapshot JSON path")
     fs = {
         "read-fs": "sequential FS read (read_operation)",
         "write": "durable write (write_operations)",
@@ -243,6 +246,13 @@ def main(argv=None) -> int:
         res = cmd_read(cfg, args)
     elif args.cmd == "pod-ingest":
         res = cmd_pod_ingest(cfg, args)
+    elif args.cmd == "stream":
+        from tpubench.workloads.pod_ingest_stream import run_pod_ingest_stream
+
+        res = run_pod_ingest_stream(
+            cfg, n_objects=args.objects, verify=args.validate,
+            snapshot_path=args.snapshot,
+        )
     elif args.cmd == "read-fs":
         from tpubench.workloads.fsbench import run_read_fs
 

@@ -1,0 +1,218 @@
+"""Streamed pod ingest: a pipeline of objects with fetch ∥ stage+gather
+overlap — the I/O analog of pipeline parallelism (SURVEY §2.6 PP row).
+
+``pod_ingest`` measures one object with strict stage separation; this
+driver ingests a *sequence* of objects the way a training job consumes a
+dataset: while object *k* is being staged to HBM and all-gathered over ICI,
+a background fetcher is already pulling object *k+1*'s local byte-range
+shards into the second host-buffer set (double buffering at the object
+level; the granule-level double buffering lives in
+:mod:`tpubench.staging.device`).
+
+Reports per-stage seconds (summed), wall time, and the overlap efficiency
+``(fetch + device) / wall`` — 1.0 means no overlap, 2.0 means perfect
+fetch/device overlap.
+
+Periodic per-host JSON snapshots (SURVEY §5.4) make long streams
+restart-inspectable: each completed object updates the snapshot.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+import jax
+
+from tpubench.config import BenchConfig
+from tpubench.dist.reassemble import make_mesh, make_reassemble, shard_to_device_array
+from tpubench.dist.shard import ShardTable
+from tpubench.metrics.report import RunResult
+from tpubench.obs.exporters import SnapshotWriter
+from tpubench.storage import open_backend
+from tpubench.storage.base import StorageBackend
+from tpubench.workloads.common import WorkerGroup
+
+
+@dataclass
+class _ObjectPlan:
+    name: str
+    size: int
+    table: ShardTable
+
+
+class StreamedPodIngest:
+    def __init__(
+        self,
+        cfg: BenchConfig,
+        backend: StorageBackend,
+        n_objects: int,
+        verify: bool = False,
+        snapshot_path: Optional[str] = None,
+    ):
+        self.cfg = cfg
+        self.backend = backend
+        self.n_objects = n_objects
+        self.verify = verify
+        self.snapshot_path = snapshot_path
+        self._progress: dict = {"objects_done": 0, "bytes": 0}
+
+    def _fetch_local(self, plan: _ObjectPlan, buffers: list[np.ndarray], local_idx):
+        w = self.cfg.workload
+
+        def fetch(k: int, cancel) -> None:
+            i = local_idx[k]
+            sh = plan.table.shard(i)
+            if sh.length == 0:
+                return
+            reader = self.backend.open_read(plan.name, start=sh.start, length=sh.length)
+            mv = memoryview(buffers[k])[: sh.length]
+            got = 0
+            try:
+                while got < sh.length:
+                    r = reader.readinto(mv[got:])
+                    if r <= 0:
+                        break
+                    got += r
+            finally:
+                reader.close()
+            if got != sh.length:
+                raise IOError(f"{plan.name} shard {i}: short fetch {got}/{sh.length}")
+
+        WorkerGroup(abort_on_error=w.abort_on_error).run(
+            len(local_idx), fetch, name="stream-fetch"
+        )
+
+    def run(self) -> RunResult:
+        w = self.cfg.workload
+        lane = self.cfg.staging.lane
+        mesh = make_mesh(axis=self.cfg.dist.mesh_axis)
+        n = int(mesh.devices.size)
+        pid = jax.process_index()
+        all_devices = list(mesh.devices.reshape(-1))
+        local_idx = [i for i, d in enumerate(all_devices) if d.process_index == pid]
+
+        names = [f"{w.object_name_prefix}{k % max(1, w.workers)}" for k in range(self.n_objects)]
+        plans = []
+        for name in names:
+            size = self.backend.stat(name).size
+            plans.append(_ObjectPlan(name, size, ShardTable.build(size, n, align=lane)))
+        shard_bytes = max(p.table.shard_bytes for p in plans)
+
+        # Two host-buffer sets: fetch into one while the other stages.
+        buffer_sets = [
+            [np.zeros(shard_bytes, dtype=np.uint8) for _ in local_idx] for _ in range(2)
+        ]
+        reassemble = make_reassemble(mesh, self.cfg.dist.mesh_axis)
+
+        # Warmup compile on the first object's padded shape (static across
+        # objects of equal size; differing sizes recompile once per shape).
+        fetch_s = stage_s = gather_s = 0.0
+        total_bytes = 0
+        checks_ok = True
+
+        def snapshot() -> dict:
+            return dict(self._progress)
+
+        snap_ctx = (
+            SnapshotWriter(snapshot, self.snapshot_path, interval_s=5.0, process_index=pid)
+            if self.snapshot_path
+            else None
+        )
+
+        pool = ThreadPoolExecutor(max_workers=1)
+        t_wall0 = time.perf_counter()
+        try:
+            if snap_ctx:
+                snap_ctx.__enter__()
+
+            def timed_fetch(k: int):
+                t0 = time.perf_counter()
+                self._fetch_local(plans[k], buffer_sets[k % 2], local_idx)
+                return time.perf_counter() - t0
+
+            pending = pool.submit(timed_fetch, 0)
+            compiled_shapes = set()
+            for k in range(self.n_objects):
+                fetch_s += pending.result()  # object k's shards are on host
+                if k + 1 < self.n_objects:
+                    pending = pool.submit(timed_fetch, k + 1)  # overlap next fetch
+
+                plan = plans[k]
+                rows = plan.table.shard_bytes // lane
+                shards = [b[: rows * lane] for b in buffer_sets[k % 2]]
+                t0 = time.perf_counter()
+                arr = shard_to_device_array(shards, mesh, self.cfg.dist.mesh_axis, lane)
+                jax.block_until_ready(arr)
+                t1 = time.perf_counter()
+                stage_s += t1 - t0
+                shape_key = arr.shape
+                if shape_key not in compiled_shapes:
+                    jax.block_until_ready(reassemble(arr))  # compile, uncounted
+                    compiled_shapes.add(shape_key)
+                    t1 = time.perf_counter()
+                gathered, csum = reassemble(arr)
+                jax.block_until_ready(gathered)
+                gather_s += time.perf_counter() - t1
+                total_bytes += plan.size
+                if self.verify and jax.process_count() == 1:
+                    host = sum(int(s.astype(np.uint32).sum()) for s in shards)
+                    checks_ok = checks_ok and int(jax.device_get(csum)) == host % (1 << 32)
+                self._progress = {
+                    "objects_done": k + 1,
+                    "bytes": total_bytes,
+                    "fetch_seconds": fetch_s,
+                    "stage_seconds": stage_s,
+                    "gather_seconds": gather_s,
+                }
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
+            if snap_ctx:
+                snap_ctx.__exit__(None, None, None)
+        wall = time.perf_counter() - t_wall0
+
+        device_s = stage_s + gather_s
+        res = RunResult(
+            workload="pod_ingest_stream",
+            config=self.cfg.to_dict(),
+            bytes_total=total_bytes,
+            wall_seconds=wall,
+            gbps=(total_bytes / 1e9) / wall if wall > 0 else 0.0,
+            gbps_per_chip=((total_bytes / 1e9) / wall / n) if wall > 0 else 0.0,
+            n_chips=n,
+            errors=0 if checks_ok else 1,
+        )
+        res.extra.update(
+            {
+                "objects": self.n_objects,
+                "fetch_seconds": fetch_s,
+                "stage_seconds": stage_s,
+                "gather_seconds": gather_s,
+                # >1.0 means fetch genuinely overlapped device work.
+                "overlap_efficiency": (fetch_s + device_s) / wall if wall > 0 else 0.0,
+                "verified": checks_ok if self.verify else None,
+            }
+        )
+        return res
+
+
+def run_pod_ingest_stream(
+    cfg: BenchConfig,
+    n_objects: int,
+    backend: Optional[StorageBackend] = None,
+    verify: bool = False,
+    snapshot_path: Optional[str] = None,
+) -> RunResult:
+    owns = backend is None
+    backend = backend or open_backend(cfg)
+    try:
+        return StreamedPodIngest(
+            cfg, backend, n_objects, verify=verify, snapshot_path=snapshot_path
+        ).run()
+    finally:
+        if owns:
+            backend.close()
