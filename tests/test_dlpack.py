@@ -1,0 +1,184 @@
+"""Native DLPack producer + zero-copy staging-slot path (SURVEY §2.5.4,
+hard-part (a)): pinned AlignedBuffers consumed by numpy/JAX with no
+Python-held copy, and the sink acquire/commit protocol that lets the fetch
+path fill staging slots in place."""
+
+import gc
+
+import numpy as np
+import pytest
+
+from tpubench.native.engine import get_engine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = get_engine()
+    if eng is None:
+        pytest.skip("native engine unavailable")
+    return eng
+
+
+def test_from_dlpack_is_zero_copy(engine):
+    buf = engine.alloc(4096)
+    buf.array[:] = np.arange(4096, dtype=np.uint8)
+    arr = np.from_dlpack(buf)
+    assert arr.shape == (32, 128) and arr.dtype == np.uint8
+    assert np.array_equal(arr.reshape(-1), buf.array)
+    buf.array[7] = 201  # mutate producer; consumer must see it (no copy)
+    assert arr.reshape(-1)[7] == 201
+    del arr
+    buf.free()
+
+
+def test_dlpack_device_and_unaligned_shape(engine):
+    buf = engine.alloc(1000)  # not a lane multiple → (1, n) fallback
+    assert buf.__dlpack_device__() == (1, 0)
+    arr = np.from_dlpack(buf)
+    assert arr.shape == (1, 1000)
+    buf.free()
+
+
+def test_unconsumed_capsule_freed_without_crash(engine):
+    buf = engine.alloc(2048)
+    cap = buf.__dlpack__()
+    del cap  # destructor path: descriptor freed, buffer untouched
+    gc.collect()
+    buf.array[0] = 5  # buffer still usable
+    assert buf.array[0] == 5
+    buf.free()
+
+
+def test_dlpack_after_free_raises(engine):
+    buf = engine.alloc(1024)
+    buf.free()
+    with pytest.raises(ValueError):
+        buf.__dlpack__()
+
+
+def test_consumer_pins_buffer_lifetime(engine):
+    """DLPack contract: arrays from a temporary/freed producer stay valid —
+    the managed tensor pins the buffer; free() defers until the consumer's
+    deleter runs."""
+    buf0 = engine.alloc(2048)
+    buf0.array[:] = 7
+    arr = np.from_dlpack(buf0)
+    del buf0  # producer dropped; only the pin registry keeps it alive
+    gc.collect()
+    assert int(arr.astype(np.uint32).sum()) == 7 * 2048  # use-after-free without pinning
+
+    buf = engine.alloc(1024)
+    buf.array[:] = 3
+    arr2 = np.from_dlpack(buf)
+    buf.free()  # pinned: must defer
+    assert buf._free_pending and buf._ptr != 0
+    assert int(arr2.sum()) == 3 * 1024  # memory still alive
+    del arr2  # consumer deleter fires → deferred free happens
+    gc.collect()
+    assert buf._ptr == 0 and not buf._free_pending
+
+
+def test_unpinned_after_consumer_release(engine):
+    buf = engine.alloc(1024)
+    arr = np.from_dlpack(buf)
+    assert buf._pins == 1
+    del arr
+    gc.collect()
+    assert buf._pins == 0
+    buf.free()
+    assert buf._ptr == 0
+
+
+def test_as_2d_is_view_and_checks_lane(engine):
+    buf = engine.alloc(4096)
+    v = buf.as_2d(128)
+    assert v.shape == (32, 128) and v.base is buf.array
+    with pytest.raises(ValueError):
+        buf.as_2d(100)
+    buf.free()
+
+
+def test_device_put_from_native_slot(engine):
+    import jax
+
+    buf = engine.alloc(4096)
+    buf.array[:] = np.arange(4096, dtype=np.uint8)
+    landed = jax.device_put(buf.as_2d())
+    landed.block_until_ready()
+    assert np.array_equal(np.asarray(landed).reshape(-1), buf.array)
+    del landed
+    buf.free()
+
+
+# -------------------------------------------------- zero-copy sink protocol
+
+
+def test_acquire_commit_matches_submit():
+    from tpubench.config import StagingConfig
+    from tpubench.staging.device import DevicePutStager
+
+    cfg = StagingConfig(validate_checksum=True)
+    rng = np.random.default_rng(3)
+    payloads = [rng.integers(0, 256, 3000, dtype=np.uint8) for _ in range(5)]
+    payloads.append(rng.integers(0, 256, 777, dtype=np.uint8))  # short tail
+
+    sums = []
+    for use_zero_copy in (True, False):
+        st = DevicePutStager(0, granule_bytes=3000, cfg=cfg)
+        for p in payloads:
+            if use_zero_copy:
+                dst = st.acquire()
+                dst[: len(p)] = memoryview(p)
+                st.commit(len(p))
+            else:
+                st.submit(memoryview(p))
+        stats = st.finish()
+        assert stats["checksum_ok"], stats
+        assert stats["staged_bytes"] == sum(len(p) for p in payloads)
+        assert stats["granules"] == len(payloads)
+        sums.append(stats["checksum_device"])
+    assert sums[0] == sums[1]
+
+
+def test_native_slots_reported_when_engine_available():
+    from tpubench.config import StagingConfig
+    from tpubench.staging.device import DevicePutStager
+
+    st = DevicePutStager(0, granule_bytes=1024, cfg=StagingConfig())
+    st.submit(memoryview(bytes(range(256)) * 4))
+    stats = st.finish()
+    assert stats["native_slots"] == (get_engine() is not None)
+
+
+def test_read_object_into_sink_streams_all_bytes():
+    """Zero-copy read loop: granule decomposition + EOF + short tail, against
+    the fake backend reader."""
+    from tpubench.config import BenchConfig
+    from tpubench.storage import open_backend
+    from tpubench.storage.base import deterministic_bytes, read_object_into_sink
+
+    class CollectSink:
+        def __init__(self, slot_bytes):
+            self._slot = bytearray(slot_bytes)
+            self.out = bytearray()
+
+        def acquire(self):
+            return memoryview(self._slot)
+
+        def commit(self, n):
+            self.out += self._slot[:n]
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.object_size = 10_000
+    backend = open_backend(cfg)
+    try:
+        sink = CollectSink(4096)
+        reader = backend.open_read("tpubench/file_0")
+        total, fb = read_object_into_sink(reader, sink, 4096)
+        assert total == 10_000
+        assert bytes(sink.out) == deterministic_bytes(
+            "tpubench/file_0", 10_000
+        ).tobytes()
+    finally:
+        backend.close()

@@ -107,6 +107,13 @@ class StagingConfig:
     mode: str = "device_put"  # "none" (host RAM, reference parity) |
     # "device_put" | "pallas"
     double_buffer: bool = True  # overlap fetch with host→HBM DMA
+    # Staging slots in native posix_memalign'd buffers (DLPack producers,
+    # SURVEY §2.5.4) so fetch→slot→HBM has no Python-held copy; auto-falls
+    # back to numpy slots when the C++ engine is unavailable.
+    native_slots: bool = True
+    # Fetch directly into the staging slot (sink acquire/commit) instead of
+    # through a per-worker granule buffer that is then copied to the slot.
+    zero_copy: bool = True
     # Shape landed arrays as (granule//lane, lane) uint8 so XLA tiles them;
     # lane=128 matches the TPU lane width.
     lane: int = 128

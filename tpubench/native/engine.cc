@@ -54,6 +54,82 @@ void* tb_alloc_aligned(size_t size, size_t align) {
 
 void tb_free_aligned(void* p) { free(p); }
 
+// ---------------------------------------------------------------- dlpack --
+// DLPack producer over engine-owned aligned buffers (SURVEY §2.5.4: expose
+// pinned host buffers to JAX/numpy with no Python-held copy). Minimal stable
+// ABI structs (dlpack.h v0.8 layout); the tensor does NOT own the bytes —
+// buffer lifetime stays with the AlignedBuffer, the deleter frees only the
+// descriptor. kDLCPU = 1, uint dtype code = 1.
+struct TbDLDevice { int32_t device_type; int32_t device_id; };
+struct TbDLDataType { uint8_t code; uint8_t bits; uint16_t lanes; };
+struct TbDLTensor {
+  void* data;
+  TbDLDevice device;
+  int32_t ndim;
+  TbDLDataType dtype;
+  int64_t* shape;
+  int64_t* strides;
+  uint64_t byte_offset;
+};
+struct TbDLManagedTensor {
+  TbDLTensor dl_tensor;
+  void* manager_ctx;
+  void (*deleter)(TbDLManagedTensor*);
+};
+
+static void tb_dlpack_deleter(TbDLManagedTensor* t) {
+  if (!t) return;
+  free(t->dl_tensor.shape);  // strides allocated in the same block
+  free(t);
+}
+
+// 2-D row-major uint8 tensor (rows, cols) viewing `data`. Returns an opaque
+// DLManagedTensor* for Python to wrap in a "dltensor" PyCapsule. `deleter`
+// (optional) overrides the default descriptor-free — the Python side passes
+// a ctypes callback here so the consumer's deleter call also un-pins the
+// producer buffer (DLPack contract: the managed tensor keeps data alive).
+void* tb_dlpack_create(void* data, int64_t rows, int64_t cols,
+                       void (*deleter)(TbDLManagedTensor*)) {
+  if (!data || rows <= 0 || cols <= 0) return nullptr;
+  TbDLManagedTensor* t =
+      static_cast<TbDLManagedTensor*>(calloc(1, sizeof(TbDLManagedTensor)));
+  if (!t) return nullptr;
+  int64_t* dims = static_cast<int64_t*>(malloc(4 * sizeof(int64_t)));
+  if (!dims) {
+    free(t);
+    return nullptr;
+  }
+  dims[0] = rows;
+  dims[1] = cols;
+  dims[2] = cols;  // strides (elements): row-major contiguous
+  dims[3] = 1;
+  t->dl_tensor.data = data;
+  t->dl_tensor.device.device_type = 1;  // kDLCPU
+  t->dl_tensor.device.device_id = 0;
+  t->dl_tensor.ndim = 2;
+  t->dl_tensor.dtype.code = 1;  // kDLUInt
+  t->dl_tensor.dtype.bits = 8;
+  t->dl_tensor.dtype.lanes = 1;
+  t->dl_tensor.shape = dims;
+  t->dl_tensor.strides = dims + 2;
+  t->dl_tensor.byte_offset = 0;
+  t->manager_ctx = nullptr;
+  t->deleter = deleter ? deleter : tb_dlpack_deleter;
+  return t;
+}
+
+// Invokes the tensor's registered deleter (unconsumed-capsule destructor
+// path; consumers call t->deleter themselves).
+void tb_dlpack_free(void* managed) {
+  TbDLManagedTensor* t = static_cast<TbDLManagedTensor*>(managed);
+  if (t && t->deleter) t->deleter(t);
+}
+
+// Descriptor-only free, for custom deleters to delegate to.
+void tb_dlpack_free_descriptor(void* managed) {
+  tb_dlpack_deleter(static_cast<TbDLManagedTensor*>(managed));
+}
+
 // ------------------------------------------------------------------ open --
 // flags: bit0 write (else read), bit1 create+trunc, bit2 O_DIRECT wanted.
 // Returns fd >= 0; *direct_applied set to 1 if O_DIRECT actually engaged

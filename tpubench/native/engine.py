@@ -32,13 +32,40 @@ def _check(rc: int, what: str) -> int:
     return rc
 
 
+# --------------------------------------------------------------- dlpack ----
+# PyCapsule plumbing for the native DLPack producer (SURVEY §2.5.4): the
+# DLManagedTensor descriptor is built in C++ (tb_dlpack_create); here we only
+# wrap it in the standard "dltensor" capsule. Consumers (np.from_dlpack,
+# jax.dlpack) rename the capsule to "used_dltensor" and invoke the embedded
+# deleter themselves; the ctypes destructor below only fires for capsules
+# that were never consumed.
+_PyCapsule_New = ctypes.pythonapi.PyCapsule_New
+_PyCapsule_New.restype = ctypes.py_object
+_PyCapsule_New.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p]
+_PyCapsule_GetName = ctypes.pythonapi.PyCapsule_GetName
+_PyCapsule_GetName.restype = ctypes.c_char_p
+_PyCapsule_GetName.argtypes = [ctypes.c_void_p]
+_PyCapsule_GetPointer = ctypes.pythonapi.PyCapsule_GetPointer
+_PyCapsule_GetPointer.restype = ctypes.c_void_p
+_PyCapsule_GetPointer.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+
+_CAPSULE_DTOR_T = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+_MANAGED_DELETER_T = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
 class AlignedBuffer:
-    """posix_memalign'd buffer exposed as numpy/memoryview, zero-copy.
+    """posix_memalign'd buffer exposed as numpy/memoryview/DLPack, zero-copy.
 
     O_DIRECT needs buffer alignment the Go reference never arranged
     explicitly (SURVEY hard-part (e)); 4096 covers all common logical block
     sizes. Also serves as the pre-registered receive buffer for the native
-    HTTP path.
+    HTTP path, and as a DLPack producer so JAX/numpy consume the bytes with
+    no Python-held copy (``np.from_dlpack(buf)`` / ``jax.device_put`` of
+    :meth:`as_2d`). Lifetime: DLPack consumers pin the buffer (their
+    deleter un-pins; ``free()`` defers while pinned), so ``from_dlpack``
+    arrays never dangle. Plain numpy views (:attr:`array` / :meth:`as_2d`)
+    do NOT pin — holders must keep the buffer alive, which the staging slot
+    ring does by draining a slot's in-flight transfer before reuse/free.
     """
 
     def __init__(self, engine: "NativeEngine", size: int, align: int = 4096):
@@ -48,6 +75,8 @@ class AlignedBuffer:
         if not ptr:
             raise MemoryError(f"aligned alloc of {size} failed")
         self._ptr = ptr
+        self._pins = 0  # live DLPack consumers; memory free defers on them
+        self._free_pending = False
         self.array = np.ctypeslib.as_array(
             ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)), shape=(size,)
         )
@@ -59,10 +88,54 @@ class AlignedBuffer:
     def view(self, n: Optional[int] = None) -> memoryview:
         return memoryview(self.array)[: self.size if n is None else n]
 
+    def as_2d(self, lane: int = 128) -> np.ndarray:
+        """Zero-copy ``(size//lane, lane) uint8`` view — the lane-aligned
+        layout the staging pipeline ships to HBM (static shape, XLA tiles
+        it directly)."""
+        if self.size % lane:
+            raise ValueError(f"buffer size {self.size} not a multiple of lane {lane}")
+        return self.array.reshape(self.size // lane, lane)
+
+    # DLPack producer protocol -------------------------------------------
+    def __dlpack_device__(self) -> tuple[int, int]:
+        return (1, 0)  # (kDLCPU, 0)
+
+    def __dlpack__(self, stream=None, lane: int = 128):
+        """``dltensor`` capsule viewing this buffer as ``(size//lane, lane)
+        uint8`` (falls back to ``(1, size)`` when unaligned). Descriptor is
+        built natively (tb_dlpack_create); bytes are NOT copied. The buffer
+        is pinned until the consumer's deleter runs, so consumer arrays
+        never dangle — an explicit :meth:`free` while pinned defers until
+        the last consumer lets go."""
+        if not self._ptr or self._free_pending:
+            raise ValueError("buffer already freed")
+        rows, cols = (
+            (self.size // lane, lane) if self.size % lane == 0 else (1, self.size)
+        )
+        managed = self._engine.lib.tb_dlpack_create(
+            self._ptr, rows, cols, self._engine._managed_deleter_addr
+        )
+        if not managed:
+            raise MemoryError("tb_dlpack_create failed")
+        self._engine._dlpack_pin(managed, self)
+        self._pins += 1
+        return _PyCapsule_New(managed, b"dltensor", self._engine.capsule_dtor_addr)
+
     def free(self) -> None:
+        if self._pins > 0:
+            # DLPack consumers still view this memory; defer the actual
+            # free until the last consumer's deleter un-pins us.
+            self._free_pending = True
+            return
         if self._ptr:
             self._engine.lib.tb_free_aligned(self._ptr)
             self._ptr = 0
+
+    def _unpin(self) -> None:
+        self._pins -= 1
+        if self._pins == 0 and self._free_pending:
+            self._free_pending = False
+            self.free()
 
     def __del__(self):
         try:
@@ -100,6 +173,10 @@ class NativeEngine:
             c.POINTER(c.c_int64), c.c_int64, c.c_int, c.POINTER(c.c_int64),
         ]
         lib.tb_fill_random.argtypes = [c.c_void_p, c.c_int64, c.c_uint64]
+        lib.tb_dlpack_create.restype = c.c_void_p
+        lib.tb_dlpack_create.argtypes = [c.c_void_p, c.c_int64, c.c_int64, c.c_void_p]
+        lib.tb_dlpack_free.argtypes = [c.c_void_p]
+        lib.tb_dlpack_free_descriptor.argtypes = [c.c_void_p]
         lib.tb_http_get.restype = c.c_int64
         lib.tb_http_get.argtypes = [
             c.c_char_p, c.c_int, c.c_char_p, c.c_char_p,
@@ -107,6 +184,40 @@ class NativeEngine:
             c.POINTER(c.c_int64), c.POINTER(c.c_int64),
         ]
         self.lib = lib
+
+        # DLPack lifetime plumbing. Every managed tensor we produce gets a
+        # Python-side deleter callback as its `deleter` field, so whichever
+        # party disposes of the tensor — the consumer (numpy/jax call
+        # t->deleter when the consuming array dies) or the unconsumed-capsule
+        # destructor — un-pins the producer AlignedBuffer and frees the
+        # descriptor. The pin registry keeps the buffer (and its memory)
+        # alive for as long as any consumer array views it, per the DLPack
+        # contract. ctypes callbacks acquire the GIL on entry, so the
+        # registry mutation is safe from whatever thread the consumer's
+        # deallocator runs on.
+        self._dlpack_pins: dict[int, "AlignedBuffer"] = {}
+
+        def _managed_deleter(managed_ptr):
+            buf = self._dlpack_pins.pop(managed_ptr, None)
+            lib.tb_dlpack_free_descriptor(managed_ptr)
+            if buf is not None:
+                buf._unpin()
+
+        self._managed_deleter = _MANAGED_DELETER_T(_managed_deleter)
+        self._managed_deleter_addr = ctypes.cast(self._managed_deleter, ctypes.c_void_p)
+
+        def _dtor(capsule_ptr):
+            name = _PyCapsule_GetName(capsule_ptr)
+            if name == b"dltensor":  # never consumed: dispose via deleter
+                managed = _PyCapsule_GetPointer(capsule_ptr, name)
+                if managed:
+                    lib.tb_dlpack_free(managed)
+
+        self._capsule_dtor = _CAPSULE_DTOR_T(_dtor)
+        self.capsule_dtor_addr = ctypes.cast(self._capsule_dtor, ctypes.c_void_p)
+
+    def _dlpack_pin(self, managed: int, buf: "AlignedBuffer") -> None:
+        self._dlpack_pins[managed] = buf
 
     # ------------------------------------------------------------ helpers --
     def now_ns(self) -> int:

@@ -38,8 +38,20 @@ def _accum_checksum(acc, x):
 
 
 class DevicePutStager:
-    """One per worker. ``submit(mv)`` copies the filled granule into a free
-    host slot and launches the async host→HBM transfer."""
+    """One per worker. Two sink protocols:
+
+    * copying — ``submit(mv)`` copies the filled granule into a free host
+      slot and launches the async host→HBM transfer;
+    * zero-copy — ``acquire()`` hands out the next free slot's memory for
+      the fetch path to fill *in place* (native HTTP receive / ``readinto``
+      land bytes directly in the staging slot), then ``commit(n)`` launches
+      the transfer with no intermediate Python-held copy (SURVEY hard-part
+      (a): socket → pinned buffer → HBM).
+
+    Slots are native posix_memalign'd :class:`AlignedBuffer`\\ s (DLPack/
+    numpy zero-copy views) when the C++ engine is available, plain numpy
+    otherwise.
+    """
 
     def __init__(
         self,
@@ -60,7 +72,24 @@ class DevicePutStager:
         # zero-padded so checksums see only real bytes.
         self._slot_bytes = ((granule_bytes + lane - 1) // lane) * lane
         self._shape = (self._slot_bytes // lane, lane)
-        self._slots = [np.zeros(self._shape, dtype=np.uint8) for _ in range(depth)]
+        self._native_bufs = []
+        self._slots = []
+        engine = None
+        if getattr(cfg, "native_slots", True):
+            from tpubench.native.engine import get_engine
+
+            engine = get_engine()
+        for _ in range(depth):
+            if engine is not None:
+                buf = engine.alloc(self._slot_bytes)
+                self._native_bufs.append(buf)
+                arr = buf.as_2d(lane)
+                arr[:] = 0
+                self._slots.append(arr)
+            else:
+                self._slots.append(np.zeros(self._shape, dtype=np.uint8))
+        self.native_slots = engine is not None
+        self._slot_views = [memoryview(s.reshape(-1)) for s in self._slots]
         self._futures: list[Optional[jax.Array]] = [None] * depth
         self._submit_ns = [0] * depth
         self._true_bytes = [0] * depth
@@ -92,13 +121,19 @@ class DevicePutStager:
             self._dev_sum.block_until_ready()
         self._futures[k] = None
 
-    def submit(self, mv: memoryview) -> None:
-        n = len(mv)
+    def acquire(self) -> memoryview:
+        """Zero-copy path: drain the next slot's in-flight transfer (the
+        backpressure point) and hand its memory to the fetcher to fill."""
         k = self._k
-        self._drain_slot(k)  # backpressure: wait for this slot's last transfer
+        self._drain_slot(k)
+        return self._slot_views[k]
+
+    def commit(self, n: int) -> None:
+        """Stage the first ``n`` bytes of the slot handed out by
+        :meth:`acquire` (which the fetcher filled in place)."""
+        k = self._k
         slot = self._slots[k]
         flat = slot.reshape(-1)
-        flat[:n] = np.frombuffer(mv, dtype=np.uint8)
         if n < self._slot_bytes:
             flat[n:] = 0  # keep checksum/pad semantics exact
         if self._validate:
@@ -114,13 +149,28 @@ class DevicePutStager:
             # where the sync route beats queued async dispatch.)
             self._drain_slot(k)
 
+    def submit(self, mv: memoryview) -> None:
+        """Copying path (granule was filled elsewhere): copy into the next
+        free slot, then stage."""
+        n = len(mv)
+        dst = self.acquire()
+        dst[:n] = mv
+        self.commit(n)
+
     def finish(self) -> dict:
         for k in range(self.depth):
             self._drain_slot(k)
+        # All transfers complete; native slot memory is safe to release.
+        self._slot_views = []
+        self._slots = []
+        for buf in self._native_bufs:
+            buf.free()
+        self._native_bufs = []
         stats = {
             "staged_bytes": self.staged_bytes,
             "granules": self.granules,
             "n_chips": self.n_chips,
+            "native_slots": self.native_slots,
             "stage_recorder": self.stage_recorder,
             "device": str(self.device),
         }

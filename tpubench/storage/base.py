@@ -113,6 +113,30 @@ def read_object_through(
     return total, reader.first_byte_ns
 
 
+def read_object_into_sink(
+    reader: ObjectReader, sink, granule_bytes: int
+) -> tuple[int, Optional[int]]:
+    """Zero-copy variant of :func:`read_object_through`: each granule is read
+    *directly into* the staging slot the sink hands out (``sink.acquire()``),
+    then staged with ``sink.commit(n)`` — no intermediate granule buffer
+    (SURVEY hard-part (a): socket → pinned slot → HBM with no Python-held
+    copy). Semantics otherwise identical: streams to EOF, closes the reader,
+    returns (total_bytes, first_byte_ns).
+    """
+    total = 0
+    try:
+        while True:
+            dst = sink.acquire()
+            n = reader.readinto(dst[:granule_bytes])
+            if n <= 0:
+                break
+            total += n
+            sink.commit(n)
+    finally:
+        reader.close()
+    return total, reader.first_byte_ns
+
+
 def iter_ranges(size: int, granule: int) -> Iterator[tuple[int, int]]:
     """(start, length) granule decomposition of a byte range."""
     off = 0

@@ -73,3 +73,33 @@ class WorkerGroup:
             # errgroup returns the *first* error (main.go:212-219).
             raise collected[0]
         return GroupResult(errors=collected)
+
+
+def fetch_shard(backend, name: str, table, shard_index: int, buffer) -> None:
+    """Fetch one byte-range shard of ``name`` into ``buffer`` (host staging
+    buffer of ``table.shard_bytes`` capacity), zeroing the padding tail.
+
+    Shared by the pod-ingest workloads (one-shot and streamed) so the hot
+    fetch path has a single definition. The explicit tail-zeroing matters
+    when buffers are *reused* across objects of different sizes (the
+    streamed pipeline's double-buffer sets): without it, bytes of a
+    previously staged object would survive in the pad region and be
+    gathered into the current object's pod array.
+    """
+    sh = table.shard(shard_index)
+    buffer[sh.length :] = 0  # zero pad (and the whole buffer for an empty shard)
+    if sh.length == 0:
+        return
+    mv = memoryview(buffer)
+    reader = backend.open_read(name, start=sh.start, length=sh.length)
+    got = 0
+    try:
+        while got < sh.length:
+            r = reader.readinto(mv[got : sh.length])
+            if r <= 0:
+                break
+            got += r
+    finally:
+        reader.close()
+    if got != sh.length:
+        raise IOError(f"{name} shard {shard_index}: short fetch {got}/{sh.length}")

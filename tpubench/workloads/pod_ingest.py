@@ -36,7 +36,7 @@ from tpubench.dist.shard import ShardTable
 from tpubench.metrics.report import RunResult
 from tpubench.storage import open_backend
 from tpubench.storage.base import StorageBackend
-from tpubench.workloads.common import WorkerGroup
+from tpubench.workloads.common import WorkerGroup, fetch_shard
 
 
 @dataclass
@@ -64,23 +64,7 @@ class PodIngestWorkload:
         buffers = [np.zeros(table.shard_bytes, dtype=np.uint8) for _ in local_idx]
 
         def fetch(k: int, cancel) -> None:
-            i = local_idx[k]
-            sh = table.shard(i)
-            if sh.length == 0:
-                return
-            reader = self.backend.open_read(name, start=sh.start, length=sh.length)
-            mv = memoryview(buffers[k])[: sh.length]
-            got = 0
-            try:
-                while got < sh.length:
-                    r = reader.readinto(mv[got:])
-                    if r <= 0:
-                        break
-                    got += r
-            finally:
-                reader.close()
-            if got != sh.length:
-                raise IOError(f"shard {i}: short fetch {got} != {sh.length}")
+            fetch_shard(self.backend, name, table, local_idx[k], buffers[k])
 
         t0 = time.perf_counter()
         WorkerGroup(abort_on_error=w.abort_on_error).run(

@@ -35,7 +35,7 @@ from tpubench.metrics.report import RunResult
 from tpubench.obs.exporters import SnapshotWriter
 from tpubench.storage import open_backend
 from tpubench.storage.base import StorageBackend
-from tpubench.workloads.common import WorkerGroup
+from tpubench.workloads.common import WorkerGroup, fetch_shard
 
 
 @dataclass
@@ -65,23 +65,10 @@ class StreamedPodIngest:
         w = self.cfg.workload
 
         def fetch(k: int, cancel) -> None:
-            i = local_idx[k]
-            sh = plan.table.shard(i)
-            if sh.length == 0:
-                return
-            reader = self.backend.open_read(plan.name, start=sh.start, length=sh.length)
-            mv = memoryview(buffers[k])[: sh.length]
-            got = 0
-            try:
-                while got < sh.length:
-                    r = reader.readinto(mv[got:])
-                    if r <= 0:
-                        break
-                    got += r
-            finally:
-                reader.close()
-            if got != sh.length:
-                raise IOError(f"{plan.name} shard {i}: short fetch {got}/{sh.length}")
+            # fetch_shard zeroes the pad tail — essential here because the
+            # double-buffer sets are REUSED across objects of differing
+            # sizes; stale bytes would otherwise be gathered as padding.
+            fetch_shard(self.backend, plan.name, plan.table, local_idx[k], buffers[k])
 
         WorkerGroup(abort_on_error=w.abort_on_error).run(
             len(local_idx), fetch, name="stream-fetch"
@@ -109,11 +96,24 @@ class StreamedPodIngest:
         ]
         reassemble = make_reassemble(mesh, self.cfg.dist.mesh_axis)
 
-        # Warmup compile on the first object's padded shape (static across
-        # objects of equal size; differing sizes recompile once per shape).
+        # Warm the first object's shape BEFORE the wall clock starts: the
+        # one-off XLA compile would otherwise dominate short streams and
+        # mask the fetch∥device overlap the efficiency metric reports.
+        # Objects of other sizes still compile (once per shape) in-loop.
+        compiled_shapes = set()
+        rows0 = plans[0].table.shard_bytes // lane
+        warm = shard_to_device_array(
+            [b[: rows0 * lane] for b in buffer_sets[0]], mesh,
+            self.cfg.dist.mesh_axis, lane,
+        )
+        jax.block_until_ready(reassemble(warm))
+        compiled_shapes.add(warm.shape)
+        del warm
+
         fetch_s = stage_s = gather_s = 0.0
         total_bytes = 0
         checks_ok = True
+        object_checksums: list[int] = []
 
         def snapshot() -> dict:
             return dict(self._progress)
@@ -136,7 +136,6 @@ class StreamedPodIngest:
                 return time.perf_counter() - t0
 
             pending = pool.submit(timed_fetch, 0)
-            compiled_shapes = set()
             for k in range(self.n_objects):
                 fetch_s += pending.result()  # object k's shards are on host
                 if k + 1 < self.n_objects:
@@ -160,8 +159,15 @@ class StreamedPodIngest:
                 gather_s += time.perf_counter() - t1
                 total_bytes += plan.size
                 if self.verify and jax.process_count() == 1:
+                    # On-device checksum of the gathered pod array, exposed
+                    # per object so callers can compare against the TRUE
+                    # object bytes (an oracle independent of the host
+                    # buffers — catches stale-padding-class bugs the
+                    # host-vs-device comparison is blind to).
+                    dev_sum = int(jax.device_get(csum))
+                    object_checksums.append(dev_sum)
                     host = sum(int(s.astype(np.uint32).sum()) for s in shards)
-                    checks_ok = checks_ok and int(jax.device_get(csum)) == host % (1 << 32)
+                    checks_ok = checks_ok and dev_sum == host % (1 << 32)
                 self._progress = {
                     "objects_done": k + 1,
                     "bytes": total_bytes,
@@ -195,6 +201,7 @@ class StreamedPodIngest:
                 # >1.0 means fetch genuinely overlapped device work.
                 "overlap_efficiency": (fetch_s + device_s) / wall if wall > 0 else 0.0,
                 "verified": checks_ok if self.verify else None,
+                "object_checksums": object_checksums if self.verify else None,
             }
         )
         return res
