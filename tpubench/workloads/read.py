@@ -31,16 +31,36 @@ from tpubench.metrics import MetricSet
 from tpubench.metrics.report import RunResult
 from tpubench.obs.tracing import NoopTracer, Tracer
 from tpubench.storage import open_backend
-from tpubench.storage.base import StorageBackend, read_object_through
+from tpubench.storage.base import (
+    StorageBackend,
+    read_object_into_sink,
+    read_object_through,
+)
 from tpubench.workloads.common import WorkerGroup
 
 
 class Sink(Protocol):
-    """Per-worker granule consumer (the staging hook)."""
+    """Per-worker granule consumer (the staging hook).
+
+    Sinks may additionally implement the zero-copy pair
+    ``acquire() -> memoryview`` / ``commit(n: int)`` (see
+    :class:`ZeroCopySink`); the workload routes through it only when BOTH
+    methods are present, and records which route ran in the result extras
+    (``staging_zero_copy``) so a silently degraded sink is visible in
+    reports.
+    """
 
     def submit(self, mv: memoryview) -> None: ...
 
     def finish(self) -> dict: ...
+
+
+class ZeroCopySink(Sink, Protocol):
+    """Sink whose staging slots the fetch path fills in place."""
+
+    def acquire(self) -> memoryview: ...
+
+    def commit(self, n: int) -> None: ...
 
 
 SinkFactory = Callable[[int], Sink]
@@ -60,13 +80,27 @@ class ReadWorkload:
         recorders = [metrics.new_worker(f"w{i}") for i in range(n)]
         worker_bytes = [0] * n
         sink_stats: list[dict] = [{} for _ in range(n)]
+        zero_copy_used = [False] * n
 
         def worker(i: int, cancel) -> None:
             read_rec, fb_rec = recorders[i]
             name = f"{w.object_name_prefix}{i}"  # main.go:121
-            granule = memoryview(bytearray(w.granule_bytes))  # one per worker, main.go:125
             sink = self.sink_factory(i) if self.sink_factory else None
-            submit = sink.submit if sink else None
+            # Zero-copy route: fetch lands bytes directly in the staging
+            # slot (sink.acquire/commit); otherwise stream through a reused
+            # per-worker granule buffer (main.go:125) with optional copying
+            # submit.
+            zero_copy = (
+                sink is not None
+                and self.cfg.staging.zero_copy
+                and hasattr(sink, "acquire")
+                and hasattr(sink, "commit")
+            )
+            zero_copy_used[i] = zero_copy
+            granule = (
+                None if zero_copy else memoryview(bytearray(w.granule_bytes))
+            )
+            submit = sink.submit if (sink and not zero_copy) else None
             total_local = 0
             try:
                 for _ in range(w.read_calls_per_worker):
@@ -77,7 +111,14 @@ class ReadWorkload:
                     ) as span:
                         t0 = time.perf_counter_ns()
                         reader = self.backend.open_read(name)
-                        nbytes, fb_ns = read_object_through(reader, granule, submit)
+                        if zero_copy:
+                            nbytes, fb_ns = read_object_into_sink(
+                                reader, sink, w.granule_bytes
+                            )
+                        else:
+                            nbytes, fb_ns = read_object_through(
+                                reader, granule, submit
+                            )
                         t1 = time.perf_counter_ns()
                         read_rec.record_ns(t1 - t0)
                         if fb_ns is not None:
@@ -121,6 +162,7 @@ class ReadWorkload:
             errors=result_errors,
         )
         if staged:
+            res.extra["staging_zero_copy"] = all(zero_copy_used)
             res.extra["staged_bytes"] = staged
             res.extra["staged_gbps"] = (staged / 1e9) / wall if wall > 0 else 0.0
             res.extra["staged_gbps_per_chip"] = res.extra["staged_gbps"] / n_chips
