@@ -28,3 +28,7 @@ def jax_cpu_devices():
     devices = jax.devices()
     assert len(devices) == 8, f"expected 8 simulated devices, got {devices}"
     return devices
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running hygiene/stress tests")

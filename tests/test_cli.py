@@ -107,3 +107,23 @@ def test_cli_sweep_fake(tmp_path, capsys):
     assert rc == 0
     rows = json.loads(capsys.readouterr().out)
     assert rows[0]["protocol"] == "fake" and rows[0]["gbps"] > 0
+
+
+def test_profile_dir_captures_xplane_trace(tmp_path, capsys):
+    """--profile-dir wraps the run in jax.profiler.trace; xplane artifacts
+    must exist afterwards (SURVEY §5.1 profiling north star)."""
+    import glob
+    import os
+
+    from tpubench.cli import main
+
+    prof = str(tmp_path / "prof")
+    rc = main([
+        "read", "--protocol", "fake", "--workers", "1",
+        "--read-call-per-worker", "1", "--object-size", "65536",
+        "--staging", "none", "--profile-dir", prof,
+        "--results-dir", str(tmp_path / "res"),
+    ])
+    assert rc == 0
+    hits = glob.glob(os.path.join(prof, "**", "*.xplane.pb"), recursive=True)
+    assert hits, f"no xplane trace under {prof}"

@@ -1,0 +1,44 @@
+"""ThreadSanitizer hygiene for the native engine (SURVEY §4 prescription:
+the reference shipped a real latency-slice data race, ssd_test/main.go:80;
+the engine's per-thread-array contract is verified under TSAN here)."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+HERE = os.path.dirname(__file__)
+NATIVE = os.path.join(HERE, "..", "tpubench", "native")
+
+
+@pytest.mark.slow
+def test_engine_clean_under_tsan(tmp_path):
+    gxx = shutil.which("g++")
+    if not gxx:
+        pytest.skip("g++ unavailable")
+    binary = str(tmp_path / "stress_tsan")
+    compile_cmd = [
+        gxx, "-O1", "-g", "-fsanitize=thread", "-std=c++17",
+        os.path.join(NATIVE, "engine.cc"),
+        os.path.join(NATIVE, "stress.cc"),
+        "-o", binary, "-lpthread",
+    ]
+    cp = subprocess.run(compile_cmd, capture_output=True, text=True)
+    if cp.returncode != 0:
+        if "tsan" in (cp.stderr or "").lower():
+            pytest.skip(f"TSAN runtime unavailable: {cp.stderr[-200:]}")
+        raise AssertionError(f"stress build failed: {cp.stderr}")
+
+    scratch = tmp_path / "scratch"
+    scratch.mkdir()
+    run = subprocess.run(
+        [binary, str(scratch)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "TSAN_OPTIONS": "halt_on_error=1 exitcode=66"},
+    )
+    assert run.returncode == 0, (
+        f"TSAN stress failed (rc={run.returncode}):\n{run.stdout}\n{run.stderr}"
+    )
+    assert "WARNING: ThreadSanitizer" not in run.stderr
+    assert "stress ok" in run.stdout

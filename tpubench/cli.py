@@ -50,6 +50,7 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--validate", action="store_true", help="on-device checksum")
     p.add_argument("--enable-tracing", action="store_true")
     p.add_argument("--trace-sample-rate", type=float)
+    p.add_argument("--profile-dir", help="capture a jax.profiler xplane trace here")
     p.add_argument("--results-dir")
     p.add_argument("--no-abort-on-error", action="store_true",
                    help="per-worker failure domains instead of errgroup abort")
@@ -96,6 +97,8 @@ def build_config(args) -> BenchConfig:
         o.enable_tracing = True
     if args.trace_sample_rate is not None:
         o.trace_sample_rate = args.trace_sample_rate
+    if args.profile_dir:
+        o.profile_dir = args.profile_dir
     if args.results_dir:
         o.results_dir = args.results_dir
     if args.no_abort_on_error:
@@ -238,43 +241,53 @@ def main(argv=None) -> int:
         cmd_prepare(cfg, args)
         return 0
     if args.cmd == "sweep":
-        cmd_sweep(cfg, args)
+        from tpubench.obs.profiling import maybe_profile
+
+        with maybe_profile(cfg.obs.profile_dir):
+            cmd_sweep(cfg, args)
+        if cfg.obs.profile_dir:
+            print(f"profile trace: {cfg.obs.profile_dir}", file=sys.stderr)
         return 0
 
     direct = not args.no_direct
-    if args.cmd == "read":
-        res = cmd_read(cfg, args)
-    elif args.cmd == "pod-ingest":
-        res = cmd_pod_ingest(cfg, args)
-    elif args.cmd == "stream":
-        from tpubench.workloads.pod_ingest_stream import run_pod_ingest_stream
+    from tpubench.obs.profiling import maybe_profile
 
-        res = run_pod_ingest_stream(
-            cfg, n_objects=args.objects, verify=args.validate,
-            snapshot_path=args.snapshot,
-        )
-    elif args.cmd == "read-fs":
-        from tpubench.workloads.fsbench import run_read_fs
+    with maybe_profile(cfg.obs.profile_dir):
+        if args.cmd == "read":
+            res = cmd_read(cfg, args)
+        elif args.cmd == "pod-ingest":
+            res = cmd_pod_ingest(cfg, args)
+        elif args.cmd == "stream":
+            from tpubench.workloads.pod_ingest_stream import run_pod_ingest_stream
 
-        res = run_read_fs(cfg, direct=direct)
-    elif args.cmd == "write":
-        from tpubench.workloads.fsbench import run_write
+            res = run_pod_ingest_stream(
+                cfg, n_objects=args.objects, verify=args.validate,
+                snapshot_path=args.snapshot,
+            )
+        elif args.cmd == "read-fs":
+            from tpubench.workloads.fsbench import run_read_fs
 
-        res = run_write(cfg, direct=direct)
-    elif args.cmd == "list":
-        from tpubench.workloads.fsbench import run_listing
+            res = run_read_fs(cfg, direct=direct)
+        elif args.cmd == "write":
+            from tpubench.workloads.fsbench import run_write
 
-        res = run_listing(cfg)
-    elif args.cmd == "open":
-        from tpubench.workloads.fsbench import run_open_file
+            res = run_write(cfg, direct=direct)
+        elif args.cmd == "list":
+            from tpubench.workloads.fsbench import run_listing
 
-        res = run_open_file(cfg, direct=direct)
-    elif args.cmd == "ssd":
-        from tpubench.workloads.fsbench import run_ssd_compare
+            res = run_listing(cfg)
+        elif args.cmd == "open":
+            from tpubench.workloads.fsbench import run_open_file
 
-        res = run_ssd_compare(cfg, direct=direct)
-    else:  # pragma: no cover
-        raise SystemExit(f"unknown cmd {args.cmd}")
+            res = run_open_file(cfg, direct=direct)
+        elif args.cmd == "ssd":
+            from tpubench.workloads.fsbench import run_ssd_compare
+
+            res = run_ssd_compare(cfg, direct=direct)
+        else:  # pragma: no cover
+            raise SystemExit(f"unknown cmd {args.cmd}")
+    if cfg.obs.profile_dir:
+        print(f"profile trace: {cfg.obs.profile_dir}", file=sys.stderr)
     _finish(res, cfg)
     return 0
 

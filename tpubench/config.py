@@ -145,6 +145,10 @@ class ObservabilityConfig:
     # "none" | "json" | "otel" | "cloud" (cloud requires GCP creds; gated)
     export: str = "json"
     results_dir: str = "results"
+    # Non-empty = capture a jax.profiler (xplane) trace of the run there
+    # (SURVEY §5.1: the DMA/collective path profiled first-class, replacing
+    # the reference's attach-an-external-profiler sleeps).
+    profile_dir: str = ""
 
 
 @dataclass

@@ -1,0 +1,43 @@
+"""JAX profiler capture around workload runs (SURVEY §5.1 north star).
+
+The reference's profiling story is indirect — ``GODEBUG=asyncpreemptoff=1``
+in every launcher (read_operations.sh:8) plus 3-minute post-run sleeps so an
+external profiler can attach (write_operations/main.go:115-117). The
+TPU-native equivalent is first-class: wrap the run in ``jax.profiler.trace``
+so the device_put/Pallas DMA path, XLA compilation, and ICI collectives land
+in an xplane trace viewable in TensorBoard/XProf (plus optional annotations
+via :func:`annotate` for host-side pipeline stages).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def maybe_profile(profile_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler (xplane) trace of the enclosed run into
+    ``profile_dir``; no-op when the dir is empty/None."""
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(profile_dir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named host-side region inside a capture (shows as a TraceAnnotation
+    row in xprof); no-op outside a trace and on failure."""
+    try:
+        import jax
+
+        ctx = jax.profiler.TraceAnnotation(name)
+    except Exception:
+        yield
+        return
+    with ctx:
+        yield

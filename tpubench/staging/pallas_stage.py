@@ -138,12 +138,25 @@ class PallasStager:
         self._host_sum = 0
         self._dev_sum = 0
 
-    def submit(self, mv: memoryview) -> None:
-        n = len(mv)
+    def acquire(self) -> memoryview:
+        """Zero-copy sink protocol (see ReadWorkload): the single slot is
+        synchronous — by the time acquire is called again, the previous
+        granule's landing pass has completed."""
+        return memoryview(self._slot.reshape(-1))
+
+    def commit(self, n: int) -> None:
         flat = self._slot.reshape(-1)
-        flat[:n] = np.frombuffer(mv, dtype=np.uint8)
         if n < self._slot_bytes:
             flat[n:] = 0
+        self._land(flat, n)
+
+    def submit(self, mv: memoryview) -> None:
+        n = len(mv)
+        dst = self.acquire()
+        dst[:n] = mv
+        self.commit(n)
+
+    def _land(self, flat: np.ndarray, n: int) -> None:
         t0 = time.perf_counter_ns()
         staged = jax.device_put(self._slot, self.device)
         landed, csum = pallas_land(staged)

@@ -33,6 +33,7 @@ from tpubench.dist.reassemble import make_mesh, make_reassemble, shard_to_device
 from tpubench.dist.shard import ShardTable
 from tpubench.metrics.report import RunResult
 from tpubench.obs.exporters import SnapshotWriter
+from tpubench.obs.profiling import annotate
 from tpubench.storage import open_backend
 from tpubench.storage.base import StorageBackend
 from tpubench.workloads.common import WorkerGroup, fetch_shard
@@ -132,7 +133,8 @@ class StreamedPodIngest:
 
             def timed_fetch(k: int):
                 t0 = time.perf_counter()
-                self._fetch_local(plans[k], buffer_sets[k % 2], local_idx)
+                with annotate(f"fetch/obj{k}"):
+                    self._fetch_local(plans[k], buffer_sets[k % 2], local_idx)
                 return time.perf_counter() - t0
 
             pending = pool.submit(timed_fetch, 0)
@@ -145,8 +147,11 @@ class StreamedPodIngest:
                 rows = plan.table.shard_bytes // lane
                 shards = [b[: rows * lane] for b in buffer_sets[k % 2]]
                 t0 = time.perf_counter()
-                arr = shard_to_device_array(shards, mesh, self.cfg.dist.mesh_axis, lane)
-                jax.block_until_ready(arr)
+                with annotate(f"stage/obj{k}"):
+                    arr = shard_to_device_array(
+                        shards, mesh, self.cfg.dist.mesh_axis, lane
+                    )
+                    jax.block_until_ready(arr)
                 t1 = time.perf_counter()
                 stage_s += t1 - t0
                 shape_key = arr.shape
@@ -154,8 +159,9 @@ class StreamedPodIngest:
                     jax.block_until_ready(reassemble(arr))  # compile, uncounted
                     compiled_shapes.add(shape_key)
                     t1 = time.perf_counter()
-                gathered, csum = reassemble(arr)
-                jax.block_until_ready(gathered)
+                with annotate(f"gather/obj{k}"):
+                    gathered, csum = reassemble(arr)
+                    jax.block_until_ready(gathered)
                 gather_s += time.perf_counter() - t1
                 total_bytes += plan.size
                 if self.verify and jax.process_count() == 1:
