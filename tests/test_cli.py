@@ -127,3 +127,49 @@ def test_profile_dir_captures_xplane_trace(tmp_path, capsys):
     assert rc == 0
     hits = glob.glob(os.path.join(prof, "**", "*.xplane.pb"), recursive=True)
     assert hits, f"no xplane trace under {prof}"
+
+
+def test_fault_injection_from_cli(tmp_path):
+    """§5.3: fault-injection mode reachable from the CLI — injected open
+    errors are retried by the gax-style policy, so the run still completes
+    with all bytes; with retry disabled and abort off, errors surface."""
+    import glob
+    import json
+
+    from tpubench.cli import main
+
+    rc = main([
+        "read", "--protocol", "fake", "--workers", "2",
+        "--read-call-per-worker", "2", "--object-size", "65536",
+        "--staging", "none", "--fault-error-rate", "0.5",
+        "--results-dir", str(tmp_path / "r1"),
+    ])
+    assert rc == 0
+    res = json.load(open(glob.glob(str(tmp_path / "r1" / "*.json"))[0]))
+    assert res["errors"] == 0  # retry absorbed the injected 503s
+    assert res["bytes_total"] == 2 * 2 * 65536
+
+
+def test_retry_deadline_bounds_total_fault_injection(tmp_path):
+    """--retry-deadline terminates the otherwise-infinite retry loop when
+    every read fails (reference semantics are retry-forever; the deadline is
+    the CLI-reachable safety valve)."""
+    import glob
+    import json
+    import time
+
+    from tpubench.cli import main
+
+    t0 = time.monotonic()
+    rc = main([
+        "read", "--protocol", "fake", "--workers", "1",
+        "--read-call-per-worker", "1", "--object-size", "65536",
+        "--fault-read-error-rate", "1.0", "--retry-deadline", "0.5",
+        "--no-abort-on-error",
+        "--results-dir", str(tmp_path / "r"),
+    ])
+    elapsed = time.monotonic() - t0
+    assert rc == 0
+    assert elapsed < 30, f"deadline did not bound the retry loop ({elapsed:.1f}s)"
+    res = json.load(open(glob.glob(str(tmp_path / "r" / "*.json"))[0]))
+    assert res["errors"] == 1 and res["bytes_total"] == 0

@@ -102,3 +102,74 @@ def test_gathered_checksum_matches_host(jax_cpu_devices):
     _, csum = make_reassemble(mesh)(arr)
     host = sum(int(s.astype(np.uint32).sum()) for s in shards)
     assert int(csum) == host
+
+
+def test_pod_ingest_failure_domain_holes(jax_cpu_devices):
+    """SURVEY §5.3: with abort_on_error=False a failed shard fetch becomes a
+    reported hole (zeroed range + shard index + missing bytes) instead of a
+    pod-wide abort."""
+    import numpy as np
+
+    from tpubench.config import BenchConfig
+    from tpubench.storage import FakeBackend
+    from tpubench.storage.base import StorageError
+    from tpubench.workloads.pod_ingest import run_pod_ingest
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.object_size = 160_000
+    cfg.workload.abort_on_error = False
+    inner = FakeBackend.prepopulated(cfg.workload.object_name_prefix, 1, 160_000)
+
+    class FailOneShard:
+        """Backend wrapper: the shard whose range starts at `fail_start`
+        always fails to open — a deterministic single-failure domain."""
+
+        def __init__(self, backend, fail_start):
+            self._b = backend
+            self._fail_start = fail_start
+
+        def open_read(self, name, start=0, length=None):
+            if start == self._fail_start:
+                raise StorageError("injected host failure", transient=False)
+            return self._b.open_read(name, start=start, length=length)
+
+        def __getattr__(self, attr):
+            return getattr(self._b, attr)
+
+    # Shard 3's byte range (8 shards over the object, lane-aligned).
+    from tpubench.dist.shard import ShardTable
+
+    table = ShardTable.build(160_000, 8, align=128)
+    backend = FailOneShard(inner, table.shard(3).start)
+
+    res = run_pod_ingest(cfg, backend=backend, verify=True)
+    assert res.extra["holes"]["shards"] == [3]
+    assert res.extra["holes"]["bytes"] == table.shard(3).length
+    assert res.errors == 1  # the hole, not a verify failure
+    assert res.extra["verified"] is True  # gather is correct; data has a hole
+
+
+def test_pod_ingest_abort_on_error_still_aborts(jax_cpu_devices):
+    """Default errgroup semantics unchanged: first fetch error propagates."""
+    import pytest
+
+    from tpubench.config import BenchConfig
+    from tpubench.storage import FakeBackend
+    from tpubench.storage.base import StorageError
+    from tpubench.workloads.pod_ingest import run_pod_ingest
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.object_size = 160_000
+    inner = FakeBackend.prepopulated(cfg.workload.object_name_prefix, 1, 160_000)
+
+    class AlwaysFail:
+        def open_read(self, name, start=0, length=None):
+            raise StorageError("boom", transient=False)
+
+        def __getattr__(self, attr):
+            return getattr(inner, attr)
+
+    with pytest.raises(Exception):
+        run_pod_ingest(cfg, backend=AlwaysFail(), verify=False)

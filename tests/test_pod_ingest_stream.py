@@ -95,3 +95,54 @@ def test_stream_snapshots(jax_cpu_devices, tmp_path):
         snap = json.load(f)
     assert snap["objects_done"] == 3
     assert snap["bytes"] == 3 * 120_000
+
+
+def test_stream_failure_domain_holes(jax_cpu_devices):
+    """A failing shard on one object becomes a zeroed, reported hole; later
+    objects reusing that buffer are unaffected."""
+    import numpy as np
+
+    from tpubench.dist.shard import ShardTable
+    from tpubench.storage.base import StorageError, deterministic_bytes
+
+    cfg = _cfg(size=120_000, workers=2)
+    cfg.workload.abort_on_error = False
+    inner = FakeBackend.prepopulated(cfg.workload.object_name_prefix, 2, 120_000)
+    table = ShardTable.build(120_000, 8, align=128)
+    fail_start = table.shard(5).start
+    prefix = cfg.workload.object_name_prefix
+
+    class FailShardOfObject0:
+        def __init__(self):
+            self.fired = False
+
+        def open_read(self, name, start=0, length=None):
+            if name == f"{prefix}0" and start == fail_start and not self.fired:
+                self.fired = True  # fail only the FIRST object-0 fetch
+                raise StorageError("injected", transient=False)
+            return inner.open_read(name, start=start, length=length)
+
+        def __getattr__(self, attr):
+            return getattr(inner, attr)
+
+    res = run_pod_ingest_stream(
+        cfg, n_objects=4, backend=FailShardOfObject0(), verify=True
+    )
+    sh5 = table.shard(5)
+    assert res.extra["holes"] == {"0": {"shards": [5], "bytes": sh5.length}}
+    assert res.errors == 1
+    # Objects 1..3 (incl. object 2 reusing object 0's buffer set) intact:
+    for k in (1, 2, 3):
+        name = f"{prefix}{k % 2}"
+        true_sum = int(
+            deterministic_bytes(name, 120_000).astype(np.uint32).sum()
+        ) % (1 << 32)
+        assert res.extra["object_checksums"][k] == true_sum
+    # Object 0's checksum equals true bytes MINUS the holed shard's bytes.
+    sh = table.shard(5)
+    data0 = deterministic_bytes(f"{prefix}0", 120_000)
+    expect0 = (
+        int(data0.astype(np.uint32).sum())
+        - int(data0[sh.start : sh.start + sh.length].astype(np.uint32).sum())
+    ) % (1 << 32)
+    assert res.extra["object_checksums"][0] == expect0

@@ -54,6 +54,18 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--results-dir")
     p.add_argument("--no-abort-on-error", action="store_true",
                    help="per-worker failure domains instead of errgroup abort")
+    p.add_argument("--fault-error-rate", type=float,
+                   help="fake backend: P(open raises transient 503)")
+    p.add_argument("--fault-read-error-rate", type=float,
+                   help="fake backend: P(granule read fails mid-stream)")
+    p.add_argument("--fault-latency", type=float,
+                   help="fake backend: added first-byte latency (s)")
+    p.add_argument("--retry-deadline", type=float,
+                   help="per-op retry deadline (s); bounds the reference's "
+                        "retry-forever default — set this with --fault-* "
+                        "rates near 1.0 or the run retries indefinitely")
+    p.add_argument("--retry-max-attempts", type=int,
+                   help="retry attempt cap (0 = unlimited, reference default)")
     p.add_argument("--no-direct", action="store_true", help="skip O_DIRECT")
     p.add_argument("--ring", action="store_true",
                    help="pod-ingest: explicit ppermute ring instead of all_gather")
@@ -103,6 +115,16 @@ def build_config(args) -> BenchConfig:
         o.results_dir = args.results_dir
     if args.no_abort_on_error:
         w.abort_on_error = False
+    if args.fault_error_rate is not None:
+        t.fault.error_rate = args.fault_error_rate
+    if args.fault_read_error_rate is not None:
+        t.fault.read_error_rate = args.fault_read_error_rate
+    if args.fault_latency is not None:
+        t.fault.latency_s = args.fault_latency
+    if args.retry_deadline is not None:
+        t.retry.deadline_s = args.retry_deadline
+    if args.retry_max_attempts is not None:
+        t.retry.max_attempts = args.retry_max_attempts
     return cfg
 
 

@@ -44,6 +44,28 @@ class RetryConfig:
 
 
 @dataclass
+class FaultConfig:
+    """Fault injection for the fake backend (SURVEY §5.3 prescription:
+    error %, latency injection — the resilience-testing mode the reference
+    lacked). Ignored by real backends."""
+
+    error_rate: float = 0.0  # P(read-open raises transient 503)
+    read_error_rate: float = 0.0  # P(granule read raises mid-stream)
+    latency_s: float = 0.0  # added first-byte latency per open
+    per_read_latency_s: float = 0.0  # added latency per granule read
+    seed: int = 0
+
+    @property
+    def active(self) -> bool:
+        return bool(
+            self.error_rate
+            or self.read_error_rate
+            or self.latency_s
+            or self.per_read_latency_s
+        )
+
+
+@dataclass
 class TransportConfig:
     """L1 client construction knobs (reference ``main.go:30-42,62-117``)."""
 
@@ -61,6 +83,7 @@ class TransportConfig:
     # Endpoint override so the same client drives the hermetic fake GCS server.
     endpoint: str = ""  # empty = https://storage.googleapis.com
     retry: RetryConfig = field(default_factory=RetryConfig)
+    fault: FaultConfig = field(default_factory=FaultConfig)
 
 
 @dataclass
@@ -195,6 +218,7 @@ _SUBTYPES = {
     "dist": DistConfig,
     "obs": ObservabilityConfig,
     "retry": RetryConfig,
+    "fault": FaultConfig,
 }
 
 

@@ -29,8 +29,17 @@ def open_backend(cfg, fault=None) -> StorageBackend:
     client-level retry policy (main.go:179-184)."""
     proto = cfg.transport.protocol
     if proto == "fake":
-        from tpubench.storage.fake import FakeBackend
+        from tpubench.storage.fake import FakeBackend, FaultPlan
 
+        if fault is None and getattr(cfg.transport, "fault", None) is not None:
+            fc = cfg.transport.fault
+            if fc.active:
+                import dataclasses
+
+                # FaultConfig and FaultPlan share fields by contract; build
+                # by name so a new knob added to one side fails loudly here
+                # instead of being silently dropped.
+                fault = FaultPlan(**dataclasses.asdict(fc))
         inner = FakeBackend.prepopulated(
             prefix=cfg.workload.object_name_prefix,
             count=max(cfg.workload.workers, cfg.workload.threads),

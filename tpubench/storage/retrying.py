@@ -13,11 +13,12 @@ uniformly for every backend via this wrapper:
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 from tpubench.config import RetryConfig
 from tpubench.storage.base import ObjectMeta, StorageBackend, StorageError
-from tpubench.storage.retry import _is_retryable, retry_call
+from tpubench.storage.retry import Backoff, _is_retryable, retry_call
 
 
 class _ResumingReader:
@@ -54,6 +55,7 @@ class _ResumingReader:
 
     def readinto(self, buf: memoryview) -> int:
         attempts = 0
+        backoff = start = None  # lazily created: the happy path pays nothing
         while True:
             try:
                 n = self._inner.readinto(buf)
@@ -63,6 +65,18 @@ class _ResumingReader:
                     raise
                 if self._retry.max_attempts and attempts >= self._retry.max_attempts:
                     raise
+                # Same bounding as retry_call: gax backoff pause between
+                # resume attempts, and deadline_s terminates an otherwise
+                # endless resume loop (e.g. 100% injected read faults).
+                if backoff is None:
+                    backoff = Backoff(self._retry)
+                    start = time.monotonic()
+                pause = backoff.pause()
+                if self._retry.deadline_s and (
+                    time.monotonic() - start
+                ) + pause > self._retry.deadline_s:
+                    raise
+                time.sleep(pause)
                 self._reopen()
                 continue
             if n > 0 and self.first_byte_ns is None:

@@ -103,3 +103,17 @@ def fetch_shard(backend, name: str, table, shard_index: int, buffer) -> None:
         reader.close()
     if got != sh.length:
         raise IOError(f"{name} shard {shard_index}: short fetch {got}/{sh.length}")
+
+
+def zero_failed_shards(gres: GroupResult, table, buffers, local_idx) -> dict:
+    """Turn fetch failures into deterministic HOLES (SURVEY §5.3): zero each
+    failed worker's buffer (critical when buffers are reused across objects)
+    and return the uniform hole record ``{"shards": [global indices],
+    "bytes": missing}`` both pod-ingest workloads report."""
+    for e in gres.errors:
+        buffers[e.worker_id][:] = 0
+    shards = sorted(local_idx[e.worker_id] for e in gres.errors)
+    return {
+        "shards": shards,
+        "bytes": sum(table.shard(i).length for i in shards),
+    }

@@ -36,7 +36,7 @@ from tpubench.dist.shard import ShardTable
 from tpubench.metrics.report import RunResult
 from tpubench.storage import open_backend
 from tpubench.storage.base import StorageBackend
-from tpubench.workloads.common import WorkerGroup, fetch_shard
+from tpubench.workloads.common import WorkerGroup, fetch_shard, zero_failed_shards
 
 
 @dataclass
@@ -67,10 +67,16 @@ class PodIngestWorkload:
             fetch_shard(self.backend, name, table, local_idx[k], buffers[k])
 
         t0 = time.perf_counter()
-        WorkerGroup(abort_on_error=w.abort_on_error).run(
+        gres = WorkerGroup(abort_on_error=w.abort_on_error).run(
             len(local_idx), fetch, name="fetch"
         )
         t_fetch = time.perf_counter() - t0
+
+        # Failure domains (SURVEY §5.3): with abort_on_error=False a failed
+        # shard does not abort the pod — its buffer is zeroed so the gather
+        # carries a deterministic HOLE, reported below (shard indices +
+        # missing bytes) instead of crashing the run.
+        holes = zero_failed_shards(gres, table, buffers, local_idx)
 
         # ---- stage: host shard buffers → per-chip HBM --------------------
         t0 = time.perf_counter()
@@ -122,10 +128,11 @@ class PodIngestWorkload:
             gbps=(size / 1e9) / wall if wall > 0 else 0.0,
             gbps_per_chip=((size / 1e9) / wall / n) if wall > 0 else 0.0,
             n_chips=n,
-            errors=0 if ok else 1,
+            errors=len(holes["shards"]) + (0 if ok else 1),
         )
         res.extra.update(
             {
+                "holes": holes,
                 "mode": "ring" if self.ring else "all_gather",
                 "fetch_seconds": t_fetch,
                 "stage_seconds": t_stage,

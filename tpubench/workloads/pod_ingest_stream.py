@@ -36,7 +36,7 @@ from tpubench.obs.exporters import SnapshotWriter
 from tpubench.obs.profiling import annotate
 from tpubench.storage import open_backend
 from tpubench.storage.base import StorageBackend
-from tpubench.workloads.common import WorkerGroup, fetch_shard
+from tpubench.workloads.common import WorkerGroup, fetch_shard, zero_failed_shards
 
 
 @dataclass
@@ -71,9 +71,14 @@ class StreamedPodIngest:
             # sizes; stale bytes would otherwise be gathered as padding.
             fetch_shard(self.backend, plan.name, plan.table, local_idx[k], buffers[k])
 
-        WorkerGroup(abort_on_error=w.abort_on_error).run(
+        gres = WorkerGroup(abort_on_error=w.abort_on_error).run(
             len(local_idx), fetch, name="stream-fetch"
         )
+        # Failure domains (SURVEY §5.3): zero failed shards (deterministic
+        # holes — critical with reused buffers, which would otherwise leak
+        # the PREVIOUS object's bytes into this one) and report them in the
+        # same {"shards", "bytes"} shape pod_ingest uses.
+        return zero_failed_shards(gres, plan.table, buffers, local_idx)
 
     def run(self) -> RunResult:
         w = self.cfg.workload
@@ -115,6 +120,9 @@ class StreamedPodIngest:
         total_bytes = 0
         checks_ok = True
         object_checksums: list[int] = []
+        # object idx → {"shards": [...], "bytes": n} (same leaf shape as
+        # pod_ingest's extra["holes"], so result consumers parse one schema).
+        object_holes: dict[int, dict] = {}
 
         def snapshot() -> dict:
             return dict(self._progress)
@@ -134,12 +142,15 @@ class StreamedPodIngest:
             def timed_fetch(k: int):
                 t0 = time.perf_counter()
                 with annotate(f"fetch/obj{k}"):
-                    self._fetch_local(plans[k], buffer_sets[k % 2], local_idx)
-                return time.perf_counter() - t0
+                    holes = self._fetch_local(plans[k], buffer_sets[k % 2], local_idx)
+                return time.perf_counter() - t0, holes
 
             pending = pool.submit(timed_fetch, 0)
             for k in range(self.n_objects):
-                fetch_s += pending.result()  # object k's shards are on host
+                dt, holes = pending.result()  # object k's shards are on host
+                fetch_s += dt
+                if holes["shards"]:
+                    object_holes[k] = holes
                 if k + 1 < self.n_objects:
                     pending = pool.submit(timed_fetch, k + 1)  # overlap next fetch
 
@@ -196,7 +207,8 @@ class StreamedPodIngest:
             gbps=(total_bytes / 1e9) / wall if wall > 0 else 0.0,
             gbps_per_chip=((total_bytes / 1e9) / wall / n) if wall > 0 else 0.0,
             n_chips=n,
-            errors=0 if checks_ok else 1,
+            errors=sum(len(v["shards"]) for v in object_holes.values())
+            + (0 if checks_ok else 1),
         )
         res.extra.update(
             {
@@ -208,6 +220,7 @@ class StreamedPodIngest:
                 "overlap_efficiency": (fetch_s + device_s) / wall if wall > 0 else 0.0,
                 "verified": checks_ok if self.verify else None,
                 "object_checksums": object_checksums if self.verify else None,
+                "holes": {str(k): v for k, v in object_holes.items()},
             }
         )
         return res
