@@ -142,6 +142,9 @@ def test_fault_injection_from_cli(tmp_path):
         "read", "--protocol", "fake", "--workers", "2",
         "--read-call-per-worker", "2", "--object-size", "65536",
         "--staging", "none", "--fault-error-rate", "0.5",
+        "--retry-max-attempts", "20",  # bound the heavy-tailed backoff tail
+        # (attempt cap, not deadline: a deadline could spuriously surface an
+        # unlucky 503 streak as a run error; 20 attempts never will)
         "--results-dir", str(tmp_path / "r1"),
     ])
     assert rc == 0

@@ -129,8 +129,10 @@ def test_stream_failure_domain_holes(jax_cpu_devices):
         cfg, n_objects=4, backend=FailShardOfObject0(), verify=True
     )
     sh5 = table.shard(5)
-    assert res.extra["holes"] == {"0": {"shards": [5], "bytes": sh5.length}}
+    assert res.extra["holes_by_object"] == {"0": {"shards": [5], "bytes": sh5.length}}
     assert res.errors == 1
+    # Throughput counts delivered bytes only — the hole moved nothing.
+    assert res.bytes_total == 4 * 120_000 - sh5.length
     # Objects 1..3 (incl. object 2 reusing object 0's buffer set) intact:
     for k in (1, 2, 3):
         name = f"{prefix}{k % 2}"

@@ -50,6 +50,8 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--validate", action="store_true", help="on-device checksum")
     p.add_argument("--enable-tracing", action="store_true")
     p.add_argument("--trace-sample-rate", type=float)
+    p.add_argument("--trace-exporter", choices=("console", "cloud_trace"),
+                   help="span export path (with --enable-tracing)")
     p.add_argument("--profile-dir", help="capture a jax.profiler xplane trace here")
     p.add_argument("--results-dir")
     p.add_argument("--no-abort-on-error", action="store_true",
@@ -109,6 +111,8 @@ def build_config(args) -> BenchConfig:
         o.enable_tracing = True
     if args.trace_sample_rate is not None:
         o.trace_sample_rate = args.trace_sample_rate
+    if args.trace_exporter:
+        o.trace_exporter = args.trace_exporter
     if args.profile_dir:
         o.profile_dir = args.profile_dir
     if args.results_dir:

@@ -163,6 +163,9 @@ class ObservabilityConfig:
 
     enable_tracing: bool = False  # --enable-tracing, main.go:56
     trace_sample_rate: float = 1.0  # --trace-sample-rate, main.go:57
+    # Span export path: "" (spans created, not exported), "console", or
+    # "cloud_trace" (reference: trace_exporter.go:19, gated on the GCP pkg).
+    trace_exporter: str = ""
     metrics_interval_s: float = 30.0  # Stackdriver reporting interval (:44)
     metric_prefix: str = "custom.googleapis.com/tpubench/"  # (:41)
     # "none" | "json" | "otel" | "cloud" (cloud requires GCP creds; gated)

@@ -86,10 +86,23 @@ class RecordingTracer:
 
 
 class OtelTracer:
-    """OTel SDK-backed tracer (gated; reference trace_exporter.go:18-61)."""
+    """OTel SDK-backed tracer (gated; reference trace_exporter.go:18-61).
 
-    def __init__(self, sample_rate: float, service_name: str, transport: str):
-        from opentelemetry import trace
+    ``span_processor`` (or the ``exporter`` name) attaches the export path —
+    the reference ships spans to Cloud Trace; here "console" (stdout, for
+    local inspection), "cloud_trace" (gated on the GCP exporter package), or
+    a caller-supplied processor (tests use an in-memory one). Without one,
+    spans are sampled/created but not exported.
+    """
+
+    def __init__(
+        self,
+        sample_rate: float,
+        service_name: str,
+        transport: str,
+        span_processor=None,
+        exporter: str = "",
+    ):
         from opentelemetry.sdk.resources import Resource
         from opentelemetry.sdk.trace import TracerProvider
         from opentelemetry.sdk.trace.sampling import TraceIdRatioBased
@@ -100,7 +113,29 @@ class OtelTracer:
         self._provider = TracerProvider(
             sampler=TraceIdRatioBased(sample_rate), resource=resource
         )
+        if span_processor is None and exporter:
+            span_processor = self._make_processor(exporter)
+        if span_processor is not None:
+            self._provider.add_span_processor(span_processor)
         self._tracer = self._provider.get_tracer("tpubench")
+
+    @staticmethod
+    def _make_processor(exporter: str):
+        from opentelemetry.sdk.trace.export import (
+            BatchSpanProcessor,
+            ConsoleSpanExporter,
+        )
+
+        if exporter == "console":
+            return BatchSpanProcessor(ConsoleSpanExporter())
+        if exporter == "cloud_trace":
+            # Reference: texporter.New → Cloud Trace (trace_exporter.go:19).
+            from opentelemetry.exporter.cloud_trace import (  # gated
+                CloudTraceSpanExporter,
+            )
+
+            return BatchSpanProcessor(CloudTraceSpanExporter())
+        raise ValueError(f"unknown trace exporter {exporter!r}")
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs) -> Iterator[Span]:
@@ -123,12 +158,17 @@ def make_tracer(cfg) -> Tracer:
     if not cfg.obs.enable_tracing:
         return NoopTracer()
     try:
-        return OtelTracer(
-            sample_rate=cfg.obs.trace_sample_rate,
-            service_name="tpubench",
-            transport=cfg.transport.protocol,
-        )
-    except Exception:
-        # OTel SDK missing/broken: degrade to in-process recording rather
-        # than failing the benchmark run.
+        import opentelemetry.sdk.trace  # noqa: F401 — availability probe
+    except ImportError:
+        # OTel SDK missing: degrade to in-process recording rather than
+        # failing the benchmark run (spans still observable locally).
         return RecordingTracer(sample_rate=cfg.obs.trace_sample_rate)
+    # SDK present: an explicitly requested exporter that cannot be built
+    # (unknown name, cloud-trace package absent) is a CONFIG error and must
+    # surface, not silently degrade.
+    return OtelTracer(
+        sample_rate=cfg.obs.trace_sample_rate,
+        service_name="tpubench",
+        transport=cfg.transport.protocol,
+        exporter=getattr(cfg.obs, "trace_exporter", ""),
+    )

@@ -120,13 +120,16 @@ class PodIngestWorkload:
                 )
 
         wall = t_fetch + t_stage + t_gather
+        # Throughput counts DELIVERED bytes: holes moved nothing, so a
+        # degraded run must not report healthy-looking bandwidth.
+        delivered = size - holes["bytes"]
         res = RunResult(
             workload="pod_ingest",
             config=self.cfg.to_dict(),
-            bytes_total=size,
+            bytes_total=delivered,
             wall_seconds=wall,
-            gbps=(size / 1e9) / wall if wall > 0 else 0.0,
-            gbps_per_chip=((size / 1e9) / wall / n) if wall > 0 else 0.0,
+            gbps=(delivered / 1e9) / wall if wall > 0 else 0.0,
+            gbps_per_chip=((delivered / 1e9) / wall / n) if wall > 0 else 0.0,
             n_chips=n,
             errors=len(holes["shards"]) + (0 if ok else 1),
         )
@@ -138,10 +141,11 @@ class PodIngestWorkload:
                 "stage_seconds": t_stage,
                 "gather_seconds": t_gather,
                 "compile_seconds": t_compile,
-                "fetch_gbps": (size / 1e9) / t_fetch if t_fetch > 0 else 0.0,
-                "stage_gbps": (size / 1e9) / t_stage if t_stage > 0 else 0.0,
+                "object_size": size,
+                "fetch_gbps": (delivered / 1e9) / t_fetch if t_fetch > 0 else 0.0,
+                "stage_gbps": (delivered / 1e9) / t_stage if t_stage > 0 else 0.0,
                 # ICI traffic: each chip receives the other n-1 shards.
-                "gather_gbps": (size / 1e9) / t_gather if t_gather > 0 else 0.0,
+                "gather_gbps": (delivered / 1e9) / t_gather if t_gather > 0 else 0.0,
                 "ici_bytes_moved": table.shard_bytes * n * (n - 1),
                 "verified": ok,
                 "shard_bytes": table.shard_bytes,

@@ -174,7 +174,8 @@ class StreamedPodIngest:
                     gathered, csum = reassemble(arr)
                     jax.block_until_ready(gathered)
                 gather_s += time.perf_counter() - t1
-                total_bytes += plan.size
+                # Delivered bytes only: holes moved nothing (see pod_ingest).
+                total_bytes += plan.size - holes["bytes"]
                 if self.verify and jax.process_count() == 1:
                     # On-device checksum of the gathered pod array, exposed
                     # per object so callers can compare against the TRUE
@@ -220,7 +221,9 @@ class StreamedPodIngest:
                 "overlap_efficiency": (fetch_s + device_s) / wall if wall > 0 else 0.0,
                 "verified": checks_ok if self.verify else None,
                 "object_checksums": object_checksums if self.verify else None,
-                "holes": {str(k): v for k, v in object_holes.items()},
+                # Distinct key from pod_ingest's flat extra["holes"]: this is
+                # object-indexed; leaf shape {"shards", "bytes"} is shared.
+                "holes_by_object": {str(k): v for k, v in object_holes.items()},
             }
         )
         return res
