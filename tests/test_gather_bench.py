@@ -1,0 +1,36 @@
+"""ICI collective micro-benchmark over the simulated mesh."""
+
+from tpubench.config import BenchConfig
+from tpubench.workloads.gather_bench import run_gather_bench
+
+
+def _cfg():
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    return cfg
+
+
+def test_gather_bench_scaling_rows(jax_cpu_devices):
+    res = run_gather_bench(_cfg(), shard_mb=0.5, reps=2)
+    rows = res.extra["scaling"]
+    assert [r["devices"] for r in rows] == [2, 4, 8]
+    for r in rows:
+        assert r["per_chip_rx_gbps"] > 0
+        assert r["ici_bytes_moved"] == r["shard_bytes"] * r["devices"] * (r["devices"] - 1)
+    assert res.errors == 0 and res.n_chips == 8
+
+
+def test_gather_bench_ring_mode(jax_cpu_devices):
+    res = run_gather_bench(_cfg(), shard_mb=0.25, reps=1, ring=True)
+    assert res.extra["mode"] == "ring"
+    assert len(res.extra["scaling"]) == 3
+
+
+def test_gather_bench_cli(jax_cpu_devices, tmp_path):
+    from tpubench.cli import main
+
+    rc = main([
+        "gather-bench", "--protocol", "fake", "--shard-mb", "0.25",
+        "--reps", "1", "--results-dir", str(tmp_path),
+    ])
+    assert rc == 0

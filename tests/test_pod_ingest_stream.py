@@ -129,7 +129,10 @@ def test_stream_failure_domain_holes(jax_cpu_devices):
         cfg, n_objects=4, backend=FailShardOfObject0(), verify=True
     )
     sh5 = table.shard(5)
-    assert res.extra["holes_by_object"] == {"0": {"shards": [5], "bytes": sh5.length}}
+    h0 = res.extra["holes_by_object"]["0"]
+    assert list(res.extra["holes_by_object"]) == ["0"]
+    assert h0["shards"] == [5] and h0["bytes"] == sh5.length
+    assert h0["global"] == {"shards": 1, "bytes": sh5.length}  # 1-process: identity
     assert res.errors == 1
     # Throughput counts delivered bytes only — the hole moved nothing.
     assert res.bytes_total == 4 * 120_000 - sh5.length

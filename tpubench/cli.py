@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from tpubench.config import KB, MB, BenchConfig, preset
@@ -229,6 +230,9 @@ def main(argv=None) -> int:
     stream = add("stream", "pipelined multi-object pod ingest (fetch ∥ stage+gather)")
     stream.add_argument("--objects", type=int, default=8)
     stream.add_argument("--snapshot", help="periodic progress snapshot JSON path")
+    gb = add("gather-bench", "ICI all-gather bandwidth vs mesh size")
+    gb.add_argument("--shard-mb", type=float, default=4.0)
+    gb.add_argument("--reps", type=int, default=5)
     fs = {
         "read-fs": "sequential FS read (read_operation)",
         "write": "durable write (write_operations)",
@@ -248,6 +252,17 @@ def main(argv=None) -> int:
     args = top.parse_args(argv)
     cfg = build_config(args)
 
+    def pin_platform() -> None:
+        # Honor JAX_PLATFORMS even when a device plugin rewrites it at
+        # import (this image's TPU plugin does): the config knob wins over
+        # the plugin, so JAX_PLATFORMS=cpu + forced host device count
+        # reliably yields the simulated mesh the README documents. Called
+        # only on jax-using paths — save-config/prepare stay jax-free.
+        if os.environ.get("JAX_PLATFORMS"):
+            import jax
+
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     if args.save_config:
         with open(args.save_config, "w") as f:
             f.write(cfg.to_json())
@@ -257,6 +272,7 @@ def main(argv=None) -> int:
     if args.cmd == "info":
         print(cfg.to_json())
         try:
+            pin_platform()
             import jax
 
             print(f"devices: {jax.devices()}", file=sys.stderr)
@@ -267,6 +283,7 @@ def main(argv=None) -> int:
         cmd_prepare(cfg, args)
         return 0
     if args.cmd == "sweep":
+        pin_platform()
         from tpubench.obs.profiling import maybe_profile
 
         with maybe_profile(cfg.obs.profile_dir):
@@ -276,6 +293,7 @@ def main(argv=None) -> int:
         return 0
 
     direct = not args.no_direct
+    pin_platform()
     from tpubench.obs.profiling import maybe_profile
 
     with maybe_profile(cfg.obs.profile_dir):
@@ -310,6 +328,12 @@ def main(argv=None) -> int:
             from tpubench.workloads.fsbench import run_ssd_compare
 
             res = run_ssd_compare(cfg, direct=direct)
+        elif args.cmd == "gather-bench":
+            from tpubench.workloads.gather_bench import run_gather_bench
+
+            res = run_gather_bench(
+                cfg, shard_mb=args.shard_mb, reps=args.reps, ring=args.ring
+            )
         else:  # pragma: no cover
             raise SystemExit(f"unknown cmd {args.cmd}")
     if cfg.obs.profile_dir:

@@ -157,11 +157,19 @@ def make_tracer(cfg) -> Tracer:
     """From an ObservabilityConfig (+TransportConfig context)."""
     if not cfg.obs.enable_tracing:
         return NoopTracer()
+    requested_exporter = getattr(cfg.obs, "trace_exporter", "")
     try:
         import opentelemetry.sdk.trace  # noqa: F401 — availability probe
     except ImportError:
-        # OTel SDK missing: degrade to in-process recording rather than
-        # failing the benchmark run (spans still observable locally).
+        if requested_exporter:
+            # The user explicitly asked for an export path; dropping it
+            # silently would hide that no spans ever leave the process.
+            raise RuntimeError(
+                f"trace_exporter={requested_exporter!r} requires the "
+                "opentelemetry-sdk package, which is not installed"
+            ) from None
+        # OTel SDK missing, no exporter requested: degrade to in-process
+        # recording rather than failing the benchmark run.
         return RecordingTracer(sample_rate=cfg.obs.trace_sample_rate)
     # SDK present: an explicitly requested exporter that cannot be built
     # (unknown name, cloud-trace package absent) is a CONFIG error and must
@@ -170,5 +178,5 @@ def make_tracer(cfg) -> Tracer:
         sample_rate=cfg.obs.trace_sample_rate,
         service_name="tpubench",
         transport=cfg.transport.protocol,
-        exporter=getattr(cfg.obs, "trace_exporter", ""),
+        exporter=requested_exporter,
     )

@@ -117,3 +117,24 @@ def zero_failed_shards(gres: GroupResult, table, buffers, local_idx) -> dict:
         "shards": shards,
         "bytes": sum(table.shard(i).length for i in shards),
     }
+
+
+def global_hole_totals(holes: dict) -> dict:
+    """Pod-wide hole totals. Each process only sees failures of ITS local
+    shard fetches; delivered-bytes accounting must subtract every host's
+    holes or non-failing hosts report healthy bandwidth for a degraded
+    gather. Single-process: identity. Multi-host: all-gather the per-process
+    (shard_count, bytes) pair over DCN and sum."""
+    import jax
+
+    if jax.process_count() == 1:
+        return {"shards": len(holes["shards"]), "bytes": holes["bytes"]}
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    local = np.array([len(holes["shards"]), holes["bytes"]], dtype=np.int64)
+    all_counts = np.asarray(multihost_utils.process_allgather(local))
+    return {
+        "shards": int(all_counts[:, 0].sum()),
+        "bytes": int(all_counts[:, 1].sum()),
+    }

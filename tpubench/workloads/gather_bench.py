@@ -1,0 +1,92 @@
+"""ICI collective micro-benchmark: all-gather bandwidth vs mesh size.
+
+The reference has no inter-worker communication to measure; its closest
+transport benchmark is the gRPC/DirectPath client path (SURVEY §5.8). The
+TPU-native framework's transport IS the ICI collective, so it gets its own
+benchmark: for each device count n (powers of two up to the host's chips),
+shard a buffer over an n-chip 1-D mesh and time the jitted all-gather (XLA
+lowering and, optionally, the explicit ppermute ring), reporting effective
+per-chip collective bandwidth.
+
+Bandwidth definition: one all-gather moves ``shard_bytes × n × (n-1)`` bytes
+over ICI in total (each chip receives the other n-1 shards); per-chip
+receive bandwidth is ``shard_bytes × (n-1) / t``.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+
+from tpubench.config import BenchConfig
+from tpubench.dist.reassemble import (
+    make_mesh,
+    make_reassemble,
+    make_ring_reassemble,
+    shard_to_device_array,
+)
+from tpubench.metrics.report import RunResult
+
+
+def run_gather_bench(
+    cfg: BenchConfig,
+    shard_mb: float = 4.0,
+    reps: int = 5,
+    ring: bool = False,
+) -> RunResult:
+    lane = cfg.staging.lane
+    devices = jax.devices()
+    shard_bytes = int(shard_mb * 1024 * 1024) // lane * lane
+    rows = []
+    n = 2
+    sizes = []
+    while n <= len(devices):
+        sizes.append(n)
+        n *= 2
+    if not sizes:
+        sizes = [1]
+
+    rng = np.random.default_rng(0)
+    for n in sizes:
+        mesh = make_mesh(devices[:n], axis=cfg.dist.mesh_axis)
+        shards = [
+            rng.integers(0, 256, (shard_bytes,), dtype=np.uint8) for _ in range(n)
+        ]
+        arr = shard_to_device_array(shards, mesh, cfg.dist.mesh_axis, lane)
+        fn = (make_ring_reassemble if ring else make_reassemble)(
+            mesh, cfg.dist.mesh_axis
+        )
+        jax.block_until_ready(fn(arr)[0])  # compile, uncounted
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            gathered, _ = fn(arr)
+        jax.block_until_ready(gathered)
+        dt = (time.perf_counter() - t0) / reps
+        per_chip_rx = shard_bytes * (n - 1) / dt / 1e9 if dt > 0 else 0.0
+        rows.append(
+            {
+                "devices": n,
+                "shard_bytes": shard_bytes,
+                "seconds": dt,
+                "ici_bytes_moved": shard_bytes * n * (n - 1),
+                "per_chip_rx_gbps": per_chip_rx,
+                "total_gbps": shard_bytes * n * (n - 1) / dt / 1e9 if dt > 0 else 0.0,
+            }
+        )
+
+    best = max(rows, key=lambda r: r["per_chip_rx_gbps"])
+    res = RunResult(
+        workload="gather_bench",
+        config=cfg.to_dict(),
+        bytes_total=sum(r["ici_bytes_moved"] for r in rows) * reps,
+        wall_seconds=sum(r["seconds"] for r in rows) * reps,
+        gbps=best["total_gbps"],
+        gbps_per_chip=best["per_chip_rx_gbps"],
+        n_chips=max(r["devices"] for r in rows),
+        errors=0,
+    )
+    res.extra.update({"mode": "ring" if ring else "all_gather", "scaling": rows})
+    return res

@@ -36,7 +36,12 @@ from tpubench.dist.shard import ShardTable
 from tpubench.metrics.report import RunResult
 from tpubench.storage import open_backend
 from tpubench.storage.base import StorageBackend
-from tpubench.workloads.common import WorkerGroup, fetch_shard, zero_failed_shards
+from tpubench.workloads.common import (
+    WorkerGroup,
+    fetch_shard,
+    global_hole_totals,
+    zero_failed_shards,
+)
 
 
 @dataclass
@@ -121,8 +126,11 @@ class PodIngestWorkload:
 
         wall = t_fetch + t_stage + t_gather
         # Throughput counts DELIVERED bytes: holes moved nothing, so a
-        # degraded run must not report healthy-looking bandwidth.
-        delivered = size - holes["bytes"]
+        # degraded run must not report healthy-looking bandwidth. Hole
+        # totals are aggregated pod-wide (a failing shard on ANOTHER host
+        # degrades this host's gathered object just the same).
+        ghole = global_hole_totals(holes)
+        delivered = size - ghole["bytes"]
         res = RunResult(
             workload="pod_ingest",
             config=self.cfg.to_dict(),
@@ -131,11 +139,12 @@ class PodIngestWorkload:
             gbps=(delivered / 1e9) / wall if wall > 0 else 0.0,
             gbps_per_chip=((delivered / 1e9) / wall / n) if wall > 0 else 0.0,
             n_chips=n,
-            errors=len(holes["shards"]) + (0 if ok else 1),
+            errors=ghole["shards"] + (0 if ok else 1),
         )
         res.extra.update(
             {
-                "holes": holes,
+                "holes": holes,  # this process's failed shards
+                "holes_global": ghole,  # pod-wide totals used for delivered bytes
                 "mode": "ring" if self.ring else "all_gather",
                 "fetch_seconds": t_fetch,
                 "stage_seconds": t_stage,

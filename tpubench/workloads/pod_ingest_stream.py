@@ -36,7 +36,12 @@ from tpubench.obs.exporters import SnapshotWriter
 from tpubench.obs.profiling import annotate
 from tpubench.storage import open_backend
 from tpubench.storage.base import StorageBackend
-from tpubench.workloads.common import WorkerGroup, fetch_shard, zero_failed_shards
+from tpubench.workloads.common import (
+    WorkerGroup,
+    fetch_shard,
+    global_hole_totals,
+    zero_failed_shards,
+)
 
 
 @dataclass
@@ -149,8 +154,11 @@ class StreamedPodIngest:
             for k in range(self.n_objects):
                 dt, holes = pending.result()  # object k's shards are on host
                 fetch_s += dt
-                if holes["shards"]:
-                    object_holes[k] = holes
+                # Pod-wide totals (collective over DCN when multi-host —
+                # called unconditionally so every process participates).
+                ghole = global_hole_totals(holes)
+                if ghole["shards"]:
+                    object_holes[k] = {**holes, "global": ghole}
                 if k + 1 < self.n_objects:
                     pending = pool.submit(timed_fetch, k + 1)  # overlap next fetch
 
@@ -174,8 +182,9 @@ class StreamedPodIngest:
                     gathered, csum = reassemble(arr)
                     jax.block_until_ready(gathered)
                 gather_s += time.perf_counter() - t1
-                # Delivered bytes only: holes moved nothing (see pod_ingest).
-                total_bytes += plan.size - holes["bytes"]
+                # Delivered bytes only: holes moved nothing (see pod_ingest);
+                # pod-wide totals so another host's failure counts here too.
+                total_bytes += plan.size - ghole["bytes"]
                 if self.verify and jax.process_count() == 1:
                     # On-device checksum of the gathered pod array, exposed
                     # per object so callers can compare against the TRUE
@@ -208,7 +217,7 @@ class StreamedPodIngest:
             gbps=(total_bytes / 1e9) / wall if wall > 0 else 0.0,
             gbps_per_chip=((total_bytes / 1e9) / wall / n) if wall > 0 else 0.0,
             n_chips=n,
-            errors=sum(len(v["shards"]) for v in object_holes.values())
+            errors=sum(v["global"]["shards"] for v in object_holes.values())
             + (0 if checks_ok else 1),
         )
         res.extra.update(
