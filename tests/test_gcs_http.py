@@ -147,3 +147,96 @@ def test_concurrent_readers(server):
         th.join()
     assert not errors
     c.close()
+
+
+# ---------------------------------------------------- native receive path --
+
+
+def _native_client(server) -> GcsHttpBackend:
+    t = TransportConfig(endpoint=server.endpoint, native_receive=True)
+    return GcsHttpBackend(bucket="testbucket", transport=t)
+
+
+def _native_available() -> bool:
+    from tpubench.native.engine import get_engine
+
+    return get_engine() is not None
+
+
+pytestmark_native = pytest.mark.skipif(
+    not _native_available(), reason="native engine unavailable"
+)
+
+
+@pytestmark_native
+def test_native_receive_full_read(server):
+    import time
+
+    c = _native_client(server)
+    expected = deterministic_bytes("bench/file_0", 1_000_000).tobytes()
+    t0 = time.perf_counter_ns()
+    reader = c.open_read("bench/file_0")
+    granule = memoryview(bytearray(128 * 1024))
+    got = bytearray()
+    total, fb = read_object_through(reader, granule, sink=lambda mv: got.extend(mv))
+    assert total == 1_000_000 and bytes(got) == expected
+    # Native first-byte stamp is CLOCK_MONOTONIC — comparable to
+    # perf_counter_ns and must fall inside the request window.
+    assert fb is not None and 0 < fb - t0 < 60 * 10**9
+    c.close()
+
+
+@pytestmark_native
+def test_native_receive_range_read(server):
+    c = _native_client(server)
+    expected = deterministic_bytes("bench/file_1", 1_000_000).tobytes()
+    reader = c.open_read("bench/file_1", start=1000, length=4096)
+    buf = memoryview(bytearray(8192))
+    n = reader.readinto(buf)
+    assert bytes(buf[:n]) == expected[1000 : 1000 + n]
+    reader.close()
+    c.close()
+
+
+@pytestmark_native
+def test_native_receive_rejects_https(server):
+    from tpubench.storage.auth import AnonymousTokenSource
+
+    t = TransportConfig(endpoint="https://storage.googleapis.com",
+                        native_receive=True)
+    c = GcsHttpBackend(bucket="b", transport=t,
+                       token_source=AnonymousTokenSource())
+    with pytest.raises(StorageError, match="plain-HTTP"):
+        c.open_read("x")
+
+
+@pytestmark_native
+def test_native_receive_missing_object_404(server):
+    c = _native_client(server)
+    with pytest.raises(StorageError):
+        c.open_read("bench/nope")
+    c.close()
+
+
+@pytestmark_native
+def test_native_receive_read_workload_end_to_end(server):
+    """Full hot loop over the C++ receive path: socket → aligned buffer →
+    (zero-copy sink) staging, bytes validated on device."""
+    from tpubench.config import BenchConfig
+    from tpubench.staging.device import make_sink_factory
+    from tpubench.workloads.read import run_read
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "http"
+    cfg.transport.endpoint = server.endpoint
+    cfg.transport.native_receive = True
+    cfg.workload.bucket = "testbucket"
+    cfg.workload.object_name_prefix = "bench/file_"
+    cfg.workload.workers = 2
+    cfg.workload.read_calls_per_worker = 2
+    cfg.workload.object_size = 1_000_000
+    cfg.staging.validate_checksum = True
+    res = run_read(cfg, sink_factory=make_sink_factory(cfg))
+    assert res.errors == 0
+    assert res.extra["checksum_ok"] is True
+    assert res.bytes_total == 2 * 2 * 1_000_000

@@ -69,6 +69,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "rates near 1.0 or the run retries indefinitely")
     p.add_argument("--retry-max-attempts", type=int,
                    help="retry attempt cap (0 = unlimited, reference default)")
+    p.add_argument("--native-receive", action="store_true",
+                   help="C++ HTTP receive path into pre-registered buffers "
+                        "(plain-HTTP endpoints only)")
     p.add_argument("--no-direct", action="store_true", help="skip O_DIRECT")
     p.add_argument("--ring", action="store_true",
                    help="pod-ingest: explicit ppermute ring instead of all_gather")
@@ -130,6 +133,8 @@ def build_config(args) -> BenchConfig:
         t.retry.deadline_s = args.retry_deadline
     if args.retry_max_attempts is not None:
         t.retry.max_attempts = args.retry_max_attempts
+    if args.native_receive:
+        t.native_receive = True
     return cfg
 
 

@@ -74,6 +74,10 @@ class TransportConfig:
     max_conns_per_host: int = 100  # main.go:31
     max_idle_conns_per_host: int = 100  # main.go:32
     http2: bool = False  # reference disables HTTP/2 for perf (main.go:64-72)
+    # Opt-in C++ receive path (SURVEY §2.5.1): body streams from the socket
+    # into a pre-registered aligned buffer with a native first-byte stamp.
+    # Plain-HTTP endpoints only; one fresh connection per GET.
+    native_receive: bool = False
     user_agent: str = "tpubench"  # reference: "prince" (main.go:100)
     # gRPC path (CreateGrpcClient, main.go:106-117):
     grpc_conn_pool_size: int = 1  # main.go:30

@@ -149,6 +149,37 @@ class _HttpReader:
         self._conn = None
 
 
+class _NativeBufReader:
+    """Reader over a natively received body (SURVEY §2.5.1: the streaming
+    receive ran in C++ straight into a pre-registered aligned buffer).
+
+    The GET has already completed by construction time; ``first_byte_ns``
+    is the C++-side CLOCK_MONOTONIC stamp of the first payload byte —
+    directly comparable with ``time.perf_counter_ns()`` on Linux, and more
+    precise than the Python-side stamp (no interpreter wakeup in between).
+    ``readinto`` serves granule-sized slices from the buffer.
+    """
+
+    def __init__(self, buf, length: int, first_byte_ns: int):
+        self._buf = buf
+        self._len = length
+        self._pos = 0
+        self.first_byte_ns: Optional[int] = first_byte_ns
+
+    def readinto(self, out: memoryview) -> int:
+        n = min(len(out), self._len - self._pos)
+        if n <= 0:
+            return 0
+        out[:n] = self._buf.view(self._len)[self._pos : self._pos + n]
+        self._pos += n
+        return n
+
+    def close(self) -> None:
+        if self._buf is not None:
+            self._buf.free()
+            self._buf = None
+
+
 class GcsHttpBackend:
     """Thread-safe JSON-API client; one instance shared by all workers
     (reference shares one ``*storage.Client``, main.go:200-203)."""
@@ -177,6 +208,9 @@ class GcsHttpBackend:
         self._tokens = token_source or make_token_source(
             self.transport.key_file, self.transport.endpoint
         )
+        # Object sizes for the native receive path (buffer pre-sizing).
+        self._stat_cache: dict[str, int] = {}
+        self._stat_cache_lock = threading.Lock()
 
     # ------------------------------------------------------------ request --
     def _headers(self) -> dict[str, str]:
@@ -232,6 +266,8 @@ class GcsHttpBackend:
         )
 
     def open_read(self, name: str, start: int = 0, length: Optional[int] = None):
+        if self.transport.native_receive:
+            return self._open_read_native(name, start, length)
         headers = {}
         if start or length is not None:
             end = "" if length is None else str(start + length - 1)
@@ -241,6 +277,82 @@ class GcsHttpBackend:
         )
         clen = int(resp.headers.get("Content-Length", "0"))
         return _HttpReader(self._pool, conn, resp, clen)
+
+    def _open_read_native(self, name: str, start: int, length: Optional[int]):
+        """Opt-in C++ receive path (``transport.native_receive``): the body
+        streams from the socket into a pre-registered posix_memalign'd
+        buffer with a native first-byte timestamp. Tradeoffs vs the pooled
+        Python path: plain HTTP only (no TLS in the engine — hermetic fake
+        servers and private endpoints) and one fresh connection per GET (no
+        keep-alive pool), so it measures the pure receive path, not
+        connection reuse."""
+        from tpubench.native.engine import get_engine
+
+        engine = get_engine()
+        if engine is None:
+            raise StorageError(
+                "transport.native_receive=True but the native engine is "
+                "unavailable (C++ toolchain missing?)", transient=False
+            )
+        if self._scheme != "http":
+            raise StorageError(
+                "transport.native_receive supports plain-HTTP endpoints only "
+                f"(endpoint scheme is {self._scheme!r}; the C++ receive path "
+                "has no TLS)", transient=False
+            )
+        if length is None:
+            # Size the receive buffer from object metadata, cached per name
+            # (one extra stat on the first read of each object).
+            with self._stat_cache_lock:
+                size = self._stat_cache.get(name)
+            if size is None:
+                size = self.stat(name).size
+                with self._stat_cache_lock:
+                    self._stat_cache[name] = size
+            want = size - start
+        else:
+            want = length
+        headers = "".join(
+            f"{k}: {v}\r\n"
+            for k, v in self._headers().items()
+            if k.lower() != "host"  # tb_http_get sets Host itself
+        )
+        if length is not None:
+            headers += f"Range: bytes={start}-{start + want - 1}\r\n"
+        elif start:
+            # Open-ended: never derive the end from (possibly stale) stat —
+            # a grown object then fails loudly (body-exceeds-buffer) instead
+            # of being silently truncated by a too-short Range.
+            headers += f"Range: bytes={start}-\r\n"
+        buf = engine.alloc(max(4096, want))
+        try:
+            r = engine.http_get(
+                self._host, self._port, self._opath(name) + "?alt=media",
+                buf, headers=headers,
+            )
+        except NativeError as e:
+            # Module contract: this layer raises classified StorageErrors.
+            # Socket-level failures (resets, refusals, timeouts) are
+            # transient and retried under policy; protocol-shape errors
+            # (malformed response, chunked encoding, body too big) are not.
+            buf.free()
+            with self._stat_cache_lock:
+                self._stat_cache.pop(name, None)  # size may be stale
+            transient = not any(
+                s in str(e)
+                for s in ("malformed", "exceeds buffer", "chunked")
+            )
+            raise StorageError(f"native GET {name}: {e}", transient=transient) from e
+        except Exception:
+            buf.free()
+            raise
+        if r["status"] not in (200, 206):
+            buf.free()
+            raise StorageError(
+                f"GET {name}: HTTP {r['status']}", transient=r["status"] >= 500,
+                code=r["status"],
+            )
+        return _NativeBufReader(buf, r["length"], r["first_byte_ns"])
 
     def write(self, name: str, data: bytes) -> ObjectMeta:
         path = (
