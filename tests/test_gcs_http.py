@@ -240,3 +240,246 @@ def test_native_receive_read_workload_end_to_end(server):
     assert res.errors == 0
     assert res.extra["checksum_ok"] is True
     assert res.bytes_total == 2 * 2 * 1_000_000
+
+
+# ------------------------------------------- native receive failure paths --
+# A raw TCP server crafting broken responses: the engine must return distinct
+# error codes (engine.cc TB_* ABI) and the backend must classify on them —
+# transient for network conditions, permanent for protocol-shape failures —
+# and free the pre-registered receive buffer on every failure path.
+
+
+class _BrokenHttpServer:
+    """Serves one scripted response per connection, then closes the socket."""
+
+    def __init__(self, body_len: int, send_len: int, raw: bytes = b""):
+        import socket
+        import threading
+
+        self._body_len = body_len
+        self._send_len = send_len
+        self._raw = raw  # when set, sent verbatim instead of a response
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(4)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            with conn:
+                conn.settimeout(5)
+                try:
+                    req = b""
+                    while b"\r\n\r\n" not in req:
+                        chunk = conn.recv(65536)
+                        if not chunk:
+                            break
+                        req += chunk
+                    if self._raw:
+                        conn.sendall(self._raw)
+                        continue
+                    hdr = (
+                        f"HTTP/1.1 200 OK\r\nContent-Length: {self._body_len}"
+                        "\r\nConnection: close\r\n\r\n"
+                    ).encode()
+                    conn.sendall(hdr + b"x" * self._send_len)
+                    # Orderly FIN with the body short of Content-Length: the
+                    # client's recv returns 0 and the engine's short-body
+                    # check (TB_ESHORT) — not a socket errno — must fire.
+                except OSError:
+                    pass
+
+    def close(self):
+        self._stop.set()
+        self._sock.close()
+
+
+def _tracked_native_client(endpoint, monkeypatch):
+    """Native-receive client whose engine.alloc is spied so tests can assert
+    the receive buffer was freed on the failure path."""
+    from tpubench.native.engine import get_engine
+
+    eng = get_engine()
+    allocated = []
+    real_alloc = eng.alloc
+
+    def spy_alloc(size, align=4096):
+        buf = real_alloc(size, align)
+        allocated.append(buf)
+        return buf
+
+    monkeypatch.setattr(eng, "alloc", spy_alloc)
+    t = TransportConfig(endpoint=endpoint, native_receive=True)
+    return GcsHttpBackend(bucket="testbucket", transport=t), allocated
+
+
+@pytestmark_native
+def test_native_receive_connection_killed_mid_body(monkeypatch):
+    """Peer dies mid-body: classified transient StorageError (TB_ESHORT),
+    never a NameError/raw NativeError, and the aligned buffer is freed."""
+    srv = _BrokenHttpServer(body_len=64 * 1024, send_len=8 * 1024)
+    try:
+        c, allocated = _tracked_native_client(srv.endpoint, monkeypatch)
+        with pytest.raises(StorageError) as ei:
+            c.open_read("bench/file_0", length=64 * 1024)
+        assert ei.value.transient is True
+        # The engine's short-body code (TB_ESHORT), not a socket errno,
+        # must be the classified cause — codes are the ABI, not wording.
+        assert ei.value.__cause__.code == -1004
+        assert allocated and all(b._ptr == 0 for b in allocated)
+        c.close()
+    finally:
+        srv.close()
+
+
+@pytestmark_native
+def test_native_receive_body_exceeds_buffer_is_permanent(monkeypatch):
+    """Server ships more bytes than the requested range: protocol-shape
+    failure (TB_ETOOBIG) — permanent, because a retry reproduces it."""
+    srv = _BrokenHttpServer(body_len=64 * 1024, send_len=64 * 1024)
+    try:
+        c, allocated = _tracked_native_client(srv.endpoint, monkeypatch)
+        with pytest.raises(StorageError) as ei:
+            c.open_read("bench/file_0", length=100)  # 4096-byte min buffer
+        assert ei.value.transient is False
+        assert allocated and all(b._ptr == 0 for b in allocated)
+        c.close()
+    finally:
+        srv.close()
+
+
+@pytestmark_native
+def test_native_receive_connection_refused_is_transient(monkeypatch):
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens here now
+    c, allocated = _tracked_native_client(f"http://127.0.0.1:{port}", monkeypatch)
+    with pytest.raises(StorageError) as ei:
+        c.open_read("bench/file_0", length=4096)
+    assert ei.value.transient is True
+    assert allocated and all(b._ptr == 0 for b in allocated)
+    c.close()
+
+
+@pytestmark_native
+def test_native_receive_eof_mid_headers_is_transient(monkeypatch):
+    """Peer FIN before the header terminator: early close, transient
+    (TB_ESHORT) — not a permanent protocol error."""
+    srv = _BrokenHttpServer(0, 0, raw=b"HTTP/1.1 200 OK\r\nContent-Le")
+    try:
+        c, allocated = _tracked_native_client(srv.endpoint, monkeypatch)
+        with pytest.raises(StorageError) as ei:
+            c.open_read("bench/file_0", length=4096)
+        assert ei.value.transient is True
+        assert ei.value.__cause__.code == -1004
+        assert allocated and all(b._ptr == 0 for b in allocated)
+        c.close()
+    finally:
+        srv.close()
+
+
+@pytestmark_native
+def test_native_receive_trailing_junk_ignored(monkeypatch):
+    """Bytes past Content-Length are never read (standard client semantics):
+    the declared body is served intact, deterministically, regardless of how
+    the kernel batches the excess."""
+    body = b"a" * 1000
+    raw = (
+        b"HTTP/1.1 200 OK\r\nContent-Length: 1000\r\nConnection: close\r\n\r\n"
+        + body + b"JUNKJUNKJUNK"
+    )
+    srv = _BrokenHttpServer(0, 0, raw=raw)
+    try:
+        c, _ = _tracked_native_client(srv.endpoint, monkeypatch)
+        r = c.open_read("bench/file_0", length=1000)
+        out = memoryview(bytearray(2000))
+        n = r.readinto(out)
+        assert n == 1000 and bytes(out[:1000]) == body
+        assert r.readinto(out) == 0
+        r.close()
+        c.close()
+    finally:
+        srv.close()
+
+
+@pytestmark_native
+def test_native_receive_chunked_rejected(monkeypatch):
+    """Transfer-Encoding: chunked must be rejected loudly (TB_ECHUNKED,
+    permanent) — never returned as body bytes with chunk framing inside."""
+    raw = (
+        b"HTTP/1.1 200 OK\r\nTransfer-Encoding: chunked\r\n"
+        b"Connection: close\r\n\r\n5\r\nhello\r\n0\r\n\r\n"
+    )
+    srv = _BrokenHttpServer(0, 0, raw=raw)
+    try:
+        c, allocated = _tracked_native_client(srv.endpoint, monkeypatch)
+        with pytest.raises(StorageError) as ei:
+            c.open_read("bench/file_0", length=4096)
+        assert ei.value.transient is False
+        assert ei.value.__cause__.code == -1005
+        assert allocated and all(b._ptr == 0 for b in allocated)
+        c.close()
+    finally:
+        srv.close()
+
+
+@pytestmark_native
+def test_native_receive_grown_object_recovers_via_retry():
+    """Object grows after its size was stat-cached: the too-small buffer
+    fails the GET, but the failure is transient and pops the cache, so the
+    retry layer re-stats and the read succeeds (gcs_http grown-object
+    recovery design)."""
+    from tpubench.storage.retrying import RetryingBackend
+
+    be = FakeBackend.prepopulated("grow/file_", count=1, size=10_000)
+    with FakeGcsServer(be) as srv:
+        t = TransportConfig(
+            endpoint=srv.endpoint, native_receive=True,
+            retry=RetryConfig(jitter=False, initial_backoff_s=0.001,
+                              max_backoff_s=0.01, max_attempts=3),
+        )
+        raw = GcsHttpBackend(bucket="testbucket", transport=t)
+        c = RetryingBackend(raw, t.retry)
+        granule = memoryview(bytearray(64 * 1024))
+        total, _ = read_object_through(c.open_read("grow/file_0"), granule)
+        assert total == 10_000  # stat now cached at 10_000
+        grown = deterministic_bytes("grow/file_0", 50_000).tobytes()
+        be.write("grow/file_0", grown)
+        got = bytearray()
+        total, _ = read_object_through(
+            c.open_read("grow/file_0"), granule, sink=lambda mv: got.extend(mv)
+        )
+        assert total == 50_000 and bytes(got) == grown
+        c.close()
+
+
+@pytestmark_native
+def test_native_receive_chunked_rejected_case_insensitive(monkeypatch):
+    raw = (
+        b"HTTP/1.1 200 OK\r\ntransfer-encoding: Chunked\r\n"
+        b"Connection: close\r\n\r\n5\r\nhello\r\n0\r\n\r\n"
+    )
+    srv = _BrokenHttpServer(0, 0, raw=raw)
+    try:
+        c, _ = _tracked_native_client(srv.endpoint, monkeypatch)
+        with pytest.raises(StorageError) as ei:
+            c.open_read("bench/file_0", length=4096)
+        assert ei.value.__cause__.code == -1005
+        c.close()
+    finally:
+        srv.close()

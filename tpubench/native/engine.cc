@@ -273,10 +273,22 @@ void tb_fill_random(void* buf, int64_t n, uint64_t seed) {
 // TLS is deliberately out of scope: the native receive path exists to
 // measure the receive loop itself against localhost servers; real-GCS https
 // traffic uses the Python client (SURVEY hard-part (b)).
+// Error-code contract with the Python layer (gcs_http classifies
+// transient-vs-permanent on these codes, NOT on message text): -1001/-1002
+// are protocol-shape failures (permanent — retrying the same request against
+// the same server yields the same malformed/oversized response); -1003/-1004
+// are network-condition failures (transient, like plain -errno socket
+// errors).
 enum {
-  TB_EPROTO = -1001,    // malformed response
-  TB_ETOOBIG = -1002,   // body exceeds buffer
-  TB_ERESOLVE = -1003,  // getaddrinfo failure
+  TB_EPROTO = -1001,    // malformed response [permanent]
+  TB_ETOOBIG = -1002,   // body exceeds buffer [permanent]
+  TB_ERESOLVE = -1003,  // getaddrinfo failure [transient]
+  TB_ESHORT = -1004,    // peer closed before the response was complete
+                        // (mid-headers or body short of Content-Length)
+                        // [transient]
+  TB_ECHUNKED = -1005,  // Transfer-Encoding: chunked — unsupported here;
+                        // rejected loudly instead of returning chunk
+                        // framing as body bytes [permanent]
 };
 
 int64_t tb_http_get(const char* host, int port, const char* path,
@@ -352,7 +364,10 @@ int64_t tb_http_get(const char* host, int port, const char* path,
   }
   if (!body_start) {
     close(fd);
-    return TB_EPROTO;
+    // Header buffer exhausted without a terminator: the server is speaking
+    // broken HTTP (permanent). EOF mid-headers: early close (transient) —
+    // same condition class as a body cut short.
+    return hlen >= hdr_cap ? TB_EPROTO : TB_ESHORT;
   }
 
   int status = 0;
@@ -363,34 +378,55 @@ int64_t tb_http_get(const char* host, int port, const char* path,
   if (status_out) *status_out = status;
 
   int64_t content_len = -1;
-  // Case-insensitive Content-Length scan over the header block.
+  // Case-insensitive Content-Length / Transfer-Encoding scan over the
+  // header block. Chunked bodies are rejected (TB_ECHUNKED): this receive
+  // path has no de-chunker, and copying chunk framing into the buffer as
+  // body bytes would be silent corruption.
   for (char* line = hdr; line < body_start;) {
     char* eol = static_cast<char*>(memmem(line, body_start - line, "\r\n", 2));
     if (!eol) break;
     if (strncasecmp(line, "Content-Length:", 15) == 0)
       content_len = strtoll(line + 15, nullptr, 10);
+    if (strncasecmp(line, "Transfer-Encoding:", 18) == 0) {
+      // Transfer-coding names are case-insensitive (RFC 9112 §7).
+      for (char* p = line + 18; p + 7 <= eol; p++) {
+        if (strncasecmp(p, "chunked", 7) == 0) {
+          close(fd);
+          return TB_ECHUNKED;
+        }
+      }
+    }
     line = eol + 2;
   }
 
+  // Read exactly Content-Length body bytes (standard HTTP-client semantics:
+  // bytes past Content-Length are never read, so a server shipping trailing
+  // junk classifies deterministically regardless of packet boundaries; the
+  // connection is close-mode, one GET per connection, so unread trailing
+  // bytes are harmless).
   char* out = static_cast<char*>(buf);
   int64_t got = 0;
   if (body_in_hdr > 0) {
-    if (body_in_hdr > buf_len) {
+    int64_t take = body_in_hdr;
+    if (content_len >= 0 && take > content_len) take = content_len;
+    if (take > buf_len) {
       close(fd);
       return TB_ETOOBIG;
     }
-    memcpy(out, body_start, body_in_hdr);
-    got = body_in_hdr;
+    memcpy(out, body_start, take);
+    got = take;
   }
   for (;;) {
     if (content_len >= 0 && got >= content_len) break;
-    if (got >= buf_len) {
-      // Buffer full: with known length this is an error; with unknown
+    int64_t want = buf_len - got;
+    if (content_len >= 0 && content_len - got < want) want = content_len - got;
+    if (want <= 0) {
+      // Buffer full: with known length the body doesn't fit; with unknown
       // length (close-delimited) it's also an error for our use.
       close(fd);
       return TB_ETOOBIG;
     }
-    ssize_t k = recv(fd, out + got, buf_len - got, 0);
+    ssize_t k = recv(fd, out + got, want, 0);
     if (k < 0) {
       if (errno == EINTR) continue;
       int e = errno;
@@ -402,7 +438,8 @@ int64_t tb_http_get(const char* host, int port, const char* path,
     got += k;
   }
   close(fd);
-  if (content_len >= 0 && got != content_len) return TB_EPROTO;
+  // Peer FIN before Content-Length bytes arrived: transient early close.
+  if (content_len >= 0 && got < content_len) return TB_ESHORT;
   if (first_byte_ns_out) *first_byte_ns_out = first_byte_ns;
   if (total_ns_out) *total_ns_out = tb_now_ns() - t_start;
   return got;

@@ -12,23 +12,40 @@ from tpubench.native.build import build_library
 
 
 class NativeError(OSError):
-    pass
+    """Engine failure. ``code`` is the raw negative return: -1000-series
+    protocol codes (see ``PERMANENT_CODES``) or ``-errno`` for socket/fs
+    failures. Callers classify on the code, never on message text (the
+    wording is free to change; the codes are the engine's ABI)."""
+
+    def __init__(self, msg: str, code: int = 0):
+        super().__init__(msg)
+        self.code = code
 
 
 _PROTO_ERRORS = {
     -1001: "malformed HTTP response",
     -1002: "body exceeds buffer",
     -1003: "hostname resolution failed",
+    -1004: "short response: connection closed early",
+    -1005: "chunked transfer encoding (unsupported by the native receive path)",
 }
+
+# Protocol-shape failures: re-sending the same request to the same server
+# reproduces them, so retry is futile (engine.cc TB_EPROTO/TB_ETOOBIG/
+# TB_ECHUNKED). Resolution failures and short bodies are network
+# conditions — transient. (-1002 has one caller-visible exception: when the
+# buffer was sized from a cached stat, the caller may treat it as
+# retryable after invalidating the cache — see gcs_http.)
+PERMANENT_CODES = frozenset({-1001, -1002, -1005})
 
 
 def _check(rc: int, what: str) -> int:
     if rc < 0:
         if rc in _PROTO_ERRORS:
-            raise NativeError(f"{what}: {_PROTO_ERRORS[rc]}")
+            raise NativeError(f"{what}: {_PROTO_ERRORS[rc]}", code=rc)
         import os
 
-        raise NativeError(f"{what}: {os.strerror(-rc)} (errno {-rc})")
+        raise NativeError(f"{what}: {os.strerror(-rc)} (errno {-rc})", code=rc)
     return rc
 
 

@@ -286,7 +286,7 @@ class GcsHttpBackend:
         servers and private endpoints) and one fresh connection per GET (no
         keep-alive pool), so it measures the pure receive path, not
         connection reuse."""
-        from tpubench.native.engine import get_engine
+        from tpubench.native.engine import PERMANENT_CODES, NativeError, get_engine
 
         engine = get_engine()
         if engine is None:
@@ -332,16 +332,20 @@ class GcsHttpBackend:
             )
         except NativeError as e:
             # Module contract: this layer raises classified StorageErrors.
-            # Socket-level failures (resets, refusals, timeouts) are
-            # transient and retried under policy; protocol-shape errors
-            # (malformed response, chunked encoding, body too big) are not.
+            # Classification is on the engine's error-code ABI (engine.cc
+            # TB_* enum), not message text: socket-level failures (resets,
+            # refusals, timeouts, short bodies) are transient and retried
+            # under policy; protocol-shape errors (malformed response,
+            # chunked encoding, body too big for the buffer) reproduce on
+            # retry and are not. Exception: body-exceeds-buffer when the
+            # buffer was sized from the (just-invalidated) stat cache — the
+            # object may have grown, and one retry re-stats and re-sizes.
             buf.free()
             with self._stat_cache_lock:
                 self._stat_cache.pop(name, None)  # size may be stale
-            transient = not any(
-                s in str(e)
-                for s in ("malformed", "exceeds buffer", "chunked")
-            )
+            transient = e.code not in PERMANENT_CODES
+            if e.code == -1002 and length is None:
+                transient = True
             raise StorageError(f"native GET {name}: {e}", transient=transient) from e
         except Exception:
             buf.free()
