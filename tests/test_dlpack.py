@@ -117,7 +117,7 @@ def test_acquire_commit_matches_submit():
     from tpubench.config import StagingConfig
     from tpubench.staging.device import DevicePutStager
 
-    cfg = StagingConfig(validate_checksum=True)
+    cfg = StagingConfig(validate_checksum=True, slot_bytes=3000)
     rng = np.random.default_rng(3)
     payloads = [rng.integers(0, 256, 3000, dtype=np.uint8) for _ in range(5)]
     payloads.append(rng.integers(0, 256, 777, dtype=np.uint8))  # short tail
@@ -135,7 +135,7 @@ def test_acquire_commit_matches_submit():
         stats = st.finish()
         assert stats["checksum_ok"], stats
         assert stats["staged_bytes"] == sum(len(p) for p in payloads)
-        assert stats["granules"] == len(payloads)
+        assert stats["transfers"] == len(payloads)
         sums.append(stats["checksum_device"])
     assert sums[0] == sums[1]
 

@@ -8,6 +8,7 @@ from tpubench.config import RetryConfig, TransportConfig
 from tpubench.storage import FakeBackend, FaultPlan, StorageError
 from tpubench.storage.base import deterministic_bytes, read_object_through
 from tpubench.storage.fake_server import FakeGcsServer
+from tpubench.native.engine import TB_ECHUNKED, TB_ESHORT
 from tpubench.storage.gcs_http import GcsHttpBackend
 
 
@@ -337,7 +338,7 @@ def test_native_receive_connection_killed_mid_body(monkeypatch):
         assert ei.value.transient is True
         # The engine's short-body code (TB_ESHORT), not a socket errno,
         # must be the classified cause — codes are the ABI, not wording.
-        assert ei.value.__cause__.code == -1004
+        assert ei.value.__cause__.code == TB_ESHORT
         assert allocated and all(b._ptr == 0 for b in allocated)
         c.close()
     finally:
@@ -386,7 +387,7 @@ def test_native_receive_eof_mid_headers_is_transient(monkeypatch):
         with pytest.raises(StorageError) as ei:
             c.open_read("bench/file_0", length=4096)
         assert ei.value.transient is True
-        assert ei.value.__cause__.code == -1004
+        assert ei.value.__cause__.code == TB_ESHORT
         assert allocated and all(b._ptr == 0 for b in allocated)
         c.close()
     finally:
@@ -431,7 +432,7 @@ def test_native_receive_chunked_rejected(monkeypatch):
         with pytest.raises(StorageError) as ei:
             c.open_read("bench/file_0", length=4096)
         assert ei.value.transient is False
-        assert ei.value.__cause__.code == -1005
+        assert ei.value.__cause__.code == TB_ECHUNKED
         assert allocated and all(b._ptr == 0 for b in allocated)
         c.close()
     finally:
@@ -479,7 +480,7 @@ def test_native_receive_chunked_rejected_case_insensitive(monkeypatch):
         c, _ = _tracked_native_client(srv.endpoint, monkeypatch)
         with pytest.raises(StorageError) as ei:
             c.open_read("bench/file_0", length=4096)
-        assert ei.value.__cause__.code == -1005
+        assert ei.value.__cause__.code == TB_ECHUNKED
         c.close()
     finally:
         srv.close()

@@ -134,6 +134,16 @@ class StagingConfig:
     mode: str = "device_put"  # "none" (host RAM, reference parity) |
     # "device_put" | "pallas"
     double_buffer: bool = True  # overlap fetch with host→HBM DMA
+    # Slot ring depth when overlapping (double_buffer=True): how many slots
+    # can be in flight to HBM while the fetcher fills the next one.
+    # double_buffer=False forces a fully synchronous single slot.
+    depth: int = 3
+    # Granule-aggregation target: fetched granules are packed into slots of
+    # this size and shipped with ONE device_put per slot. Host→HBM transfer
+    # engines have per-transfer fixed cost; 2 MB granules transfer ~20%
+    # slower than 8-16 MB slots (measured on TPU v5e: 1.47 vs 1.79 GB/s).
+    # Clamped up to granule_bytes when granules are larger.
+    slot_bytes: int = 16 * MB
     # Staging slots in native posix_memalign'd buffers (DLPack producers,
     # SURVEY §2.5.4) so fetch→slot→HBM has no Python-held copy; auto-falls
     # back to numpy slots when the C++ engine is unavailable.

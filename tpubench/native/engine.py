@@ -22,12 +22,20 @@ class NativeError(OSError):
         self.code = code
 
 
+# Engine error-code ABI (mirrors the engine.cc TB_* enum) — compare against
+# these names, never bare numbers.
+TB_EPROTO = -1001
+TB_ETOOBIG = -1002
+TB_ERESOLVE = -1003
+TB_ESHORT = -1004
+TB_ECHUNKED = -1005
+
 _PROTO_ERRORS = {
-    -1001: "malformed HTTP response",
-    -1002: "body exceeds buffer",
-    -1003: "hostname resolution failed",
-    -1004: "short response: connection closed early",
-    -1005: "chunked transfer encoding (unsupported by the native receive path)",
+    TB_EPROTO: "malformed HTTP response",
+    TB_ETOOBIG: "body exceeds buffer",
+    TB_ERESOLVE: "hostname resolution failed",
+    TB_ESHORT: "short response: connection closed early",
+    TB_ECHUNKED: "chunked transfer encoding (unsupported by the native receive path)",
 }
 
 # Protocol-shape failures: re-sending the same request to the same server
@@ -36,7 +44,7 @@ _PROTO_ERRORS = {
 # conditions — transient. (-1002 has one caller-visible exception: when the
 # buffer was sized from a cached stat, the caller may treat it as
 # retryable after invalidating the cache — see gcs_http.)
-PERMANENT_CODES = frozenset({-1001, -1002, -1005})
+PERMANENT_CODES = frozenset({TB_EPROTO, TB_ETOOBIG, TB_ECHUNKED})
 
 
 def _check(rc: int, what: str) -> int:

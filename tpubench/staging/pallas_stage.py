@@ -133,7 +133,7 @@ class PallasStager:
         self._shape = (self._slot_bytes // LANE, LANE)
         self._slot = np.zeros(self._shape, dtype=np.uint8)
         self.staged_bytes = 0
-        self.granules = 0
+        self.transfers = 0
         self.stage_recorder = LatencyRecorder(f"w{worker_id}/pallas_stage")
         self._host_sum = 0
         self._dev_sum = 0
@@ -167,12 +167,12 @@ class PallasStager:
             self._host_sum + int(flat[:n].astype(np.uint32).sum())
         ) % (1 << 32)
         self.staged_bytes += n
-        self.granules += 1
+        self.transfers += 1
 
     def finish(self) -> dict:
         return {
             "staged_bytes": self.staged_bytes,
-            "granules": self.granules,
+            "transfers": self.transfers,
             "n_chips": self.n_chips,
             "stage_recorder": self.stage_recorder,
             "device": str(self.device),

@@ -286,7 +286,12 @@ class GcsHttpBackend:
         servers and private endpoints) and one fresh connection per GET (no
         keep-alive pool), so it measures the pure receive path, not
         connection reuse."""
-        from tpubench.native.engine import PERMANENT_CODES, NativeError, get_engine
+        from tpubench.native.engine import (
+            PERMANENT_CODES,
+            TB_ETOOBIG,
+            NativeError,
+            get_engine,
+        )
 
         engine = get_engine()
         if engine is None:
@@ -344,7 +349,7 @@ class GcsHttpBackend:
             with self._stat_cache_lock:
                 self._stat_cache.pop(name, None)  # size may be stale
             transient = e.code not in PERMANENT_CODES
-            if e.code == -1002 and length is None:
+            if e.code == TB_ETOOBIG and length is None:
                 transient = True
             raise StorageError(f"native GET {name}: {e}", transient=transient) from e
         except Exception:
