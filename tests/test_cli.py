@@ -176,3 +176,28 @@ def test_retry_deadline_bounds_total_fault_injection(tmp_path):
     assert elapsed < 30, f"deadline did not bound the retry loop ({elapsed:.1f}s)"
     res = json.load(open(glob.glob(str(tmp_path / "r" / "*.json"))[0]))
     assert res["errors"] == 1 and res["bytes_total"] == 0
+
+
+def test_cli_partial_multihost_config_rejected(tmp_path):
+    """--process-id/--coordinator without --num-processes must fail loudly,
+    not silently run a standalone bench while the pod hangs."""
+    import pytest
+
+    from tpubench.cli import main
+
+    with pytest.raises(SystemExit, match="num-processes"):
+        main(["read", "--protocol", "fake", "--process-id", "1",
+              "--results-dir", str(tmp_path)])
+    with pytest.raises(SystemExit, match="num-processes"):
+        main(["read", "--protocol", "fake", "--coordinator", "h:1",
+              "--results-dir", str(tmp_path)])
+
+
+def test_cli_process_id_zero_also_rejected(tmp_path):
+    import pytest
+
+    from tpubench.cli import main
+
+    with pytest.raises(SystemExit, match="num-processes"):
+        main(["read", "--protocol", "fake", "--process-id", "0",
+              "--results-dir", str(tmp_path)])

@@ -46,3 +46,107 @@ def test_two_process_distributed_gather():
         assert rc == 0, f"worker failed:\n{err[-3000:]}"
     assert "multihost-ok process=0" in outs[0][1]
     assert "multihost-ok process=1" in outs[1][1]
+
+
+def test_cli_two_process_pod_ingest(tmp_path):
+    """The documented multi-host launch path: the SAME `tpubench pod-ingest`
+    command line on every host (reference property: launchable everywhere,
+    main.go:158), here 2 localhost processes × 4 virtual chips. Process 0
+    gets the knobs via flags, process 1 via TPUBENCH_* env — both wiring
+    paths covered. Exactly one pod-level report (process 0) is written."""
+    import glob
+    import json
+
+    port = _free_port()
+    base_env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    base_env["JAX_PLATFORMS"] = "cpu"
+    base_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get("PYTHONPATH", "")
+    cmd = [
+        sys.executable, "-m", "tpubench.cli", "pod-ingest",
+        "--protocol", "fake", "--object-size", "100000",
+        "--results-dir", str(tmp_path),
+    ]
+    envs = []
+    cmds = []
+    # process 0: flags
+    cmds.append(cmd + ["--num-processes", "2", "--process-id", "0",
+                       "--coordinator", f"127.0.0.1:{port}"])
+    envs.append(dict(base_env))
+    # process 1: env autodetect
+    e1 = dict(base_env)
+    e1.update({
+        "TPUBENCH_NUM_PROCESSES": "2",
+        "TPUBENCH_PROCESS_ID": "1",
+        "TPUBENCH_COORDINATOR": f"127.0.0.1:{port}",
+    })
+    cmds.append(list(cmd))
+    envs.append(e1)
+    procs = [
+        subprocess.Popen(c, cwd=REPO, env=e, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True)
+        for c, e in zip(cmds, envs)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"CLI worker failed:\n{err[-3000:]}"
+    assert "result:" in outs[0][1]
+    assert "process 1/2 done" in outs[1][1]
+    results = glob.glob(str(tmp_path / "pod_ingest_*.json"))
+    assert len(results) == 1  # process 0 only
+    r = json.load(open(results[0]))
+    assert r["errors"] == 0
+    assert r["n_chips"] == 8
+    assert r["extra"]["topology"]["process_count"] == 2
+    assert r["extra"]["verified"] is True
+
+
+def test_cli_multihost_per_host_workload_reports_every_process(tmp_path):
+    """Per-host workloads (plain `read`) are NOT deduplicated to process 0:
+    each host's numbers are its own measurement, so each process writes a
+    result, non-zero ones tagged p<idx>."""
+    import glob
+
+    port = _free_port()
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [
+        sys.executable, "-m", "tpubench.cli", "read",
+        "--protocol", "fake", "--workers", "1", "--read-call-per-worker", "1",
+        "--object-size", "65536", "--staging", "none",
+        "--results-dir", str(tmp_path),
+        "--num-processes", "2", "--coordinator", f"127.0.0.1:{port}",
+    ]
+    procs = [
+        subprocess.Popen(cmd + ["--process-id", str(i)], cwd=REPO, env=env,
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True)
+        for i in range(2)
+    ]
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, err[-3000:]
+    all_results = sorted(glob.glob(str(tmp_path / "read_*.json")))
+    assert len(all_results) == 2, all_results
+    assert any("read_p1_" in r for r in all_results), all_results

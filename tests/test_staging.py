@@ -122,3 +122,26 @@ def test_make_sink_factory_modes():
     cfg.staging.mode = "bogus"
     with pytest.raises(ValueError):
         make_sink_factory(cfg)
+
+
+def test_budgeted_slot_bytes_scales_with_workers():
+    """48 reference-default workers must not pin workers×depth×16MB of
+    aligned host memory: slot_bytes scales down to the host budget, never
+    below one granule."""
+    from tpubench.config import MB
+    from tpubench.staging.device import budgeted_slot_bytes
+
+    cfg = BenchConfig()
+    cfg.workload.granule_bytes = 2 * MB
+    cfg.staging.slot_bytes = 16 * MB
+    cfg.staging.depth = 3
+    cfg.staging.host_budget_mb = 1024
+
+    cfg.workload.workers = 2  # small fan-out: full slot size
+    assert budgeted_slot_bytes(cfg) == 16 * MB
+    cfg.workload.workers = 48  # reference default: capped by budget
+    capped = budgeted_slot_bytes(cfg)
+    assert 2 * MB <= capped < 16 * MB
+    assert capped * 48 * 3 <= 1024 * MB
+    cfg.workload.workers = 4096  # absurd fan-out: floor at one granule
+    assert budgeted_slot_bytes(cfg) == 2 * MB

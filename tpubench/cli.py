@@ -75,6 +75,15 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--no-direct", action="store_true", help="skip O_DIRECT")
     p.add_argument("--ring", action="store_true",
                    help="pod-ingest: explicit ppermute ring instead of all_gather")
+    p.add_argument("--num-processes", type=int,
+                   help="multi-host: total process count (jax.distributed); "
+                        "also TPUBENCH_NUM_PROCESSES")
+    p.add_argument("--process-id", type=int,
+                   help="multi-host: this process's id; also "
+                        "TPUBENCH_PROCESS_ID")
+    p.add_argument("--coordinator",
+                   help="multi-host: coordinator host:port (process 0's "
+                        "address); also TPUBENCH_COORDINATOR")
     p.add_argument("--save-config", help="write effective config JSON and exit")
 
 
@@ -135,14 +144,72 @@ def build_config(args) -> BenchConfig:
         t.retry.max_attempts = args.retry_max_attempts
     if args.native_receive:
         t.native_receive = True
+    # Multi-host bring-up knobs: flags win over env autodetect, so one
+    # launch template works on every VM of a pod (reference property: the
+    # same binary is launchable everywhere, main.go:158).
+    d = cfg.dist
+    env = os.environ
+    if env.get("TPUBENCH_NUM_PROCESSES"):
+        d.num_processes = int(env["TPUBENCH_NUM_PROCESSES"])
+    if env.get("TPUBENCH_PROCESS_ID"):
+        d.process_id = int(env["TPUBENCH_PROCESS_ID"])
+    if env.get("TPUBENCH_COORDINATOR"):
+        d.coordinator_address = env["TPUBENCH_COORDINATOR"]
+    pid_given = bool(env.get("TPUBENCH_PROCESS_ID"))
+    if getattr(args, "num_processes", None) is not None:
+        d.num_processes = args.num_processes
+    if getattr(args, "process_id", None) is not None:
+        d.process_id = args.process_id
+        pid_given = True
+    if getattr(args, "coordinator", None):
+        d.coordinator_address = args.coordinator
+    if d.num_processes <= 1 and (pid_given or d.coordinator_address):
+        # A pod member that dropped --num-processes must not silently run a
+        # standalone bench while the rest of the pod hangs waiting for it
+        # (including the explicit --process-id 0 host).
+        raise SystemExit(
+            "--process-id/--coordinator set but --num-processes is 1: "
+            "pass the pod's total process count on every host"
+        )
     return cfg
 
 
-def _finish(res: RunResult, cfg: BenchConfig, quiet: bool = False) -> None:
-    path = write_result(res, cfg.obs.results_dir)
+# Workloads whose RunResult is already pod-global (collectives / DCN
+# aggregation inside the workload): process 0 owns the one report. Per-host
+# workloads (read, FS paths) measure THIS host — every process reports,
+# tagged by process index.
+POD_COLLECTIVE_CMDS = {"pod-ingest", "stream", "gather-bench"}
+
+
+def _finish(res: RunResult, cfg: BenchConfig, quiet: bool = False,
+            pod_collective: bool = True) -> None:
+    topo = res.extra.get("topology")
+    tag = ""
+    if topo and topo.get("process_count", 1) > 1:
+        idx = topo.get("process_index", 0)
+        if pod_collective:
+            if idx != 0:
+                # This process participated in the collectives; the pod-level
+                # numbers live in process 0's report — don't race N files.
+                print(f"process {idx}/{topo['process_count']} done "
+                      f"(report at process 0)")
+                return
+        else:
+            # Per-host measurement: EVERY process reports its own host,
+            # uniformly tagged (p0, p1, …) so one glob collects the pod.
+            tag = f"p{idx}"
+    path = write_result(res, cfg.obs.results_dir, tag=tag)
     if not quiet:
         print(res.format())
         print(f"result: {path}")
+
+
+def _bringup(cfg: BenchConfig) -> dict:
+    """Multi-host control-plane bring-up (jax.distributed over DCN) when
+    configured; returns topology facts stamped into the run result."""
+    from tpubench.dist.bringup import initialize
+
+    return initialize(cfg.dist)
 
 
 def cmd_read(cfg: BenchConfig, args) -> RunResult:
@@ -183,9 +250,14 @@ def cmd_prepare(cfg: BenchConfig, args) -> None:
     print(f"prepared files under {w.dir}")
 
 
-def cmd_sweep(cfg: BenchConfig, args) -> None:
-    """Protocol A/B × size sweep (execute_pb.sh + read_operations.sh:8-14)."""
-    from tpubench.workloads.read import run_read
+def cmd_sweep(cfg: BenchConfig, args, topo=None) -> None:
+    """Protocol A/B × size sweep (execute_pb.sh + read_operations.sh:8-14).
+
+    A per-host measurement: under multi-host config every process runs and
+    writes its own rows, tagged with its process index."""
+    tag = ""
+    if topo and topo.get("process_count", 1) > 1:
+        tag = f"p{topo['process_index']}"
 
     protocols = args.sweep_protocols.split(",")
     sizes = {
@@ -207,7 +279,7 @@ def cmd_sweep(cfg: BenchConfig, args) -> None:
             )
             res = cmd_read(c, args)
             res.extra["sweep"] = {"protocol": proto, "size": sz}
-            path = write_result(res, cfg.obs.results_dir)
+            path = write_result(res, cfg.obs.results_dir, tag=tag)
             rows.append(
                 {
                     "protocol": proto,
@@ -289,16 +361,18 @@ def main(argv=None) -> int:
         return 0
     if args.cmd == "sweep":
         pin_platform()
+        topo = _bringup(cfg)
         from tpubench.obs.profiling import maybe_profile
 
         with maybe_profile(cfg.obs.profile_dir):
-            cmd_sweep(cfg, args)
+            cmd_sweep(cfg, args, topo)
         if cfg.obs.profile_dir:
             print(f"profile trace: {cfg.obs.profile_dir}", file=sys.stderr)
         return 0
 
     direct = not args.no_direct
     pin_platform()
+    topo = _bringup(cfg)
     from tpubench.obs.profiling import maybe_profile
 
     with maybe_profile(cfg.obs.profile_dir):
@@ -343,7 +417,9 @@ def main(argv=None) -> int:
             raise SystemExit(f"unknown cmd {args.cmd}")
     if cfg.obs.profile_dir:
         print(f"profile trace: {cfg.obs.profile_dir}", file=sys.stderr)
-    _finish(res, cfg)
+    if topo["process_count"] > 1:
+        res.extra["topology"] = topo
+    _finish(res, cfg, pod_collective=args.cmd in POD_COLLECTIVE_CMDS)
     return 0
 
 

@@ -142,8 +142,13 @@ class StagingConfig:
     # this size and shipped with ONE device_put per slot. Host→HBM transfer
     # engines have per-transfer fixed cost; 2 MB granules transfer ~20%
     # slower than 8-16 MB slots (measured on TPU v5e: 1.47 vs 1.79 GB/s).
-    # Clamped up to granule_bytes when granules are larger.
+    # Clamped up to granule_bytes when granules are larger, and down so
+    # workers × depth × slot stays within host_budget_mb.
     slot_bytes: int = 16 * MB
+    # Total host staging-slot memory budget across all workers: slot_bytes
+    # is scaled down (never below one granule) when workers × depth × slot
+    # would exceed it — 48 default workers must not pin 2+ GB up front.
+    host_budget_mb: int = 1024
     # Staging slots in native posix_memalign'd buffers (DLPack producers,
     # SURVEY §2.5.4) so fetch→slot→HBM has no Python-held copy; auto-falls
     # back to numpy slots when the C++ engine is unavailable.
@@ -161,14 +166,16 @@ class StagingConfig:
 class DistConfig:
     """Multi-host / multi-chip fan-out (replaces "run on more VMs by hand")."""
 
-    # jax.distributed bring-up; 0/empty = single-process.
+    # jax.distributed bring-up (CLI: --num-processes/--process-id/
+    # --coordinator, or TPUBENCH_NUM_PROCESSES/_PROCESS_ID/_COORDINATOR env);
+    # 1 = single-process. The pod workloads then fetch only their local
+    # chips' shards and reassemble over ICI — the launchable-everywhere
+    # property of the reference (main.go:158) without "run on more VMs by
+    # hand".
     num_processes: int = 1
     process_id: int = 0
     coordinator_address: str = ""
     mesh_axis: str = "pod"  # 1-D mesh over all chips
-    # Shard a single logical object's byte-range across the pod (the CP-analog,
-    # SURVEY §5.7) and reassemble with an ICI all-gather.
-    shard_object: bool = False
 
 
 @dataclass

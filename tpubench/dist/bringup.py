@@ -11,15 +11,19 @@ from tpubench.config import DistConfig
 
 
 def initialize(cfg: DistConfig) -> dict:
-    """Idempotent bring-up; returns topology facts for the run report."""
+    """Idempotent bring-up; returns topology facts for the run report.
+
+    Single-process configs return immediately WITHOUT importing jax, so
+    jax-free paths (FS workloads, config handling) stay jax-free."""
+    if cfg.num_processes <= 1:
+        return {"process_index": 0, "process_count": 1}
     import jax
 
-    if cfg.num_processes > 1:
-        jax.distributed.initialize(
-            coordinator_address=cfg.coordinator_address or None,
-            num_processes=cfg.num_processes,
-            process_id=cfg.process_id,
-        )
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address or None,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
     return {
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),

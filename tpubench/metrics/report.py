@@ -59,9 +59,9 @@ class RunResult:
         return "\n".join(lines)
 
 
-def write_result(result: RunResult, results_dir: str) -> str:
+def write_result(result: RunResult, results_dir: str, tag: str = "") -> str:
     os.makedirs(results_dir, exist_ok=True)
-    fname = f"{result.workload}_{int(time.time() * 1000)}.json"
+    fname = f"{result.workload}_{tag + '_' if tag else ''}{int(time.time() * 1000)}.json"
     path = os.path.join(results_dir, fname)
     with open(path, "w") as f:
         json.dump(result.to_dict(), f, indent=2)

@@ -49,7 +49,64 @@ def _accum_checksum(acc, x):
     return acc + jnp.sum(x.astype(jnp.uint32))
 
 
-class DevicePutStager:
+class GranuleAggregator:
+    """Shared zero-copy sink protocol: granules pack into ``_slot_bytes``
+    slots; one ``_launch()`` per slot ships it. Concrete stagers provide
+    ``_launch`` (ship the first ``_fill`` bytes of the current slot and
+    reset ``_fill``), ``_free_view`` (memoryview of the current slot from
+    ``_fill``), and optionally ``_precommit(n)`` (inspect the next ``n``
+    committed bytes before the fill mark moves)."""
+
+    _fill: int
+    _granule: int
+    _slot_bytes: int
+
+    def _launch(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _free_view(self) -> memoryview:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _precommit(self, n: int) -> None:
+        pass
+
+    def acquire(self) -> memoryview:
+        """At least one granule of free slot space; a slot whose remainder
+        is smaller than a granule ships now (slightly under-full) — the
+        fetcher is never asked to do sub-granule socket reads."""
+        if self._slot_bytes - self._fill < self._granule and self._fill > 0:
+            self._launch()
+        return self._free_view()
+
+    def commit(self, n: int) -> None:
+        """Advance the fill mark over the first ``n`` bytes of the space
+        handed out by :meth:`acquire` (which the fetcher filled in place);
+        launches the slot when full."""
+        if n > 0:
+            self._precommit(n)
+        self._fill += n
+        if self._fill >= self._slot_bytes:
+            self._launch()
+
+    def submit(self, mv: memoryview) -> None:
+        """Copying path (granule was filled elsewhere): copy into slot free
+        space, launching transfers as slots fill."""
+        off = 0
+        n = len(mv)
+        while off < n:
+            dst = self.acquire()
+            take = min(len(dst), n - off)
+            dst[:take] = mv[off : off + take]
+            self.commit(take)
+            off += take
+
+    def flush(self) -> None:
+        """Ship any partially-filled slot now (end of stream)."""
+        if self._fill > 0:
+            self._launch()
+
+
+class DevicePutStager(GranuleAggregator):
     """One per worker. Two sink protocols:
 
     * copying — ``submit(mv)`` copies the filled granule into the current
@@ -73,6 +130,7 @@ class DevicePutStager:
         cfg: Optional[StagingConfig] = None,
         device=None,
         depth: Optional[int] = None,
+        slot_bytes: Optional[int] = None,
     ):
         cfg = cfg or StagingConfig()
         self.cfg = cfg
@@ -86,14 +144,17 @@ class DevicePutStager:
         # Slot capacity: the aggregation target (but never smaller than one
         # granule), rounded up to a lane multiple so the landed shape is
         # static and lane-aligned; unfilled tails are zero-padded at launch
-        # so checksums see only real bytes.
-        slot_bytes = max(getattr(cfg, "slot_bytes", 0) or 0, granule_bytes)
+        # so checksums see only real bytes. ``slot_bytes`` overrides the
+        # config (make_sink_factory passes the host-budget-capped value).
+        if slot_bytes is None:
+            slot_bytes = cfg.slot_bytes
+        slot_bytes = max(slot_bytes, granule_bytes)
         self._slot_bytes = ((slot_bytes + lane - 1) // lane) * lane
         self._shape = (self._slot_bytes // lane, lane)
         self._native_bufs = []
         self._slots = []
         engine = None
-        if getattr(cfg, "native_slots", True):
+        if cfg.native_slots:
             from tpubench.native.engine import get_engine
 
             engine = get_engine()
@@ -162,47 +223,18 @@ class DevicePutStager:
             # before the fetcher can touch the slot again.
             self._drain_slot(k)
 
-    def acquire(self) -> memoryview:
-        """Zero-copy path: hand the fetcher at least one granule of slot
-        space to fill. If the current slot's remainder is smaller than a
-        granule, it ships now (slightly under-full) — the fetcher is never
-        asked to do sub-granule socket reads. Draining the slot's prior
-        in-flight transfer here is the backpressure point."""
-        if self._slot_bytes - self._fill < self._granule and self._fill > 0:
-            self._launch()
+    def _free_view(self) -> memoryview:
+        """Draining the current slot's prior in-flight transfer here is the
+        ring's backpressure point."""
         k = self._k
         self._drain_slot(k)
         return self._slot_views[k][self._fill :]
 
-    def commit(self, n: int) -> None:
-        """Advance the fill mark over the first ``n`` bytes of the space
-        handed out by :meth:`acquire` (which the fetcher filled in place);
-        launches the slot's transfer when full."""
-        if self._validate and n > 0:
+    def _precommit(self, n: int) -> None:
+        if self._validate:
             k = self._k
             chunk = self._slots[k].reshape(-1)[self._fill : self._fill + n]
             self._host_sum += np.uint64(int(chunk.astype(np.uint32).sum()))
-        self._fill += n
-        if self._fill >= self._slot_bytes:
-            self._launch()
-
-    def submit(self, mv: memoryview) -> None:
-        """Copying path (granule was filled elsewhere): copy into slot free
-        space, launching transfers as slots fill."""
-        off = 0
-        n = len(mv)
-        while off < n:
-            dst = self.acquire()
-            take = min(len(dst), n - off)
-            dst[:take] = mv[off : off + take]
-            self.commit(take)
-            off += take
-
-    def flush(self) -> None:
-        """Ship any partially-filled slot now (end of stream)."""
-        if self._fill > 0:
-            # acquire()'s drain has already run for this slot; launch as-is.
-            self._launch()
 
     def finish(self) -> dict:
         self.flush()
@@ -232,16 +264,34 @@ class DevicePutStager:
         return stats
 
 
+def budgeted_slot_bytes(cfg: BenchConfig) -> int:
+    """slot_bytes scaled so ALL workers' slots fit the host budget (never
+    below one granule): 48 reference-default workers must not pin gigabytes
+    of aligned memory before the first byte is fetched. The pallas stager
+    holds exactly one slot per worker; the device_put ring holds depth."""
+    s = cfg.staging
+    if s.mode == "pallas":
+        depth = 1
+    else:
+        depth = max(1, s.depth) if s.double_buffer else 1
+    workers = max(1, cfg.workload.workers)
+    budget = max(1, s.host_budget_mb) * (1 << 20)
+    per_worker = budget // (workers * depth)
+    return max(cfg.workload.granule_bytes, min(s.slot_bytes, per_worker))
+
+
 def make_sink_factory(cfg: BenchConfig) -> Optional[Callable[[int], DevicePutStager]]:
     """Staging sink factory for the read workload, from config."""
     mode = cfg.staging.mode
     if mode == "none":
         return None
+    slot = budgeted_slot_bytes(cfg)
     if mode == "device_put":
         return lambda worker_id: DevicePutStager(
             worker_id,
             granule_bytes=cfg.workload.granule_bytes,
             cfg=cfg.staging,
+            slot_bytes=slot,
         )
     if mode == "pallas":
         from tpubench.staging.pallas_stage import PallasStager
@@ -250,5 +300,6 @@ def make_sink_factory(cfg: BenchConfig) -> Optional[Callable[[int], DevicePutSta
             worker_id,
             granule_bytes=cfg.workload.granule_bytes,
             cfg=cfg.staging,
+            slot_bytes=slot,
         )
     raise ValueError(f"unknown staging mode {mode!r} (none|device_put|pallas)")

@@ -31,6 +31,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from tpubench.config import StagingConfig
 from tpubench.metrics.recorder import LatencyRecorder
+from tpubench.staging.device import GranuleAggregator
 
 LANE = 128
 # uint8 min tile is (32, 128); 512 rows = 64 KB/block in VMEM.
@@ -106,12 +107,14 @@ def pallas_land(x: jax.Array, block_rows: int = BLOCK_ROWS):
     return landed, jax.lax.bitcast_convert_type(csum[0, 0], jnp.uint32)
 
 
-class PallasStager:
-    """Staging sink: granule → device_put → fused pallas land (copy+checksum).
+class PallasStager(GranuleAggregator):
+    """Staging sink: slot → device_put → fused pallas land (copy+checksum).
 
-    Same interface as DevicePutStager; always validates (the checksum is
-    free inside the landing pass). Simpler ring (sync per granule) since the
-    landing kernel itself is the demonstration payload here.
+    Same sink contract as DevicePutStager — granules aggregate into
+    ``slot_bytes`` slots (one transfer + one landing pass per slot),
+    ``acquire`` guarantees granule-sized free space — but synchronous
+    single-slot, and always validates (the checksum is free inside the
+    landing pass).
     """
 
     def __init__(
@@ -120,6 +123,7 @@ class PallasStager:
         granule_bytes: int,
         cfg: Optional[StagingConfig] = None,
         device=None,
+        slot_bytes: Optional[int] = None,
     ):
         cfg = cfg or StagingConfig()
         devices = jax.local_devices()
@@ -127,36 +131,32 @@ class PallasStager:
         self.n_chips = len(devices)
         lane = cfg.lane
         assert lane == LANE, "pallas path is lane-128 only"
-        # Round slot up so rows divide the kernel block size.
+        self._granule = granule_bytes
+        # Round the aggregation target up so rows divide the kernel block.
+        if slot_bytes is None:
+            slot_bytes = cfg.slot_bytes
+        slot_bytes = max(slot_bytes, granule_bytes)
         block_bytes = BLOCK_ROWS * LANE
-        self._slot_bytes = -(-granule_bytes // block_bytes) * block_bytes
+        self._slot_bytes = -(-slot_bytes // block_bytes) * block_bytes
         self._shape = (self._slot_bytes // LANE, LANE)
         self._slot = np.zeros(self._shape, dtype=np.uint8)
+        self._fill = 0
         self.staged_bytes = 0
         self.transfers = 0
         self.stage_recorder = LatencyRecorder(f"w{worker_id}/pallas_stage")
         self._host_sum = 0
         self._dev_sum = 0
 
-    def acquire(self) -> memoryview:
-        """Zero-copy sink protocol (see ReadWorkload): the single slot is
-        synchronous — by the time acquire is called again, the previous
-        granule's landing pass has completed."""
-        return memoryview(self._slot.reshape(-1))
+    def _free_view(self) -> memoryview:
+        """The single slot is synchronous — by the time the aggregator asks
+        again, the previous landing pass has completed."""
+        return memoryview(self._slot.reshape(-1))[self._fill :]
 
-    def commit(self, n: int) -> None:
+    def _launch(self) -> None:
         flat = self._slot.reshape(-1)
+        n = self._fill
         if n < self._slot_bytes:
             flat[n:] = 0
-        self._land(flat, n)
-
-    def submit(self, mv: memoryview) -> None:
-        n = len(mv)
-        dst = self.acquire()
-        dst[:n] = mv
-        self.commit(n)
-
-    def _land(self, flat: np.ndarray, n: int) -> None:
         t0 = time.perf_counter_ns()
         staged = jax.device_put(self._slot, self.device)
         landed, csum = pallas_land(staged)
@@ -168,11 +168,14 @@ class PallasStager:
         ) % (1 << 32)
         self.staged_bytes += n
         self.transfers += 1
+        self._fill = 0
 
     def finish(self) -> dict:
+        self.flush()
         return {
             "staged_bytes": self.staged_bytes,
             "transfers": self.transfers,
+            "slot_bytes": self._slot_bytes,
             "n_chips": self.n_chips,
             "stage_recorder": self.stage_recorder,
             "device": str(self.device),
