@@ -64,3 +64,186 @@ def test_snapshot_writer_atomic(tmp_path):
         data = json.load(f)
     assert data["latencies"] >= 2
     assert "time" in data and data["process_index"] == 0
+
+
+# ------------------------------------------------- in-run export sessions --
+
+
+def test_read_workload_in_run_cloud_export_dry_run():
+    """VERDICT done-criterion: an export="cloud" dry-run captures >=2
+    interval flushes DURING the run plus the final flush, each carrying the
+    FULL latency histogram (bucket counts), never a mean-only stand-in."""
+    from tpubench.config import BenchConfig
+    from tpubench.storage import FakeBackend, FaultPlan
+    from tpubench.workloads.read import run_read
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 2
+    cfg.workload.read_calls_per_worker = 40
+    cfg.workload.object_size = 256 * 1024
+    cfg.obs.export = "cloud"
+    cfg.obs.export_dry_run = True
+    cfg.obs.metrics_interval_s = 0.05  # fast intervals for the test
+    # Latency injection slows reads so several intervals elapse mid-run.
+    backend = FakeBackend.prepopulated(
+        cfg.workload.object_name_prefix, count=2, size=cfg.workload.object_size,
+        fault=FaultPlan(latency_s=0.01, seed=3),
+    )
+    res = run_read(cfg, backend=backend)
+    assert res.errors == 0
+    exp = res.extra["metrics_export"]
+    assert exp["dry_run"] is True
+    assert exp["flushes"] >= 3  # >=2 interval + 1 final
+    assert exp["points"] > 0
+
+
+def test_metrics_session_payloads_have_full_histograms():
+    from tpubench.config import BenchConfig
+    from tpubench.metrics import MetricSet
+    from tpubench.obs.exporters import metrics_session_from_config
+
+    cfg = BenchConfig()
+    cfg.obs.export = "cloud"
+    cfg.obs.export_dry_run = True
+    cfg.obs.metrics_interval_s = 60  # only the final flush fires
+    m = MetricSet()
+    r, fb = m.new_worker("w0")
+    for ns in (1_000_000, 5_000_000, 250_000_000):  # 1ms, 5ms, 250ms
+        r.record_ns(ns)
+    m.ingest.start()
+    m.ingest.bytes = 12345
+    m.ingest.stop()
+    session = metrics_session_from_config(cfg, m)
+    with session:
+        pass
+    dists = [p for p in session.exporter.exported if "distribution" in p]
+    assert dists, session.exporter.exported
+    d = dists[0]["distribution"]
+    assert d["count"] == 3
+    assert sum(d["counts"]) == 3
+    # 1ms lands in the first bucket (bound 1, side=right -> index 1); the
+    # histogram really is bucketed, not a mean.
+    assert len(d["counts"]) == len(d["bounds_ms"]) + 1
+    assert d["counts"][1] == 1
+    points = {p["type"].rsplit("/", 1)[-1]: p for p in session.exporter.exported
+              if "value" in p}
+    assert points["bytes_ingested"]["value"] == 12345.0
+
+
+def test_export_json_means_no_session():
+    from tpubench.config import BenchConfig
+    from tpubench.metrics import MetricSet
+    from tpubench.obs.exporters import metrics_session_from_config
+
+    cfg = BenchConfig()
+    cfg.obs.export = "json"
+    assert metrics_session_from_config(cfg, MetricSet()) is None
+    cfg.obs.export = "bogus"
+    import pytest
+
+    with pytest.raises(ValueError):
+        metrics_session_from_config(cfg, MetricSet())
+
+
+def test_stream_in_run_export(tmp_path, jax_cpu_devices):
+    """The long-running stream emits periodic progress series mid-run."""
+    from tpubench.config import BenchConfig
+    from tpubench.workloads.pod_ingest_stream import run_pod_ingest_stream
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 2
+    cfg.workload.object_size = 512 * 1024
+    cfg.obs.export = "cloud"
+    cfg.obs.export_dry_run = True
+    cfg.obs.metrics_interval_s = 0.05
+    res = run_pod_ingest_stream(cfg, n_objects=6, verify=True)
+    assert res.errors == 0
+    exp = res.extra["metrics_export"]
+    assert exp["dry_run"] is True
+    assert exp["flushes"] >= 1
+    assert exp["points"] >= 3  # objects_done, bytes_ingested, ingest_gbps
+
+
+def test_periodic_exporter_survives_flush_errors():
+    """A failing flush must not kill the thread nor crash close(); errors
+    are counted and the last one kept for the run report."""
+    import time as _time
+
+    from tpubench.obs.exporters import PeriodicExporter
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] % 2 == 1:
+            raise ConnectionError("monitoring api blip")
+
+    p = PeriodicExporter(flaky, interval_s=0.02).start()
+    _time.sleep(0.15)
+    p.close()  # must not raise even if the final flush fails
+    assert p.flush_count >= 1
+    assert p.error_count >= 1
+    assert "monitoring api blip" in p.last_error
+
+
+def test_export_includes_stage_latency_and_process_label(jax_cpu_devices):
+    """The final flush must carry the stage histogram (sink recorders merge
+    before the session closes) and every series a per-process label."""
+    from tpubench.config import BenchConfig
+    from tpubench.staging.device import make_sink_factory
+    from tpubench.workloads.read import run_read
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 1
+    cfg.workload.read_calls_per_worker = 1
+    cfg.workload.object_size = 256 * 1024
+    cfg.staging.mode = "device_put"
+    cfg.obs.export = "cloud"
+    cfg.obs.export_dry_run = True
+    cfg.obs.metrics_interval_s = 60  # only the final flush fires
+    cfg.dist.process_id = 0
+
+    captured = {}
+
+    from tpubench.obs import exporters as expmod
+
+    orig = expmod.metrics_session_from_config
+
+    def capture(cfg_, metrics, bytes_fn=None):
+        s = orig(cfg_, metrics, bytes_fn=bytes_fn)
+        captured["s"] = s
+        return s
+
+    expmod.metrics_session_from_config = capture
+    try:
+        res = run_read(cfg, sink_factory=make_sink_factory(cfg))
+    finally:
+        expmod.metrics_session_from_config = orig
+    assert res.errors == 0
+    exported = captured["s"].exporter.exported
+    types = {p["type"].rsplit("/", 1)[-1] for p in exported}
+    assert "stage_latency" in types, types
+    assert all(p["labels"].get("process") == "0" for p in exported)
+
+
+def test_cli_metrics_live_implies_cloud(tmp_path):
+    import json
+
+    import pytest
+
+    from tpubench.cli import main
+
+    # --metrics-live + a non-cloud export is a contradiction: fail loudly.
+    with pytest.raises(SystemExit, match="requires --export cloud"):
+        main(["read", "--protocol", "fake", "--metrics-live",
+              "--export", "json", "--save-config", str(tmp_path / "x.json")])
+    # --metrics-live alone implies export=cloud with live pushes.
+    out = tmp_path / "live.json"
+    main(["read", "--protocol", "fake", "--metrics-live",
+          "--save-config", str(out)])
+    cfg = json.load(open(out))
+    assert cfg["obs"]["export"] == "cloud"
+    assert cfg["obs"]["export_dry_run"] is False

@@ -54,6 +54,16 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--trace-exporter", choices=("console", "cloud_trace"),
                    help="span export path (with --enable-tracing)")
     p.add_argument("--profile-dir", help="capture a jax.profiler xplane trace here")
+    p.add_argument("--export", choices=("none", "json", "cloud"),
+                   help="metric export: cloud = in-run periodic push of full "
+                        "latency histograms (metrics_exporter.go:36-58); "
+                        "dry-run capture unless --metrics-live")
+    p.add_argument("--metrics-interval", type=float,
+                   help="export interval seconds (reference: 30)")
+    p.add_argument("--metrics-live", action="store_true",
+                   help="really push to Cloud Monitoring (needs "
+                        "google-cloud-monitoring + GCP creds; default is "
+                        "dry-run capture stamped into the result)")
     p.add_argument("--results-dir")
     p.add_argument("--no-abort-on-error", action="store_true",
                    help="per-worker failure domains instead of errgroup abort")
@@ -128,6 +138,15 @@ def build_config(args) -> BenchConfig:
         o.trace_exporter = args.trace_exporter
     if args.profile_dir:
         o.profile_dir = args.profile_dir
+    if args.export:
+        o.export = args.export
+    if args.metrics_interval is not None:
+        o.metrics_interval_s = args.metrics_interval
+    if args.metrics_live:
+        if args.export and args.export != "cloud":
+            raise SystemExit("--metrics-live requires --export cloud")
+        o.export = "cloud"  # the flag implies the cloud path; never a no-op
+        o.export_dry_run = False
     if args.results_dir:
         o.results_dir = args.results_dir
     if args.no_abort_on_error:

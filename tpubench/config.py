@@ -189,8 +189,14 @@ class ObservabilityConfig:
     trace_exporter: str = ""
     metrics_interval_s: float = 30.0  # Stackdriver reporting interval (:44)
     metric_prefix: str = "custom.googleapis.com/tpubench/"  # (:41)
-    # "none" | "json" | "otel" | "cloud" (cloud requires GCP creds; gated)
+    # "none"/"json" = result file only; "cloud" = in-run periodic push of
+    # the full latency histograms + ingest gauges every metrics_interval_s
+    # (metrics_exporter.go:36-58) with a guaranteed final flush.
     export: str = "json"
+    # "cloud" pushes are captured locally (and stamped into the result)
+    # unless this is False, which requires google-cloud-monitoring + GCP
+    # creds — absence fails loudly, never a silent no-op.
+    export_dry_run: bool = True
     results_dir: str = "results"
     # Non-empty = capture a jax.profiler (xplane) trace of the run there
     # (SURVEY §5.1: the DMA/collective path profiled first-class, replacing

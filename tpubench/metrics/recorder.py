@@ -43,6 +43,24 @@ class LatencyRecorder:
     def as_ns_array(self) -> np.ndarray:
         return np.frombuffer(self._ns, dtype=np.int64).copy() if self._ns else np.empty(0, np.int64)
 
+    def snapshot_ns(self) -> np.ndarray:
+        """Mid-run-safe copy for the periodic exporter: ``tolist()`` never
+        exports the array's buffer, so the owning worker's concurrent
+        ``append`` cannot hit BufferError-on-resize (which a ``frombuffer``
+        view would cause). Items appended during the copy may or may not be
+        included — fine for an in-flight flush."""
+        return np.array(self._ns.tolist(), dtype=np.int64)
+
+    def snapshot_tail_ns(self, start: int) -> tuple[np.ndarray, int]:
+        """Mid-run-safe copy of samples [start:len) plus the new consumed
+        offset — the periodic exporter's incremental read, O(new samples)
+        instead of O(all samples) per flush. Array slicing copies in C
+        without exporting the buffer, so concurrent appends stay safe."""
+        end = len(self._ns)
+        if end <= start:
+            return np.empty(0, np.int64), start
+        return np.array(self._ns[start:end].tolist(), dtype=np.int64), end
+
     def extend_ns(self, values: Iterable[int]) -> None:
         self._ns.extend(int(v) for v in values)
 

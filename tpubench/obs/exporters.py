@@ -84,11 +84,16 @@ class CloudMonitoringExporter:
         metric_prefix: str,
         interval_s: float = 30.0,
         dry_run: bool = False,
+        base_labels: Optional[dict] = None,
     ):
         self.project = project
         self.prefix = metric_prefix.rstrip("/")
         self.interval_s = interval_s
         self.dry_run = dry_run
+        # Stamped on every series; multi-host runs MUST carry a per-process
+        # label or N hosts write the same time series and Cloud Monitoring
+        # rejects all but one per sampling period.
+        self.base_labels = dict(base_labels or {})
         self.exported: list[dict] = []  # dry-run capture
         self._client = None
         if not dry_run:
@@ -97,11 +102,14 @@ class CloudMonitoringExporter:
             self._client = monitoring_v3.MetricServiceClient()
             self._monitoring_v3 = monitoring_v3
 
+    def _labels(self, labels: Optional[dict]) -> dict:
+        return {**self.base_labels, **(labels or {})}
+
     def export_point(self, name: str, value: float, labels: Optional[dict] = None):
         payload = {
             "type": f"{self.prefix}/{name}",
             "value": value,
-            "labels": labels or {},
+            "labels": self._labels(labels),
             "time": time.time(),
         }
         if self.dry_run or self._client is None:
@@ -125,18 +133,58 @@ class CloudMonitoringExporter:
         )
 
     def export_distribution(self, name: str, dist: LatencyDistribution, labels=None):
-        # Cloud Monitoring distributions need a typed series; the dry-run
-        # payload keeps the full histogram for assertion/offline upload.
+        """Typed Distribution time-series: full histogram (explicit bucket
+        bounds + per-bucket counts), never a lossy mean-only stand-in. The
+        dry-run payload keeps the same histogram for assertion/offline
+        upload."""
         payload = {
             "type": f"{self.prefix}/{name}",
             "distribution": dist.to_dict(),
-            "labels": labels or {},
+            "labels": self._labels(labels),
             "time": time.time(),
         }
         if self.dry_run or self._client is None:
             self.exported.append(payload)
             return
-        self.export_point(f"{name}_mean_ms", dist.mean_ms, labels)
+        mv3 = self._monitoring_v3
+        series = mv3.TimeSeries()
+        series.metric.type = payload["type"]
+        for k, v in payload["labels"].items():
+            series.metric.labels[k] = str(v)
+        series.resource.type = "global"
+        dval = mv3.types.Distribution(
+            count=dist.count,
+            mean=dist.mean_ms,
+            bucket_options=mv3.types.Distribution.BucketOptions(
+                explicit_buckets=mv3.types.Distribution.BucketOptions.Explicit(
+                    bounds=[float(b) for b in dist.bounds]
+                )
+            ),
+            bucket_counts=[int(c) for c in dist.counts],
+        )
+        point = mv3.Point()
+        point.value.distribution_value = dval
+        now = time.time()
+        point.interval = mv3.TimeInterval(
+            {"end_time": {"seconds": int(now), "nanos": int((now % 1) * 1e9)}}
+        )
+        series.points = [point]
+        self._client.create_time_series(
+            name=f"projects/{self.project}", time_series=[series]
+        )
+
+    def summary(self, periodic: Optional["PeriodicExporter"] = None) -> dict:
+        """The run-report stamp shared by every workload's extras."""
+        out = {
+            "flushes": periodic.flush_count if periodic else 1,
+            "points": len(self.exported),
+            "dry_run": self.dry_run,
+            "prefix": self.prefix,
+        }
+        if periodic and periodic.error_count:
+            out["flush_errors"] = periodic.error_count
+            out["last_error"] = periodic.last_error
+        return out
 
     def close(self) -> None:  # always flush (unlike the reference's bug)
         pass
@@ -144,29 +192,45 @@ class CloudMonitoringExporter:
 
 class PeriodicExporter:
     """Background thread: calls ``fn()`` every ``interval_s`` and once at
-    close — the 30 s reporting loop + guaranteed final flush."""
+    close — the 30 s reporting loop + guaranteed final flush.
+
+    A flush error (live Cloud Monitoring push hitting a network blip) must
+    never kill the flush thread silently NOR crash the workload's finally
+    block at the very end of a long run: errors are counted and the last
+    one is kept for the run report. A lock serializes flushes so close()'s
+    final flush cannot run concurrently with a slow in-flight one."""
 
     def __init__(self, fn: Callable[[], None], interval_s: float = 30.0):
         self._fn = fn
         self._interval = interval_s
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._flush_lock = threading.Lock()
         self.flush_count = 0
+        self.error_count = 0
+        self.last_error: Optional[str] = None
 
     def start(self) -> "PeriodicExporter":
         self._thread.start()
         return self
 
+    def _flush_once(self) -> None:
+        with self._flush_lock:
+            try:
+                self._fn()
+                self.flush_count += 1
+            except Exception as e:  # noqa: BLE001 — see class docstring
+                self.error_count += 1
+                self.last_error = f"{type(e).__name__}: {e}"
+
     def _loop(self) -> None:
         while not self._stop.wait(self._interval):
-            self._fn()
-            self.flush_count += 1
+            self._flush_once()
 
     def close(self) -> None:
         self._stop.set()
-        self._thread.join(timeout=5)
-        self._fn()  # final flush ALWAYS runs (metrics_exporter.go:37 bug fix)
-        self.flush_count += 1
+        self._thread.join(timeout=30)
+        self._flush_once()  # final flush ALWAYS runs (metrics_exporter.go:37 bug fix)
 
     def __enter__(self):
         return self.start()
@@ -208,3 +272,122 @@ class SnapshotWriter:
 
     def __exit__(self, *exc):
         self._periodic.close()
+
+
+class MetricsExportSession:
+    """In-run periodic metric export — the reference's L2 core behavior
+    (view + histogram pushed to Cloud Monitoring every 30 s DURING the run,
+    ``metrics_exporter.go:36-58``), generalized to the framework's measure
+    set: read/first-byte/stage latency distributions (full histograms) plus
+    bytes-ingested and GB/s gauges, flushed every ``interval_s`` and once at
+    close (final flush ALWAYS runs — the reference's shadowed-exporter bug
+    is not reproduced).
+
+    A long pod run emits its first series after one interval, not only when
+    it finishes.
+    """
+
+    def __init__(
+        self,
+        exporter: CloudMonitoringExporter,
+        metrics,
+        interval_s: float = 30.0,
+        labels: Optional[dict] = None,
+        bytes_fn: Optional[Callable[[], int]] = None,
+    ):
+        self.exporter = exporter
+        self._metrics = metrics
+        self._labels = labels or {}
+        # Live progress source for mid-run flushes (the MetricSet's ingest
+        # counter is only finalized after the workers join).
+        self._bytes_fn = bytes_fn
+        self._periodic = PeriodicExporter(self._flush, interval_s)
+        # Incremental histogram state: cumulative distribution per series +
+        # consumed-sample offset per recorder, so each flush reads only the
+        # NEW samples (O(new) per flush, not O(all-so-far) — a long run's
+        # flush cost must not grow over time).
+        self._dists: dict[str, LatencyDistribution] = {}
+        self._offsets: dict[tuple[str, int], int] = {}
+
+    def _dist_of(self, name: str, recorders) -> LatencyDistribution:
+        dist = self._dists.setdefault(name, LatencyDistribution())
+        for rec in recorders:
+            key = (name, id(rec))
+            ns, self._offsets[key] = rec.snapshot_tail_ns(
+                self._offsets.get(key, 0)
+            )
+            if ns.size:
+                dist.record_many_ms(ns / 1e6)
+        return dist
+
+    def _flush(self) -> None:
+        m = self._metrics
+        for name, recs in (
+            ("read_latency", m.read_latency),
+            ("first_byte_latency", m.first_byte_latency),
+            ("stage_latency", m.stage_latency),
+            ("gather_latency", m.gather_latency),
+        ):
+            dist = self._dist_of(name, recs)
+            if dist.count:
+                self.exporter.export_distribution(name, dist, self._labels)
+        nbytes = self._bytes_fn() if self._bytes_fn else m.ingest.bytes
+        self.exporter.export_point("bytes_ingested", float(nbytes), self._labels)
+        sec = m.ingest.seconds
+        self.exporter.export_point(
+            "ingest_gbps", (nbytes / 1e9) / sec if sec > 0 else 0.0, self._labels
+        )
+
+    @property
+    def flush_count(self) -> int:
+        return self._periodic.flush_count
+
+    def summary(self) -> dict:
+        """Small run-report stamp: how much was exported, where."""
+        return self.exporter.summary(self._periodic)
+
+    def __enter__(self):
+        self._periodic.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._periodic.close()
+        self.exporter.close()
+
+
+def cloud_exporter_from_config(cfg) -> Optional[CloudMonitoringExporter]:
+    """``export="cloud"`` activates the push path (dry-run unless
+    ``export_dry_run=False``, which needs google-cloud-monitoring and GCP
+    creds — absence fails loudly, never a silent no-op). ``"json"``/
+    ``"none"`` mean no in-run export."""
+    o = cfg.obs
+    if o.export in ("", "none", "json"):
+        return None
+    if o.export != "cloud":
+        raise ValueError(f"obs.export={o.export!r}: expected none|json|cloud")
+    return CloudMonitoringExporter(
+        project=cfg.workload.project or "local",
+        metric_prefix=o.metric_prefix,
+        interval_s=o.metrics_interval_s,
+        dry_run=o.export_dry_run,
+        # Per-process label: without it a multi-host pod's N processes write
+        # one identical time series and N-1 pushes are rejected.
+        base_labels={
+            "transport": cfg.transport.protocol,
+            "process": str(cfg.dist.process_id),
+        },
+    )
+
+
+def metrics_session_from_config(
+    cfg, metrics, bytes_fn: Optional[Callable[[], int]] = None
+) -> Optional[MetricsExportSession]:
+    """MetricSet-driven session (read workload family) per
+    ObservabilityConfig; see :func:`cloud_exporter_from_config`."""
+    exporter = cloud_exporter_from_config(cfg)
+    if exporter is None:
+        return None
+    return MetricsExportSession(
+        exporter, metrics, interval_s=cfg.obs.metrics_interval_s,
+        bytes_fn=bytes_fn,
+    )

@@ -52,6 +52,13 @@ class PodIngestWorkload:
     verify: bool = True
 
     def run(self, object_name: Optional[str] = None) -> RunResult:
+        from tpubench.obs.exporters import cloud_exporter_from_config
+
+        # Construct up front: a live-mode misconfiguration (missing lib,
+        # bad creds) must fail BEFORE the benchmark runs, not discard a
+        # completed run's result afterwards.
+        cloud_exp = cloud_exporter_from_config(self.cfg)
+
         w = self.cfg.workload
         lane = self.cfg.staging.lane
         name = object_name or f"{w.object_name_prefix}0"
@@ -160,6 +167,16 @@ class PodIngestWorkload:
                 "shard_bytes": table.shard_bytes,
             }
         )
+        # One-burst workload: cloud export is a single final flush of the
+        # stage-separated numbers (the periodic loop belongs to the long
+        # runners — read and stream).
+        if cloud_exp is not None:
+            for key in ("fetch_gbps", "stage_gbps", "gather_gbps"):
+                cloud_exp.export_point(key, res.extra[key])
+            cloud_exp.export_point("bytes_ingested", float(delivered))
+            cloud_exp.export_point("ingest_gbps", res.gbps)
+            cloud_exp.close()
+            res.extra["metrics_export"] = cloud_exp.summary()
         return res
 
 

@@ -32,7 +32,11 @@ from tpubench.config import BenchConfig
 from tpubench.dist.reassemble import make_mesh, make_reassemble, shard_to_device_array
 from tpubench.dist.shard import ShardTable
 from tpubench.metrics.report import RunResult
-from tpubench.obs.exporters import SnapshotWriter
+from tpubench.obs.exporters import (
+    PeriodicExporter,
+    SnapshotWriter,
+    cloud_exporter_from_config,
+)
 from tpubench.obs.profiling import annotate
 from tpubench.storage import open_backend
 from tpubench.storage.base import StorageBackend
@@ -138,11 +142,31 @@ class StreamedPodIngest:
             else None
         )
 
+        # In-run cloud export (metrics_exporter.go:36-58): stream progress
+        # gauges every metrics_interval_s during the run + final flush — a
+        # 30-minute stream emits series long before it finishes.
+        cloud_exp = cloud_exporter_from_config(self.cfg)
+        cloud_periodic = None
+
+        def flush_progress() -> None:  # closes over t_wall0 (set below)
+            p = dict(self._progress)
+            elapsed = time.perf_counter() - t_wall0
+            cloud_exp.export_point("objects_done", float(p.get("objects_done", 0)))
+            cloud_exp.export_point("bytes_ingested", float(p.get("bytes", 0)))
+            cloud_exp.export_point(
+                "ingest_gbps",
+                (p.get("bytes", 0) / 1e9) / elapsed if elapsed > 0 else 0.0,
+            )
+
         pool = ThreadPoolExecutor(max_workers=1)
         t_wall0 = time.perf_counter()
         try:
             if snap_ctx:
                 snap_ctx.__enter__()
+            if cloud_exp is not None:
+                cloud_periodic = PeriodicExporter(
+                    flush_progress, self.cfg.obs.metrics_interval_s
+                ).start()
 
             def timed_fetch(k: int):
                 t0 = time.perf_counter()
@@ -206,6 +230,9 @@ class StreamedPodIngest:
             pool.shutdown(wait=False, cancel_futures=True)
             if snap_ctx:
                 snap_ctx.__exit__(None, None, None)
+            if cloud_periodic is not None:
+                cloud_periodic.close()  # guaranteed final flush
+                cloud_exp.close()
         wall = time.perf_counter() - t_wall0
 
         device_s = stage_s + gather_s
@@ -235,6 +262,8 @@ class StreamedPodIngest:
                 "holes_by_object": {str(k): v for k, v in object_holes.items()},
             }
         )
+        if cloud_exp is not None:
+            res.extra["metrics_export"] = cloud_exp.summary(cloud_periodic)
         return res
 
 

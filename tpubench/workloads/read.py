@@ -125,26 +125,42 @@ class ReadWorkload:
                             fb_rec.record_ns(fb_ns - t0)
                             span.event("first_byte")
                         total_local += nbytes
+                        # Single-writer slot: the periodic exporter reads a
+                        # live pod-progress sum without shared hot-loop state.
+                        worker_bytes[i] = total_local
             finally:
                 if sink is not None:
                     sink_stats[i] = sink.finish() or {}
                 worker_bytes[i] = total_local
 
+        from tpubench.obs.exporters import metrics_session_from_config
+
+        session = metrics_session_from_config(
+            self.cfg, metrics, bytes_fn=lambda: sum(worker_bytes)
+        )
         metrics.ingest.start()
         group = WorkerGroup(abort_on_error=w.abort_on_error)
         result_errors = 0
         try:
-            gres = group.run(n, worker, name="read")
-            result_errors = gres.error_count
+            if session is not None:
+                session.__enter__()
+            try:
+                gres = group.run(n, worker, name="read")
+                result_errors = gres.error_count
+            finally:
+                metrics.ingest.stop()
+                metrics.ingest.bytes = sum(worker_bytes)
+                # Stage-latency recorders created by sinks live in their
+                # stats; merge BEFORE the session's final flush so the
+                # exported stage_latency histogram isn't silently empty.
+                for st in sink_stats:
+                    rec = st.get("stage_recorder")
+                    if rec is not None:
+                        metrics.stage_latency.append(rec)
         finally:
-            metrics.ingest.stop()
-            metrics.ingest.bytes = sum(worker_bytes)
-
-        # Stage-latency recorders created by sinks live in their stats.
-        for st in sink_stats:
-            rec = st.get("stage_recorder")
-            if rec is not None:
-                metrics.stage_latency.append(rec)
+            if session is not None:
+                # Guaranteed final flush — now with complete counters.
+                session.__exit__(None, None, None)
 
         wall = metrics.ingest.seconds
         gbps = metrics.ingest.gbps()
@@ -161,6 +177,8 @@ class ReadWorkload:
             summaries=metrics.summaries(),
             errors=result_errors,
         )
+        if session is not None:
+            res.extra["metrics_export"] = session.summary()
         if staged:
             res.extra["staging_zero_copy"] = all(zero_copy_used)
             res.extra["staged_bytes"] = staged
