@@ -141,3 +141,56 @@ def test_conn_pool_round_robin(server):
     for _ in range(6):  # all channels exercised
         assert c.stat("bench/file_0").size == 3_000_000
     c.close()
+
+
+# --------------------------------------------------------------- DirectPath
+
+
+def test_directpath_builds_c2p_channel(monkeypatch):
+    """transport.directpath against the real endpoint builds the google-c2p
+    resolver channel with compute-engine credentials — the grpcio
+    equivalent of the Go rls/xds blank imports (main.go:24-26), not an
+    env-var no-op."""
+    import grpc as grpc_mod
+
+    captured = {}
+
+    def fake_secure_channel(target, creds, opts=None):
+        captured["target"] = target
+        captured["env"] = __import__("os").environ.get(
+            "GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS"
+        )
+        return grpc_mod.insecure_channel("127.0.0.1:1")  # placeholder
+
+    monkeypatch.setattr(grpc_mod, "secure_channel", fake_secure_channel)
+    monkeypatch.setattr(
+        GcsGrpcBackend, "_call_credentials",
+        staticmethod(lambda: grpc_mod.access_token_call_credentials("t")),
+    )
+    monkeypatch.setattr(
+        grpc_mod, "compute_engine_channel_credentials",
+        lambda call_creds: grpc_mod.ssl_channel_credentials(),
+    )
+    t = TransportConfig(protocol="grpc", directpath=True)
+    c = GcsGrpcBackend(bucket="b", transport=t)
+    assert captured["target"] == "google-c2p:///storage.googleapis.com"
+    assert captured["env"] == "true"  # set only AROUND creation…
+    import os
+
+    assert os.environ.get("GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS") is None  # …and restored
+    c.close()
+
+
+def test_directpath_warns_on_custom_endpoint(server):
+    """directpath with a custom/fake endpoint cannot apply: visible warning,
+    plain channel — never a silent no-op."""
+    import warnings
+
+    t = TransportConfig(protocol="grpc", endpoint=server.endpoint, directpath=True)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        c = GcsGrpcBackend(bucket="testbucket", transport=t)
+    assert any("DirectPath serves storage.googleapis.com" in str(x.message) for x in w)
+    # The plain channel still works against the fake server.
+    assert c.stat("bench/file_0").size == 3_000_000
+    c.close()

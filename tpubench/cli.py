@@ -236,9 +236,16 @@ def cmd_read(cfg: BenchConfig, args) -> RunResult:
     from tpubench.staging.device import make_sink_factory
     from tpubench.workloads.read import run_read
 
-    return run_read(
-        cfg, tracer=make_tracer(cfg), sink_factory=make_sink_factory(cfg)
-    )
+    tracer = make_tracer(cfg)
+    try:
+        return run_read(
+            cfg, tracer=tracer, sink_factory=make_sink_factory(cfg)
+        )
+    finally:
+        # Flush-on-exit (trace_exporter.go:55-60): without this, batched
+        # spans (console/cloud_trace exporters) are dropped at process exit
+        # — the reference's lost-final-flush bug class.
+        tracer.shutdown()
 
 
 def cmd_pod_ingest(cfg: BenchConfig, args) -> RunResult:

@@ -31,6 +31,10 @@ class Span(Protocol):
 class Tracer(Protocol):
     def span(self, name: str, **attrs) -> contextlib.AbstractContextManager[Span]: ...
 
+    def shutdown(self) -> None:
+        """Flush-on-exit (reference trace_exporter.go:55-60): callers wrap
+        runs in try/finally shutdown() so batched spans are never lost."""
+
 
 class _NoopSpan:
     __slots__ = ()
@@ -46,6 +50,9 @@ class NoopTracer:
     @contextlib.contextmanager
     def span(self, name: str, **attrs) -> Iterator[Span]:
         yield _NOOP_SPAN
+
+    def shutdown(self) -> None:
+        pass
 
 
 @dataclass
@@ -83,6 +90,35 @@ class RecordingTracer:
             sp.end_ns = time.perf_counter_ns()
             with self._lock:
                 self.spans.append(sp)
+
+    def shutdown(self) -> None:
+        pass
+
+
+class SpanCarrier:
+    """A manually-entered span whose lifetime crosses a call boundary —
+    the client-internal request spans end when their READER closes, not
+    when ``open_read`` returns. Enter at construction; end exactly once via
+    :meth:`close` (optionally with the exception that ended the request, so
+    failed reads export as failed spans, not OK ones). Idempotent."""
+
+    __slots__ = ("_cm", "span")
+
+    def __init__(self, tracer: Tracer, name: str, **attrs):
+        self._cm = tracer.span(name, **attrs)
+        self.span = self._cm.__enter__()
+
+    def event(self, name: str, **attrs) -> None:
+        self.span.event(name, **attrs)
+
+    def close(self, exc: Optional[BaseException] = None) -> None:
+        if self._cm is None:
+            return
+        cm, self._cm = self._cm, None
+        if exc is not None:
+            cm.__exit__(type(exc), exc, exc.__traceback__)
+        else:
+            cm.__exit__(None, None, None)
 
 
 class OtelTracer:
@@ -174,9 +210,25 @@ def make_tracer(cfg) -> Tracer:
     # SDK present: an explicitly requested exporter that cannot be built
     # (unknown name, cloud-trace package absent) is a CONFIG error and must
     # surface, not silently degrade.
-    return OtelTracer(
-        sample_rate=cfg.obs.trace_sample_rate,
-        service_name="tpubench",
-        transport=cfg.transport.protocol,
-        exporter=requested_exporter,
-    )
+    try:
+        return OtelTracer(
+            sample_rate=cfg.obs.trace_sample_rate,
+            service_name="tpubench",
+            transport=cfg.transport.protocol,
+            exporter=requested_exporter,
+        )
+    except Exception as e:
+        if requested_exporter:
+            raise
+        # SDK importable but broken (api/sdk version skew breaking
+        # TracerProvider/Resource construction) with no exporter asked for:
+        # degrade to in-process recording rather than failing the run —
+        # but VISIBLY (never a silent downgrade).
+        import warnings
+
+        warnings.warn(
+            f"OTel tracer construction failed ({type(e).__name__}: {e}); "
+            "degrading to in-process RecordingTracer",
+            stacklevel=2,
+        )
+        return RecordingTracer(sample_rate=cfg.obs.trace_sample_rate)

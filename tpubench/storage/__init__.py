@@ -23,10 +23,12 @@ from tpubench.storage.retry import Backoff, retry_call  # noqa: F401
 from tpubench.storage.retrying import RetryingBackend  # noqa: F401
 
 
-def open_backend(cfg, fault=None) -> StorageBackend:
+def open_backend(cfg, fault=None, tracer=None) -> StorageBackend:
     """Factory from a BenchConfig (reference: main.go:169-177 protocol switch,
     minus its ignored-error bug). Every backend is wrapped with the
-    client-level retry policy (main.go:179-184)."""
+    client-level retry policy (main.go:179-184). ``tracer`` gives the
+    HTTP/gRPC clients library-internal request spans (OC-bridge analog,
+    trace_exporter.go:49-52)."""
     proto = cfg.transport.protocol
     if proto == "fake":
         from tpubench.storage.fake import FakeBackend, FaultPlan
@@ -49,11 +51,15 @@ def open_backend(cfg, fault=None) -> StorageBackend:
     elif proto == "http":
         from tpubench.storage.gcs_http import GcsHttpBackend
 
-        inner = GcsHttpBackend(bucket=cfg.workload.bucket, transport=cfg.transport)
+        inner = GcsHttpBackend(
+            bucket=cfg.workload.bucket, transport=cfg.transport, tracer=tracer
+        )
     elif proto == "grpc":
         from tpubench.storage.gcs_grpc import GcsGrpcBackend
 
-        inner = GcsGrpcBackend(bucket=cfg.workload.bucket, transport=cfg.transport)
+        inner = GcsGrpcBackend(
+            bucket=cfg.workload.bucket, transport=cfg.transport, tracer=tracer
+        )
     elif proto == "local":
         from tpubench.storage.local_fs import LocalFsBackend
 

@@ -2,10 +2,12 @@
 
 Reference parity (``CreateGrpcClient``, main.go:106-117):
 
-* **DirectPath**: ``GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS=true`` is set for
-  the duration of channel creation and then restored (main.go:107-113); the
-  xds bootstrap happens inside grpc-core exactly as the Go rls/xds blank
-  imports arrange it (main.go:24-26).
+* **DirectPath**: via the ``google-c2p`` resolver + compute-engine channel
+  credentials — the grpcio mechanism equivalent to the Go client's rls/xds
+  blank imports (main.go:24-26). The env-var gate is set only around
+  channel creation, like main.go:107-113. Preconditions are validated
+  loudly (default endpoint only; needs a DirectPath-eligible GCP VM at
+  runtime); it is never a silent no-op knob.
 * **Single-connection pool**: ``GrpcConnPoolSize = 1`` (main.go:30,111) —
   one shared channel by default; >1 round-robins.
 * **2 MB chunking**: the gRPC server streams ``ReadObjectResponse`` messages
@@ -30,6 +32,7 @@ from typing import Optional
 import grpc
 
 from tpubench.config import TransportConfig
+from tpubench.obs.tracing import NoopTracer, SpanCarrier
 from tpubench.storage.base import ObjectMeta, StorageError
 
 from google.cloud._storage_v2 import types as s2
@@ -66,32 +69,51 @@ def _wrap_rpc_error(e: grpc.RpcError, what: str) -> StorageError:
 
 class _GrpcReader:
     """Streams ReadObjectResponse messages; leftover message bytes are
-    carried between ``readinto`` calls (no whole-object buffering)."""
+    carried between ``readinto`` calls (no whole-object buffering, no
+    per-chunk copies — ``readinto`` slices a memoryview straight over the
+    message's content bytes).
 
-    def __init__(self, stream):
+    First-byte stamping: the read stub's deserializer is wrapped to stamp
+    arrival BEFORE protobuf parsing (``_stamped_read_deserializer``), so
+    ``first_byte_ns`` measures network arrival of the first response
+    message, not arrival + 2 MiB of proto decode.
+
+    ``carrier`` (optional) is the client-internal request span (OC-bridge
+    analog): ``first_byte`` event on the first message; the span ends at
+    close — with the error attached when the stream failed, so failed
+    reads export as failed spans.
+    """
+
+    def __init__(self, stream, carrier=None):
         self._stream = stream
         self._pending = memoryview(b"")
         self.first_byte_ns: Optional[int] = None
         self._done = False
+        self._carrier = carrier
 
     def readinto(self, buf: memoryview) -> int:
         if self._done and not self._pending:
             return 0
-        if not self._pending:
+        while not self._pending:
             try:
-                msg = next(self._stream, None)
+                item = next(self._stream, None)
             except grpc.RpcError as e:
                 self._done = True
-                raise _wrap_rpc_error(e, "ReadObject stream") from e
-            if msg is None:
+                err = _wrap_rpc_error(e, "ReadObject stream")
+                if self._carrier is not None:
+                    self._carrier.close(err)
+                raise err from e
+            if item is None:
                 self._done = True
                 return 0
-            content = bytes(msg.checksummed_data.content)
+            arrival_ns, msg = item
             if self.first_byte_ns is None:
-                self.first_byte_ns = time.perf_counter_ns()
-            self._pending = memoryview(content)
-            if not content:
-                return self.readinto(buf)
+                self.first_byte_ns = arrival_ns
+                if self._carrier is not None:
+                    self._carrier.event("first_byte")
+            content = msg.checksummed_data.content
+            if content:
+                self._pending = memoryview(content)
         n = min(len(buf), len(self._pending))
         buf[:n] = self._pending[:n]
         self._pending = self._pending[n:]
@@ -103,6 +125,14 @@ class _GrpcReader:
         except Exception:
             pass
         self._done = True
+        if self._carrier is not None:
+            self._carrier.close()  # idempotent; failure paths closed it already
+
+
+def _stamped_read_deserializer(b: bytes):
+    """Arrival stamp taken on the raw wire bytes BEFORE proto decode: the
+    first-byte latency must not include deserializing a 2 MiB message."""
+    return time.perf_counter_ns(), s2.ReadObjectResponse.deserialize(b)
 
 
 class GcsGrpcBackend:
@@ -111,9 +141,11 @@ class GcsGrpcBackend:
         bucket: str,
         transport: Optional[TransportConfig] = None,
         channel: Optional[grpc.Channel] = None,
+        tracer=None,
     ):
         self.bucket = bucket
         self.transport = transport or TransportConfig()
+        self._tracer = tracer or NoopTracer()
         n = max(1, self.transport.grpc_conn_pool_size)
         if channel is not None:
             self._channels = [channel]
@@ -132,42 +164,77 @@ class GcsGrpcBackend:
             ("grpc.max_receive_message_length", 16 * 1024 * 1024),
             ("grpc.keepalive_time_ms", 30000),
         ]
+        if self.transport.directpath:
+            if endpoint in ("storage.googleapis.com:443", "storage.googleapis.com"):
+                return self._make_directpath_channel(opts)
+            # DirectPath serves real GCS only; with a custom/fake endpoint
+            # the knob cannot apply — say so visibly (never a silent no-op)
+            # and use the plain channel.
+            import warnings
+
+            warnings.warn(
+                f"transport.directpath=True ignored for custom endpoint "
+                f"{endpoint!r}: DirectPath serves storage.googleapis.com only",
+                stacklevel=3,
+            )
+        if endpoint.startswith("insecure://"):
+            return grpc.insecure_channel(endpoint[len("insecure://"):], opts)
+        creds = grpc.ssl_channel_credentials()
+        if "googleapis.com" in endpoint:
+            creds = grpc.composite_channel_credentials(
+                creds, self._call_credentials()
+            )
+        return grpc.secure_channel(endpoint, creds, opts)
+
+    @staticmethod
+    def _call_credentials() -> grpc.CallCredentials:
+        import google.auth
+        import google.auth.transport.grpc
+        import google.auth.transport.requests
+
+        from tpubench.storage.auth import GCS_SCOPE
+
+        gcreds, _ = google.auth.default(scopes=[GCS_SCOPE])
+        return grpc.metadata_call_credentials(
+            google.auth.transport.grpc.AuthMetadataPlugin(
+                gcreds, google.auth.transport.requests.Request()
+            )
+        )
+
+    def _make_directpath_channel(self, opts: list) -> grpc.Channel:
+        """Real DirectPath from grpcio: the ``google-c2p`` resolver picks
+        DirectPath backends over the VPC fabric when the VM is eligible,
+        falling back to the public path otherwise — the grpcio equivalent of
+        the Go client's rls/xds blank imports + env var
+        (``main.go:24-26,107-113``; a plain ``grpc.secure_channel`` with the
+        env var set does NOTHING in Python, so the previous env-var-only
+        arrangement was a no-op and is gone). Needs grpc-core built with xds
+        (standard wheels are) and google-auth for the compute-engine
+        credentials DirectPath requires — import failures surface loudly.
+        """
+        # GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS gates the c2p resolver's xds
+        # path inside grpc-core — set only around channel creation, exactly
+        # like the reference (main.go:107-113).
         saved = os.environ.get("GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS")
+        os.environ["GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS"] = "true"
         try:
-            if self.transport.directpath:
-                # main.go:107: set only around client creation.
-                os.environ["GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS"] = "true"
-            if endpoint.startswith("insecure://"):
-                return grpc.insecure_channel(endpoint[len("insecure://"):], opts)
-            creds = grpc.ssl_channel_credentials()
-            if "googleapis.com" in endpoint:
-                import google.auth
-                import google.auth.transport.grpc
-                import google.auth.transport.requests
-
-                from tpubench.storage.auth import GCS_SCOPE
-
-                gcreds, _ = google.auth.default(scopes=[GCS_SCOPE])
-                call_creds = grpc.metadata_call_credentials(
-                    google.auth.transport.grpc.AuthMetadataPlugin(
-                        gcreds, google.auth.transport.requests.Request()
-                    )
-                )
-                creds = grpc.composite_channel_credentials(creds, call_creds)
-            return grpc.secure_channel(endpoint, creds, opts)
+            creds = grpc.compute_engine_channel_credentials(
+                self._call_credentials()
+            )
+            return grpc.secure_channel("google-c2p:///storage.googleapis.com",
+                                       creds, opts)
         finally:
-            if self.transport.directpath:
-                if saved is None:
-                    os.environ.pop("GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS", None)
-                else:
-                    os.environ["GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS"] = saved
+            if saved is None:
+                os.environ.pop("GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS", None)
+            else:
+                os.environ["GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS"] = saved
 
     def _make_stubs(self, ch: grpc.Channel) -> dict:
         return {
             "read": ch.unary_stream(
                 f"{_SVC}/ReadObject",
                 request_serializer=s2.ReadObjectRequest.serialize,
-                response_deserializer=s2.ReadObjectResponse.deserialize,
+                response_deserializer=_stamped_read_deserializer,
             ),
             "get": ch.unary_unary(
                 f"{_SVC}/GetObject",
@@ -207,11 +274,17 @@ class GcsGrpcBackend:
             read_offset=start,
             read_limit=length or 0,
         )
+        carrier = SpanCarrier(
+            self._tracer, "gcs_grpc.read_object", object=name, bucket=self.bucket
+        )
         try:
             stream = self._stub()["read"](req)
-        except grpc.RpcError as e:  # pragma: no cover - connect-time failure
-            raise _wrap_rpc_error(e, f"ReadObject {name}") from e
-        return _GrpcReader(stream)
+            return _GrpcReader(stream, carrier=carrier)
+        except BaseException as e:
+            carrier.close(e)
+            if isinstance(e, grpc.RpcError):  # pragma: no cover - connect-time
+                raise _wrap_rpc_error(e, f"ReadObject {name}") from e
+            raise
 
     def write(self, name: str, data: bytes) -> ObjectMeta:
         def requests():

@@ -39,6 +39,7 @@ from typing import Optional
 import time
 
 from tpubench.config import TransportConfig
+from tpubench.obs.tracing import NoopTracer, SpanCarrier
 from tpubench.storage.auth import TokenSource, make_token_source
 from tpubench.storage.base import ObjectMeta, StorageError
 
@@ -101,15 +102,24 @@ class _ConnectionPool:
 class _HttpReader:
     """Streams one media response; returns its connection to the pool on
     close. EOF-complete responses are reusable (keep-alive); aborted ones are
-    not."""
+    not.
 
-    def __init__(self, pool: _ConnectionPool, conn, resp, length: int):
+    ``carrier`` (optional) is the client-internal request span (the
+    OC-bridge analog, trace_exporter.go:49-52): it covers
+    request→body-complete, gets a ``first_byte`` event when the first
+    payload byte lands, and ends when the reader closes — with the error
+    attached when the body failed, so failed reads export as failed spans.
+    """
+
+    def __init__(self, pool: _ConnectionPool, conn, resp, length: int,
+                 carrier=None):
         self._pool = pool
         self._conn = conn
         self._resp = resp
         self._remaining = length
         self.first_byte_ns: Optional[int] = None
         self._done = False
+        self._carrier = carrier
 
     def readinto(self, buf: memoryview) -> int:
         if self._done or self._remaining == 0:
@@ -119,16 +129,24 @@ class _HttpReader:
             n = self._resp.readinto(buf[:want])
         except (http.client.HTTPException, OSError) as e:
             self._done = True
-            raise StorageError(f"mid-stream read failed: {e}", transient=True) from e
+            err = StorageError(f"mid-stream read failed: {e}", transient=True)
+            if self._carrier is not None:
+                self._carrier.close(err)
+            raise err from e
         if n == 0:
             self._done = True
             if self._remaining > 0:
-                raise StorageError(
+                err = StorageError(
                     f"short body: {self._remaining} bytes missing", transient=True
                 )
+                if self._carrier is not None:
+                    self._carrier.close(err)
+                raise err
             return 0
         if self.first_byte_ns is None:
             self.first_byte_ns = time.perf_counter_ns()
+            if self._carrier is not None:
+                self._carrier.event("first_byte")
         self._remaining -= n
         return n
 
@@ -147,6 +165,8 @@ class _HttpReader:
                     complete = False
         self._pool.release(self._conn, reusable=complete)
         self._conn = None
+        if self._carrier is not None:
+            self._carrier.close()  # idempotent; failure paths closed it already
 
 
 class _NativeBufReader:
@@ -189,9 +209,15 @@ class GcsHttpBackend:
         bucket: str,
         transport: Optional[TransportConfig] = None,
         token_source: Optional[TokenSource] = None,
+        tracer=None,
     ):
         self.bucket = bucket
         self.transport = transport or TransportConfig()
+        # Client-internal spans (the reference's OC-bridge capability,
+        # trace_exporter.go:49-52): per-request spans nest under the
+        # workload's ReadObject span when the tracer propagates context
+        # (OTel); NoopTracer costs nothing.
+        self._tracer = tracer or NoopTracer()
         if self.transport.http2:
             # Reference kills HTTP/2 deliberately (main.go:64-72); we don't
             # ship a slower path behind a flag that silently no-ops.
@@ -272,11 +298,24 @@ class GcsHttpBackend:
         if start or length is not None:
             end = "" if length is None else str(start + length - 1)
             headers["Range"] = f"bytes={start}-{end}"
-        conn, resp = self._checked(
-            "GET", self._opath(name) + "?alt=media", headers=headers
+        # Request span spanning request→body-complete: the reader owns its
+        # end (close()), mirroring the library-internal spans the reference
+        # gets from the OC bridge. Everything between enter and reader
+        # construction stays inside the guard — a leaked entered span would
+        # corrupt the thread's OTel context for the rest of the run.
+        carrier = SpanCarrier(
+            self._tracer, "gcs_http.get", object=name, bucket=self.bucket
         )
-        clen = int(resp.headers.get("Content-Length", "0"))
-        return _HttpReader(self._pool, conn, resp, clen)
+        try:
+            conn, resp = self._checked(
+                "GET", self._opath(name) + "?alt=media", headers=headers
+            )
+            carrier.event("response_headers", status=resp.status)
+            clen = int(resp.headers.get("Content-Length", "0"))
+            return _HttpReader(self._pool, conn, resp, clen, carrier=carrier)
+        except BaseException as e:
+            carrier.close(e)
+            raise
 
     def _open_read_native(self, name: str, start: int, length: Optional[int]):
         """Opt-in C++ receive path (``transport.native_receive``): the body
@@ -331,10 +370,17 @@ class GcsHttpBackend:
             headers += f"Range: bytes={start}-\r\n"
         buf = engine.alloc(max(4096, want))
         try:
-            r = engine.http_get(
-                self._host, self._port, self._opath(name) + "?alt=media",
-                buf, headers=headers,
-            )
+            # The native GET is complete on return, so one span covers the
+            # whole request; the first-byte event carries the C++-side
+            # CLOCK_MONOTONIC stamp.
+            with self._tracer.span(
+                "gcs_http.get_native", object=name, bucket=self.bucket
+            ) as sp:
+                r = engine.http_get(
+                    self._host, self._port, self._opath(name) + "?alt=media",
+                    buf, headers=headers,
+                )
+                sp.event("first_byte", native_ns=r["first_byte_ns"])
         except NativeError as e:
             # Module contract: this layer raises classified StorageErrors.
             # Classification is on the engine's error-code ABI (engine.cc

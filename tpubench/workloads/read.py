@@ -197,12 +197,15 @@ def run_read(
     sink_factory: Optional[SinkFactory] = None,
 ) -> RunResult:
     owns_backend = backend is None
-    backend = backend or open_backend(cfg)
+    tracer = tracer or NoopTracer()
+    # The backend gets the same tracer: its per-request spans nest under
+    # the workload's ReadObject spans (OC-bridge analog).
+    backend = backend or open_backend(cfg, tracer=tracer)
     try:
         return ReadWorkload(
             cfg=cfg,
             backend=backend,
-            tracer=tracer or NoopTracer(),
+            tracer=tracer,
             sink_factory=sink_factory,
         ).run()
     finally:
