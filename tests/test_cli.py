@@ -201,3 +201,61 @@ def test_cli_process_id_zero_also_rejected(tmp_path):
     with pytest.raises(SystemExit, match="num-processes"):
         main(["read", "--protocol", "fake", "--process-id", "0",
               "--results-dir", str(tmp_path)])
+
+
+def test_results_bucket_upload(tmp_path):
+    """--results-bucket closes the execute_pb.sh:5 loop: the run's result
+    JSON lands in the bucket over the same storage protocol."""
+    import json
+
+    from tpubench.config import BenchConfig, TransportConfig
+    from tpubench.metrics.report import upload_result, write_result
+    from tpubench.storage import FakeBackend
+    from tpubench.storage.base import read_object_through
+    from tpubench.storage.fake_server import FakeGcsServer
+    from tpubench.storage.gcs_http import GcsHttpBackend
+    from tpubench.workloads.read import run_read
+
+    store = FakeBackend.prepopulated("up/file_", count=1, size=10_000)
+    with FakeGcsServer(store) as srv:
+        cfg = BenchConfig()
+        cfg.transport.protocol = "http"
+        cfg.transport.endpoint = srv.endpoint
+        cfg.workload.bucket = "testbucket"
+        cfg.workload.object_name_prefix = "up/file_"
+        cfg.workload.workers = 1
+        cfg.workload.read_calls_per_worker = 1
+        cfg.workload.object_size = 10_000
+        cfg.obs.results_dir = str(tmp_path)
+        cfg.obs.results_bucket = "resultsbucket"
+        res = run_read(cfg)
+        path = write_result(res, cfg.obs.results_dir)
+        obj = upload_result(cfg, path)
+        # Fetch it back through the same protocol and compare.
+        c = GcsHttpBackend(bucket="resultsbucket",
+                           transport=TransportConfig(endpoint=srv.endpoint))
+        got = bytearray()
+        read_object_through(
+            c.open_read(obj), memoryview(bytearray(65536)), got.extend
+        )
+        c.close()
+    uploaded = json.loads(bytes(got))
+    assert uploaded["workload"] == "read"
+    assert uploaded["bytes_total"] == 10_000
+
+
+def test_results_bucket_rejected_for_non_object_store(tmp_path):
+    """'uploaded' must never be a lie: local/fake protocols can't host a
+    results bucket and fail loudly."""
+    import pytest
+
+    from tpubench.config import BenchConfig
+    from tpubench.metrics.report import upload_result
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.obs.results_bucket = "b"
+    p = tmp_path / "r.json"
+    p.write_text("{}")
+    with pytest.raises(ValueError, match="object-store protocol"):
+        upload_result(cfg, str(p))

@@ -79,7 +79,8 @@ def test_open_file_hold(tmp_path):
     cfg.workload.hold_seconds = 0.05
     res = run_open_file(cfg, direct=False)
     assert res.extra["open_files"] == 5
-    assert res.summaries["open"].count == 5
+    # cold pass + hot pass: every file opened twice
+    assert res.summaries["open"].count == 10
     assert res.wall_seconds >= 0.05
 
 
@@ -129,3 +130,91 @@ def test_ssd_random_pattern_deterministic(tmp_path):
     r1 = run_ssd_compare(cfg, direct=False)
     r2 = run_ssd_compare(cfg, direct=False)
     assert r1.bytes_total == r2.bytes_total
+
+
+# -------------------------------------------- mount hooks + hot/cold rounds
+
+
+def test_mount_hooks_bracket_fs_run(tmp_path):
+    """maybe_mounted runs the configured mount/unmount templates around the
+    workload with {dir} expanded (read_operations.sh:18-21 convention)."""
+    from tpubench.workloads.fsbench import maybe_mounted
+
+    cfg = BenchConfig()
+    cfg.workload.dir = str(tmp_path)
+    log = tmp_path / "hooks.log"
+    cfg.workload.mount_cmd = f"echo mount {{dir}} >> {log}"
+    cfg.workload.unmount_cmd = f"echo unmount {{dir}} >> {log}"
+    with maybe_mounted(cfg):
+        assert log.read_text().strip() == f"mount {tmp_path}"
+    lines = log.read_text().strip().splitlines()
+    assert lines == [f"mount {tmp_path}", f"unmount {tmp_path}"]
+
+
+def test_mount_failure_aborts(tmp_path):
+    from tpubench.workloads.fsbench import maybe_mounted
+
+    cfg = BenchConfig()
+    cfg.workload.dir = str(tmp_path)
+    cfg.workload.mount_cmd = "false"
+    with pytest.raises(RuntimeError, match="mount hook failed"):
+        with maybe_mounted(cfg):
+            pass
+
+
+def test_listing_hot_cold_rounds(tmp_path):
+    """Round 0 is the cold round (with a remount when hooks configured);
+    the rest are hot — PARITY row 13's hot/cold claim."""
+    from tpubench.workloads.fsbench import prepare_files, run_listing
+
+    cfg = BenchConfig()
+    cfg.workload.dir = str(tmp_path / "mnt")
+    prepare_files(cfg.workload.dir, 8, 1024)
+    log = tmp_path / "remounts.log"
+    cfg.workload.mount_cmd = f"echo mount >> {log}"
+    cfg.workload.unmount_cmd = f"echo unmount >> {log}"
+    cfg.workload.list_rounds = 4
+    res = run_listing(cfg)
+    assert res.errors == 0
+    assert res.extra["rounds"] == 4
+    assert res.extra["cold_via_remount"] is True
+    assert res.summaries["list_cold"].count == 1
+    assert res.summaries["list_hot"].count == 3
+    assert res.summaries["list"].count == 4
+    # remount = unmount + mount before the cold round
+    assert log.read_text().strip().splitlines() == ["unmount", "mount"]
+
+
+def test_open_file_hot_cold(tmp_path):
+    from tpubench.workloads.fsbench import prepare_files, run_open_file
+
+    cfg = BenchConfig()
+    cfg.workload.dir = str(tmp_path)
+    cfg.workload.open_files = 6
+    prepare_files(cfg.workload.dir, 6, 4096)
+    res = run_open_file(cfg, direct=False)
+    assert res.errors == 0
+    assert res.summaries["open_cold"].count == 6
+    assert res.summaries["open_hot"].count == 6
+    assert res.extra["cold_via_remount"] is False
+
+
+def test_cli_list_with_mount_hooks(tmp_path):
+    """End-to-end: tpubench list --mount-cmd/--unmount-cmd brackets the run."""
+    from tpubench.cli import main
+    from tpubench.workloads.fsbench import prepare_files
+
+    d = tmp_path / "mnt"
+    prepare_files(str(d), 4, 512)
+    log = tmp_path / "hooks.log"
+    rc = main([
+        "list", "--dir", str(d), "--rounds", "3",
+        "--mount-cmd", f"echo mount {{dir}} >> {log}",
+        "--unmount-cmd", f"echo unmount {{dir}} >> {log}",
+        "--results-dir", str(tmp_path / "res"),
+    ])
+    assert rc == 0
+    lines = log.read_text().strip().splitlines()
+    # maybe_mounted's fresh mount IS the cold state: run_listing's cold
+    # round consumes it without paying a redundant unmount+mount cycle.
+    assert lines == [f"mount {d}", f"unmount {d}"]

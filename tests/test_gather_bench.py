@@ -1,5 +1,7 @@
 """ICI collective micro-benchmark over the simulated mesh."""
 
+import pytest
+
 from tpubench.config import BenchConfig
 from tpubench.workloads.gather_bench import run_gather_bench
 
@@ -34,3 +36,34 @@ def test_gather_bench_cli(jax_cpu_devices, tmp_path):
         "--reps", "1", "--results-dir", str(tmp_path),
     ])
     assert rc == 0
+
+
+def test_result_fields_self_consistent(jax_cpu_devices):
+    """gbps == bytes_total/wall and gbps_per_chip == gbps/n_chips — result
+    consumers can recompute/sanity-check throughput from totals like with
+    every other workload; the best mesh size lives in extra['best']."""
+    cfg = BenchConfig()
+    res = run_gather_bench(cfg, shard_mb=0.5, reps=3)
+    assert res.bytes_total > 0 and res.wall_seconds > 0
+    assert res.gbps == pytest.approx(res.bytes_total / 1e9 / res.wall_seconds)
+    assert res.gbps_per_chip == pytest.approx(res.gbps / res.n_chips)
+    assert res.extra["best"] in res.extra["scaling"]
+    assert res.extra["single_device"] is False
+    # per-row totals: bytes_total is the sum over rows × reps
+    assert res.bytes_total == sum(
+        r["ici_bytes_moved"] for r in res.extra["scaling"]
+    ) * 3
+
+
+def test_single_device_labelled(monkeypatch, jax_cpu_devices):
+    """On one chip the gather is an identity: the run still works and the
+    result says single_device instead of reporting fake ICI bandwidth."""
+    import jax
+
+    devs = jax.devices()
+    monkeypatch.setattr(jax, "devices", lambda *a: devs[:1])
+    cfg = BenchConfig()
+    res = run_gather_bench(cfg, shard_mb=0.25, reps=2)
+    assert res.extra["single_device"] is True
+    assert res.n_chips == 1
+    assert res.errors == 0

@@ -373,7 +373,9 @@ def test_native_receive_connection_refused_is_transient(monkeypatch):
     with pytest.raises(StorageError) as ei:
         c.open_read("bench/file_0", length=4096)
     assert ei.value.transient is True
-    assert allocated and all(b._ptr == 0 for b in allocated)
+    # Connect fails before the receive buffer is even allocated; whatever
+    # was allocated must be freed.
+    assert all(b._ptr == 0 for b in allocated)
     c.close()
 
 
@@ -484,3 +486,19 @@ def test_native_receive_chunked_rejected_case_insensitive(monkeypatch):
         c.close()
     finally:
         srv.close()
+
+
+@pytestmark_native
+def test_native_receive_connection_reuse(server):
+    """Keep-alive on the native path: repeated GETs ride one pooled
+    connection (same discipline as the Python pool, so native-vs-Python
+    A/Bs isolate the receive loop, not per-GET connect cost)."""
+    c = _native_client(server)
+    for _ in range(5):
+        r = c.open_read("bench/file_0", length=65536)
+        buf = memoryview(bytearray(65536))
+        assert r.readinto(buf) == 65536
+        r.close()
+    assert c.native_conn_stats["connects"] == 1
+    assert c.native_conn_stats["reuses"] == 4
+    c.close()

@@ -24,7 +24,7 @@ import os
 import sys
 
 from tpubench.config import KB, MB, BenchConfig, preset
-from tpubench.metrics.report import RunResult, write_result
+from tpubench.metrics.report import RunResult, upload_result, write_result
 
 
 def _add_common(p: argparse.ArgumentParser) -> None:
@@ -65,6 +65,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "google-cloud-monitoring + GCP creds; default is "
                         "dry-run capture stamped into the result)")
     p.add_argument("--results-dir")
+    p.add_argument("--results-bucket",
+                   help="also upload result JSONs to this bucket via the "
+                        "configured storage protocol (execute_pb.sh:5)")
     p.add_argument("--no-abort-on-error", action="store_true",
                    help="per-worker failure domains instead of errgroup abort")
     p.add_argument("--fault-error-rate", type=float,
@@ -83,6 +86,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="C++ HTTP receive path into pre-registered buffers "
                         "(plain-HTTP endpoints only)")
     p.add_argument("--no-direct", action="store_true", help="skip O_DIRECT")
+    p.add_argument("--mount-cmd",
+                   help="shell template run before FS workloads; {dir} "
+                        "expands (read_operations.sh:18 convention)")
+    p.add_argument("--unmount-cmd",
+                   help="shell template run after FS workloads; {dir} expands")
+    p.add_argument("--rounds", type=int,
+                   help="listing rounds (round 0 = cold, rest hot)")
     p.add_argument("--ring", action="store_true",
                    help="pod-ingest: explicit ppermute ring instead of all_gather")
     p.add_argument("--num-processes", type=int,
@@ -149,6 +159,8 @@ def build_config(args) -> BenchConfig:
         o.export_dry_run = False
     if args.results_dir:
         o.results_dir = args.results_dir
+    if getattr(args, "results_bucket", None):
+        o.results_bucket = args.results_bucket
     if args.no_abort_on_error:
         w.abort_on_error = False
     if args.fault_error_rate is not None:
@@ -163,6 +175,12 @@ def build_config(args) -> BenchConfig:
         t.retry.max_attempts = args.retry_max_attempts
     if args.native_receive:
         t.native_receive = True
+    if getattr(args, "mount_cmd", None):
+        w.mount_cmd = args.mount_cmd
+    if getattr(args, "unmount_cmd", None):
+        w.unmount_cmd = args.unmount_cmd
+    if getattr(args, "rounds", None) is not None:
+        w.list_rounds = args.rounds
     # Multi-host bring-up knobs: flags win over env autodetect, so one
     # launch template works on every VM of a pod (reference property: the
     # same binary is launchable everywhere, main.go:158).
@@ -221,6 +239,10 @@ def _finish(res: RunResult, cfg: BenchConfig, quiet: bool = False,
     if not quiet:
         print(res.format())
         print(f"result: {path}")
+    if cfg.obs.results_bucket:
+        obj = upload_result(cfg, path)
+        if not quiet:
+            print(f"uploaded: {cfg.obs.results_bucket}/{obj}")
 
 
 def _bringup(cfg: BenchConfig) -> dict:
@@ -306,6 +328,8 @@ def cmd_sweep(cfg: BenchConfig, args, topo=None) -> None:
             res = cmd_read(c, args)
             res.extra["sweep"] = {"protocol": proto, "size": sz}
             path = write_result(res, cfg.obs.results_dir, tag=tag)
+            if cfg.obs.results_bucket:
+                upload_result(cfg, path)
             rows.append(
                 {
                     "protocol": proto,
@@ -383,7 +407,13 @@ def main(argv=None) -> int:
             print(f"jax unavailable: {e}", file=sys.stderr)
         return 0
     if args.cmd == "prepare":
-        cmd_prepare(cfg, args)
+        # Prepare writes THROUGH the mount when hooks are configured —
+        # writing into the unmounted shadow directory would hide the files
+        # from every subsequent mounted run.
+        from tpubench.workloads.fsbench import maybe_mounted
+
+        with maybe_mounted(cfg):
+            cmd_prepare(cfg, args)
         return 0
     if args.cmd == "sweep":
         pin_platform()
@@ -413,26 +443,20 @@ def main(argv=None) -> int:
                 cfg, n_objects=args.objects, verify=args.validate,
                 snapshot_path=args.snapshot,
             )
-        elif args.cmd == "read-fs":
-            from tpubench.workloads.fsbench import run_read_fs
+        elif args.cmd in ("read-fs", "write", "list", "open", "ssd"):
+            from tpubench.workloads import fsbench
 
-            res = run_read_fs(cfg, direct=direct)
-        elif args.cmd == "write":
-            from tpubench.workloads.fsbench import run_write
-
-            res = run_write(cfg, direct=direct)
-        elif args.cmd == "list":
-            from tpubench.workloads.fsbench import run_listing
-
-            res = run_listing(cfg)
-        elif args.cmd == "open":
-            from tpubench.workloads.fsbench import run_open_file
-
-            res = run_open_file(cfg, direct=direct)
-        elif args.cmd == "ssd":
-            from tpubench.workloads.fsbench import run_ssd_compare
-
-            res = run_ssd_compare(cfg, direct=direct)
+            fs_runner = {
+                "read-fs": lambda: fsbench.run_read_fs(cfg, direct=direct),
+                "write": lambda: fsbench.run_write(cfg, direct=direct),
+                "list": lambda: fsbench.run_listing(cfg),
+                "open": lambda: fsbench.run_open_file(cfg, direct=direct),
+                "ssd": lambda: fsbench.run_ssd_compare(cfg, direct=direct),
+            }[args.cmd]
+            # Launcher convention: bracket the run with mount/unmount
+            # (read_operations.sh:18-21); no-op without configured hooks.
+            with fsbench.maybe_mounted(cfg):
+                res = fs_runner()
         elif args.cmd == "gather-bench":
             from tpubench.workloads.gather_bench import run_gather_bench
 

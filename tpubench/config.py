@@ -119,6 +119,16 @@ class WorkloadConfig:
     hold_seconds: float = 0.0  # open_file FD-hold (ref: 180 s, :52-55)
     read_type: str = "seq"  # ssd_test --read-type: "seq" | "random" (:118-128)
     seed: int = 0  # offset-shuffle seed (ssd_test uses global rand)
+    # Mount orchestration (launcher convention, read_operations.sh:18-21):
+    # shell command templates run before/after FS workloads; "{dir}" expands
+    # to the workload dir. Empty = assume pre-mounted (the default). With
+    # both set, listing/open also get TRUE cold rounds via remount.
+    mount_cmd: str = ""  # e.g. "gcsfuse --stat-cache-ttl 10000m B {dir}"
+    unmount_cmd: str = ""  # e.g. "fusermount -u {dir}"
+    # Listing rounds: round 0 is the cold round (after remount when
+    # available), the rest are hot — the list_operations.sh:11-21 hot/cold
+    # sweep in one run.
+    list_rounds: int = 5
     # Object/file sizes for data generation in hermetic/fake runs.
     object_size: int = 100 * MB  # reference objects are ~100 MB-class (main.go:52)
     # errgroup semantics: first worker error aborts the run (main.go:200-219).
@@ -197,6 +207,10 @@ class ObservabilityConfig:
     # unless this is False, which requires google-cloud-monitoring + GCP
     # creds — absence fails loudly, never a silent no-op.
     export_dry_run: bool = True
+    # Upload result JSONs to this bucket via the framework's own storage
+    # backends — the execute_pb.sh:5 `gsutil cp` loop, first-class. Empty =
+    # local disk only. Object names: results/<filename>.
+    results_bucket: str = ""
     results_dir: str = "results"
     # Non-empty = capture a jax.profiler (xplane) trace of the run there
     # (SURVEY §5.1: the DMA/collective path profiled first-class, replacing

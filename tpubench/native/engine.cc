@@ -291,11 +291,9 @@ enum {
                         // framing as body bytes [permanent]
 };
 
-int64_t tb_http_get(const char* host, int port, const char* path,
-                    const char* extra_headers,  // "K: V\r\n..." or ""
-                    void* buf, int64_t buf_len, int* status_out,
-                    int64_t* first_byte_ns_out, int64_t* total_ns_out) {
-  int64_t t_start = tb_now_ns();
+// Connect a TCP socket for HTTP use (TCP_NODELAY). Returns fd >= 0, or
+// TB_ERESOLVE / -errno.
+int tb_http_connect(const char* host, int port) {
   char portstr[16];
   snprintf(portstr, sizeof portstr, "%d", port);
   struct addrinfo hints, *res = nullptr;
@@ -315,23 +313,37 @@ int64_t tb_http_get(const char* host, int port, const char* path,
   if (fd < 0) return -ECONNREFUSED;
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  return fd;
+}
 
+int tb_http_close(int fd) { return close(fd) == 0 ? 0 : -errno; }
+
+// One GET on an ALREADY-CONNECTED socket (keep-alive: the caller pools
+// connections, so the receive loop can be measured with the same
+// connection discipline as the pooled Python client instead of paying a
+// fresh TCP handshake per GET). The socket is NOT closed here on success;
+// *reusable_out reports whether it may carry another request (complete
+// Content-Length body, no "Connection: close" from the server). On ANY
+// error return the caller must tb_http_close the fd — the stream state is
+// unknown.
+int64_t tb_http_request(int fd, const char* host, int port, const char* path,
+                        const char* extra_headers,  // "K: V\r\n..." or ""
+                        void* buf, int64_t buf_len, int* status_out,
+                        int64_t* first_byte_ns_out, int64_t* total_ns_out,
+                        int* reusable_out) {
+  int64_t t_start = tb_now_ns();
+  if (reusable_out) *reusable_out = 0;
   char req[4096];
   int m = snprintf(req, sizeof req,
                    "GET %s HTTP/1.1\r\nHost: %s:%d\r\nUser-Agent: tpubench-native\r\n"
-                   "%sConnection: close\r\n\r\n",
+                   "%s\r\n",
                    path, host, port, extra_headers ? extra_headers : "");
-  if (m <= 0 || m >= static_cast<int>(sizeof req)) {
-    close(fd);
-    return TB_EPROTO;
-  }
+  if (m <= 0 || m >= static_cast<int>(sizeof req)) return TB_EPROTO;
   for (int sent = 0; sent < m;) {
     ssize_t k = send(fd, req + sent, m - sent, 0);
     if (k < 0) {
       if (errno == EINTR) continue;
-      int e = errno;
-      close(fd);
-      return -e;
+      return -errno;
     }
     sent += k;
   }
@@ -347,9 +359,7 @@ int64_t tb_http_get(const char* host, int port, const char* path,
     ssize_t k = recv(fd, hdr + hlen, hdr_cap - hlen, 0);
     if (k < 0) {
       if (errno == EINTR) continue;
-      int e = errno;
-      close(fd);
-      return -e;
+      return -errno;
     }
     if (k == 0) break;
     if (first_byte_ns == 0) first_byte_ns = tb_now_ns();
@@ -363,7 +373,6 @@ int64_t tb_http_get(const char* host, int port, const char* path,
     }
   }
   if (!body_start) {
-    close(fd);
     // Header buffer exhausted without a terminator: the server is speaking
     // broken HTTP (permanent). EOF mid-headers: early close (transient) —
     // same condition class as a body cut short.
@@ -371,17 +380,16 @@ int64_t tb_http_get(const char* host, int port, const char* path,
   }
 
   int status = 0;
-  if (sscanf(hdr, "HTTP/1.%*d %d", &status) != 1) {
-    close(fd);
-    return TB_EPROTO;
-  }
+  int http_minor = 0;
+  if (sscanf(hdr, "HTTP/1.%d %d", &http_minor, &status) != 2) return TB_EPROTO;
   if (status_out) *status_out = status;
 
   int64_t content_len = -1;
-  // Case-insensitive Content-Length / Transfer-Encoding scan over the
-  // header block. Chunked bodies are rejected (TB_ECHUNKED): this receive
-  // path has no de-chunker, and copying chunk framing into the buffer as
-  // body bytes would be silent corruption.
+  int server_close = 0;
+  // Case-insensitive Content-Length / Transfer-Encoding / Connection scan
+  // over the header block. Chunked bodies are rejected (TB_ECHUNKED): this
+  // receive path has no de-chunker, and copying chunk framing into the
+  // buffer as body bytes would be silent corruption.
   for (char* line = hdr; line < body_start;) {
     char* eol = static_cast<char*>(memmem(line, body_start - line, "\r\n", 2));
     if (!eol) break;
@@ -390,10 +398,12 @@ int64_t tb_http_get(const char* host, int port, const char* path,
     if (strncasecmp(line, "Transfer-Encoding:", 18) == 0) {
       // Transfer-coding names are case-insensitive (RFC 9112 §7).
       for (char* p = line + 18; p + 7 <= eol; p++) {
-        if (strncasecmp(p, "chunked", 7) == 0) {
-          close(fd);
-          return TB_ECHUNKED;
-        }
+        if (strncasecmp(p, "chunked", 7) == 0) return TB_ECHUNKED;
+      }
+    }
+    if (strncasecmp(line, "Connection:", 11) == 0) {
+      for (char* p = line + 11; p + 5 <= eol; p++) {
+        if (strncasecmp(p, "close", 5) == 0) server_close = 1;
       }
     }
     line = eol + 2;
@@ -401,18 +411,13 @@ int64_t tb_http_get(const char* host, int port, const char* path,
 
   // Read exactly Content-Length body bytes (standard HTTP-client semantics:
   // bytes past Content-Length are never read, so a server shipping trailing
-  // junk classifies deterministically regardless of packet boundaries; the
-  // connection is close-mode, one GET per connection, so unread trailing
-  // bytes are harmless).
+  // junk classifies deterministically regardless of packet boundaries).
   char* out = static_cast<char*>(buf);
   int64_t got = 0;
   if (body_in_hdr > 0) {
     int64_t take = body_in_hdr;
     if (content_len >= 0 && take > content_len) take = content_len;
-    if (take > buf_len) {
-      close(fd);
-      return TB_ETOOBIG;
-    }
+    if (take > buf_len) return TB_ETOOBIG;
     memcpy(out, body_start, take);
     got = take;
   }
@@ -423,26 +428,49 @@ int64_t tb_http_get(const char* host, int port, const char* path,
     if (want <= 0) {
       // Buffer full: with known length the body doesn't fit; with unknown
       // length (close-delimited) it's also an error for our use.
-      close(fd);
       return TB_ETOOBIG;
     }
     ssize_t k = recv(fd, out + got, want, 0);
     if (k < 0) {
       if (errno == EINTR) continue;
-      int e = errno;
-      close(fd);
-      return -e;
+      return -errno;
     }
     if (k == 0) break;
     if (first_byte_ns == 0) first_byte_ns = tb_now_ns();
     got += k;
   }
-  close(fd);
   // Peer FIN before Content-Length bytes arrived: transient early close.
   if (content_len >= 0 && got < content_len) return TB_ESHORT;
+  // Reusable only when the body boundary is known and fully consumed, the
+  // server speaks HTTP/1.1 (1.0 defaults to close) and didn't announce
+  // close; body_in_hdr beyond Content-Length (pipelined junk) poisons the
+  // stream — don't reuse.
+  if (reusable_out)
+    *reusable_out = (content_len >= 0 && !server_close && http_minor >= 1 &&
+                     body_in_hdr <= content_len)
+                        ? 1
+                        : 0;
   if (first_byte_ns_out) *first_byte_ns_out = first_byte_ns;
   if (total_ns_out) *total_ns_out = tb_now_ns() - t_start;
   return got;
+}
+
+// One-shot GET: fresh connection, Connection: close semantics via a
+// non-reused socket. Kept as the simple entry point; the pooled path is
+// tb_http_connect + tb_http_request (keep-alive).
+int64_t tb_http_get(const char* host, int port, const char* path,
+                    const char* extra_headers, void* buf, int64_t buf_len,
+                    int* status_out, int64_t* first_byte_ns_out,
+                    int64_t* total_ns_out) {
+  int64_t t_start = tb_now_ns();
+  int fd = tb_http_connect(host, port);
+  if (fd < 0) return fd;
+  int64_t n = tb_http_request(fd, host, port, path, extra_headers, buf,
+                              buf_len, status_out, first_byte_ns_out,
+                              nullptr, nullptr);
+  close(fd);
+  if (n >= 0 && total_ns_out) *total_ns_out = tb_now_ns() - t_start;
+  return n;
 }
 
 }  // extern "C"

@@ -208,6 +208,15 @@ class NativeEngine:
             c.c_void_p, c.c_int64, c.POINTER(c.c_int),
             c.POINTER(c.c_int64), c.POINTER(c.c_int64),
         ]
+        lib.tb_http_connect.restype = c.c_int
+        lib.tb_http_connect.argtypes = [c.c_char_p, c.c_int]
+        lib.tb_http_close.argtypes = [c.c_int]
+        lib.tb_http_request.restype = c.c_int64
+        lib.tb_http_request.argtypes = [
+            c.c_int, c.c_char_p, c.c_int, c.c_char_p, c.c_char_p,
+            c.c_void_p, c.c_int64, c.POINTER(c.c_int),
+            c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.POINTER(c.c_int),
+        ]
         self.lib = lib
 
         # DLPack lifetime plumbing. Every managed tensor we produce gets a
@@ -354,6 +363,55 @@ class NativeEngine:
             "length": n,
             "first_byte_ns": fb.value,
             "total_ns": total_ns.value,
+        }
+
+    def http_connect(self, host: str, port: int) -> int:
+        """Keep-alive path: open a TCP connection for repeated
+        :meth:`http_request` calls (the pooled-connection discipline of the
+        Python client, so native-vs-Python A/Bs isolate the receive loop
+        rather than conflating it with per-GET connect cost)."""
+        return _check(self.lib.tb_http_connect(host.encode(), port),
+                      f"connect {host}:{port}")
+
+    def http_close(self, fd: int) -> None:
+        self.lib.tb_http_close(fd)
+
+    def http_request(
+        self,
+        fd: int,
+        host: str,
+        port: int,
+        path: str,
+        buf: AlignedBuffer,
+        headers: str = "",
+    ) -> dict:
+        """One GET on a kept-alive connection; ``reusable`` reports whether
+        the socket may carry another request. On NativeError the caller must
+        :meth:`http_close` the fd (stream state unknown)."""
+        status = ctypes.c_int(0)
+        fb = ctypes.c_int64(0)
+        total_ns = ctypes.c_int64(0)
+        reusable = ctypes.c_int(0)
+        n = self.lib.tb_http_request(
+            fd,
+            host.encode(),
+            port,
+            path.encode(),
+            headers.encode(),
+            buf.address,
+            buf.size,
+            ctypes.byref(status),
+            ctypes.byref(fb),
+            ctypes.byref(total_ns),
+            ctypes.byref(reusable),
+        )
+        _check(n, f"http_request {host}:{port}{path}")
+        return {
+            "status": status.value,
+            "length": n,
+            "first_byte_ns": fb.value,
+            "total_ns": total_ns.value,
+            "reusable": bool(reusable.value),
         }
 
 

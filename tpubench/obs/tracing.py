@@ -217,13 +217,14 @@ def make_tracer(cfg) -> Tracer:
             transport=cfg.transport.protocol,
             exporter=requested_exporter,
         )
-    except Exception as e:
+    except (ImportError, AttributeError) as e:
+        # Import/ABI shape failures = SDK version skew. Config-shaped errors
+        # (e.g. an out-of-range sample rate raising ValueError) are NOT
+        # caught — a bad config must surface, not silently downgrade.
         if requested_exporter:
             raise
-        # SDK importable but broken (api/sdk version skew breaking
-        # TracerProvider/Resource construction) with no exporter asked for:
-        # degrade to in-process recording rather than failing the run —
-        # but VISIBLY (never a silent downgrade).
+        # Skew with no exporter asked for: degrade to in-process recording
+        # rather than failing the run — but VISIBLY.
         import warnings
 
         warnings.warn(

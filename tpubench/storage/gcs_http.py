@@ -237,6 +237,12 @@ class GcsHttpBackend:
         # Object sizes for the native receive path (buffer pre-sizing).
         self._stat_cache: dict[str, int] = {}
         self._stat_cache_lock = threading.Lock()
+        # Keep-alive pool for the native receive path (same connection
+        # discipline as the Python client's pool, so A/Bs isolate the
+        # receive loop): idle fds, capped like the Python pool.
+        self._native_idle: list[int] = []
+        self._native_lock = threading.Lock()
+        self.native_conn_stats = {"connects": 0, "reuses": 0}
 
     # ------------------------------------------------------------ request --
     def _headers(self) -> dict[str, str]:
@@ -368,7 +374,29 @@ class GcsHttpBackend:
             # a grown object then fails loudly (body-exceeds-buffer) instead
             # of being silently truncated by a too-short Range.
             headers += f"Range: bytes={start}-\r\n"
+        # Buffer first, socket second: whichever acquisition fails, the
+        # other resource is released on that path (no fd leak when a huge
+        # alloc fails; no buffer leak when connect fails).
         buf = engine.alloc(max(4096, want))
+        # Keep-alive: reuse a pooled native connection when available (a
+        # dead idle socket surfaces as a transient error and the retry
+        # layer re-runs on a fresh one, like any HTTP client pool).
+        with self._native_lock:
+            fd = self._native_idle.pop() if self._native_idle else -1
+        if fd < 0:
+            try:
+                fd = engine.http_connect(self._host, self._port)
+            except NativeError as e:
+                buf.free()
+                # Connect failures (refused, resolve) are network
+                # conditions — transient under the module contract.
+                raise StorageError(
+                    f"native GET {name}: {e}",
+                    transient=e.code not in PERMANENT_CODES,
+                ) from e
+            self.native_conn_stats["connects"] += 1
+        else:
+            self.native_conn_stats["reuses"] += 1
         try:
             # The native GET is complete on return, so one span covers the
             # whole request; the first-byte event carries the C++-side
@@ -376,12 +404,21 @@ class GcsHttpBackend:
             with self._tracer.span(
                 "gcs_http.get_native", object=name, bucket=self.bucket
             ) as sp:
-                r = engine.http_get(
-                    self._host, self._port, self._opath(name) + "?alt=media",
-                    buf, headers=headers,
+                r = engine.http_request(
+                    fd, self._host, self._port,
+                    self._opath(name) + "?alt=media", buf, headers=headers,
                 )
                 sp.event("first_byte", native_ns=r["first_byte_ns"])
+            put_back = False
+            if r["reusable"]:
+                with self._native_lock:
+                    if len(self._native_idle) < self.transport.max_idle_conns_per_host:
+                        self._native_idle.append(fd)
+                        put_back = True
+            if not put_back:
+                engine.http_close(fd)
         except NativeError as e:
+            engine.http_close(fd)  # stream state unknown after any failure
             # Module contract: this layer raises classified StorageErrors.
             # Classification is on the engine's error-code ABI (engine.cc
             # TB_* enum), not message text: socket-level failures (resets,
@@ -399,6 +436,7 @@ class GcsHttpBackend:
                 transient = True
             raise StorageError(f"native GET {name}: {e}", transient=transient) from e
         except Exception:
+            engine.http_close(fd)
             buf.free()
             raise
         if r["status"] not in (200, 206):
@@ -459,3 +497,12 @@ class GcsHttpBackend:
 
     def close(self) -> None:
         self._pool.close()
+        with self._native_lock:
+            fds, self._native_idle = self._native_idle, []
+        if fds:
+            from tpubench.native.engine import get_engine
+
+            engine = get_engine()
+            if engine is not None:
+                for fd in fds:
+                    engine.http_close(fd)

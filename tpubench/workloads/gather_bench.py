@@ -46,7 +46,12 @@ def run_gather_bench(
     while n <= len(devices):
         sizes.append(n)
         n *= 2
-    if not sizes:
+    single_device = not sizes
+    if single_device:
+        # One chip: there is no ICI to exercise — the gather lowers to an
+        # identity. Run it anyway (sane CLI behavior on a 1-chip host) and
+        # label the result clearly instead of reporting it as collective
+        # bandwidth.
         sizes = [1]
 
     rng = np.random.default_rng(0)
@@ -64,29 +69,46 @@ def run_gather_bench(
         for _ in range(reps):
             gathered, _ = fn(arr)
         jax.block_until_ready(gathered)
-        dt = (time.perf_counter() - t0) / reps
+        dt = (time.perf_counter() - t0) / reps  # per-gather mean
         per_chip_rx = shard_bytes * (n - 1) / dt / 1e9 if dt > 0 else 0.0
         rows.append(
             {
                 "devices": n,
                 "shard_bytes": shard_bytes,
                 "seconds": dt,
-                "ici_bytes_moved": shard_bytes * n * (n - 1),
+                "reps": reps,
+                "ici_bytes_moved": shard_bytes * n * (n - 1),  # per gather
                 "per_chip_rx_gbps": per_chip_rx,
                 "total_gbps": shard_bytes * n * (n - 1) / dt / 1e9 if dt > 0 else 0.0,
             }
         )
 
+    # Headline fields are SELF-CONSISTENT sweep aggregates: gbps equals
+    # bytes_total / wall_seconds by construction (every row's per-gather
+    # bytes and per-gather mean seconds scaled by the same reps), and
+    # gbps_per_chip = gbps / n_chips like every other workload. The
+    # per-mesh-size picture (including the best row) lives in extras.
+    bytes_total = sum(r["ici_bytes_moved"] for r in rows) * reps
+    wall = sum(r["seconds"] for r in rows) * reps
+    n_chips = max(r["devices"] for r in rows)
+    gbps = (bytes_total / 1e9) / wall if wall > 0 else 0.0
     best = max(rows, key=lambda r: r["per_chip_rx_gbps"])
     res = RunResult(
         workload="gather_bench",
         config=cfg.to_dict(),
-        bytes_total=sum(r["ici_bytes_moved"] for r in rows) * reps,
-        wall_seconds=sum(r["seconds"] for r in rows) * reps,
-        gbps=best["total_gbps"],
-        gbps_per_chip=best["per_chip_rx_gbps"],
-        n_chips=max(r["devices"] for r in rows),
+        bytes_total=bytes_total,
+        wall_seconds=wall,
+        gbps=gbps,
+        gbps_per_chip=gbps / n_chips,
+        n_chips=n_chips,
         errors=0,
     )
-    res.extra.update({"mode": "ring" if ring else "all_gather", "scaling": rows})
+    res.extra.update(
+        {
+            "mode": "ring" if ring else "all_gather",
+            "scaling": rows,
+            "best": best,
+            "single_device": single_device,
+        }
+    )
     return res
