@@ -253,13 +253,16 @@ def test_native_receive_read_workload_end_to_end(server):
 class _BrokenHttpServer:
     """Serves one scripted response per connection, then closes the socket."""
 
-    def __init__(self, body_len: int, send_len: int, raw: bytes = b""):
+    def __init__(
+        self, body_len: int, send_len: int, raw: bytes = b"", hold_open: float = 0.0
+    ):
         import socket
         import threading
 
         self._body_len = body_len
         self._send_len = send_len
         self._raw = raw  # when set, sent verbatim instead of a response
+        self._hold_open = hold_open  # keep the conn open after sending raw
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("127.0.0.1", 0))
@@ -290,6 +293,10 @@ class _BrokenHttpServer:
                         req += chunk
                     if self._raw:
                         conn.sendall(self._raw)
+                        if self._hold_open:
+                            # Keep-alive server: do NOT close — a client
+                            # that read-to-FINs on this response hangs.
+                            self._stop.wait(self._hold_open)
                         continue
                     hdr = (
                         f"HTTP/1.1 200 OK\r\nContent-Length: {self._body_len}"
@@ -373,9 +380,9 @@ def test_native_receive_connection_refused_is_transient(monkeypatch):
     with pytest.raises(StorageError) as ei:
         c.open_read("bench/file_0", length=4096)
     assert ei.value.transient is True
-    # Connect fails before the receive buffer is even allocated; whatever
-    # was allocated must be freed.
-    assert all(b._ptr == 0 for b in allocated)
+    # The receive buffer is allocated before the connect attempt; the
+    # connect-failure path must free it.
+    assert allocated and all(b._ptr == 0 for b in allocated)
     c.close()
 
 
@@ -486,6 +493,59 @@ def test_native_receive_chunked_rejected_case_insensitive(monkeypatch):
         c.close()
     finally:
         srv.close()
+
+
+@pytestmark_native
+def test_native_receive_unknown_length_keepalive_errors_not_hangs(monkeypatch):
+    """A keep-alive (HTTP/1.1, no Connection: close) response with neither
+    Content-Length nor Transfer-Encoding has no findable body end: the
+    engine must fail fast (permanent protocol error), not recv until a FIN
+    that never comes. The server holds the connection open after sending —
+    a read-to-FIN client hangs here."""
+    import time
+
+    srv = _BrokenHttpServer(
+        0, 0, raw=b"HTTP/1.1 200 OK\r\n\r\npayload-bytes", hold_open=8.0
+    )
+    try:
+        c, allocated = _tracked_native_client(srv.endpoint, monkeypatch)
+        t0 = time.monotonic()
+        with pytest.raises(StorageError) as ei:
+            c.open_read("bench/file_0", length=4096)
+        assert time.monotonic() - t0 < 5.0  # failed fast, no FIN wait
+        assert ei.value.transient is False
+        assert allocated and all(b._ptr == 0 for b in allocated)
+        c.close()
+    finally:
+        srv.close()
+
+
+@pytestmark_native
+def test_native_receive_stale_pooled_connection_retried(server):
+    """A pooled connection that died while idle must not surface as a
+    request failure: first use fails → one immediate retransmit on a fresh
+    socket succeeds (standard HTTP-client pool discipline)."""
+    import socket as socket_mod
+
+    c = _native_client(server)
+    # Inject a stale connection: a socket whose peer closed immediately.
+    lst = socket_mod.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    s = socket_mod.socket()
+    s.connect(lst.getsockname())
+    conn, _ = lst.accept()
+    conn.close()  # peer FIN: the pooled fd is now stale
+    lst.close()
+    c._native_idle.append(s.detach())
+    r = c.open_read("bench/file_0", length=65536)
+    buf = memoryview(bytearray(65536))
+    assert r.readinto(buf) == 65536
+    r.close()
+    assert c.native_conn_stats["stale_retries"] == 1
+    assert c.native_conn_stats["reuses"] == 1
+    assert c.native_conn_stats["connects"] == 1
+    c.close()
 
 
 @pytestmark_native

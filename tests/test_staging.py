@@ -113,6 +113,62 @@ def test_read_workload_with_staging(jax_cpu_devices):
     assert res.extra["staged_bytes"] == res.bytes_total
 
 
+def test_stager_thread_drain_lands_exact_bytes(jax_cpu_devices):
+    """Threaded drain: a per-worker drainer owns transfer completion; all
+    bytes still land, stage latencies still recorded, counters coherent
+    after finish() joins the drainer."""
+    data = deterministic_bytes("thr", 10 * 64 * 1024)
+    st = DevicePutStager(
+        0,
+        granule_bytes=64 * 1024,
+        cfg=StagingConfig(
+            drain="thread", depth=3, slot_bytes=128 * 1024
+        ),
+    )
+    mv = memoryview(data.tobytes())
+    for off in range(0, len(mv), 64 * 1024):
+        st.submit(mv[off : off + 64 * 1024])
+    stats = st.finish()
+    assert stats["drain"] == "thread"
+    assert stats["staged_bytes"] == 10 * 64 * 1024
+    assert stats["transfers"] == 5
+    assert len(stats["stage_recorder"]) == 5
+
+
+def test_stager_thread_drain_validation_falls_back_inline(jax_cpu_devices):
+    """validate_checksum needs orderly inline drains; drain='thread' must
+    not silently break integrity checking — it degrades to inline."""
+    data = deterministic_bytes("thrv", 4 * 64 * 1024)
+    st = DevicePutStager(
+        0,
+        granule_bytes=64 * 1024,
+        cfg=StagingConfig(
+            drain="thread", depth=3, slot_bytes=64 * 1024,
+            validate_checksum=True,
+        ),
+    )
+    st.submit(memoryview(data.tobytes()))
+    stats = st.finish()
+    assert stats["drain"] == "inline"
+    assert stats["checksum_ok"], stats
+
+
+def test_read_workload_thread_drain(jax_cpu_devices):
+    cfg = BenchConfig()
+    cfg.workload.workers = 2
+    cfg.workload.read_calls_per_worker = 2
+    cfg.workload.object_size = 200_000
+    cfg.workload.granule_bytes = 64 * 1024
+    cfg.transport.protocol = "fake"
+    cfg.staging.mode = "device_put"
+    cfg.staging.slot_bytes = 128 * 1024
+    cfg.staging.drain = "thread"
+    res = run_read(cfg, sink_factory=make_sink_factory(cfg))
+    assert res.errors == 0
+    assert res.extra["staged_bytes"] == 2 * 2 * 200_000
+    assert res.extra["staged_bytes"] == res.bytes_total
+
+
 def test_make_sink_factory_modes():
     cfg = BenchConfig()
     cfg.staging.mode = "none"

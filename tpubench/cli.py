@@ -48,6 +48,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--open-files", type=int)
     p.add_argument("--staging", choices=("none", "device_put", "pallas"))
     p.add_argument("--no-double-buffer", action="store_true")
+    p.add_argument("--staging-drain", choices=("inline", "thread"),
+                   help="who completes in-flight host→HBM transfers: the "
+                        "fetch thread (inline) or a per-worker drainer "
+                        "thread (true fetch∥transfer overlap)")
     p.add_argument("--validate", action="store_true", help="on-device checksum")
     p.add_argument("--enable-tracing", action="store_true")
     p.add_argument("--trace-sample-rate", type=float)
@@ -136,6 +140,8 @@ def build_config(args) -> BenchConfig:
         t.endpoint = args.endpoint
     if args.staging:
         s.mode = args.staging
+    if getattr(args, "staging_drain", None):
+        s.drain = args.staging_drain
     if args.no_double_buffer:
         s.double_buffer = False
     if args.validate:
@@ -207,6 +213,14 @@ def build_config(args) -> BenchConfig:
         raise SystemExit(
             "--process-id/--coordinator set but --num-processes is 1: "
             "pass the pod's total process count on every host"
+        )
+    if o.results_bucket and t.protocol not in ("http", "grpc"):
+        # Fail at parse time, not after an hour-long run: upload_result
+        # needs an object-store protocol ('local' roots at workload.dir,
+        # 'fake' drops the bytes in a throwaway in-process store).
+        raise SystemExit(
+            f"--results-bucket requires --protocol http|grpc, "
+            f"not {t.protocol!r}"
         )
     return cfg
 

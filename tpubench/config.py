@@ -166,6 +166,15 @@ class StagingConfig:
     # Fetch directly into the staging slot (sink acquire/commit) instead of
     # through a per-worker granule buffer that is then copied to the slot.
     zero_copy: bool = True
+    # Who completes in-flight host→HBM transfers when overlapping:
+    # "inline" — the fetch thread blocks on the oldest transfer at the
+    #   ring's backpressure point (acquire of a busy slot). Transfer-drive
+    #   time serializes with fetch: throughput ≤ harmonic(fetch, tunnel).
+    # "thread" — a per-worker drainer thread owns block_until_ready, so
+    #   fetch and transfer genuinely overlap (both release the GIL):
+    #   throughput → min(fetch, tunnel). Ignored when depth == 1 or
+    #   validate_checksum (validation needs orderly inline drains).
+    drain: str = "inline"
     # Shape landed arrays as (granule//lane, lane) uint8 so XLA tiles them;
     # lane=128 matches the TPU lane width.
     lane: int = 128

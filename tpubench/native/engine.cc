@@ -409,9 +409,24 @@ int64_t tb_http_request(int fd, const char* host, int port, const char* path,
     line = eol + 2;
   }
 
+  // Unknown body length is only readable when the connection is committed
+  // to closing — server announced close, HTTP/1.0 default-close, or WE
+  // requested "Connection: close" (a conformant server must then close
+  // after responding, RFC 9112 §9.6, whether or not it echoes the
+  // header): read-to-FIN then terminates. A keep-alive response with
+  // neither Content-Length nor Transfer-Encoding leaves no way to find
+  // the body end — recv would block forever — so that shape is a
+  // protocol error, not a hang.
+  int client_close =
+      extra_headers && strcasestr(extra_headers, "connection: close") != nullptr;
+  if (content_len < 0 && !server_close && !client_close && http_minor >= 1)
+    return TB_EPROTO;
+
   // Read exactly Content-Length body bytes (standard HTTP-client semantics:
-  // bytes past Content-Length are never read, so a server shipping trailing
-  // junk classifies deterministically regardless of packet boundaries).
+  // bytes past Content-Length are never read as body; junk that has already
+  // arrived behind the body is caught by the reuse-time drain check below,
+  // and junk arriving later surfaces on the next request of a pooled
+  // connection, which the caller retries on a fresh socket).
   char* out = static_cast<char*>(buf);
   int64_t got = 0;
   if (body_in_hdr > 0) {
@@ -444,20 +459,33 @@ int64_t tb_http_request(int fd, const char* host, int port, const char* path,
   // Reusable only when the body boundary is known and fully consumed, the
   // server speaks HTTP/1.1 (1.0 defaults to close) and didn't announce
   // close; body_in_hdr beyond Content-Length (pipelined junk) poisons the
-  // stream — don't reuse.
-  if (reusable_out)
-    *reusable_out = (content_len >= 0 && !server_close && http_minor >= 1 &&
-                     body_in_hdr <= content_len)
-                        ? 1
-                        : 0;
+  // stream — don't reuse. A nonblocking peek catches junk that arrived in
+  // a later packet than the header read (pk==0 means the peer already
+  // FIN'd — also not worth pooling).
+  if (reusable_out) {
+    int reusable = (content_len >= 0 && !server_close && http_minor >= 1 &&
+                    body_in_hdr <= content_len)
+                       ? 1
+                       : 0;
+    if (reusable) {
+      char junk;
+      ssize_t pk = recv(fd, &junk, 1, MSG_PEEK | MSG_DONTWAIT);
+      // Pool only a provably idle socket: pk>=0 is junk/FIN, and a recv
+      // error other than "no data yet" (RST, etc.) is a dead socket.
+      if (pk >= 0 || (errno != EAGAIN && errno != EWOULDBLOCK)) reusable = 0;
+    }
+    *reusable_out = reusable;
+  }
   if (first_byte_ns_out) *first_byte_ns_out = first_byte_ns;
   if (total_ns_out) *total_ns_out = tb_now_ns() - t_start;
   return got;
 }
 
-// One-shot GET: fresh connection, Connection: close semantics via a
-// non-reused socket. Kept as the simple entry point; the pooled path is
-// tb_http_connect + tb_http_request (keep-alive).
+// One-shot GET: fresh connection, with an explicit "Connection: close"
+// request header so a close-delimited (no Content-Length) HTTP/1.1
+// response is legal: the server commits to closing and read-to-FIN
+// terminates. The pooled path is tb_http_connect + tb_http_request
+// (keep-alive).
 int64_t tb_http_get(const char* host, int port, const char* path,
                     const char* extra_headers, void* buf, int64_t buf_len,
                     int* status_out, int64_t* first_byte_ns_out,
@@ -465,7 +493,14 @@ int64_t tb_http_get(const char* host, int port, const char* path,
   int64_t t_start = tb_now_ns();
   int fd = tb_http_connect(host, port);
   if (fd < 0) return fd;
-  int64_t n = tb_http_request(fd, host, port, path, extra_headers, buf,
+  char hdrs[4096];
+  int hm = snprintf(hdrs, sizeof hdrs, "%sConnection: close\r\n",
+                    extra_headers ? extra_headers : "");
+  if (hm <= 0 || hm >= static_cast<int>(sizeof hdrs)) {
+    close(fd);
+    return TB_EPROTO;
+  }
+  int64_t n = tb_http_request(fd, host, port, path, hdrs, buf,
                               buf_len, status_out, first_byte_ns_out,
                               nullptr, nullptr);
   close(fd);

@@ -217,10 +217,11 @@ def make_tracer(cfg) -> Tracer:
             transport=cfg.transport.protocol,
             exporter=requested_exporter,
         )
-    except (ImportError, AttributeError) as e:
-        # Import/ABI shape failures = SDK version skew. Config-shaped errors
-        # (e.g. an out-of-range sample rate raising ValueError) are NOT
-        # caught — a bad config must surface, not silently downgrade.
+    except (ImportError, AttributeError, TypeError) as e:
+        # Import/ABI shape failures = SDK version skew (TypeError covers
+        # constructor-signature drift across SDK versions). Config-shaped
+        # errors (e.g. an out-of-range sample rate raising ValueError) are
+        # NOT caught — a bad config must surface, not silently downgrade.
         if requested_exporter:
             raise
         # Skew with no exporter asked for: degrade to in-process recording

@@ -31,6 +31,8 @@ are zero-padded at launch so the device sum sees only real bytes.
 
 from __future__ import annotations
 
+import queue
+import threading
 import time
 from typing import Callable, Optional
 
@@ -183,8 +185,50 @@ class DevicePutStager(GranuleAggregator):
         self._dev_sum = None
         if self._validate:
             self._dev_sum = jax.device_put(jnp.zeros((), jnp.uint32), self.device)
+        # Threaded drain: a per-worker drainer owns block_until_ready so the
+        # fetch thread never pays transfer-completion time (both sides
+        # release the GIL → true fetch ∥ transfer overlap). Validation keeps
+        # inline drains: the checksum accumulate must read the landed array
+        # before the slot is reused, which is an ordering the ring's inline
+        # backpressure provides for free.
+        self._drain_thread = (
+            cfg.drain == "thread" and depth > 1 and not self._validate
+        )
+        self._drain_q: Optional[queue.Queue] = None
+        self._drain_err: Optional[BaseException] = None
+        self._slot_free: list[threading.Event] = []
+        self._drainer: Optional[threading.Thread] = None
+        if self._drain_thread:
+            self._drain_q = queue.Queue()
+            self._slot_free = [threading.Event() for _ in range(depth)]
+            for e in self._slot_free:
+                e.set()
+            self._drainer = threading.Thread(
+                target=self._drain_loop, name=f"w{worker_id}-drain", daemon=True
+            )
+            self._drainer.start()
 
     # ------------------------------------------------------------ pipeline --
+    def _drain_loop(self) -> None:
+        """Drainer thread: completes transfers in submission order. All
+        mutation of staged_bytes/transfers accounting it does is read by
+        the fetch thread only after :meth:`finish` joins this thread."""
+        assert self._drain_q is not None
+        while True:
+            item = self._drain_q.get()
+            if item is None:
+                return
+            k, fut, submit_ns, nbytes = item
+            try:
+                fut.block_until_ready()
+                self.stage_recorder.record_ns(time.perf_counter_ns() - submit_ns)
+                self.staged_bytes += nbytes
+            except BaseException as e:  # surfaced from finish()
+                if self._drain_err is None:
+                    self._drain_err = e
+            finally:
+                self._slot_free[k].set()
+
     def _drain_slot(self, k: int) -> None:
         fut = self._futures[k]
         if fut is None:
@@ -212,10 +256,16 @@ class DevicePutStager(GranuleAggregator):
             # the tail so checksum/pad semantics stay exact. Full slots —
             # the steady state — skip this memset.
             slot.reshape(-1)[self._fill :] = 0
-        self._submit_ns[k] = time.perf_counter_ns()
-        self._futures[k] = jax.device_put(slot, self.device)
-        self._true_bytes[k] = self._fill
+        submit_ns = time.perf_counter_ns()
+        fut = jax.device_put(slot, self.device)
         self.transfers += 1
+        if self._drain_thread:
+            self._slot_free[k].clear()
+            self._drain_q.put((k, fut, submit_ns, self._fill))
+        else:
+            self._submit_ns[k] = submit_ns
+            self._futures[k] = fut
+            self._true_bytes[k] = self._fill
         self._fill = 0
         self._k = (k + 1) % self.depth
         if self.depth == 1:
@@ -224,10 +274,14 @@ class DevicePutStager(GranuleAggregator):
             self._drain_slot(k)
 
     def _free_view(self) -> memoryview:
-        """Draining the current slot's prior in-flight transfer here is the
-        ring's backpressure point."""
+        """Completing the current slot's prior in-flight transfer here is
+        the ring's backpressure point (wait on the drainer, or drain
+        inline)."""
         k = self._k
-        self._drain_slot(k)
+        if self._drain_thread:
+            self._slot_free[k].wait()
+        else:
+            self._drain_slot(k)
         return self._slot_views[k][self._fill :]
 
     def _precommit(self, n: int) -> None:
@@ -237,21 +291,42 @@ class DevicePutStager(GranuleAggregator):
             self._host_sum += np.uint64(int(chunk.astype(np.uint32).sum()))
 
     def finish(self) -> dict:
-        self.flush()
-        for k in range(self.depth):
-            self._drain_slot(k)
-        # All transfers complete; native slot memory is safe to release.
+        # Slot buffers are released even when a drain failed (a failed
+        # worker must not leak depth × slot_bytes of pinned native memory
+        # while the run's other failure domains keep going) — but only
+        # after every in-flight transfer has settled, failed or not, so no
+        # transfer can touch freed memory.
+        err: Optional[BaseException] = None
+        try:
+            self.flush()
+        except BaseException as e:
+            err = e
+        if self._drain_thread:
+            self._drain_q.put(None)
+            self._drainer.join()
+            if err is None:
+                err = self._drain_err
+        else:
+            for k in range(self.depth):
+                try:
+                    self._drain_slot(k)
+                except BaseException as e:
+                    if err is None:
+                        err = e
         self._slot_views = []
         self._slots = []
         for buf in self._native_bufs:
             buf.free()
         self._native_bufs = []
+        if err is not None:
+            raise err
         stats = {
             "staged_bytes": self.staged_bytes,
             "transfers": self.transfers,
             "slot_bytes": self._slot_bytes,
             "n_chips": self.n_chips,
             "native_slots": self.native_slots,
+            "drain": "thread" if self._drain_thread else "inline",
             "stage_recorder": self.stage_recorder,
             "device": str(self.device),
         }

@@ -242,7 +242,7 @@ class GcsHttpBackend:
         # receive loop): idle fds, capped like the Python pool.
         self._native_idle: list[int] = []
         self._native_lock = threading.Lock()
-        self.native_conn_stats = {"connects": 0, "reuses": 0}
+        self.native_conn_stats = {"connects": 0, "reuses": 0, "stale_retries": 0}
 
     # ------------------------------------------------------------ request --
     def _headers(self) -> dict[str, str]:
@@ -378,12 +378,18 @@ class GcsHttpBackend:
         # other resource is released on that path (no fd leak when a huge
         # alloc fails; no buffer leak when connect fails).
         buf = engine.alloc(max(4096, want))
-        # Keep-alive: reuse a pooled native connection when available (a
-        # dead idle socket surfaces as a transient error and the retry
-        # layer re-runs on a fresh one, like any HTTP client pool).
+        # Keep-alive: reuse a pooled native connection when available. A
+        # stale pooled socket (server timed it out, or trailing junk from
+        # the previous response arrived after the reuse-time drain check)
+        # fails on first use — standard HTTP-client behavior is one
+        # immediate retransmit of the idempotent GET on a FRESH socket, so
+        # pool staleness never surfaces as a request failure.
         with self._native_lock:
             fd = self._native_idle.pop() if self._native_idle else -1
-        if fd < 0:
+            if fd >= 0:
+                self.native_conn_stats["reuses"] += 1
+        reused = fd >= 0
+        if not reused:
             try:
                 fd = engine.http_connect(self._host, self._port)
             except NativeError as e:
@@ -394,51 +400,74 @@ class GcsHttpBackend:
                     f"native GET {name}: {e}",
                     transient=e.code not in PERMANENT_CODES,
                 ) from e
-            self.native_conn_stats["connects"] += 1
-        else:
-            self.native_conn_stats["reuses"] += 1
-        try:
-            # The native GET is complete on return, so one span covers the
-            # whole request; the first-byte event carries the C++-side
-            # CLOCK_MONOTONIC stamp.
-            with self._tracer.span(
-                "gcs_http.get_native", object=name, bucket=self.bucket
-            ) as sp:
-                r = engine.http_request(
-                    fd, self._host, self._port,
-                    self._opath(name) + "?alt=media", buf, headers=headers,
-                )
-                sp.event("first_byte", native_ns=r["first_byte_ns"])
-            put_back = False
-            if r["reusable"]:
-                with self._native_lock:
-                    if len(self._native_idle) < self.transport.max_idle_conns_per_host:
-                        self._native_idle.append(fd)
-                        put_back = True
-            if not put_back:
+            with self._native_lock:
+                self.native_conn_stats["connects"] += 1
+        while True:
+            try:
+                # The native GET is complete on return, so one span covers
+                # the whole request; the first-byte event carries the
+                # C++-side CLOCK_MONOTONIC stamp.
+                with self._tracer.span(
+                    "gcs_http.get_native", object=name, bucket=self.bucket
+                ) as sp:
+                    r = engine.http_request(
+                        fd, self._host, self._port,
+                        self._opath(name) + "?alt=media", buf, headers=headers,
+                    )
+                    sp.event("first_byte", native_ns=r["first_byte_ns"])
+                put_back = False
+                if r["reusable"]:
+                    with self._native_lock:
+                        if len(self._native_idle) < self.transport.max_idle_conns_per_host:
+                            self._native_idle.append(fd)
+                            put_back = True
+                if not put_back:
+                    engine.http_close(fd)
+                break
+            except NativeError as e:
+                engine.http_close(fd)  # stream state unknown after failure
+                if reused:
+                    # First use of a pooled connection failed: retry once
+                    # on a fresh socket before classifying anything — the
+                    # failure may be pool staleness, not the request.
+                    reused = False
+                    with self._native_lock:
+                        self.native_conn_stats["stale_retries"] += 1
+                    try:
+                        fd = engine.http_connect(self._host, self._port)
+                    except NativeError as e2:
+                        buf.free()
+                        raise StorageError(
+                            f"native GET {name}: {e2}",
+                            transient=e2.code not in PERMANENT_CODES,
+                        ) from e2
+                    with self._native_lock:
+                        self.native_conn_stats["connects"] += 1
+                    continue
+                # Module contract: this layer raises classified
+                # StorageErrors. Classification is on the engine's
+                # error-code ABI (engine.cc TB_* enum), not message text:
+                # socket-level failures (resets, refusals, timeouts, short
+                # bodies) are transient and retried under policy;
+                # protocol-shape errors (malformed response, chunked
+                # encoding, body too big for the buffer) reproduce on retry
+                # and are not. Exception: body-exceeds-buffer when the
+                # buffer was sized from the (just-invalidated) stat cache —
+                # the object may have grown, and one retry re-stats and
+                # re-sizes.
+                buf.free()
+                with self._stat_cache_lock:
+                    self._stat_cache.pop(name, None)  # size may be stale
+                transient = e.code not in PERMANENT_CODES
+                if e.code == TB_ETOOBIG and length is None:
+                    transient = True
+                raise StorageError(
+                    f"native GET {name}: {e}", transient=transient
+                ) from e
+            except Exception:
                 engine.http_close(fd)
-        except NativeError as e:
-            engine.http_close(fd)  # stream state unknown after any failure
-            # Module contract: this layer raises classified StorageErrors.
-            # Classification is on the engine's error-code ABI (engine.cc
-            # TB_* enum), not message text: socket-level failures (resets,
-            # refusals, timeouts, short bodies) are transient and retried
-            # under policy; protocol-shape errors (malformed response,
-            # chunked encoding, body too big for the buffer) reproduce on
-            # retry and are not. Exception: body-exceeds-buffer when the
-            # buffer was sized from the (just-invalidated) stat cache — the
-            # object may have grown, and one retry re-stats and re-sizes.
-            buf.free()
-            with self._stat_cache_lock:
-                self._stat_cache.pop(name, None)  # size may be stale
-            transient = e.code not in PERMANENT_CODES
-            if e.code == TB_ETOOBIG and length is None:
-                transient = True
-            raise StorageError(f"native GET {name}: {e}", transient=transient) from e
-        except Exception:
-            engine.http_close(fd)
-            buf.free()
-            raise
+                buf.free()
+                raise
         if r["status"] not in (200, 206):
             buf.free()
             raise StorageError(

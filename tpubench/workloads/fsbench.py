@@ -45,10 +45,10 @@ def _engine_or_raise():
 
 
 # ------------------------------------------------------- mount orchestration
-def _run_hook(template: str, dirpath: str, check: bool) -> None:
+def _run_hook(template: str, dirpath: str) -> None:
     cmd = template.format(dir=dirpath)
     proc = subprocess.run(cmd, shell=True, capture_output=True, text=True)
-    if check and proc.returncode != 0:
+    if proc.returncode != 0:
         raise RuntimeError(
             f"mount hook failed ({proc.returncode}): {cmd}\n{proc.stderr[-500:]}"
         )
@@ -71,7 +71,7 @@ def maybe_mounted(cfg: BenchConfig):
     only warns."""
     w = cfg.workload
     if w.mount_cmd:
-        _run_hook(w.mount_cmd, w.dir, check=True)
+        _run_hook(w.mount_cmd, w.dir)
         with _fresh_lock:
             _fresh_mounts.add(w.dir)
     try:
@@ -81,7 +81,7 @@ def maybe_mounted(cfg: BenchConfig):
             _fresh_mounts.discard(w.dir)
         if w.unmount_cmd:
             try:
-                _run_hook(w.unmount_cmd, w.dir, check=True)
+                _run_hook(w.unmount_cmd, w.dir)
             except RuntimeError as e:
                 import warnings
 
@@ -95,14 +95,16 @@ def _remount(cfg: BenchConfig) -> bool:
     is already cold — consumed without paying another cycle. Returns
     whether the cold state came from a (re)mount."""
     w = cfg.workload
-    if not (w.mount_cmd and w.unmount_cmd):
-        return False
     with _fresh_lock:
         if w.dir in _fresh_mounts:
+            # A fresh mount is cold whether or not an unmount hook exists
+            # (mount-only config: the dir was pre-unmounted).
             _fresh_mounts.discard(w.dir)  # one cold round per fresh mount
             return True
-    _run_hook(w.unmount_cmd, w.dir, check=True)
-    _run_hook(w.mount_cmd, w.dir, check=True)
+    if not (w.mount_cmd and w.unmount_cmd):
+        return False
+    _run_hook(w.unmount_cmd, w.dir)
+    _run_hook(w.mount_cmd, w.dir)
     return True
 
 
