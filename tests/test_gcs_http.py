@@ -200,15 +200,23 @@ def test_native_receive_range_read(server):
 
 
 @pytestmark_native
-def test_native_receive_rejects_https(server):
+def test_native_receive_https_against_plaintext_server_fails_cleanly(server):
+    """An https endpoint whose listener speaks plaintext (misconfig) must
+    surface as a classified handshake failure, not a hang or raw-byte
+    garbage read."""
     from tpubench.storage.auth import AnonymousTokenSource
 
-    t = TransportConfig(endpoint="https://storage.googleapis.com",
-                        native_receive=True)
-    c = GcsHttpBackend(bucket="b", transport=t,
+    t = TransportConfig(
+        endpoint=server.endpoint.replace("http://", "https://"),
+        native_receive=True,
+        tls_insecure_skip_verify=True,
+    )
+    c = GcsHttpBackend(bucket="testbucket", transport=t,
                        token_source=AnonymousTokenSource())
-    with pytest.raises(StorageError, match="plain-HTTP"):
-        c.open_read("x")
+    with pytest.raises(StorageError) as ei:
+        c.open_read("bench/file_0", length=1024)
+    assert ei.value.transient is False  # TB_ETLS: reproduces on retry
+    c.close()
 
 
 @pytestmark_native
@@ -495,6 +503,84 @@ def test_native_receive_chunked_rejected_case_insensitive(monkeypatch):
         srv.close()
 
 
+def _tls_server():
+    be = FakeBackend.prepopulated("bench/file_", count=2, size=500_000)
+    return FakeGcsServer(be, tls=True)
+
+
+@pytestmark_native
+def test_native_receive_tls_end_to_end():
+    """The native receive loop over TLS (dlopen'd OpenSSL): full read with
+    cert verification against the server's self-signed PEM, and the TLS
+    connection pools for keep-alive like the plaintext one."""
+    with _tls_server() as srv:
+        t = TransportConfig(
+            endpoint=srv.endpoint, native_receive=True, tls_ca_file=srv.cafile
+        )
+        c = GcsHttpBackend(bucket="testbucket", transport=t)
+        from tpubench.storage.base import deterministic_bytes
+
+        want = deterministic_bytes("bench/file_0", 500_000).tobytes()
+        for rep in range(2):
+            r = c.open_read("bench/file_0")
+            out = bytearray(500_000)
+            mv = memoryview(out)
+            got = 0
+            while got < len(out):
+                n = r.readinto(mv[got:])
+                if n == 0:
+                    break
+                got += n
+            r.close()
+            assert got == 500_000 and bytes(out) == want
+        assert c.native_conn_stats["reuses"] == 1  # TLS conn was pooled
+        c.close()
+
+
+@pytestmark_native
+def test_native_receive_tls_untrusted_cert_rejected():
+    """Verification ON by default: a self-signed server without a trusted
+    CA must fail the handshake permanently (TB_ETLS), not serve bytes."""
+    with _tls_server() as srv:
+        t = TransportConfig(endpoint=srv.endpoint, native_receive=True)
+        c = GcsHttpBackend(bucket="testbucket", transport=t)
+        with pytest.raises(StorageError) as ei:
+            c.open_read("bench/file_0", length=1024)
+        assert ei.value.transient is False
+        c.close()
+
+
+@pytestmark_native
+def test_native_receive_tls_insecure_skip_verify():
+    with _tls_server() as srv:
+        t = TransportConfig(
+            endpoint=srv.endpoint,
+            native_receive=True,
+            tls_insecure_skip_verify=True,
+        )
+        c = GcsHttpBackend(bucket="testbucket", transport=t)
+        r = c.open_read("bench/file_0", length=1024)
+        buf = memoryview(bytearray(1024))
+        assert r.readinto(buf) == 1024
+        r.close()
+        c.close()
+
+
+def test_python_pool_tls_with_cafile():
+    """The pooled Python client honors tls_ca_file/insecure too (stat()
+    rides this pool even when the data path is native)."""
+    with _tls_server() as srv:
+        t = TransportConfig(endpoint=srv.endpoint, tls_ca_file=srv.cafile)
+        c = GcsHttpBackend(bucket="testbucket", transport=t)
+        meta = c.stat("bench/file_0")
+        assert meta.size == 500_000
+        r = c.open_read("bench/file_0", length=2048)
+        buf = memoryview(bytearray(2048))
+        assert r.readinto(buf) == 2048
+        r.close()
+        c.close()
+
+
 @pytestmark_native
 def test_native_receive_unknown_length_keepalive_errors_not_hangs(monkeypatch):
     """A keep-alive (HTTP/1.1, no Connection: close) response with neither
@@ -537,7 +623,9 @@ def test_native_receive_stale_pooled_connection_retried(server):
     conn, _ = lst.accept()
     conn.close()  # peer FIN: the pooled fd is now stale
     lst.close()
-    c._native_idle.append(s.detach())
+    from tpubench.native.engine import get_engine
+
+    c._native_idle.append(get_engine().conn_plain(s.detach()))
     r = c.open_read("bench/file_0", length=65536)
     buf = memoryview(bytearray(65536))
     assert r.readinto(buf) == 65536

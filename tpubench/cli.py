@@ -34,6 +34,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--bucket")
     p.add_argument("--project")
     p.add_argument("--endpoint", help="override API endpoint (fake servers)")
+    p.add_argument("--tls-ca-file",
+                   help="CA bundle to trust for https endpoints (overrides "
+                        "the system store; test endpoints with a private CA)")
+    p.add_argument("--tls-insecure-skip-verify", action="store_true",
+                   help="skip TLS certificate verification (self-signed "
+                        "test endpoints only)")
     p.add_argument("--dir", help="directory for local/FS workloads")
     p.add_argument("--workers", type=int)
     p.add_argument("--read-call-per-worker", type=int, dest="read_calls")
@@ -88,7 +94,7 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="retry attempt cap (0 = unlimited, reference default)")
     p.add_argument("--native-receive", action="store_true",
                    help="C++ HTTP receive path into pre-registered buffers "
-                        "(plain-HTTP endpoints only)")
+                        "(pooled keep-alive; http and https endpoints)")
     p.add_argument("--no-direct", action="store_true", help="skip O_DIRECT")
     p.add_argument("--mount-cmd",
                    help="shell template run before FS workloads; {dir} "
@@ -181,6 +187,10 @@ def build_config(args) -> BenchConfig:
         t.retry.max_attempts = args.retry_max_attempts
     if args.native_receive:
         t.native_receive = True
+    if getattr(args, "tls_ca_file", None):
+        t.tls_ca_file = args.tls_ca_file
+    if getattr(args, "tls_insecure_skip_verify", False):
+        t.tls_insecure_skip_verify = True
     if getattr(args, "mount_cmd", None):
         w.mount_cmd = args.mount_cmd
     if getattr(args, "unmount_cmd", None):

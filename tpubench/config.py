@@ -75,9 +75,14 @@ class TransportConfig:
     max_idle_conns_per_host: int = 100  # main.go:32
     http2: bool = False  # reference disables HTTP/2 for perf (main.go:64-72)
     # Opt-in C++ receive path (SURVEY §2.5.1): body streams from the socket
-    # into a pre-registered aligned buffer with a native first-byte stamp.
-    # Plain-HTTP endpoints only; one fresh connection per GET.
+    # into a pre-registered aligned buffer with a native first-byte stamp,
+    # over pooled keep-alive connections; plaintext and TLS endpoints.
     native_receive: bool = False
+    # TLS trust for the native receive path: a CA bundle overriding the
+    # system store (test endpoints with a private CA), and an escape hatch
+    # that skips verification entirely.
+    tls_ca_file: str = ""
+    tls_insecure_skip_verify: bool = False
     user_agent: str = "tpubench"  # reference: "prince" (main.go:100)
     # gRPC path (CreateGrpcClient, main.go:106-117):
     grpc_conn_pool_size: int = 1  # main.go:30

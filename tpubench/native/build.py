@@ -35,6 +35,7 @@ def build_library(force: bool = False) -> str:
             "-o",
             _LIB + ".tmp",
             _SRC,
+            "-ldl",  # TLS loader: dlopen(libssl) at first use
         ]
         subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(_LIB + ".tmp", _LIB)

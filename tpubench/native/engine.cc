@@ -25,7 +25,10 @@
 #include <cstring>
 #include <ctime>
 
+#include <arpa/inet.h>
+#include <dlfcn.h>
 #include <fcntl.h>
+#include <pthread.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -270,9 +273,9 @@ void tb_fill_random(void* buf, int64_t n, uint64_t seed) {
 // server and GCS JSON media GETs produce). Returns body length, or -errno /
 // -1000-series protocol errors.
 //
-// TLS is deliberately out of scope: the native receive path exists to
-// measure the receive loop itself against localhost servers; real-GCS https
-// traffic uses the Python client (SURVEY hard-part (b)).
+// TLS is supported through the tb_conn layer below (dlopen'd OpenSSL), so
+// the same receive loop can face both localhost plaintext servers and real
+// https endpoints (SURVEY hard-part (b)).
 // Error-code contract with the Python layer (gcs_http classifies
 // transient-vs-permanent on these codes, NOT on message text): -1001/-1002
 // are protocol-shape failures (permanent — retrying the same request against
@@ -289,6 +292,9 @@ enum {
   TB_ECHUNKED = -1005,  // Transfer-Encoding: chunked — unsupported here;
                         // rejected loudly instead of returning chunk
                         // framing as body bytes [permanent]
+  TB_ETLS = -1006,      // TLS unavailable / handshake or verification
+                        // failure — reproduces against the same endpoint
+                        // and trust config [permanent]
 };
 
 // Connect a TCP socket for HTTP use (TCP_NODELAY). Returns fd >= 0, or
@@ -318,6 +324,263 @@ int tb_http_connect(const char* host, int port) {
 
 int tb_http_close(int fd) { return close(fd) == 0 ? 0 : -errno; }
 
+// ------------------------------------------------------------------- TLS --
+// TLS via dlopen(libssl.so.3): the image ships OpenSSL runtime libraries
+// but not headers, so the handful of client-side entry points are declared
+// here and resolved at first use. The receive loop itself is shared with
+// the plaintext path through the tb_conn vtable below — TLS is a transport
+// detail, not a second implementation.
+namespace tls {
+typedef void* (*fn_pv)();
+static void* libssl = nullptr;
+static void* libcrypto = nullptr;
+static void* (*SSL_CTX_new_)(void*) = nullptr;
+static void (*SSL_CTX_free_)(void*) = nullptr;
+static void* (*TLS_client_method_)() = nullptr;
+static int (*SSL_CTX_set_default_verify_paths_)(void*) = nullptr;
+static int (*SSL_CTX_load_verify_locations_)(void*, const char*, const char*) =
+    nullptr;
+static void (*SSL_CTX_set_verify_)(void*, int, void*) = nullptr;
+static void* (*SSL_new_)(void*) = nullptr;
+static void (*SSL_free_)(void*) = nullptr;
+static int (*SSL_set_fd_)(void*, int) = nullptr;
+static int (*SSL_connect_)(void*) = nullptr;
+static int (*SSL_read_)(void*, void*, int) = nullptr;
+static int (*SSL_write_)(void*, const void*, int) = nullptr;
+static int (*SSL_shutdown_)(void*) = nullptr;
+static int (*SSL_pending_)(void*) = nullptr;
+static long (*SSL_ctrl_)(void*, int, long, void*) = nullptr;
+static void* (*SSL_get0_param_)(void*) = nullptr;
+static int (*SSL_CTX_up_ref_)(void*) = nullptr;
+static int (*X509_VERIFY_PARAM_set1_host_)(void*, const char*, size_t) = nullptr;
+static int (*X509_VERIFY_PARAM_set1_ip_asc_)(void*, const char*) = nullptr;
+
+static bool do_load() {
+  // RTLD_GLOBAL so libssl can resolve its libcrypto dependency if the
+  // loader brings them in separately.
+  libcrypto = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+  if (!libcrypto) libcrypto = dlopen("libcrypto.so", RTLD_NOW | RTLD_GLOBAL);
+  libssl = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+  if (!libssl) libssl = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+  if (!libssl || !libcrypto) return false;
+#define TB_SYM(lib, name)                                       \
+  do {                                                          \
+    *reinterpret_cast<void**>(&name##_) = dlsym(lib, #name);    \
+    if (!name##_) return false;                                 \
+  } while (0)
+  TB_SYM(libssl, SSL_CTX_new);
+  TB_SYM(libssl, SSL_CTX_free);
+  TB_SYM(libssl, TLS_client_method);
+  TB_SYM(libssl, SSL_CTX_set_default_verify_paths);
+  TB_SYM(libssl, SSL_CTX_load_verify_locations);
+  TB_SYM(libssl, SSL_CTX_set_verify);
+  TB_SYM(libssl, SSL_new);
+  TB_SYM(libssl, SSL_free);
+  TB_SYM(libssl, SSL_set_fd);
+  TB_SYM(libssl, SSL_connect);
+  TB_SYM(libssl, SSL_read);
+  TB_SYM(libssl, SSL_write);
+  TB_SYM(libssl, SSL_shutdown);
+  TB_SYM(libssl, SSL_pending);
+  TB_SYM(libssl, SSL_ctrl);
+  TB_SYM(libssl, SSL_get0_param);
+  TB_SYM(libssl, SSL_CTX_up_ref);
+  TB_SYM(libcrypto, X509_VERIFY_PARAM_set1_host);
+  TB_SYM(libcrypto, X509_VERIFY_PARAM_set1_ip_asc);
+#undef TB_SYM
+  return true;
+}
+
+static bool load() {
+  // C++11 magic-static: exactly one thread runs do_load(), concurrent
+  // callers block until the init completes — the global function-pointer
+  // stores are fully visible before any caller proceeds (workers hit
+  // first-https-use concurrently; an unsynchronized flag would race).
+  static bool ok = do_load();
+  return ok;
+}
+
+// One SSL_CTX per trust configuration, created once and shared by every
+// connection: re-parsing the CA bundle / system trust store per connect
+// costs tens of ms and would skew exactly the connect/TTFB timings this
+// benchmark measures (the Python pool it is A/B'd against also shares one
+// ssl.SSLContext). SSL_new up-refs the CTX, so cached entries can live for
+// the process lifetime.
+struct CtxCacheEntry {
+  char cafile[512];
+  int insecure;
+  void* ctx;
+};
+static CtxCacheEntry ctx_cache[8];
+static int ctx_cache_n = 0;
+static pthread_mutex_t ctx_cache_mu = PTHREAD_MUTEX_INITIALIZER;
+
+static void* make_ctx(const char* cafile, int insecure) {
+  void* ctx = SSL_CTX_new_(TLS_client_method_());
+  if (!ctx) return nullptr;
+  if (insecure) {
+    SSL_CTX_set_verify_(ctx, 0 /*SSL_VERIFY_NONE*/, nullptr);
+  } else {
+    SSL_CTX_set_verify_(ctx, 1 /*SSL_VERIFY_PEER*/, nullptr);
+    int ok = (cafile && cafile[0])
+                 ? SSL_CTX_load_verify_locations_(ctx, cafile, nullptr)
+                 : SSL_CTX_set_default_verify_paths_(ctx);
+    if (ok != 1) {
+      SSL_CTX_free_(ctx);
+      return nullptr;
+    }
+  }
+  return ctx;
+}
+
+static void* get_ctx(const char* cafile, int insecure) {
+  const char* cf = cafile ? cafile : "";
+  if (strlen(cf) >= sizeof ctx_cache[0].cafile)
+    return make_ctx(cafile, insecure);  // pathological path: uncached
+  // The caller always receives an OWNED reference (freed after SSL_new):
+  // cache hits up-ref the cached CTX, so the cache's own reference keeps
+  // it alive for the process lifetime.
+  pthread_mutex_lock(&ctx_cache_mu);
+  for (int i = 0; i < ctx_cache_n; i++) {
+    if (ctx_cache[i].insecure == insecure &&
+        strcmp(ctx_cache[i].cafile, cf) == 0) {
+      void* c = ctx_cache[i].ctx;
+      SSL_CTX_up_ref_(c);
+      pthread_mutex_unlock(&ctx_cache_mu);
+      return c;
+    }
+  }
+  void* ctx = make_ctx(cafile, insecure);
+  if (ctx && ctx_cache_n < static_cast<int>(sizeof ctx_cache / sizeof ctx_cache[0])) {
+    snprintf(ctx_cache[ctx_cache_n].cafile, sizeof ctx_cache[0].cafile, "%s", cf);
+    ctx_cache[ctx_cache_n].insecure = insecure;
+    ctx_cache[ctx_cache_n].ctx = ctx;
+    ctx_cache_n++;
+    SSL_CTX_up_ref_(ctx);  // the cache's reference
+  }
+  pthread_mutex_unlock(&ctx_cache_mu);
+  return ctx;
+}
+}  // namespace tls
+
+int tb_tls_available() { return tls::load() ? 1 : 0; }
+
+// Connection handle: plaintext (ssl == null) or TLS. Returned to Python as
+// an opaque int64 (heap pointer); every path through the receive loop goes
+// through the conn_* helpers so both transports share one implementation.
+struct tb_conn {
+  int fd;
+  void* ssl;
+};
+
+// SSL_read/SSL_write take int lengths: cap chunks well under INT_MAX so
+// multi-GiB receive buffers never produce a negative length (the loop in
+// request_on just calls again for the rest).
+static const size_t kTlsIoCap = size_t{1} << 30;
+
+static ssize_t conn_send(tb_conn* c, const void* p, size_t n) {
+  if (!c->ssl) return send(c->fd, p, n, 0);
+  if (n > kTlsIoCap) n = kTlsIoCap;
+  for (;;) {
+    errno = 0;  // stale EINTR from an earlier call must not loop us
+    int k = tls::SSL_write_(c->ssl, p, static_cast<int>(n));
+    if (k <= 0) {
+      if (errno == EINTR) continue;  // interrupted syscall under SSL_write
+      errno = ECONNRESET;  // classified transient, like any mid-stream break
+      return -1;
+    }
+    return k;
+  }
+}
+
+static ssize_t conn_recv(tb_conn* c, void* p, size_t n) {
+  if (!c->ssl) return recv(c->fd, p, n, 0);
+  if (n > kTlsIoCap) n = kTlsIoCap;
+  for (;;) {
+    errno = 0;  // stale EINTR from an earlier call must not loop us
+    int k = tls::SSL_read_(c->ssl, p, static_cast<int>(n));
+    if (k < 0) {
+      if (errno == EINTR) continue;  // interrupted syscall under SSL_read
+      errno = ECONNRESET;
+      return -1;
+    }
+    return k;  // 0 = close_notify / EOF, same contract as recv
+  }
+}
+
+// True only for a provably idle connection (nothing buffered, nothing
+// pending on the wire) — the reuse-time drain check.
+static int conn_idle(tb_conn* c) {
+  if (c->ssl && tls::SSL_pending_(c->ssl) > 0) return 0;
+  char junk;
+  ssize_t pk = recv(c->fd, &junk, 1, MSG_PEEK | MSG_DONTWAIT);
+  // Raw bytes pending on a TLS socket may be an in-flight close_notify —
+  // conservatively not reusable either way.
+  if (pk >= 0 || (errno != EAGAIN && errno != EWOULDBLOCK)) return 0;
+  return 1;
+}
+
+int64_t tb_conn_plain(int fd) {
+  tb_conn* c = static_cast<tb_conn*>(calloc(1, sizeof(tb_conn)));
+  if (!c) return -ENOMEM;
+  c->fd = fd;
+  return reinterpret_cast<int64_t>(c);
+}
+
+// TLS handshake on a connected fd. On failure the fd is NOT closed (the
+// caller owns it). ``sni`` is the server name for SNI + certificate
+// verification; ``cafile`` overrides the system trust store; ``insecure``
+// skips verification entirely (tests against self-signed endpoints).
+int64_t tb_conn_tls(int fd, const char* sni, const char* cafile, int insecure) {
+  if (!tls::load()) return TB_ETLS;
+  void* ctx = tls::get_ctx(cafile, insecure);
+  if (!ctx) return TB_ETLS;
+  void* ssl = tls::SSL_new_(ctx);
+  tls::SSL_CTX_free_(ctx);  // drop our reference; SSL holds its own
+  if (!ssl) return TB_ETLS;
+  if (sni && sni[0]) {
+    // SNI (SSL_set_tlsext_host_name macro = SSL_ctrl 55/0).
+    tls::SSL_ctrl_(ssl, 55, 0, const_cast<char*>(sni));
+    if (!insecure) {
+      void* param = tls::SSL_get0_param_(ssl);
+      struct in_addr a4;
+      struct in6_addr a6;
+      int is_ip = inet_pton(AF_INET, sni, &a4) == 1 ||
+                  inet_pton(AF_INET6, sni, &a6) == 1;
+      int ok = is_ip ? tls::X509_VERIFY_PARAM_set1_ip_asc_(param, sni)
+                     : tls::X509_VERIFY_PARAM_set1_host_(param, sni, 0);
+      if (ok != 1) {
+        tls::SSL_free_(ssl);
+        return TB_ETLS;
+      }
+    }
+  }
+  if (tls::SSL_set_fd_(ssl, fd) != 1 || tls::SSL_connect_(ssl) != 1) {
+    tls::SSL_free_(ssl);
+    return TB_ETLS;
+  }
+  tb_conn* c = static_cast<tb_conn*>(calloc(1, sizeof(tb_conn)));
+  if (!c) {
+    tls::SSL_free_(ssl);
+    return -ENOMEM;
+  }
+  c->fd = fd;
+  c->ssl = ssl;
+  return reinterpret_cast<int64_t>(c);
+}
+
+int tb_conn_close(int64_t h) {
+  if (h <= 0) return -EINVAL;
+  tb_conn* c = reinterpret_cast<tb_conn*>(h);
+  if (c->ssl) {
+    tls::SSL_shutdown_(c->ssl);  // best-effort close_notify
+    tls::SSL_free_(c->ssl);
+  }
+  int rc = close(c->fd) == 0 ? 0 : -errno;
+  free(c);
+  return rc;
+}
+
 // One GET on an ALREADY-CONNECTED socket (keep-alive: the caller pools
 // connections, so the receive loop can be measured with the same
 // connection discipline as the pooled Python client instead of paying a
@@ -326,11 +589,12 @@ int tb_http_close(int fd) { return close(fd) == 0 ? 0 : -errno; }
 // Content-Length body, no "Connection: close" from the server). On ANY
 // error return the caller must tb_http_close the fd — the stream state is
 // unknown.
-int64_t tb_http_request(int fd, const char* host, int port, const char* path,
-                        const char* extra_headers,  // "K: V\r\n..." or ""
-                        void* buf, int64_t buf_len, int* status_out,
-                        int64_t* first_byte_ns_out, int64_t* total_ns_out,
-                        int* reusable_out) {
+static int64_t request_on(tb_conn* cn, const char* host, int port,
+                          const char* path,
+                          const char* extra_headers,  // "K: V\r\n..." or ""
+                          void* buf, int64_t buf_len, int* status_out,
+                          int64_t* first_byte_ns_out, int64_t* total_ns_out,
+                          int* reusable_out) {
   int64_t t_start = tb_now_ns();
   if (reusable_out) *reusable_out = 0;
   char req[4096];
@@ -340,7 +604,7 @@ int64_t tb_http_request(int fd, const char* host, int port, const char* path,
                    path, host, port, extra_headers ? extra_headers : "");
   if (m <= 0 || m >= static_cast<int>(sizeof req)) return TB_EPROTO;
   for (int sent = 0; sent < m;) {
-    ssize_t k = send(fd, req + sent, m - sent, 0);
+    ssize_t k = conn_send(cn, req + sent, m - sent);
     if (k < 0) {
       if (errno == EINTR) continue;
       return -errno;
@@ -356,7 +620,7 @@ int64_t tb_http_request(int fd, const char* host, int port, const char* path,
   int body_in_hdr = 0;
   int64_t first_byte_ns = 0;
   while (hlen < hdr_cap) {
-    ssize_t k = recv(fd, hdr + hlen, hdr_cap - hlen, 0);
+    ssize_t k = conn_recv(cn, hdr + hlen, hdr_cap - hlen);
     if (k < 0) {
       if (errno == EINTR) continue;
       return -errno;
@@ -445,7 +709,7 @@ int64_t tb_http_request(int fd, const char* host, int port, const char* path,
       // length (close-delimited) it's also an error for our use.
       return TB_ETOOBIG;
     }
-    ssize_t k = recv(fd, out + got, want, 0);
+    ssize_t k = conn_recv(cn, out + got, want);
     if (k < 0) {
       if (errno == EINTR) continue;
       return -errno;
@@ -463,22 +727,40 @@ int64_t tb_http_request(int fd, const char* host, int port, const char* path,
   // a later packet than the header read (pk==0 means the peer already
   // FIN'd — also not worth pooling).
   if (reusable_out) {
-    int reusable = (content_len >= 0 && !server_close && http_minor >= 1 &&
-                    body_in_hdr <= content_len)
+    int reusable = (content_len >= 0 && !server_close && !client_close &&
+                    http_minor >= 1 && body_in_hdr <= content_len)
                        ? 1
                        : 0;
-    if (reusable) {
-      char junk;
-      ssize_t pk = recv(fd, &junk, 1, MSG_PEEK | MSG_DONTWAIT);
-      // Pool only a provably idle socket: pk>=0 is junk/FIN, and a recv
-      // error other than "no data yet" (RST, etc.) is a dead socket.
-      if (pk >= 0 || (errno != EAGAIN && errno != EWOULDBLOCK)) reusable = 0;
-    }
+    // Pool only a provably idle connection: junk/FIN/dead sockets (and
+    // buffered TLS records) all fail the idle check.
+    if (reusable && !conn_idle(cn)) reusable = 0;
     *reusable_out = reusable;
   }
   if (first_byte_ns_out) *first_byte_ns_out = first_byte_ns;
   if (total_ns_out) *total_ns_out = tb_now_ns() - t_start;
   return got;
+}
+
+// Plain-fd wrapper (back-compat entry point; plaintext only).
+int64_t tb_http_request(int fd, const char* host, int port, const char* path,
+                        const char* extra_headers, void* buf, int64_t buf_len,
+                        int* status_out, int64_t* first_byte_ns_out,
+                        int64_t* total_ns_out, int* reusable_out) {
+  tb_conn c{fd, nullptr};
+  return request_on(&c, host, port, path, extra_headers, buf, buf_len,
+                    status_out, first_byte_ns_out, total_ns_out, reusable_out);
+}
+
+// Handle-based entry point: one GET on a tb_conn (plaintext or TLS).
+int64_t tb_conn_request(int64_t h, const char* host, int port,
+                        const char* path, const char* extra_headers, void* buf,
+                        int64_t buf_len, int* status_out,
+                        int64_t* first_byte_ns_out, int64_t* total_ns_out,
+                        int* reusable_out) {
+  if (h <= 0) return -EINVAL;
+  return request_on(reinterpret_cast<tb_conn*>(h), host, port, path,
+                    extra_headers, buf, buf_len, status_out, first_byte_ns_out,
+                    total_ns_out, reusable_out);
 }
 
 // One-shot GET: fresh connection, with an explicit "Connection: close"

@@ -29,6 +29,7 @@ TB_ETOOBIG = -1002
 TB_ERESOLVE = -1003
 TB_ESHORT = -1004
 TB_ECHUNKED = -1005
+TB_ETLS = -1006
 
 _PROTO_ERRORS = {
     TB_EPROTO: "malformed HTTP response",
@@ -36,6 +37,7 @@ _PROTO_ERRORS = {
     TB_ERESOLVE: "hostname resolution failed",
     TB_ESHORT: "short response: connection closed early",
     TB_ECHUNKED: "chunked transfer encoding (unsupported by the native receive path)",
+    TB_ETLS: "TLS unavailable, handshake failed, or certificate rejected",
 }
 
 # Protocol-shape failures: re-sending the same request to the same server
@@ -44,7 +46,7 @@ _PROTO_ERRORS = {
 # conditions — transient. (-1002 has one caller-visible exception: when the
 # buffer was sized from a cached stat, the caller may treat it as
 # retryable after invalidating the cache — see gcs_http.)
-PERMANENT_CODES = frozenset({TB_EPROTO, TB_ETOOBIG, TB_ECHUNKED})
+PERMANENT_CODES = frozenset({TB_EPROTO, TB_ETOOBIG, TB_ECHUNKED, TB_ETLS})
 
 
 def _check(rc: int, what: str) -> int:
@@ -214,6 +216,19 @@ class NativeEngine:
         lib.tb_http_request.restype = c.c_int64
         lib.tb_http_request.argtypes = [
             c.c_int, c.c_char_p, c.c_int, c.c_char_p, c.c_char_p,
+            c.c_void_p, c.c_int64, c.POINTER(c.c_int),
+            c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.POINTER(c.c_int),
+        ]
+        lib.tb_tls_available.restype = c.c_int
+        lib.tb_conn_plain.restype = c.c_int64
+        lib.tb_conn_plain.argtypes = [c.c_int]
+        lib.tb_conn_tls.restype = c.c_int64
+        lib.tb_conn_tls.argtypes = [c.c_int, c.c_char_p, c.c_char_p, c.c_int]
+        lib.tb_conn_close.restype = c.c_int
+        lib.tb_conn_close.argtypes = [c.c_int64]
+        lib.tb_conn_request.restype = c.c_int64
+        lib.tb_conn_request.argtypes = [
+            c.c_int64, c.c_char_p, c.c_int, c.c_char_p, c.c_char_p,
             c.c_void_p, c.c_int64, c.POINTER(c.c_int),
             c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.POINTER(c.c_int),
         ]
@@ -406,6 +421,85 @@ class NativeEngine:
             ctypes.byref(reusable),
         )
         _check(n, f"http_request {host}:{port}{path}")
+        return {
+            "status": status.value,
+            "length": n,
+            "first_byte_ns": fb.value,
+            "total_ns": total_ns.value,
+            "reusable": bool(reusable.value),
+        }
+
+    # ------------------------------------------------------ conn handles --
+    # Transport-agnostic connection handles: the same receive loop over
+    # plaintext TCP or TLS (dlopen'd OpenSSL — SURVEY hard-part (b): the
+    # native path can face real https endpoints, not just localhost fakes).
+
+    def tls_available(self) -> bool:
+        return bool(self.lib.tb_tls_available())
+
+    def connect(
+        self,
+        host: str,
+        port: int,
+        *,
+        tls: bool = False,
+        sni: str = "",
+        cafile: str = "",
+        insecure: bool = False,
+    ) -> int:
+        """Open a connection handle for :meth:`conn_request` calls. TLS
+        verification: peer cert against ``cafile`` (or the system store)
+        plus hostname/IP match on ``sni`` — ``insecure`` skips both (tests
+        against self-signed endpoints)."""
+        fd = _check(self.lib.tb_http_connect(host.encode(), port),
+                    f"connect {host}:{port}")
+        if not tls:
+            return _check(self.lib.tb_conn_plain(fd), "conn_plain")
+        h = self.lib.tb_conn_tls(
+            fd, (sni or host).encode(), cafile.encode(), 1 if insecure else 0
+        )
+        if h <= 0:
+            self.lib.tb_http_close(fd)  # handshake failed: fd still ours
+            _check(int(h), f"tls handshake {host}:{port}")
+        return h
+
+    def conn_plain(self, fd: int) -> int:
+        """Wrap an existing connected fd (ownership transfers)."""
+        return _check(self.lib.tb_conn_plain(fd), "conn_plain")
+
+    def conn_close(self, handle: int) -> None:
+        self.lib.tb_conn_close(handle)
+
+    def conn_request(
+        self,
+        handle: int,
+        host: str,
+        port: int,
+        path: str,
+        buf: AlignedBuffer,
+        headers: str = "",
+    ) -> dict:
+        """One GET on a connection handle; same contract as
+        :meth:`http_request` (on NativeError the caller must
+        :meth:`conn_close` the handle — stream state unknown)."""
+        status = ctypes.c_int(0)
+        fb = ctypes.c_int64(0)
+        total_ns = ctypes.c_int64(0)
+        reusable = ctypes.c_int(0)
+        n = self.lib.tb_conn_request(
+            handle,
+            host.encode(),
+            port,
+            path.encode(),
+            headers.encode(),
+            buf.address,
+            buf.size,
+            ctypes.byref(status),
+            ctypes.byref(fb),
+            ctypes.byref(total_ns),
+            ctypes.byref(reusable),
+        )
+        _check(n, f"conn_request {host}:{port}{path}")
         return {
             "status": status.value,
             "length": n,

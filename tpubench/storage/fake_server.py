@@ -183,19 +183,42 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class FakeGcsServer:
-    """Threaded fake-GCS server; use as a context manager in tests."""
+    """Threaded fake-GCS server; use as a context manager in tests.
 
-    def __init__(self, backend: Optional[FakeBackend] = None, port: int = 0):
+    ``tls=True`` wraps the listener in TLS with an ephemeral self-signed
+    certificate (SAN: localhost + 127.0.0.1) so client TLS paths — the
+    Python pool's ssl context and the native engine's OpenSSL layer — can
+    be exercised hermetically; ``cafile`` then points at the PEM to trust.
+    """
+
+    def __init__(
+        self,
+        backend: Optional[FakeBackend] = None,
+        port: int = 0,
+        tls: bool = False,
+    ):
         self.backend = backend or FakeBackend()
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
         self._httpd.backend = self.backend  # type: ignore[attr-defined]
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
+        self._tls = tls
+        self.cafile = ""
+        if tls:
+            import ssl
+
+            self.cafile, keyfile = make_self_signed_cert()
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.cafile, keyfile)
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True
+            )
 
     @property
     def endpoint(self) -> str:
         host, port = self._httpd.server_address[:2]
-        return f"http://{host}:{port}"
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://{host}:{port}"
 
     def start(self) -> "FakeGcsServer":
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
@@ -213,3 +236,54 @@ class FakeGcsServer:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+def make_self_signed_cert(hostname: str = "localhost") -> tuple[str, str]:
+    """Ephemeral self-signed server certificate (SAN: ``hostname`` +
+    127.0.0.1), written to a temp dir. Returns ``(certfile, keyfile)`` —
+    the cert PEM doubles as the CA bundle clients should trust."""
+    import datetime
+    import ipaddress
+    import tempfile
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, hostname)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName(
+                [
+                    x509.DNSName(hostname),
+                    x509.IPAddress(ipaddress.IPv4Address("127.0.0.1")),
+                ]
+            ),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    d = tempfile.mkdtemp(prefix="tpubench-tls-")
+    certfile = f"{d}/cert.pem"
+    keyfile = f"{d}/key.pem"
+    with open(certfile, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(keyfile, "wb") as f:
+        f.write(
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            )
+        )
+    return certfile, keyfile

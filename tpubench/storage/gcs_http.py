@@ -59,7 +59,14 @@ class _ConnectionPool:
         self._max_idle = transport.max_idle_conns_per_host
         self._idle: list[http.client.HTTPConnection] = []
         self._lock = threading.Lock()
-        self._ctx = ssl.create_default_context() if scheme == "https" else None
+        self._ctx = None
+        if scheme == "https":
+            self._ctx = ssl.create_default_context(
+                cafile=transport.tls_ca_file or None
+            )
+            if transport.tls_insecure_skip_verify:
+                self._ctx.check_hostname = False
+                self._ctx.verify_mode = ssl.CERT_NONE
 
     def _new_conn(self) -> http.client.HTTPConnection:
         if self._scheme == "https":
@@ -326,11 +333,12 @@ class GcsHttpBackend:
     def _open_read_native(self, name: str, start: int, length: Optional[int]):
         """Opt-in C++ receive path (``transport.native_receive``): the body
         streams from the socket into a pre-registered posix_memalign'd
-        buffer with a native first-byte timestamp. Tradeoffs vs the pooled
-        Python path: plain HTTP only (no TLS in the engine — hermetic fake
-        servers and private endpoints) and one fresh connection per GET (no
-        keep-alive pool), so it measures the pure receive path, not
-        connection reuse."""
+        buffer with a native first-byte timestamp, over pooled keep-alive
+        connections — the same connection discipline as the Python path,
+        so A/Bs isolate the receive loop. https endpoints ride the
+        engine's TLS layer (verification against ``transport.tls_ca_file``
+        or the system store; ``transport.tls_insecure_skip_verify`` for
+        self-signed test endpoints)."""
         from tpubench.native.engine import (
             PERMANENT_CODES,
             TB_ETOOBIG,
@@ -344,11 +352,12 @@ class GcsHttpBackend:
                 "transport.native_receive=True but the native engine is "
                 "unavailable (C++ toolchain missing?)", transient=False
             )
-        if self._scheme != "http":
+        use_tls = self._scheme == "https"
+        if use_tls and not engine.tls_available():
             raise StorageError(
-                "transport.native_receive supports plain-HTTP endpoints only "
-                f"(endpoint scheme is {self._scheme!r}; the C++ receive path "
-                "has no TLS)", transient=False
+                "transport.native_receive on an https endpoint, but the "
+                "engine could not load OpenSSL (libssl.so.3)",
+                transient=False,
             )
         if length is None:
             # Size the receive buffer from object metadata, cached per name
@@ -384,24 +393,33 @@ class GcsHttpBackend:
         # fails on first use — standard HTTP-client behavior is one
         # immediate retransmit of the idempotent GET on a FRESH socket, so
         # pool staleness never surfaces as a request failure.
-        with self._native_lock:
-            fd = self._native_idle.pop() if self._native_idle else -1
-            if fd >= 0:
-                self.native_conn_stats["reuses"] += 1
-        reused = fd >= 0
-        if not reused:
+        def _connect() -> int:
+            # Connect (+ TLS handshake on https) — failures here are
+            # network/trust conditions, classified on the engine's code
+            # ABI (handshake/verification = TB_ETLS, permanent).
             try:
-                fd = engine.http_connect(self._host, self._port)
+                h = engine.connect(
+                    self._host, self._port, tls=use_tls, sni=self._host,
+                    cafile=self.transport.tls_ca_file,
+                    insecure=self.transport.tls_insecure_skip_verify,
+                )
             except NativeError as e:
                 buf.free()
-                # Connect failures (refused, resolve) are network
-                # conditions — transient under the module contract.
                 raise StorageError(
                     f"native GET {name}: {e}",
                     transient=e.code not in PERMANENT_CODES,
                 ) from e
             with self._native_lock:
                 self.native_conn_stats["connects"] += 1
+            return h
+
+        with self._native_lock:
+            conn = self._native_idle.pop() if self._native_idle else 0
+            if conn:
+                self.native_conn_stats["reuses"] += 1
+        reused = bool(conn)
+        if not reused:
+            conn = _connect()
         while True:
             try:
                 # The native GET is complete on return, so one span covers
@@ -410,8 +428,8 @@ class GcsHttpBackend:
                 with self._tracer.span(
                     "gcs_http.get_native", object=name, bucket=self.bucket
                 ) as sp:
-                    r = engine.http_request(
-                        fd, self._host, self._port,
+                    r = engine.conn_request(
+                        conn, self._host, self._port,
                         self._opath(name) + "?alt=media", buf, headers=headers,
                     )
                     sp.event("first_byte", native_ns=r["first_byte_ns"])
@@ -419,13 +437,13 @@ class GcsHttpBackend:
                 if r["reusable"]:
                     with self._native_lock:
                         if len(self._native_idle) < self.transport.max_idle_conns_per_host:
-                            self._native_idle.append(fd)
+                            self._native_idle.append(conn)
                             put_back = True
                 if not put_back:
-                    engine.http_close(fd)
+                    engine.conn_close(conn)
                 break
             except NativeError as e:
-                engine.http_close(fd)  # stream state unknown after failure
+                engine.conn_close(conn)  # stream state unknown after failure
                 if reused:
                     # First use of a pooled connection failed: retry once
                     # on a fresh socket before classifying anything — the
@@ -433,16 +451,7 @@ class GcsHttpBackend:
                     reused = False
                     with self._native_lock:
                         self.native_conn_stats["stale_retries"] += 1
-                    try:
-                        fd = engine.http_connect(self._host, self._port)
-                    except NativeError as e2:
-                        buf.free()
-                        raise StorageError(
-                            f"native GET {name}: {e2}",
-                            transient=e2.code not in PERMANENT_CODES,
-                        ) from e2
-                    with self._native_lock:
-                        self.native_conn_stats["connects"] += 1
+                    conn = _connect()
                     continue
                 # Module contract: this layer raises classified
                 # StorageErrors. Classification is on the engine's
@@ -465,7 +474,7 @@ class GcsHttpBackend:
                     f"native GET {name}: {e}", transient=transient
                 ) from e
             except Exception:
-                engine.http_close(fd)
+                engine.conn_close(conn)
                 buf.free()
                 raise
         if r["status"] not in (200, 206):
@@ -527,11 +536,11 @@ class GcsHttpBackend:
     def close(self) -> None:
         self._pool.close()
         with self._native_lock:
-            fds, self._native_idle = self._native_idle, []
-        if fds:
+            conns, self._native_idle = self._native_idle, []
+        if conns:
             from tpubench.native.engine import get_engine
 
             engine = get_engine()
             if engine is not None:
-                for fd in fds:
-                    engine.http_close(fd)
+                for h in conns:
+                    engine.conn_close(h)
