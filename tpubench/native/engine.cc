@@ -18,6 +18,7 @@
 // blocking calls run without the GIL (ctypes releases it), so Python worker
 // threads get real I/O concurrency.
 
+#include <cctype>
 #include <cerrno>
 #include <cstdint>
 #include <cstdio>
@@ -36,6 +37,8 @@
 #include <sys/stat.h>
 #include <sys/types.h>
 #include <unistd.h>
+
+#include "hpack_huffman.h"
 
 extern "C" {
 
@@ -295,6 +298,10 @@ enum {
   TB_ETLS = -1006,      // TLS unavailable / handshake or verification
                         // failure — reproduces against the same endpoint
                         // and trust config [permanent]
+  TB_EGRPC = -1007,     // RPC finished with a nonzero grpc-status; the
+                        // status lands in grpc_status_out and the caller
+                        // classifies on it (NOT_FOUND permanent,
+                        // UNAVAILABLE transient, …)
 };
 
 // Connect a TCP socket for HTTP use (TCP_NODELAY). Returns fd >= 0, or
@@ -352,6 +359,10 @@ static int (*SSL_pending_)(void*) = nullptr;
 static long (*SSL_ctrl_)(void*, int, long, void*) = nullptr;
 static void* (*SSL_get0_param_)(void*) = nullptr;
 static int (*SSL_CTX_up_ref_)(void*) = nullptr;
+static int (*SSL_set_alpn_protos_)(void*, const unsigned char*, unsigned) =
+    nullptr;
+static void (*SSL_get0_alpn_selected_)(const void*, const unsigned char**,
+                                       unsigned*) = nullptr;
 static int (*X509_VERIFY_PARAM_set1_host_)(void*, const char*, size_t) = nullptr;
 static int (*X509_VERIFY_PARAM_set1_ip_asc_)(void*, const char*) = nullptr;
 
@@ -385,6 +396,8 @@ static bool do_load() {
   TB_SYM(libssl, SSL_ctrl);
   TB_SYM(libssl, SSL_get0_param);
   TB_SYM(libssl, SSL_CTX_up_ref);
+  TB_SYM(libssl, SSL_set_alpn_protos);
+  TB_SYM(libssl, SSL_get0_alpn_selected);
   TB_SYM(libcrypto, X509_VERIFY_PARAM_set1_host);
   TB_SYM(libcrypto, X509_VERIFY_PARAM_set1_ip_asc);
 #undef TB_SYM
@@ -471,6 +484,14 @@ int tb_tls_available() { return tls::load() ? 1 : 0; }
 struct tb_conn {
   int fd;
   void* ssl;
+  // h2 session state (gRPC path): lazily initialized by tb_grpc_read;
+  // sequential RPCs on one connection use odd stream ids 1, 3, 5, …
+  int h2_started;
+  uint32_t next_stream;
+  // Per-connection gRPC message scratch (lazily allocated, freed in
+  // tb_conn_close): a per-RPC 2 MiB malloc/free would sit inside the
+  // timed window of the very path being benchmarked.
+  uint8_t* scratch;
 };
 
 // SSL_read/SSL_write take int lengths: cap chunks well under INT_MAX so
@@ -530,8 +551,12 @@ int64_t tb_conn_plain(int fd) {
 // TLS handshake on a connected fd. On failure the fd is NOT closed (the
 // caller owns it). ``sni`` is the server name for SNI + certificate
 // verification; ``cafile`` overrides the system trust store; ``insecure``
-// skips verification entirely (tests against self-signed endpoints).
-int64_t tb_conn_tls(int fd, const char* sni, const char* cafile, int insecure) {
+// skips verification entirely (tests against self-signed endpoints);
+// ``alpn_h2`` offers ALPN "h2" and REQUIRES the server to select it (the
+// gRPC path misparses an HTTP/1.1 fallback as frame garbage — fail the
+// handshake instead).
+int64_t tb_conn_tls(int fd, const char* sni, const char* cafile, int insecure,
+                    int alpn_h2) {
   if (!tls::load()) return TB_ETLS;
   void* ctx = tls::get_ctx(cafile, insecure);
   if (!ctx) return TB_ETLS;
@@ -555,9 +580,26 @@ int64_t tb_conn_tls(int fd, const char* sni, const char* cafile, int insecure) {
       }
     }
   }
+  if (alpn_h2) {
+    static const unsigned char kH2[] = {2, 'h', '2'};
+    if (tls::SSL_set_alpn_protos_(ssl, kH2, sizeof kH2) != 0) {
+      tls::SSL_free_(ssl);
+      return TB_ETLS;
+    }
+  }
   if (tls::SSL_set_fd_(ssl, fd) != 1 || tls::SSL_connect_(ssl) != 1) {
     tls::SSL_free_(ssl);
     return TB_ETLS;
+  }
+  if (alpn_h2) {
+    const unsigned char* sel = nullptr;
+    unsigned sel_len = 0;
+    tls::SSL_get0_alpn_selected_(ssl, &sel, &sel_len);
+    if (sel_len != 2 || memcmp(sel, "h2", 2) != 0) {
+      tls::SSL_shutdown_(ssl);
+      tls::SSL_free_(ssl);
+      return TB_ETLS;
+    }
   }
   tb_conn* c = static_cast<tb_conn*>(calloc(1, sizeof(tb_conn)));
   if (!c) {
@@ -577,6 +619,7 @@ int tb_conn_close(int64_t h) {
     tls::SSL_free_(c->ssl);
   }
   int rc = close(c->fd) == 0 ? 0 : -errno;
+  free(c->scratch);
   free(c);
   return rc;
 }
@@ -788,6 +831,746 @@ int64_t tb_http_get(const char* host, int port, const char* path,
   close(fd);
   if (n >= 0 && total_ns_out) *total_ns_out = tb_now_ns() - t_start;
   return n;
+}
+
+// ------------------------------------------------------------- gRPC / h2 --
+// Native receive for the gRPC path (SURVEY §2.5.1 names "HTTP/gRPC
+// response bodies"): a hand-rolled minimal HTTP/2 client speaking exactly
+// the google.storage.v2.Storage/ReadObject RPC shape over h2c prior
+// knowledge (what an insecure gRPC port speaks) or TLS via the tb_conn
+// layer. Scope decisions, made for a benchmark receive path rather than a
+// general h2 stack:
+//
+// * HPACK: requests encode every header as "literal, never indexed, new
+//   name", no huffman — minimal and legal. Responses are parsed
+//   STRUCTURALLY: every entry form has explicit lengths, so entries can
+//   be skipped exactly without maintaining the dynamic table or decoding
+//   huffman; grpc-status is extracted opportunistically when it appears
+//   in plain literal form, and success is otherwise judged by stream
+//   completion + delivered byte count (the caller sized the buffer from
+//   object metadata).
+// * Flow control: we advertise a 2^31-1 stream window and widen the
+//   connection window up front, then top both up as DATA is consumed.
+// * One connection = sequential RPCs on odd stream ids (1, 3, 5, …) —
+//   keep-alive parity with the pooled paths; no concurrent streams.
+// * gRPC messages (5-byte length-prefixed ReadObjectResponse protos) are
+//   reassembled in a scratch buffer, then ChecksummedData.content bytes
+//   are copied into the caller's aligned buffer. That is one scratch→dest
+//   copy — same count as the Python client's deserialize path, and the
+//   protobuf wire format (length-delimited submessages) does not permit
+//   landing content in place without first seeing the enclosing lengths.
+
+namespace h2 {
+
+// ---- frame io ----
+static const uint8_t kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+
+static int send_all(tb_conn* c, const uint8_t* p, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t k = conn_send(c, p + off, n - off);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    off += static_cast<size_t>(k);
+  }
+  return 0;
+}
+
+static int recv_all(tb_conn* c, uint8_t* p, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t k = conn_recv(c, p + off, n - off);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    if (k == 0) return TB_ESHORT;
+    off += static_cast<size_t>(k);
+  }
+  return 0;
+}
+
+static void put32(uint8_t* p, uint32_t v) {
+  p[0] = v >> 24;
+  p[1] = v >> 16;
+  p[2] = v >> 8;
+  p[3] = v;
+}
+
+static int send_frame(tb_conn* c, uint8_t type, uint8_t flags, uint32_t stream,
+                      const uint8_t* payload, uint32_t len) {
+  uint8_t hdr[9];
+  hdr[0] = len >> 16;
+  hdr[1] = len >> 8;
+  hdr[2] = len;
+  hdr[3] = type;
+  hdr[4] = flags;
+  put32(hdr + 5, stream & 0x7fffffffu);
+  int rc = send_all(c, hdr, 9);
+  if (rc != 0) return rc;
+  if (len) rc = send_all(c, payload, len);
+  return rc;
+}
+
+// ---- HPACK request encoding: literal never-indexed, new name, no huffman.
+static size_t hp_int(uint8_t* out, uint64_t v) {
+  // 7-bit prefix integer with a zeroed first byte (string length form).
+  if (v < 127) {
+    out[0] = static_cast<uint8_t>(v);
+    return 1;
+  }
+  out[0] = 127;
+  v -= 127;
+  size_t n = 1;
+  while (v >= 128) {
+    out[n++] = static_cast<uint8_t>(v | 0x80);
+    v >>= 7;
+  }
+  out[n++] = static_cast<uint8_t>(v);
+  return n;
+}
+
+static size_t hp_header(uint8_t* out, const char* name, const char* value) {
+  size_t n = 0;
+  out[n++] = 0x10;  // literal never-indexed, name literal
+  n += hp_int(out + n, strlen(name));
+  memcpy(out + n, name, strlen(name));
+  n += strlen(name);
+  n += hp_int(out + n, strlen(value));
+  memcpy(out + n, value, strlen(value));
+  n += strlen(value);
+  return n;
+}
+
+// ---- structural HPACK response parsing ----
+// Decode a prefix integer; returns bytes consumed or 0 on truncation.
+static size_t hpd_int(const uint8_t* p, size_t n, int prefix_bits,
+                      uint64_t* out) {
+  if (n == 0) return 0;
+  uint64_t max_prefix = (1u << prefix_bits) - 1;
+  uint64_t v = p[0] & max_prefix;
+  size_t i = 1;
+  if (v == max_prefix) {
+    uint64_t m = 0;
+    for (;;) {
+      if (i >= n || m > 56) return 0;
+      uint8_t b = p[i++];
+      v += static_cast<uint64_t>(b & 0x7f) << m;
+      if (!(b & 0x80)) break;
+      m += 7;
+    }
+  }
+  *out = v;
+  return i;
+}
+
+// HPACK Huffman decoding (RFC 7541 §5.2 + Appendix B): canonical decode
+// tree built once from the spec table. Real gRPC servers huffman-encode
+// trailer names/values (grpc-status), so the parser must decode, not
+// just skip.
+struct HuffNode {
+  int16_t next[2];
+  int16_t sym;  // >= 0: leaf (256 = EOS)
+};
+
+static const HuffNode* huff_tree() {
+  static HuffNode* tree = [] {
+    // 257 codes x <= 30 bits bounds the node count.
+    static HuffNode nodes[257 * 30 + 1];
+    int count = 1;
+    nodes[0] = {{-1, -1}, -1};
+    for (int sym = 0; sym < 257; sym++) {
+      uint32_t code = kHpackHuffman[sym].code;
+      int bits = kHpackHuffman[sym].bits;
+      int cur = 0;
+      for (int b = bits - 1; b >= 0; b--) {
+        int bit = (code >> b) & 1;
+        if (nodes[cur].next[bit] < 0) {
+          nodes[cur].next[bit] = static_cast<int16_t>(count);
+          nodes[count] = {{-1, -1}, -1};
+          count++;
+        }
+        cur = nodes[cur].next[bit];
+      }
+      nodes[cur].sym = static_cast<int16_t>(sym);
+    }
+    return nodes;
+  }();
+  return tree;
+}
+
+// Decode a huffman-coded string into out[cap]. Returns decoded length or
+// -1 (EOS in stream, truncated code mid-symbol is tolerated as RFC
+// padding, output overflow).
+static int64_t huff_decode(const uint8_t* p, size_t n, uint8_t* out,
+                           size_t cap) {
+  const HuffNode* t = huff_tree();
+  int cur = 0;
+  size_t o = 0;
+  for (size_t i = 0; i < n; i++) {
+    for (int b = 7; b >= 0; b--) {
+      int nxt = t[cur].next[(p[i] >> b) & 1];
+      if (nxt < 0) return -1;
+      cur = nxt;
+      if (t[cur].sym >= 0) {
+        if (t[cur].sym == 256) return -1;  // EOS must not appear
+        if (o >= cap) return -1;
+        out[o++] = static_cast<uint8_t>(t[cur].sym);
+        cur = 0;
+      }
+    }
+  }
+  // Leftover bits are EOS-prefix padding (<= 7 bits), consumed above.
+  return static_cast<int64_t>(o);
+}
+
+// One string (possibly huffman-coded): returns bytes consumed; *s/*slen
+// point at the raw (still-encoded when *huff) payload.
+static size_t hpd_str(const uint8_t* p, size_t n, const uint8_t** s,
+                      size_t* slen, int* huff) {
+  if (n == 0) return 0;
+  *huff = p[0] & 0x80;
+  uint64_t len;
+  size_t i = hpd_int(p, n, 7, &len);
+  if (i == 0 || len > n - i) return 0;
+  *s = p + i;
+  *slen = static_cast<size_t>(len);
+  return i + static_cast<size_t>(len);
+}
+
+// Resolve a parsed string into a bounded plain-text buffer. Returns the
+// plain length, or -1 when it cannot fit / cannot decode (caller treats
+// the entry as not-the-one-it-wants — never fatal).
+static int64_t hp_resolve(const uint8_t* s, size_t slen, int huff,
+                          uint8_t* out, size_t cap) {
+  if (!huff) {
+    if (slen > cap) return -1;
+    memcpy(out, s, slen);
+    return static_cast<int64_t>(slen);
+  }
+  return huff_decode(s, slen, out, cap);
+}
+
+// Walk one header block, extracting grpc-status (plain or huffman-coded
+// literals; indexed entries cannot carry it — grpc-status is not in the
+// h2 static table and we advertise a zero-size dynamic table). Returns 0
+// on success, TB_EPROTO on a malformed block.
+static int parse_header_block(const uint8_t* p, size_t n, int* grpc_status) {
+  size_t i = 0;
+  while (i < n) {
+    uint8_t b = p[i];
+    uint64_t idx;
+    size_t k;
+    if (b & 0x80) {  // indexed field: nothing to skip beyond the index
+      k = hpd_int(p + i, n - i, 7, &idx);
+      if (k == 0) return TB_EPROTO;
+      i += k;
+      continue;
+    } else if ((b & 0xe0) == 0x20) {  // dynamic table size update
+      k = hpd_int(p + i, n - i, 5, &idx);
+      if (k == 0) return TB_EPROTO;
+      i += k;
+      continue;
+    } else if (b & 0x40) {  // literal with incremental indexing
+      k = hpd_int(p + i, n - i, 6, &idx);
+    } else {  // literal without indexing / never indexed (4-bit prefix)
+      k = hpd_int(p + i, n - i, 4, &idx);
+    }
+    if (k == 0) return TB_EPROTO;
+    int has_name_literal = (idx == 0);
+    i += k;
+    const uint8_t* name = nullptr;
+    size_t name_len = 0;
+    int name_huff = 0;
+    if (has_name_literal) {
+      k = hpd_str(p + i, n - i, &name, &name_len, &name_huff);
+      if (k == 0) return TB_EPROTO;
+      i += k;
+    }
+    const uint8_t* val = nullptr;
+    size_t val_len = 0;
+    int val_huff = 0;
+    k = hpd_str(p + i, n - i, &val, &val_len, &val_huff);
+    if (k == 0) return TB_EPROTO;
+    i += k;
+    if (grpc_status && name) {
+      uint8_t nbuf[32];
+      int64_t nl = hp_resolve(name, name_len, name_huff, nbuf, sizeof nbuf);
+      if (nl == 11 && memcmp(nbuf, "grpc-status", 11) == 0) {
+        uint8_t vbuf[16];
+        int64_t vl = hp_resolve(val, val_len, val_huff, vbuf, sizeof vbuf);
+        int st = vl > 0 ? 0 : -1;
+        for (int64_t j = 0; j < vl; j++) {
+          if (vbuf[j] < '0' || vbuf[j] > '9') {
+            st = -1;
+            break;
+          }
+          st = st * 10 + (vbuf[j] - '0');
+        }
+        if (st >= 0) *grpc_status = st;
+      }
+    }
+  }
+  return 0;
+}
+
+// ---- minimal protobuf ----
+static size_t pb_varint(uint8_t* out, uint64_t v) {
+  size_t n = 0;
+  while (v >= 128) {
+    out[n++] = static_cast<uint8_t>(v | 0x80);
+    v >>= 7;
+  }
+  out[n++] = static_cast<uint8_t>(v);
+  return n;
+}
+
+static size_t pb_str(uint8_t* out, uint32_t field, const char* s) {
+  size_t n = 0;
+  out[n++] = static_cast<uint8_t>(field << 3 | 2);
+  n += pb_varint(out + n, strlen(s));
+  memcpy(out + n, s, strlen(s));
+  return n + strlen(s);
+}
+
+static size_t pbd_varint(const uint8_t* p, size_t n, uint64_t* out) {
+  uint64_t v = 0;
+  size_t i = 0;
+  int shift = 0;
+  for (;;) {
+    if (i >= n || shift > 63) return 0;
+    uint8_t b = p[i++];
+    v |= static_cast<uint64_t>(b & 0x7f) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+  }
+  *out = v;
+  return i;
+}
+
+// Extract ChecksummedData.content (field 1 of field 1) from one serialized
+// ReadObjectResponse; appends into dst. Returns bytes appended or TB_EPROTO.
+static int64_t pb_extract_content(const uint8_t* msg, size_t n, uint8_t* dst,
+                                  int64_t dst_cap) {
+  size_t i = 0;
+  int64_t out = 0;
+  while (i < n) {
+    uint64_t tag;
+    size_t k = pbd_varint(msg + i, n - i, &tag);
+    if (k == 0) return TB_EPROTO;
+    i += k;
+    uint32_t field = static_cast<uint32_t>(tag >> 3);
+    uint32_t wire = tag & 7;
+    uint64_t len = 0;
+    switch (wire) {
+      case 0:  // varint
+        k = pbd_varint(msg + i, n - i, &len);
+        if (k == 0) return TB_EPROTO;
+        i += k;
+        break;
+      case 1:  // fixed64
+        if (i + 8 > n) return TB_EPROTO;
+        i += 8;
+        break;
+      case 5:  // fixed32
+        if (i + 4 > n) return TB_EPROTO;
+        i += 4;
+        break;
+      case 2: {  // length-delimited
+        k = pbd_varint(msg + i, n - i, &len);
+        // Subtraction-form bound: i + k + len can wrap uint64.
+        if (k == 0 || len > n - i - k) return TB_EPROTO;
+        i += k;
+        if (field == 1) {
+          // checksummed_data submessage: find content (field 1, bytes).
+          const uint8_t* sub = msg + i;
+          size_t sn = static_cast<size_t>(len);
+          size_t j = 0;
+          while (j < sn) {
+            uint64_t stag;
+            size_t sk = pbd_varint(sub + j, sn - j, &stag);
+            if (sk == 0) return TB_EPROTO;
+            j += sk;
+            uint32_t sfield = static_cast<uint32_t>(stag >> 3);
+            uint32_t swire = stag & 7;
+            uint64_t slen = 0;
+            if (swire == 2) {
+              sk = pbd_varint(sub + j, sn - j, &slen);
+              // Subtraction form again: j + sk + slen can wrap uint64.
+              if (sk == 0 || slen > sn - j - sk) return TB_EPROTO;
+              j += sk;
+              if (sfield == 1) {
+                if (slen > static_cast<uint64_t>(dst_cap - out))
+                  return TB_ETOOBIG;
+                memcpy(dst + out, sub + j, slen);
+                out += static_cast<int64_t>(slen);
+              }
+              j += static_cast<size_t>(slen);
+            } else if (swire == 0) {
+              sk = pbd_varint(sub + j, sn - j, &slen);
+              if (sk == 0) return TB_EPROTO;
+              j += sk;
+            } else if (swire == 5) {
+              if (j + 4 > sn) return TB_EPROTO;
+              j += 4;
+            } else if (swire == 1) {
+              if (j + 8 > sn) return TB_EPROTO;
+              j += 8;
+            } else {
+              return TB_EPROTO;
+            }
+          }
+        }
+        i += static_cast<size_t>(len);
+        break;
+      }
+      default:
+        return TB_EPROTO;
+    }
+  }
+  return out;
+}
+
+}  // namespace h2
+
+// Test hook: run the structural HPACK parse over one header block and
+// return the extracted grpc-status (-1 unknown) or TB_EPROTO — lets the
+// huffman-coded trailer path be exercised directly (the hermetic grpc
+// server happens to send grpc-status unencoded).
+int tb_hpack_scan_status(const void* block, int64_t n) {
+  int st = -1;
+  int rc = h2::parse_header_block(static_cast<const uint8_t*>(block),
+                                  static_cast<size_t>(n), &st);
+  return rc != 0 ? rc : st;
+}
+
+// One gRPC ReadObject on a tb_conn handle. Returns content bytes landed in
+// ``buf``, or a negative TB_*/-errno code. ``grpc_status_out`` is the
+// trailer's grpc-status when it was parseable, else -1 (success is then
+// judged by the caller comparing the byte count against object metadata).
+int64_t tb_grpc_read(int64_t h, const char* authority, const char* bucket_path,
+                     const char* object_name,
+                     const char* extra_headers,  // "k: v\r\n..." or ""
+                     int64_t read_offset, int64_t read_limit, void* buf,
+                     int64_t buf_len, int64_t* first_byte_ns_out,
+                     int64_t* total_ns_out, int* grpc_status_out) {
+  if (h <= 0) return -EINVAL;
+  // Headers land in hb[8192] (fixed fields ≈ 120 B + authority + extra
+  // metadata such as an OAuth bearer token) and the request proto in
+  // req[2048] (framing ≈ 30 B + bucket + object): bound the
+  // caller-supplied strings so neither buffer can overflow. GCS caps
+  // object names at 1024 bytes — these limits sit above real use.
+  if (!authority || strlen(authority) > 512) return -EINVAL;
+  if (!bucket_path || !object_name ||
+      strlen(bucket_path) + strlen(object_name) > 1700)
+    return -EINVAL;
+  if (extra_headers && strlen(extra_headers) > 4096) return -EINVAL;
+  tb_conn* c = reinterpret_cast<tb_conn*>(h);
+  int64_t t_start = tb_now_ns();
+  if (grpc_status_out) *grpc_status_out = -1;
+  int rc;
+
+  if (!c->h2_started) {
+    // Client preface + SETTINGS(HEADER_TABLE_SIZE=0, INITIAL_WINDOW_SIZE=
+    // 2^31-1, MAX_FRAME_SIZE=2^24-1) + connection WINDOW_UPDATE.
+    if ((rc = h2::send_all(c, h2::kPreface, sizeof(h2::kPreface) - 1)) != 0)
+      return rc;
+    uint8_t st[18];
+    uint8_t* p = st;
+    p[0] = 0; p[1] = 1; h2::put32(p + 2, 0); p += 6;              // table 0
+    p[0] = 0; p[1] = 4; h2::put32(p + 2, 0x7fffffffu); p += 6;    // window
+    p[0] = 0; p[1] = 5; h2::put32(p + 2, 0x00ffffffu); p += 6;    // frame
+    if ((rc = h2::send_frame(c, 4 /*SETTINGS*/, 0, 0, st, 18)) != 0) return rc;
+    uint8_t wu[4];
+    h2::put32(wu, 0x40000000u - 65535);
+    if ((rc = h2::send_frame(c, 8 /*WINDOW_UPDATE*/, 0, 0, wu, 4)) != 0)
+      return rc;
+    c->h2_started = 1;
+    c->next_stream = 1;
+  }
+  uint32_t stream = c->next_stream;
+  c->next_stream += 2;
+
+  // HEADERS: the gRPC request headers, literal never-indexed.
+  uint8_t hb[8192];
+  size_t hn = 0;
+  hn += h2::hp_header(hb + hn, ":method", "POST");
+  hn += h2::hp_header(hb + hn, ":scheme", c->ssl ? "https" : "http");
+  hn += h2::hp_header(hb + hn, ":path",
+                      "/google.storage.v2.Storage/ReadObject");
+  hn += h2::hp_header(hb + hn, ":authority", authority);
+  hn += h2::hp_header(hb + hn, "content-type", "application/grpc");
+  hn += h2::hp_header(hb + hn, "te", "trailers");
+  // Caller metadata ("k: v\r\n" lines — e.g. authorization): h2 requires
+  // lowercase field names, enforced here rather than trusted.
+  for (const char* ph = extra_headers ? extra_headers : ""; *ph;) {
+    const char* eol = strstr(ph, "\r\n");
+    size_t line_len = eol ? static_cast<size_t>(eol - ph) : strlen(ph);
+    const char* colon = static_cast<const char*>(memchr(ph, ':', line_len));
+    if (!colon || colon == ph) return -EINVAL;
+    char nbuf[128];
+    size_t nl = static_cast<size_t>(colon - ph);
+    if (nl >= sizeof nbuf) return -EINVAL;
+    for (size_t i = 0; i < nl; i++)
+      nbuf[i] = static_cast<char>(tolower(static_cast<unsigned char>(ph[i])));
+    nbuf[nl] = 0;
+    const char* v = colon + 1;
+    while (*v == ' ' && v < ph + line_len) v++;
+    char vbuf[4096];
+    size_t vl = static_cast<size_t>(ph + line_len - v);
+    if (vl >= sizeof vbuf) return -EINVAL;
+    memcpy(vbuf, v, vl);
+    vbuf[vl] = 0;
+    hn += h2::hp_header(hb + hn, nbuf, vbuf);
+    ph = eol ? eol + 2 : ph + line_len;
+  }
+  if ((rc = h2::send_frame(c, 1 /*HEADERS*/, 0x4 /*END_HEADERS*/, stream, hb,
+                           static_cast<uint32_t>(hn))) != 0)
+    return rc;
+
+  // DATA: 5-byte gRPC prefix + ReadObjectRequest proto, END_STREAM.
+  uint8_t req[2048];
+  size_t rn = 5;
+  rn += h2::pb_str(req + rn, 1, bucket_path);
+  rn += h2::pb_str(req + rn, 2, object_name);
+  if (read_offset > 0) {
+    req[rn++] = 4 << 3;  // field 4 varint
+    rn += h2::pb_varint(req + rn, static_cast<uint64_t>(read_offset));
+  }
+  if (read_limit > 0) {
+    req[rn++] = 5 << 3;  // field 5 varint
+    rn += h2::pb_varint(req + rn, static_cast<uint64_t>(read_limit));
+  }
+  req[0] = 0;  // uncompressed
+  h2::put32(req + 1, static_cast<uint32_t>(rn - 5));
+  if ((rc = h2::send_frame(c, 0 /*DATA*/, 0x1 /*END_STREAM*/, stream, req,
+                           static_cast<uint32_t>(rn))) != 0)
+    return rc;
+
+  // Receive loop: reassemble gRPC messages from DATA frames, extract
+  // content bytes, answer PING/SETTINGS, top up flow-control windows.
+  int64_t out = 0;
+  int64_t first_byte_ns = 0;
+  int grpc_status = -1;
+  int stream_done = 0;
+  int got_headers = 0;
+  // Scratch for one in-flight gRPC message (server chunks at 2 MiB +
+  // proto framing overhead) — owned by the connection, allocated once.
+  size_t scratch_cap = (2u << 20) + 65536;
+  if (!c->scratch) {
+    c->scratch = static_cast<uint8_t*>(malloc(scratch_cap));
+    if (!c->scratch) return -ENOMEM;
+  }
+  uint8_t* scratch = c->scratch;
+  size_t msg_len = 0;    // total length of the current message (0 = none)
+  size_t msg_got = 0;    // bytes of it received so far
+  uint8_t prefix[5];
+  size_t prefix_got = 0;
+  uint64_t unacked = 0;  // consumed DATA bytes not yet returned as window
+
+  while (!stream_done) {
+    uint8_t fh[9];
+    if ((rc = h2::recv_all(c, fh, 9)) != 0) {
+      return rc;
+    }
+    uint32_t flen = (fh[0] << 16) | (fh[1] << 8) | fh[2];
+    uint8_t ftype = fh[3];
+    uint8_t fflags = fh[4];
+    uint32_t fstream = ((fh[5] & 0x7f) << 24) | (fh[6] << 16) | (fh[7] << 8) |
+                       fh[8];
+    if (flen > (16u << 20)) {
+      return TB_EPROTO;
+    }
+    switch (ftype) {
+      case 0: {  // DATA
+        if (fstream != stream) {
+          return TB_EPROTO;
+        }
+        if (first_byte_ns == 0 && flen > 0) first_byte_ns = tb_now_ns();
+        uint32_t left = flen;
+        uint32_t pad = 0;
+        if (fflags & 0x8) {  // PADDED
+          uint8_t pl;
+          if ((rc = h2::recv_all(c, &pl, 1)) != 0) {
+            return rc;
+          }
+          pad = pl;
+          left -= 1;
+          if (pad + 1 > flen) {
+            return TB_EPROTO;
+          }
+        }
+        uint32_t payload = left - pad;
+        uint32_t done = 0;
+        while (done < payload) {
+          if (msg_len == 0) {
+            // Reading the 5-byte gRPC message prefix.
+            uint8_t b;
+            if ((rc = h2::recv_all(c, &b, 1)) != 0) {
+              return rc;
+            }
+            done += 1;
+            prefix[prefix_got++] = b;
+            if (prefix_got == 5) {
+              if (prefix[0] != 0) {  // compressed: unsupported
+                return TB_EPROTO;
+              }
+              msg_len = (static_cast<size_t>(prefix[1]) << 24) |
+                        (prefix[2] << 16) | (prefix[3] << 8) | prefix[4];
+              msg_got = 0;
+              prefix_got = 0;
+              if (msg_len > scratch_cap) {
+                return TB_ETOOBIG;
+              }
+              // msg_len == 0 (empty message) needs nothing: the next
+              // iteration reads a fresh prefix.
+            }
+            continue;
+          }
+          uint32_t want = payload - done;
+          size_t need = msg_len - msg_got;
+          if (want > need) want = static_cast<uint32_t>(need);
+          if ((rc = h2::recv_all(c, scratch + msg_got, want)) != 0) {
+            return rc;
+          }
+          msg_got += want;
+          done += want;
+          if (msg_got == msg_len) {
+            int64_t k = h2::pb_extract_content(
+                scratch, msg_len, static_cast<uint8_t*>(buf) + out,
+                buf_len - out);
+            if (k < 0) {
+              return k;
+            }
+            out += k;
+            msg_len = 0;
+            msg_got = 0;
+          }
+        }
+        if (pad) {
+          uint8_t sink[256];
+          uint32_t left_pad = pad;
+          while (left_pad) {
+            uint32_t w = left_pad > sizeof sink ? sizeof sink : left_pad;
+            if ((rc = h2::recv_all(c, sink, w)) != 0) {
+              return rc;
+            }
+            left_pad -= w;
+          }
+        }
+        unacked += flen;
+        if (unacked >= (1u << 20)) {
+          uint8_t wu[4];
+          h2::put32(wu, static_cast<uint32_t>(unacked));
+          h2::send_frame(c, 8, 0, 0, wu, 4);
+          h2::send_frame(c, 8, 0, stream, wu, 4);
+          unacked = 0;
+        }
+        if (fflags & 0x1) stream_done = 1;  // END_STREAM
+        break;
+      }
+      case 1: {  // HEADERS (response headers or trailers)
+        if (!(fflags & 0x4)) {  // no END_HEADERS → CONTINUATION (unsupported)
+          return TB_EPROTO;
+        }
+        uint8_t* hbuf = static_cast<uint8_t*>(malloc(flen ? flen : 1));
+        if (!hbuf) {
+          return -ENOMEM;
+        }
+        if ((rc = h2::recv_all(c, hbuf, flen)) != 0) {
+          free(hbuf);
+          return rc;
+        }
+        size_t off = 0;
+        uint32_t blen = flen;
+        if (fflags & 0x8) {  // PADDED
+          uint8_t pad = hbuf[0];
+          off = 1;
+          if (pad + 1u > blen) {
+            free(hbuf);
+            return TB_EPROTO;
+          }
+          blen -= 1 + pad;
+        }
+        if (fflags & 0x20) {  // PRIORITY
+          if (blen < 5) {
+            free(hbuf);
+            return TB_EPROTO;
+          }
+          off += 5;
+          blen -= 5;
+        }
+        rc = h2::parse_header_block(hbuf + off, blen, &grpc_status);
+        free(hbuf);
+        if (rc != 0) {
+          return rc;
+        }
+        got_headers = 1;
+        if (fflags & 0x1) stream_done = 1;
+        break;
+      }
+      case 3: {  // RST_STREAM
+        return TB_ESHORT;
+      }
+      case 4: {  // SETTINGS
+        if (!(fflags & 0x1)) {  // not an ACK: read, then ACK
+          uint8_t sink[256];
+          uint32_t left = flen;
+          while (left) {
+            uint32_t w = left > sizeof sink ? sizeof sink : left;
+            if ((rc = h2::recv_all(c, sink, w)) != 0) {
+              return rc;
+            }
+            left -= w;
+          }
+          h2::send_frame(c, 4, 0x1, 0, nullptr, 0);
+        }
+        break;
+      }
+      case 6: {  // PING
+        uint8_t pp[8];
+        if (flen != 8) {
+          return TB_EPROTO;
+        }
+        if ((rc = h2::recv_all(c, pp, 8)) != 0) {
+          return rc;
+        }
+        if (!(fflags & 0x1)) h2::send_frame(c, 6, 0x1, 0, pp, 8);
+        break;
+      }
+      case 7: {  // GOAWAY
+        return TB_ESHORT;
+      }
+      default: {  // WINDOW_UPDATE, PRIORITY, PUSH_PROMISE(never), unknown
+        uint8_t sink[256];
+        uint32_t left = flen;
+        while (left) {
+          uint32_t w = left > sizeof sink ? sizeof sink : left;
+          if ((rc = h2::recv_all(c, sink, w)) != 0) {
+            return rc;
+          }
+          left -= w;
+        }
+        break;
+      }
+    }
+  }
+  // Flush any remaining connection-window credit so sequential RPCs on
+  // this connection never slowly drain the shared window.
+  if (unacked > 0) {
+    uint8_t wu[4];
+    h2::put32(wu, static_cast<uint32_t>(unacked));
+    h2::send_frame(c, 8, 0, 0, wu, 4);
+  }
+  if (grpc_status_out) *grpc_status_out = grpc_status;
+  if (msg_len != 0 || prefix_got != 0) return TB_ESHORT;  // truncated message
+  if (!got_headers) return TB_EPROTO;
+  if (grpc_status > 0) return TB_EGRPC;
+  if (first_byte_ns_out) *first_byte_ns_out = first_byte_ns;
+  if (total_ns_out) *total_ns_out = tb_now_ns() - t_start;
+  return out;
 }
 
 }  // extern "C"

@@ -30,6 +30,7 @@ TB_ERESOLVE = -1003
 TB_ESHORT = -1004
 TB_ECHUNKED = -1005
 TB_ETLS = -1006
+TB_EGRPC = -1007
 
 _PROTO_ERRORS = {
     TB_EPROTO: "malformed HTTP response",
@@ -38,6 +39,7 @@ _PROTO_ERRORS = {
     TB_ESHORT: "short response: connection closed early",
     TB_ECHUNKED: "chunked transfer encoding (unsupported by the native receive path)",
     TB_ETLS: "TLS unavailable, handshake failed, or certificate rejected",
+    TB_EGRPC: "RPC finished with a nonzero grpc-status",
 }
 
 # Protocol-shape failures: re-sending the same request to the same server
@@ -223,13 +225,23 @@ class NativeEngine:
         lib.tb_conn_plain.restype = c.c_int64
         lib.tb_conn_plain.argtypes = [c.c_int]
         lib.tb_conn_tls.restype = c.c_int64
-        lib.tb_conn_tls.argtypes = [c.c_int, c.c_char_p, c.c_char_p, c.c_int]
+        lib.tb_conn_tls.argtypes = [
+            c.c_int, c.c_char_p, c.c_char_p, c.c_int, c.c_int,
+        ]
         lib.tb_conn_close.restype = c.c_int
         lib.tb_conn_close.argtypes = [c.c_int64]
         lib.tb_conn_request.restype = c.c_int64
         lib.tb_conn_request.argtypes = [
             c.c_int64, c.c_char_p, c.c_int, c.c_char_p, c.c_char_p,
             c.c_void_p, c.c_int64, c.POINTER(c.c_int),
+            c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.POINTER(c.c_int),
+        ]
+        lib.tb_hpack_scan_status.restype = c.c_int
+        lib.tb_hpack_scan_status.argtypes = [c.c_char_p, c.c_int64]
+        lib.tb_grpc_read.restype = c.c_int64
+        lib.tb_grpc_read.argtypes = [
+            c.c_int64, c.c_char_p, c.c_char_p, c.c_char_p, c.c_char_p,
+            c.c_int64, c.c_int64, c.c_void_p, c.c_int64,
             c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.POINTER(c.c_int),
         ]
         self.lib = lib
@@ -446,17 +458,21 @@ class NativeEngine:
         sni: str = "",
         cafile: str = "",
         insecure: bool = False,
+        alpn_h2: bool = False,
     ) -> int:
         """Open a connection handle for :meth:`conn_request` calls. TLS
         verification: peer cert against ``cafile`` (or the system store)
         plus hostname/IP match on ``sni`` — ``insecure`` skips both (tests
-        against self-signed endpoints)."""
+        against self-signed endpoints). ``alpn_h2`` offers and REQUIRES
+        ALPN h2 (the gRPC path; an HTTP/1.1 fallback would be misparsed
+        as frames)."""
         fd = _check(self.lib.tb_http_connect(host.encode(), port),
                     f"connect {host}:{port}")
         if not tls:
             return _check(self.lib.tb_conn_plain(fd), "conn_plain")
         h = self.lib.tb_conn_tls(
-            fd, (sni or host).encode(), cafile.encode(), 1 if insecure else 0
+            fd, (sni or host).encode(), cafile.encode(),
+            1 if insecure else 0, 1 if alpn_h2 else 0,
         )
         if h <= 0:
             self.lib.tb_http_close(fd)  # handshake failed: fd still ours
@@ -506,6 +522,62 @@ class NativeEngine:
             "first_byte_ns": fb.value,
             "total_ns": total_ns.value,
             "reusable": bool(reusable.value),
+        }
+
+    def hpack_scan_status(self, block: bytes) -> int:
+        """Test hook: structural HPACK parse of one header block; returns
+        the extracted grpc-status (-1 unknown) or raises on a malformed
+        block."""
+        rc = self.lib.tb_hpack_scan_status(block, len(block))
+        if rc <= -1000:  # -1 is the legitimate "status unknown" answer
+            _check(rc, "hpack_scan")
+        return rc
+
+    def grpc_read(
+        self,
+        handle: int,
+        authority: str,
+        bucket_path: str,
+        object_name: str,
+        buf: AlignedBuffer,
+        read_offset: int = 0,
+        read_limit: int = 0,
+        headers: str = "",
+    ) -> dict:
+        """One google.storage.v2.Storage/ReadObject on a connection handle
+        (native h2 client): content bytes land in ``buf``. ``headers`` is
+        extra request metadata as "k: v\\r\\n" lines (e.g. authorization).
+        Sequential RPCs reuse the handle (h2 streams 1, 3, 5, …). On
+        nonzero grpc-status the NativeError carries ``grpc_status``; on
+        any error the caller must :meth:`conn_close` the handle."""
+        fb = ctypes.c_int64(0)
+        total_ns = ctypes.c_int64(0)
+        grpc_status = ctypes.c_int(-1)
+        n = self.lib.tb_grpc_read(
+            handle,
+            authority.encode(),
+            bucket_path.encode(),
+            object_name.encode(),
+            headers.encode(),
+            read_offset,
+            read_limit,
+            buf.address,
+            buf.size,
+            ctypes.byref(fb),
+            ctypes.byref(total_ns),
+            ctypes.byref(grpc_status),
+        )
+        if n < 0:
+            try:
+                _check(n, f"grpc_read {object_name}")
+            except NativeError as e:
+                e.grpc_status = grpc_status.value  # type: ignore[attr-defined]
+                raise
+        return {
+            "length": n,
+            "first_byte_ns": fb.value,
+            "total_ns": total_ns.value,
+            "grpc_status": grpc_status.value,
         }
 
 

@@ -51,6 +51,13 @@ _TRANSIENT_CODES = {
 # is 2 MiB) — mirrored by the fake server.
 MAX_READ_CHUNK = 2 * 1024 * 1024
 
+# grpc-status values (numeric: the native h2 path reports raw ints) whose
+# retry classification mirrors _TRANSIENT_CODES above.
+_TRANSIENT_STATUS_INTS = {4, 8, 10, 13, 14}  # DEADLINE_EXCEEDED,
+# RESOURCE_EXHAUSTED, ABORTED, INTERNAL, UNAVAILABLE (mirrors
+# _TRANSIENT_CODES above: UNKNOWN is NOT transient there either)
+_STATUS_HTTPISH = {5: 404, 11: 416, 14: 503}
+
 
 def _wrap_rpc_error(e: grpc.RpcError, what: str) -> StorageError:
     code = e.code() if hasattr(e, "code") else None
@@ -156,6 +163,62 @@ class GcsGrpcBackend:
         self._rr = itertools.cycle(range(len(self._channels)))
         self._rr_lock = threading.Lock()
         self._stubs = [self._make_stubs(ch) for ch in self._channels]
+        # Native-receive pool (transport.native_receive): engine tb_conn
+        # handles carrying h2 sessions; sequential RPCs reuse a handle.
+        # Shared pool machinery (same discipline as gcs_http's native
+        # path), lazily built on first use.
+        self._native_pool_obj = None
+        self._native_pool_lock = threading.Lock()
+        self._native_tokens = None
+        self._stat_cache: dict[str, int] = {}
+        self._stat_cache_lock = threading.Lock()
+
+    # ------------------------------------------------------- native pool --
+    def _native_pool(self):
+        with self._native_pool_lock:
+            if self._native_pool_obj is None:
+                from tpubench.storage.native_pool import build_native_pool
+
+                if self.transport.directpath and not (
+                    self.transport.endpoint or ""
+                ).startswith("insecure://"):
+                    # The native h2 client dials the endpoint directly; the
+                    # google-c2p resolver never runs. Same no-silent-no-op
+                    # rule as the Python channel path.
+                    import warnings
+
+                    warnings.warn(
+                        "native_receive bypasses DirectPath: the native h2 "
+                        "client connects straight to the endpoint (public "
+                        "path); transport.directpath does not apply",
+                        stacklevel=3,
+                    )
+                host, port, tls = self._native_endpoint()
+                self._native_pool_obj = build_native_pool(
+                    self.transport, host, port, tls=tls, alpn_h2=tls
+                )
+        return self._native_pool_obj
+
+    def _native_auth_headers(self) -> str:
+        """Authorization metadata for the native h2 client — same token
+        sources as the HTTP path (ADC / key file; anonymous for non-Google
+        endpoints, so hermetic runs send no header)."""
+        from tpubench.storage.auth import make_token_source
+
+        if self._native_tokens is None:
+            self._native_tokens = make_token_source(
+                self.transport.key_file, self.transport.endpoint
+            )
+        tok = self._native_tokens.token()
+        return f"authorization: Bearer {tok}\r\n" if tok else ""
+
+    @property
+    def _native_idle(self) -> list[int]:
+        return self._native_pool().idle
+
+    @property
+    def native_conn_stats(self) -> dict:
+        return self._native_pool().stats
 
     # ----------------------------------------------------------- channel --
     def _make_channel(self) -> grpc.Channel:
@@ -266,8 +329,119 @@ class GcsGrpcBackend:
     def _bucket_path(self) -> str:
         return f"projects/_/buckets/{self.bucket}"
 
+    # ------------------------------------------------------ native path --
+    def _native_endpoint(self) -> tuple[str, int, bool]:
+        """(host, port, tls) for the native h2 client. ``insecure://`` =
+        plaintext h2c prior knowledge (what an insecure gRPC port speaks);
+        anything else handshakes TLS through the engine's conn layer."""
+        ep = self.transport.endpoint or "storage.googleapis.com:443"
+        tls = True
+        if ep.startswith("insecure://"):
+            ep = ep[len("insecure://"):]
+            tls = False
+        host, _, port = ep.partition(":")
+        return host, int(port or 443), tls
+
+    def _open_read_native(self, name: str, start: int, length: Optional[int]):
+        """Native gRPC receive (SURVEY §2.5.1's gRPC half): the engine's
+        hand-rolled h2 client runs the ReadObject RPC and lands content
+        bytes in a pre-registered aligned buffer with a native first-byte
+        stamp. Connection handles pool with the shared
+        :class:`~tpubench.storage.native_pool.NativeConnPool` discipline
+        (h2 streams 1, 3, 5, … per connection; one stale-use retry)."""
+        from tpubench.native.engine import (
+            PERMANENT_CODES,
+            TB_EGRPC,
+            TB_ETOOBIG,
+            NativeError,
+        )
+        from tpubench.storage.gcs_http import _NativeBufReader
+
+        pool = self._native_pool()  # raises when the engine is unavailable
+        engine = pool.engine
+        host, port, _ = self._native_endpoint()
+        if length is None:
+            with self._stat_cache_lock:
+                size = self._stat_cache.get(name)
+            if size is None:
+                size = self.stat(name).size
+                with self._stat_cache_lock:
+                    self._stat_cache[name] = size
+            want = size - start
+        else:
+            want = length
+        buf = engine.alloc(max(4096, want))
+        metadata = self._native_auth_headers()
+
+        def do_request(conn: int) -> dict:
+            with self._tracer.span(
+                "gcs_grpc.read_native", object=name, bucket=self.bucket
+            ) as sp:
+                r = engine.grpc_read(
+                    conn, f"{host}:{port}", self._bucket_path, name, buf,
+                    read_offset=start, read_limit=length or 0,
+                    headers=metadata,
+                )
+                sp.event("first_byte", native_ns=r["first_byte_ns"])
+            return r
+
+        try:
+            # An explicit grpc-status is a server ANSWER, not pool
+            # staleness — never burn a stale retry on it.
+            r = pool.run(
+                do_request,
+                retry_stale=lambda e: getattr(e, "grpc_status", -1) < 0,
+            )
+        except StorageError:
+            buf.free()  # connect failure, already classified
+            raise
+        except NativeError as e:
+            buf.free()
+            with self._stat_cache_lock:
+                self._stat_cache.pop(name, None)
+            st = getattr(e, "grpc_status", -1)
+            if e.code == TB_EGRPC and st >= 0:
+                raise StorageError(
+                    f"native ReadObject {name}: grpc-status {st}",
+                    transient=st in _TRANSIENT_STATUS_INTS,
+                    code=_STATUS_HTTPISH.get(st, 0),
+                ) from e
+            transient = e.code not in PERMANENT_CODES
+            if e.code == TB_ETOOBIG and length is None:
+                # Buffer was sized from the (just-invalidated) stat cache;
+                # the object may have grown — one retry re-stats and
+                # re-sizes, like the HTTP native path.
+                transient = True
+            raise StorageError(
+                f"native ReadObject {name}: {e}", transient=transient
+            ) from e
+        except Exception:
+            buf.free()
+            raise
+        # A short stream with no contradicting grpc-status (trailers may be
+        # huffman-coded, which the structural HPACK parse skips) must never
+        # pass as a short success. Full reads compare against object
+        # metadata; ranged reads can only be checked when a cached stat
+        # bounds the range (a range past EOF legitimately returns less).
+        expected = want
+        if length is not None:
+            with self._stat_cache_lock:
+                size = self._stat_cache.get(name)
+            expected = min(want, max(0, size - start)) if size is not None else 0
+        if r["grpc_status"] != 0 and r["length"] < expected:
+            buf.free()
+            with self._stat_cache_lock:
+                self._stat_cache.pop(name, None)
+            raise StorageError(
+                f"native ReadObject {name}: short stream "
+                f"({r['length']} of {expected} bytes)", transient=True
+            )
+        return _NativeBufReader(buf, r["length"], r["first_byte_ns"])
+
     # ----------------------------------------------------------- backend --
     def open_read(self, name: str, start: int = 0, length: Optional[int] = None):
+        if self.transport.native_receive:
+            return self._open_read_native(name, start, length)
         req = s2.ReadObjectRequest(
             bucket=self._bucket_path,
             object_=name,
@@ -348,6 +522,8 @@ class GcsGrpcBackend:
         if self._owns_channels:
             for ch in self._channels:
                 ch.close()
+        if self._native_pool_obj is not None:
+            self._native_pool_obj.close()
 
 
 def _empty_deserializer(b: bytes):

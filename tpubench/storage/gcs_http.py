@@ -246,10 +246,30 @@ class GcsHttpBackend:
         self._stat_cache_lock = threading.Lock()
         # Keep-alive pool for the native receive path (same connection
         # discipline as the Python client's pool, so A/Bs isolate the
-        # receive loop): idle fds, capped like the Python pool.
-        self._native_idle: list[int] = []
-        self._native_lock = threading.Lock()
-        self.native_conn_stats = {"connects": 0, "reuses": 0, "stale_retries": 0}
+        # receive loop): shared pool machinery, lazily built on first use
+        # (locked: worker threads hit first use concurrently).
+        self._native_pool_obj = None
+        self._native_pool_lock = threading.Lock()
+
+    # ------------------------------------------------------- native pool --
+    def _native_pool(self):
+        with self._native_pool_lock:
+            if self._native_pool_obj is None:
+                from tpubench.storage.native_pool import build_native_pool
+
+                self._native_pool_obj = build_native_pool(
+                    self.transport, self._host, self._port,
+                    tls=self._scheme == "https",
+                )
+        return self._native_pool_obj
+
+    @property
+    def _native_idle(self) -> list[int]:
+        return self._native_pool().idle
+
+    @property
+    def native_conn_stats(self) -> dict:
+        return self._native_pool().stats
 
     # ------------------------------------------------------------ request --
     def _headers(self) -> dict[str, str]:
@@ -343,22 +363,10 @@ class GcsHttpBackend:
             PERMANENT_CODES,
             TB_ETOOBIG,
             NativeError,
-            get_engine,
         )
 
-        engine = get_engine()
-        if engine is None:
-            raise StorageError(
-                "transport.native_receive=True but the native engine is "
-                "unavailable (C++ toolchain missing?)", transient=False
-            )
-        use_tls = self._scheme == "https"
-        if use_tls and not engine.tls_available():
-            raise StorageError(
-                "transport.native_receive on an https endpoint, but the "
-                "engine could not load OpenSSL (libssl.so.3)",
-                transient=False,
-            )
+        pool = self._native_pool()  # raises when engine/TLS unavailable
+        engine = pool.engine
         if length is None:
             # Size the receive buffer from object metadata, cached per name
             # (one extra stat on the first read of each object).
@@ -393,90 +401,46 @@ class GcsHttpBackend:
         # fails on first use — standard HTTP-client behavior is one
         # immediate retransmit of the idempotent GET on a FRESH socket, so
         # pool staleness never surfaces as a request failure.
-        def _connect() -> int:
-            # Connect (+ TLS handshake on https) — failures here are
-            # network/trust conditions, classified on the engine's code
-            # ABI (handshake/verification = TB_ETLS, permanent).
-            try:
-                h = engine.connect(
-                    self._host, self._port, tls=use_tls, sni=self._host,
-                    cafile=self.transport.tls_ca_file,
-                    insecure=self.transport.tls_insecure_skip_verify,
+        def do_request(conn: int) -> dict:
+            # One span per attempt: a stale-pool retry shows as a failed
+            # span followed by the successful one.
+            with self._tracer.span(
+                "gcs_http.get_native", object=name, bucket=self.bucket
+            ) as sp:
+                r = engine.conn_request(
+                    conn, self._host, self._port,
+                    self._opath(name) + "?alt=media", buf, headers=headers,
                 )
-            except NativeError as e:
-                buf.free()
-                raise StorageError(
-                    f"native GET {name}: {e}",
-                    transient=e.code not in PERMANENT_CODES,
-                ) from e
-            with self._native_lock:
-                self.native_conn_stats["connects"] += 1
-            return h
+                sp.event("first_byte", native_ns=r["first_byte_ns"])
+            return r
 
-        with self._native_lock:
-            conn = self._native_idle.pop() if self._native_idle else 0
-            if conn:
-                self.native_conn_stats["reuses"] += 1
-        reused = bool(conn)
-        if not reused:
-            conn = _connect()
-        while True:
-            try:
-                # The native GET is complete on return, so one span covers
-                # the whole request; the first-byte event carries the
-                # C++-side CLOCK_MONOTONIC stamp.
-                with self._tracer.span(
-                    "gcs_http.get_native", object=name, bucket=self.bucket
-                ) as sp:
-                    r = engine.conn_request(
-                        conn, self._host, self._port,
-                        self._opath(name) + "?alt=media", buf, headers=headers,
-                    )
-                    sp.event("first_byte", native_ns=r["first_byte_ns"])
-                put_back = False
-                if r["reusable"]:
-                    with self._native_lock:
-                        if len(self._native_idle) < self.transport.max_idle_conns_per_host:
-                            self._native_idle.append(conn)
-                            put_back = True
-                if not put_back:
-                    engine.conn_close(conn)
-                break
-            except NativeError as e:
-                engine.conn_close(conn)  # stream state unknown after failure
-                if reused:
-                    # First use of a pooled connection failed: retry once
-                    # on a fresh socket before classifying anything — the
-                    # failure may be pool staleness, not the request.
-                    reused = False
-                    with self._native_lock:
-                        self.native_conn_stats["stale_retries"] += 1
-                    conn = _connect()
-                    continue
-                # Module contract: this layer raises classified
-                # StorageErrors. Classification is on the engine's
-                # error-code ABI (engine.cc TB_* enum), not message text:
-                # socket-level failures (resets, refusals, timeouts, short
-                # bodies) are transient and retried under policy;
-                # protocol-shape errors (malformed response, chunked
-                # encoding, body too big for the buffer) reproduce on retry
-                # and are not. Exception: body-exceeds-buffer when the
-                # buffer was sized from the (just-invalidated) stat cache —
-                # the object may have grown, and one retry re-stats and
-                # re-sizes.
-                buf.free()
-                with self._stat_cache_lock:
-                    self._stat_cache.pop(name, None)  # size may be stale
-                transient = e.code not in PERMANENT_CODES
-                if e.code == TB_ETOOBIG and length is None:
-                    transient = True
-                raise StorageError(
-                    f"native GET {name}: {e}", transient=transient
-                ) from e
-            except Exception:
-                engine.conn_close(conn)
-                buf.free()
-                raise
+        try:
+            r = pool.run(do_request, reusable=lambda r: r["reusable"])
+        except StorageError:
+            buf.free()  # connect/handshake failure, already classified
+            raise
+        except NativeError as e:
+            # Module contract: this layer raises classified StorageErrors.
+            # Classification is on the engine's error-code ABI (engine.cc
+            # TB_* enum), not message text: socket-level failures (resets,
+            # refusals, timeouts, short bodies) are transient and retried
+            # under policy; protocol-shape errors (malformed response,
+            # chunked encoding, body too big for the buffer) reproduce on
+            # retry and are not. Exception: body-exceeds-buffer when the
+            # buffer was sized from the (just-invalidated) stat cache — the
+            # object may have grown, and one retry re-stats and re-sizes.
+            buf.free()
+            with self._stat_cache_lock:
+                self._stat_cache.pop(name, None)  # size may be stale
+            transient = e.code not in PERMANENT_CODES
+            if e.code == TB_ETOOBIG and length is None:
+                transient = True
+            raise StorageError(
+                f"native GET {name}: {e}", transient=transient
+            ) from e
+        except Exception:
+            buf.free()
+            raise
         if r["status"] not in (200, 206):
             buf.free()
             raise StorageError(
@@ -535,12 +499,5 @@ class GcsHttpBackend:
 
     def close(self) -> None:
         self._pool.close()
-        with self._native_lock:
-            conns, self._native_idle = self._native_idle, []
-        if conns:
-            from tpubench.native.engine import get_engine
-
-            engine = get_engine()
-            if engine is not None:
-                for h in conns:
-                    engine.conn_close(h)
+        if self._native_pool_obj is not None:
+            self._native_pool_obj.close()

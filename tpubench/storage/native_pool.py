@@ -1,0 +1,148 @@
+"""Shared pool of native connection handles (engine ``tb_conn``).
+
+Both native receive paths — HTTP (:mod:`gcs_http`) and gRPC/h2
+(:mod:`gcs_grpc`) — pool engine connection handles with identical
+discipline:
+
+* bounded idle pool (``max_idle_conns_per_host``, main.go:32 analog);
+* ``connects`` / ``reuses`` / ``stale_retries`` accounting under the pool
+  lock;
+* one immediate retransmit on a fresh connection when the FIRST use of a
+  pooled handle fails (a socket that died while idle is a normal pool
+  condition, not a request failure — standard HTTP-client behavior).
+
+This module is that discipline, written once. The backends supply the
+protocol-specific parts: how to connect, how to run one request, whether a
+result leaves the connection reusable, and which errors prove the server
+actually answered (those must NOT be retried as staleness).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from tpubench.native.engine import NativeError
+from tpubench.storage.base import StorageError
+
+
+def build_native_pool(
+    transport, host: str, port: int, tls: bool, alpn_h2: bool = False
+) -> "NativeConnPool":
+    """The one way both backends construct their native pool: engine
+    availability and TLS loadability checks, then a connect closure that
+    classifies failures on the engine's code ABI. Callers guard the lazy
+    single assignment with their own lock (worker threads hit first use
+    concurrently)."""
+    from tpubench.native.engine import PERMANENT_CODES, get_engine
+
+    engine = get_engine()
+    if engine is None:
+        raise StorageError(
+            "transport.native_receive=True but the native engine is "
+            "unavailable (C++ toolchain missing?)", transient=False
+        )
+    if tls and not engine.tls_available():
+        raise StorageError(
+            "transport.native_receive on a TLS endpoint, but the engine "
+            "could not load OpenSSL (libssl.so.3)", transient=False
+        )
+
+    def connect() -> int:
+        try:
+            return engine.connect(
+                host, port, tls=tls, sni=host,
+                cafile=transport.tls_ca_file,
+                insecure=transport.tls_insecure_skip_verify,
+                alpn_h2=alpn_h2,
+            )
+        except NativeError as e:
+            # Connect/handshake failures classify on the code ABI
+            # (handshake/verification = TB_ETLS, permanent).
+            raise StorageError(
+                f"native connect {host}:{port}: {e}",
+                transient=e.code not in PERMANENT_CODES,
+            ) from e
+
+    return NativeConnPool(engine, connect, transport.max_idle_conns_per_host)
+
+
+class NativeConnPool:
+    """Pool of engine connection handles with one stale-use retry.
+
+    ``connect`` returns a fresh handle; it must raise for itself (the pool
+    adds no classification). Its failures propagate unchanged.
+    """
+
+    def __init__(self, engine, connect: Callable[[], int], max_idle: int):
+        self.engine = engine
+        self._connect = connect
+        self._idle: list[int] = []
+        self._lock = threading.Lock()
+        self._max_idle = max_idle
+        self.stats = {"connects": 0, "reuses": 0, "stale_retries": 0}
+
+    # Tests reach into the idle list to inject dead handles.
+    @property
+    def idle(self) -> list[int]:
+        return self._idle
+
+    def _new(self) -> int:
+        h = self._connect()
+        with self._lock:
+            self.stats["connects"] += 1
+        return h
+
+    def run(
+        self,
+        request: Callable[[int], dict],
+        reusable: Callable[[dict], bool] = lambda r: True,
+        retry_stale: Callable[[NativeError], bool] = lambda e: True,
+    ) -> dict:
+        """Run one request on a pooled (or fresh) handle.
+
+        On success the handle returns to the idle pool when ``reusable(r)``
+        and the pool has room. On :class:`NativeError` the handle is closed
+        (stream state unknown); if this was the first use of a POOLED
+        handle and ``retry_stale(e)`` holds, the request retries once on a
+        fresh connection before the error propagates — ``retry_stale``
+        exists so errors that prove the server answered (an explicit
+        grpc-status) are never misread as pool staleness.
+        """
+        with self._lock:
+            conn = self._idle.pop() if self._idle else 0
+            if conn:
+                self.stats["reuses"] += 1
+        reused = bool(conn)
+        if not reused:
+            conn = self._new()
+        while True:
+            try:
+                r = request(conn)
+            except NativeError as e:
+                self.engine.conn_close(conn)
+                if reused and retry_stale(e):
+                    reused = False
+                    with self._lock:
+                        self.stats["stale_retries"] += 1
+                    conn = self._new()
+                    continue
+                raise
+            except Exception:
+                self.engine.conn_close(conn)
+                raise
+            put_back = False
+            if reusable(r):
+                with self._lock:
+                    if len(self._idle) < self._max_idle:
+                        self._idle.append(conn)
+                        put_back = True
+            if not put_back:
+                self.engine.conn_close(conn)
+            return r
+
+    def close(self) -> None:
+        with self._lock:
+            conns, self._idle = self._idle, []
+        for h in conns:
+            self.engine.conn_close(h)
