@@ -259,3 +259,22 @@ def test_results_bucket_rejected_for_non_object_store(tmp_path):
     p.write_text("{}")
     with pytest.raises(ValueError, match="object-store protocol"):
         upload_result(cfg, str(p))
+
+
+def test_probe_subcommand(tmp_path, jax_cpu_devices):
+    """tpubench probe: transfer-physics characterization runs and reports
+    the full structure (size sweep, cycle samples, shaping verdict)."""
+    rc = main([
+        "probe", "--cycles", "2", "--cycle-sleep", "0.01",
+        "--results-dir", str(tmp_path),
+    ])
+    assert rc == 0
+    files = list(tmp_path.glob("probe_*.json"))
+    assert len(files) == 1
+    r = json.loads(files[0].read_text())
+    x = r["extra"]
+    assert set(x["size_sweep_gbps"]) == {"2MB", "8MB", "16MB", "32MB"}
+    assert len(x["cycle_samples_gbps"]) == 2
+    assert x["peak_gbps"] >= x["median_gbps"] >= x["floor_gbps"] > 0
+    assert isinstance(x["shaped"], bool)
+    assert x["slow_start"]["post_ramp_gbps"] > 0

@@ -384,6 +384,11 @@ def main(argv=None) -> int:
     gb = add("gather-bench", "ICI all-gather bandwidth vs mesh size")
     gb.add_argument("--shard-mb", type=float, default=4.0)
     gb.add_argument("--reps", type=int, default=5)
+    probe = add("probe", "host→HBM transfer-physics probe (fixed cost, "
+                         "size sweep, burst/floor shaping, slow start)")
+    probe.add_argument("--cycles", type=int, default=8,
+                       help="identical measure cycles for burst/floor detection")
+    probe.add_argument("--cycle-sleep", type=float, default=2.0)
     fs = {
         "read-fs": "sequential FS read (read_operation)",
         "write": "durable write (write_operations)",
@@ -487,6 +492,10 @@ def main(argv=None) -> int:
             res = run_gather_bench(
                 cfg, shard_mb=args.shard_mb, reps=args.reps, ring=args.ring
             )
+        elif args.cmd == "probe":
+            from tpubench.workloads.probe import run_probe
+
+            res = run_probe(cfg, cycles=args.cycles, sleep_s=args.cycle_sleep)
         else:  # pragma: no cover
             raise SystemExit(f"unknown cmd {args.cmd}")
     if cfg.obs.profile_dir:

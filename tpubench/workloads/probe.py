@@ -1,0 +1,134 @@
+"""Host→HBM transfer-physics probe (``tpubench probe``).
+
+No reference analog — this exists because benchmark numbers on shared or
+shaped transfer paths are uninterpretable without the path's physics. One
+command characterizes the device transfer tunnel:
+
+* **per-transfer fixed cost** — small (2 MB) vs mid (8 MB) sync transfer
+  rates separate per-call overhead from streaming bandwidth (why the
+  staging pipeline aggregates granules into slots);
+* **size sweep** — sync ``device_put`` bandwidth at several transfer
+  sizes, all measured in positionally identical cycles;
+* **burst/floor detection** — N identical ramp→measure→sleep cycles of
+  one fixed size; on a shaped tunnel the samples are bimodal (a fast
+  state for the first few hundred MB after idle, then a hard floor), so
+  the probe reports every sample plus peak/median/floor;
+* **slow-start** — the first transfer after an idle gap vs after a ramp.
+
+The output JSON is exactly the evidence ``bench.py``'s measurement
+protocol is built on (frontload key measurements into the granted fast
+window; medians across cycles are shaping noise, not config signal).
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+
+import numpy as np
+
+from tpubench.config import MB, BenchConfig
+from tpubench.metrics.report import RunResult
+
+
+def _mk(size: int) -> np.ndarray:
+    rng = np.random.default_rng(seed=size)
+    return rng.integers(0, 255, size=(size // 128, 128), dtype=np.uint8)
+
+
+def _put_rate(dev, arr: np.ndarray, reps: int) -> float:
+    import jax
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        jax.device_put(arr, dev).block_until_ready()
+    dt = time.perf_counter() - t0
+    return reps * arr.nbytes / 1e9 / dt if dt > 0 else 0.0
+
+
+def run_probe(cfg: BenchConfig, cycles: int = 8, sleep_s: float = 2.0) -> RunResult:
+    import jax
+
+    dev = jax.local_devices()[0]
+    warm = _mk(8 * MB)
+
+    def ramp(n: int = 3) -> None:
+        for _ in range(n):
+            jax.device_put(warm, dev).block_until_ready()
+
+    t_start = time.perf_counter()
+    total = 0
+
+    # Slow-start: first put after this process's idle (nothing sent yet)
+    # vs after a ramp.
+    cold_first = _put_rate(dev, warm, 1)
+    ramp(3)
+    warm_first = _put_rate(dev, warm, 1)
+    total += 5 * warm.nbytes
+
+    # Size sweep in positionally identical cycles (ramp → measure),
+    # run back-to-back inside whatever fast window remains.
+    sweep: dict[str, float] = {}
+    for size_mb, reps in ((2, 8), (8, 4), (16, 2), (32, 1)):
+        arr = _mk(size_mb * MB)
+        ramp(1)
+        sweep[f"{size_mb}MB"] = round(_put_rate(dev, arr, reps), 4)
+        total += warm.nbytes + reps * arr.nbytes
+
+    # Burst/floor: identical ramp → measure → sleep cycles of one fixed
+    # shape. Bimodal samples = external shaping; flat samples = a real
+    # sustained ceiling.
+    arr = _mk(16 * MB)
+    samples: list[float] = []
+    for i in range(max(1, cycles)):
+        if i:
+            time.sleep(sleep_s)  # idle gap between cycles, none after last
+        ramp(2)
+        samples.append(round(_put_rate(dev, arr, 2), 4))
+        total += 2 * warm.nbytes + 2 * arr.nbytes
+    wall = time.perf_counter() - t_start
+
+    peak = max(samples)
+    floor = min(samples)
+    med = statistics.median(samples)
+    # Shaped = large spread AND the slow state persists (median near the
+    # floor): a single transient stall depresses one sample but not the
+    # median, so it does not flip the verdict.
+    shaped = peak > 3 * floor and med < peak / 2
+    fixed_cost_ratio = (
+        sweep["8MB"] / sweep["2MB"] if sweep.get("2MB") else 0.0
+    )
+
+    res = RunResult(
+        workload="probe",
+        config=cfg.to_dict(),
+        bytes_total=total,
+        wall_seconds=wall,
+        gbps=peak,
+        gbps_per_chip=peak,  # one device under probe
+        n_chips=1,
+        summaries={},
+    )
+    res.extra.update(
+        {
+            "device": str(dev),
+            "slow_start": {
+                "cold_first_gbps": round(cold_first, 4),
+                "post_ramp_gbps": round(warm_first, 4),
+            },
+            "size_sweep_gbps": sweep,
+            "fixed_cost_speedup_8MB_over_2MB": round(fixed_cost_ratio, 3),
+            "cycle_samples_gbps": samples,
+            "peak_gbps": round(peak, 4),
+            "median_gbps": round(med, 4),
+            "floor_gbps": round(floor, 4),
+            "shaped": shaped,
+            "note": (
+                "shaped=True means peak > 3x floor across identical "
+                "cycles: the transfer path grants a fast window then "
+                "shapes to a floor — report peaks with the floor "
+                "disclosed, and never average across cycles."
+            ),
+        }
+    )
+    return res
