@@ -67,3 +67,65 @@ def test_single_device_labelled(monkeypatch, jax_cpu_devices):
     assert res.extra["single_device"] is True
     assert res.n_chips == 1
     assert res.errors == 0
+
+
+def test_reduce_scatter_mode(jax_cpu_devices):
+    from tpubench.config import BenchConfig
+    from tpubench.workloads.gather_bench import run_gather_bench
+
+    cfg = BenchConfig()
+    res = run_gather_bench(cfg, shard_mb=0.5, reps=2, collective="reduce_scatter")
+    assert res.extra["mode"] == "reduce_scatter"
+    rows = res.extra["scaling"]
+    assert [r["devices"] for r in rows] == [2, 4, 8]
+    for r in rows:
+        n, s = r["devices"], r["shard_bytes"]
+        assert r["ici_bytes_moved"] == s * (n - 1)
+    assert res.gbps > 0
+    # headline self-consistency invariant holds for every mode
+    assert abs(res.gbps - (res.bytes_total / 1e9) / res.wall_seconds) < 1e-9
+
+
+def test_psum_mode(jax_cpu_devices):
+    from tpubench.config import BenchConfig
+    from tpubench.workloads.gather_bench import run_gather_bench
+
+    cfg = BenchConfig()
+    res = run_gather_bench(cfg, shard_mb=0.5, reps=2, collective="psum")
+    assert res.extra["mode"] == "psum"
+    for r in res.extra["scaling"]:
+        n, s = r["devices"], r["shard_bytes"]
+        assert r["ici_bytes_moved"] == 2 * s * (n - 1)
+
+
+def test_reduce_scatter_correctness(jax_cpu_devices):
+    """The reduce_scatter actually sums: scatter of n identical one-blocks
+    yields n per element (mod 256)."""
+    import numpy as np
+
+    import jax
+
+    from tpubench.dist.reassemble import (
+        make_mesh,
+        make_reduce_scatter,
+        shard_to_device_array,
+    )
+
+    mesh = make_mesh(jax.devices()[:4])
+    lane = 128
+    shards = [np.ones(4 * lane, dtype=np.uint8) for _ in range(4)]
+    arr = shard_to_device_array(shards, mesh, "pod", lane)
+    out = make_reduce_scatter(mesh, "pod")(arr)
+    host = np.asarray(jax.device_get(out))
+    assert host.shape == (4, 1, lane)
+    assert (host == 4).all()
+
+
+def test_bad_collective_rejected(jax_cpu_devices):
+    import pytest
+
+    from tpubench.config import BenchConfig
+    from tpubench.workloads.gather_bench import run_gather_bench
+
+    with pytest.raises(ValueError):
+        run_gather_bench(BenchConfig(), collective="alltoall")

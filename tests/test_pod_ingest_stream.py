@@ -151,3 +151,110 @@ def test_stream_failure_domain_holes(jax_cpu_devices):
         - int(data0[sh.start : sh.start + sh.length].astype(np.uint32).sum())
     ) % (1 << 32)
     assert res.extra["object_checksums"][0] == expect0
+
+
+def test_stream_resume_skips_delivered_objects(jax_cpu_devices, tmp_path):
+    """Checkpoint/resume (SURVEY §5.4): an interrupted 4-object stream
+    whose snapshot says 2 objects were delivered resumes at object 2 —
+    only the remaining objects move bytes, and the result reports the
+    resume accounting."""
+    cfg = _cfg()
+    backend = FakeBackend.prepopulated(cfg.workload.object_name_prefix, 2, 120_000)
+    path = str(tmp_path / "snap.json")
+    # "Interrupted" first run: 2 of the eventual 4 stream positions.
+    first = run_pod_ingest_stream(
+        cfg, n_objects=2, backend=backend, snapshot_path=path
+    )
+    assert first.bytes_total == 2 * 120_000
+    resumed = run_pod_ingest_stream(
+        cfg, n_objects=4, backend=backend, snapshot_path=path,
+        resume_from=path,
+    )
+    assert resumed.errors == 0
+    assert resumed.bytes_total == 2 * 120_000  # objects 2 and 3 only
+    r = resumed.extra["resume"]
+    assert r["objects_skipped"] == 2
+    assert r["prior_bytes"] == 2 * 120_000
+    assert r["prior_found"] is True
+    assert resumed.extra["objects_this_run"] == 2
+    # The snapshot now reflects the full stream: a second resume would
+    # have nothing to do.
+    with open(path) as f:
+        snap = json.load(f)
+    assert snap["objects_done"] == 4
+
+
+def test_stream_resume_nothing_left(jax_cpu_devices, tmp_path):
+    cfg = _cfg()
+    backend = FakeBackend.prepopulated(cfg.workload.object_name_prefix, 2, 120_000)
+    path = str(tmp_path / "snap.json")
+    run_pod_ingest_stream(cfg, n_objects=2, backend=backend, snapshot_path=path)
+    res = run_pod_ingest_stream(
+        cfg, n_objects=2, backend=backend, resume_from=path
+    )
+    assert res.bytes_total == 0
+    assert res.extra["resume"]["objects_skipped"] == 2
+    assert res.extra["objects_this_run"] == 0
+
+
+def test_stream_resume_missing_snapshot_starts_fresh(jax_cpu_devices, tmp_path):
+    cfg = _cfg()
+    backend = FakeBackend.prepopulated(cfg.workload.object_name_prefix, 2, 120_000)
+    res = run_pod_ingest_stream(
+        cfg, n_objects=2, backend=backend,
+        resume_from=str(tmp_path / "nope.json"),
+    )
+    assert res.bytes_total == 2 * 120_000
+    assert res.extra["resume"]["objects_skipped"] == 0
+    assert res.extra["resume"]["prior_found"] is False
+
+
+def test_stream_resume_point_blocked_by_holes(jax_cpu_devices, tmp_path):
+    """An object delivered WITH holes must stay re-fetchable: the
+    snapshot's resume_point freezes at the degraded object even though
+    objects_done (monitoring) keeps counting, and a resume re-fetches it
+    cleanly."""
+    from tpubench.dist.shard import ShardTable
+    from tpubench.storage.base import StorageError
+
+    cfg = _cfg(size=120_000, workers=2)
+    cfg.workload.abort_on_error = False
+    inner = FakeBackend.prepopulated(cfg.workload.object_name_prefix, 2, 120_000)
+    table = ShardTable.build(120_000, 8, align=128)
+    fail_start = table.shard(3).start
+    prefix = cfg.workload.object_name_prefix
+
+    class FailShardOfObject1:
+        def __init__(self):
+            self.fired = False
+
+        def open_read(self, name, start=0, length=None):
+            # Object index 1 maps to name <prefix>1 (k % workers).
+            if name == f"{prefix}1" and start == fail_start and not self.fired:
+                self.fired = True
+                raise StorageError("injected", transient=False)
+            return inner.open_read(name, start=start, length=length)
+
+        def __getattr__(self, attr):
+            return getattr(inner, attr)
+
+    path = str(tmp_path / "snap.json")
+    first = run_pod_ingest_stream(
+        cfg, n_objects=3, backend=FailShardOfObject1(), snapshot_path=path
+    )
+    assert first.errors == 1
+    with open(path) as f:
+        snap = json.load(f)
+    assert snap["objects_done"] == 3  # monitoring counts everything
+    assert snap["resume_point"] == 1  # frozen at the degraded object
+    # Resume: objects 1 and 2 re-fetched (the failure injector fired once),
+    # delivering the previously-holed bytes.
+    resumed = run_pod_ingest_stream(
+        cfg, n_objects=3, backend=inner, snapshot_path=path, resume_from=path
+    )
+    assert resumed.errors == 0
+    assert resumed.extra["resume"]["objects_skipped"] == 1
+    assert resumed.bytes_total == 2 * 120_000
+    with open(path) as f:
+        snap = json.load(f)
+    assert snap["resume_point"] == 3

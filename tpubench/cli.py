@@ -381,9 +381,17 @@ def main(argv=None) -> int:
     stream = add("stream", "pipelined multi-object pod ingest (fetch ∥ stage+gather)")
     stream.add_argument("--objects", type=int, default=8)
     stream.add_argument("--snapshot", help="periodic progress snapshot JSON path")
-    gb = add("gather-bench", "ICI all-gather bandwidth vs mesh size")
+    stream.add_argument("--resume-from",
+                        help="resume a stream from a prior run's snapshot "
+                             "JSON: already-delivered objects are skipped")
+    gb = add("gather-bench", "ICI collective bandwidth vs mesh size")
     gb.add_argument("--shard-mb", type=float, default=4.0)
     gb.add_argument("--reps", type=int, default=5)
+    gb.add_argument("--collective",
+                    choices=("all_gather", "ring", "reduce_scatter", "psum"),
+                    default="",
+                    help="which collective to benchmark (default "
+                         "all_gather; --ring is shorthand for ring)")
     probe = add("probe", "host→HBM transfer-physics probe (fixed cost, "
                          "size sweep, burst/floor shaping, slow start)")
     probe.add_argument("--cycles", type=int, default=8,
@@ -471,6 +479,7 @@ def main(argv=None) -> int:
             res = run_pod_ingest_stream(
                 cfg, n_objects=args.objects, verify=args.validate,
                 snapshot_path=args.snapshot,
+                resume_from=getattr(args, "resume_from", None),
             )
         elif args.cmd in ("read-fs", "write", "list", "open", "ssd"):
             from tpubench.workloads import fsbench
@@ -490,7 +499,8 @@ def main(argv=None) -> int:
             from tpubench.workloads.gather_bench import run_gather_bench
 
             res = run_gather_bench(
-                cfg, shard_mb=args.shard_mb, reps=args.reps, ring=args.ring
+                cfg, shard_mb=args.shard_mb, reps=args.reps, ring=args.ring,
+                collective=args.collective,
             )
         elif args.cmd == "probe":
             from tpubench.workloads.probe import run_probe

@@ -136,6 +136,49 @@ def make_ring_reassemble(mesh: Mesh, axis: str = "pod"):
     return fn
 
 
+def make_reduce_scatter(mesh: Mesh, axis: str = "pod"):
+    """jitted reduce-scatter (``psum_scatter``): every chip contributes its
+    (rows, lane) block; the summed array is left sharded 1/n per chip —
+    the other half of the collective surface (§5.8 names psum/all_gather/
+    ppermute/reduce_scatter). uint8 wrap-add keeps the wire payload at one
+    byte per element so bandwidth accounting stays honest; ``rows`` must
+    divide by the mesh size (the bench rounds shards accordingly)."""
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(axis, None, None),
+        out_specs=P(axis, None, None),
+        check_vma=False,
+    )
+    def fn(local):  # (1, rows, lane) per chip
+        out = jax.lax.psum_scatter(
+            local[0], axis, scatter_dimension=0, tiled=True
+        )  # (rows/n, lane)
+        return out[None]
+
+    return fn
+
+
+def make_allreduce(mesh: Mesh, axis: str = "pod"):
+    """jitted all-reduce (``psum``) of each chip's (rows, lane) block —
+    replicated sum everywhere (uint8 wrap-add, see make_reduce_scatter)."""
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(axis, None, None),
+        out_specs=P(axis, None, None),
+        check_vma=False,
+    )
+    def fn(local):
+        return jax.lax.psum(local[0], axis)[None]
+
+    return fn
+
+
 def gathered_to_bytes(gathered: jax.Array, object_size: int) -> bytes:
     """Trim the padded gather back to the true object bytes (host-side)."""
     flat = np.asarray(jax.device_get(gathered)).reshape(-1)

@@ -1,16 +1,22 @@
-"""ICI collective micro-benchmark: all-gather bandwidth vs mesh size.
+"""ICI collective micro-benchmark: bandwidth vs mesh size, per collective.
 
 The reference has no inter-worker communication to measure; its closest
 transport benchmark is the gRPC/DirectPath client path (SURVEY §5.8). The
-TPU-native framework's transport IS the ICI collective, so it gets its own
-benchmark: for each device count n (powers of two up to the host's chips),
-shard a buffer over an n-chip 1-D mesh and time the jitted all-gather (XLA
-lowering and, optionally, the explicit ppermute ring), reporting effective
-per-chip collective bandwidth.
+TPU-native framework's transport IS the XLA collective set, so each gets
+its own benchmark mode: for every device count n (powers of two up to the
+host's chips), shard a buffer over an n-chip 1-D mesh and time the jitted
+collective, reporting effective per-chip bandwidth.
 
-Bandwidth definition: one all-gather moves ``shard_bytes × n × (n-1)`` bytes
-over ICI in total (each chip receives the other n-1 shards); per-chip
-receive bandwidth is ``shard_bytes × (n-1) / t``.
+Modes and their ICI byte accounting (ring-schedule algebra; per collective
+invocation):
+
+* ``all_gather`` (and ``ring``, the explicit ppermute ring) — each chip
+  receives the other n-1 shards: per-chip ``shard × (n-1)``, total
+  ``shard × n × (n-1)``.
+* ``reduce_scatter`` (``psum_scatter``) — each chip sends/receives
+  ``shard × (n-1)/n``; total ``shard × (n-1)``.
+* ``psum`` (all-reduce) — reduce-scatter + all-gather:
+  per-chip ``2 × shard × (n-1)/n``; total ``2 × shard × (n-1)``.
 """
 
 from __future__ import annotations
@@ -23,8 +29,10 @@ import jax
 
 from tpubench.config import BenchConfig
 from tpubench.dist.reassemble import (
+    make_allreduce,
     make_mesh,
     make_reassemble,
+    make_reduce_scatter,
     make_ring_reassemble,
     shard_to_device_array,
 )
@@ -36,7 +44,11 @@ def run_gather_bench(
     shard_mb: float = 4.0,
     reps: int = 5,
     ring: bool = False,
+    collective: str = "",
 ) -> RunResult:
+    mode = collective or ("ring" if ring else "all_gather")
+    if mode not in ("all_gather", "ring", "reduce_scatter", "psum"):
+        raise ValueError(f"unknown collective {mode!r}")
     lane = cfg.staging.lane
     devices = jax.devices()
     shard_bytes = int(shard_mb * 1024 * 1024) // lane * lane
@@ -47,6 +59,11 @@ def run_gather_bench(
         sizes.append(n)
         n *= 2
     single_device = not sizes
+    # reduce_scatter splits rows across chips: keep rows divisible by the
+    # largest swept mesh size so every sweep point gets a static equal
+    # split (and the byte-accounting // n divisions stay exact).
+    max_n = sizes[-1] if sizes else 1
+    shard_bytes = shard_bytes // (lane * max_n) * (lane * max_n) or lane * max_n
     if single_device:
         # One chip: there is no ICI to exercise — the gather lowers to an
         # identity. Run it anyway (sane CLI behavior on a 1-chip host) and
@@ -61,25 +78,41 @@ def run_gather_bench(
             rng.integers(0, 256, (shard_bytes,), dtype=np.uint8) for _ in range(n)
         ]
         arr = shard_to_device_array(shards, mesh, cfg.dist.mesh_axis, lane)
-        fn = (make_ring_reassemble if ring else make_reassemble)(
-            mesh, cfg.dist.mesh_axis
-        )
-        jax.block_until_ready(fn(arr)[0])  # compile, uncounted
+        make = {
+            "all_gather": make_reassemble,
+            "ring": make_ring_reassemble,
+            "reduce_scatter": make_reduce_scatter,
+            "psum": make_allreduce,
+        }[mode]
+        fn = make(mesh, cfg.dist.mesh_axis)
+        unary = mode in ("reduce_scatter", "psum")  # no checksum output
+        first = fn(arr) if unary else fn(arr)[0]
+        jax.block_until_ready(first)  # compile, uncounted
         t0 = time.perf_counter()
         for _ in range(reps):
-            gathered, _ = fn(arr)
-        jax.block_until_ready(gathered)
-        dt = (time.perf_counter() - t0) / reps  # per-gather mean
-        per_chip_rx = shard_bytes * (n - 1) / dt / 1e9 if dt > 0 else 0.0
+            out = fn(arr) if unary else fn(arr)[0]
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / reps  # per-collective mean
+        # ICI bytes per invocation (module docstring): per-chip and total.
+        if mode in ("all_gather", "ring"):
+            per_chip_bytes = shard_bytes * (n - 1)
+            total_bytes = shard_bytes * n * (n - 1)
+        elif mode == "reduce_scatter":
+            per_chip_bytes = shard_bytes * (n - 1) // n
+            total_bytes = shard_bytes * (n - 1)
+        else:  # psum
+            per_chip_bytes = 2 * shard_bytes * (n - 1) // n
+            total_bytes = 2 * shard_bytes * (n - 1)
+        per_chip_rx = per_chip_bytes / dt / 1e9 if dt > 0 else 0.0
         rows.append(
             {
                 "devices": n,
                 "shard_bytes": shard_bytes,
                 "seconds": dt,
                 "reps": reps,
-                "ici_bytes_moved": shard_bytes * n * (n - 1),  # per gather
+                "ici_bytes_moved": total_bytes,  # per invocation
                 "per_chip_rx_gbps": per_chip_rx,
-                "total_gbps": shard_bytes * n * (n - 1) / dt / 1e9 if dt > 0 else 0.0,
+                "total_gbps": total_bytes / dt / 1e9 if dt > 0 else 0.0,
             }
         )
 
@@ -105,7 +138,7 @@ def run_gather_bench(
     )
     res.extra.update(
         {
-            "mode": "ring" if ring else "all_gather",
+            "mode": mode,
             "scaling": rows,
             "best": best,
             "single_device": single_device,

@@ -14,7 +14,10 @@ Reports per-stage seconds (summed), wall time, and the overlap efficiency
 fetch/device overlap.
 
 Periodic per-host JSON snapshots (SURVEY §5.4) make long streams
-restart-inspectable: each completed object updates the snapshot.
+restartABLE, not just inspectable: ``resume_from`` loads a prior run's
+snapshot and continues at its ``resume_point`` — the count of
+consecutively hole-free objects, so degraded objects are re-fetched
+rather than baked in. Snapshot counters are cumulative across resumes.
 """
 
 from __future__ import annotations
@@ -63,12 +66,19 @@ class StreamedPodIngest:
         n_objects: int,
         verify: bool = False,
         snapshot_path: Optional[str] = None,
+        resume_from: Optional[str] = None,
     ):
         self.cfg = cfg
         self.backend = backend
         self.n_objects = n_objects
         self.verify = verify
         self.snapshot_path = snapshot_path
+        # Resume (SURVEY §5.4 upgraded from restart-inspectable to
+        # restartable): a prior run's snapshot names the objects already
+        # delivered; this run skips them and continues the stream. The
+        # object sequence is deterministic (prefix + k), so "objects_done
+        # = N" identifies exactly the first N stream positions.
+        self.resume_from = resume_from
         self._progress: dict = {"objects_done": 0, "bytes": 0}
 
     def _fetch_local(self, plan: _ObjectPlan, buffers: list[np.ndarray], local_idx):
@@ -105,6 +115,36 @@ class StreamedPodIngest:
             plans.append(_ObjectPlan(name, size, ShardTable.build(size, n, align=lane)))
         shard_bytes = max(p.table.shard_bytes for p in plans)
 
+        start_k = 0
+        prior: Optional[dict] = None
+        prior_bytes = 0
+        prior_done = 0
+        if self.resume_from:
+            import json as _json
+            import os as _os
+
+            if _os.path.exists(self.resume_from):
+                with open(self.resume_from) as f:
+                    prior = _json.load(f)
+                # resume_point = consecutively COMPLETE objects from stream
+                # start (objects delivered with holes do not advance it, so
+                # a resume re-fetches them instead of baking the holes in).
+                prior_done = int(
+                    prior.get("resume_point", prior.get("objects_done", 0))
+                )
+                prior_bytes = int(prior.get("bytes", 0))
+                start_k = min(prior_done, self.n_objects)
+        # Snapshot fields are CUMULATIVE across resumes (a chained resume
+        # must see total progress) and never regress below the prior
+        # checkpoint — even when this invocation's n_objects is smaller
+        # than what an earlier run already delivered.
+        resume_point = prior_done if prior_done > start_k else start_k
+        self._progress = {
+            "objects_done": max(start_k, prior_done),
+            "resume_point": resume_point,
+            "bytes": prior_bytes,
+        }
+
         # Two host-buffer sets: fetch into one while the other stages.
         buffer_sets = [
             [np.zeros(shard_bytes, dtype=np.uint8) for _ in local_idx] for _ in range(2)
@@ -116,14 +156,15 @@ class StreamedPodIngest:
         # mask the fetch∥device overlap the efficiency metric reports.
         # Objects of other sizes still compile (once per shape) in-loop.
         compiled_shapes = set()
-        rows0 = plans[0].table.shard_bytes // lane
-        warm = shard_to_device_array(
-            [b[: rows0 * lane] for b in buffer_sets[0]], mesh,
-            self.cfg.dist.mesh_axis, lane,
-        )
-        jax.block_until_ready(reassemble(warm))
-        compiled_shapes.add(warm.shape)
-        del warm
+        if start_k < self.n_objects:
+            rows0 = plans[start_k].table.shard_bytes // lane
+            warm = shard_to_device_array(
+                [b[: rows0 * lane] for b in buffer_sets[0]], mesh,
+                self.cfg.dist.mesh_axis, lane,
+            )
+            jax.block_until_ready(reassemble(warm))
+            compiled_shapes.add(warm.shape)
+            del warm
 
         fetch_s = stage_s = gather_s = 0.0
         total_bytes = 0
@@ -174,8 +215,12 @@ class StreamedPodIngest:
                     holes = self._fetch_local(plans[k], buffer_sets[k % 2], local_idx)
                 return time.perf_counter() - t0, holes
 
-            pending = pool.submit(timed_fetch, 0)
-            for k in range(self.n_objects):
+            pending = (
+                pool.submit(timed_fetch, start_k)
+                if start_k < self.n_objects
+                else None
+            )
+            for k in range(start_k, self.n_objects):
                 dt, holes = pending.result()  # object k's shards are on host
                 fetch_s += dt
                 # Pod-wide totals (collective over DCN when multi-host —
@@ -209,6 +254,10 @@ class StreamedPodIngest:
                 # Delivered bytes only: holes moved nothing (see pod_ingest);
                 # pod-wide totals so another host's failure counts here too.
                 total_bytes += plan.size - ghole["bytes"]
+                # The resume point advances only over consecutively
+                # hole-free objects: a degraded object stays re-fetchable.
+                if resume_point == k and not ghole["shards"]:
+                    resume_point = k + 1
                 if self.verify and jax.process_count() == 1:
                     # On-device checksum of the gathered pod array, exposed
                     # per object so callers can compare against the TRUE
@@ -220,8 +269,9 @@ class StreamedPodIngest:
                     host = sum(int(s.astype(np.uint32).sum()) for s in shards)
                     checks_ok = checks_ok and dev_sum == host % (1 << 32)
                 self._progress = {
-                    "objects_done": k + 1,
-                    "bytes": total_bytes,
+                    "objects_done": max(k + 1, prior_done),
+                    "resume_point": resume_point,
+                    "bytes": prior_bytes + total_bytes,
                     "fetch_seconds": fetch_s,
                     "stage_seconds": stage_s,
                     "gather_seconds": gather_s,
@@ -247,9 +297,17 @@ class StreamedPodIngest:
             errors=sum(v["global"]["shards"] for v in object_holes.values())
             + (0 if checks_ok else 1),
         )
+        if self.resume_from:
+            res.extra["resume"] = {
+                "from": self.resume_from,
+                "objects_skipped": start_k,
+                "prior_bytes": prior_bytes,  # cumulative across prior runs
+                "prior_found": prior is not None,
+            }
         res.extra.update(
             {
                 "objects": self.n_objects,
+                "objects_this_run": self.n_objects - start_k,
                 "fetch_seconds": fetch_s,
                 "stage_seconds": stage_s,
                 "gather_seconds": gather_s,
@@ -273,12 +331,14 @@ def run_pod_ingest_stream(
     backend: Optional[StorageBackend] = None,
     verify: bool = False,
     snapshot_path: Optional[str] = None,
+    resume_from: Optional[str] = None,
 ) -> RunResult:
     owns = backend is None
     backend = backend or open_backend(cfg)
     try:
         return StreamedPodIngest(
-            cfg, backend, n_objects, verify=verify, snapshot_path=snapshot_path
+            cfg, backend, n_objects, verify=verify,
+            snapshot_path=snapshot_path, resume_from=resume_from,
         ).run()
     finally:
         if owns:
