@@ -151,12 +151,23 @@ def main() -> int:
     # Phase 1: inside the fast window, no sleeps (idle re-triggers slow
     # start), no ramps beyond the warmup above; runs kept small (64 MB)
     # so several fit in whatever budget the shaper granted, and the best
-    # config gets two shots at it.
-    staged["sync_s8_w2"].append(_staged_run(staged_cfgs["sync_s8_w2"]))
-    tunnel.append(_tunnel_run(48, 16))
-    staged["sync_s8_w2"].append(_staged_run(staged_cfgs["sync_s8_w2"]))
-    staged["sync_s16_w2"].append(_staged_run(staged_cfgs["sync_s16_w2"]))
-    host.append(_host_ram_run(96, 2))
+    # config gets two shots at it. If the whole phase lands on the
+    # shaping floor (prior traffic had drained the budget), wait one
+    # refill window and try once more — bounded, and the honest samples
+    # from both attempts are all reported.
+    def _phase1() -> float:
+        staged["sync_s8_w2"].append(_staged_run(staged_cfgs["sync_s8_w2"]))
+        tunnel.append(_tunnel_run(48, 16))
+        staged["sync_s8_w2"].append(_staged_run(staged_cfgs["sync_s8_w2"]))
+        staged["sync_s16_w2"].append(_staged_run(staged_cfgs["sync_s16_w2"]))
+        host.append(_host_ram_run(96, 2))
+        return max(staged["sync_s8_w2"])
+
+    if _phase1() < 0.5:  # all samples at the ~0.2 GB/s floor
+        time.sleep(45)
+        for _ in range(3):
+            jax.device_put(warm, dev).block_until_ready()
+        _phase1()
 
     # Phase 2: floor documentation — identical spaced cycles.
     def _ramp():

@@ -17,7 +17,9 @@ Periodic per-host JSON snapshots (SURVEY §5.4) make long streams
 restartABLE, not just inspectable: ``resume_from`` loads a prior run's
 snapshot and continues at its ``resume_point`` — the count of
 consecutively hole-free objects, so degraded objects are re-fetched
-rather than baked in. Snapshot counters are cumulative across resumes.
+rather than baked in. Snapshot counters never regress across resumes;
+``bytes`` counts complete objects only (partial deliveries live in each
+run's result, not the checkpoint), so re-fetches never double-count.
 """
 
 from __future__ import annotations
@@ -119,6 +121,7 @@ class StreamedPodIngest:
         prior: Optional[dict] = None
         prior_bytes = 0
         prior_done = 0
+        prior_resume = 0
         if self.resume_from:
             import json as _json
             import os as _os
@@ -129,20 +132,38 @@ class StreamedPodIngest:
                 # resume_point = consecutively COMPLETE objects from stream
                 # start (objects delivered with holes do not advance it, so
                 # a resume re-fetches them instead of baking the holes in).
-                prior_done = int(
+                # The monitoring floor comes from the prior objects_done
+                # separately — a holed run has objects_done > resume_point
+                # and neither may regress.
+                prior_resume = int(
                     prior.get("resume_point", prior.get("objects_done", 0))
                 )
-                prior_bytes = int(prior.get("bytes", 0))
-                start_k = min(prior_done, self.n_objects)
-        # Snapshot fields are CUMULATIVE across resumes (a chained resume
-        # must see total progress) and never regress below the prior
-        # checkpoint — even when this invocation's n_objects is smaller
-        # than what an earlier run already delivered.
-        resume_point = prior_done if prior_done > start_k else start_k
+                prior_done = int(prior.get("objects_done", prior_resume))
+                start_k = min(prior_resume, self.n_objects)
+        resume_point = max(
+            prior_resume, start_k
+        )  # > n_objects when a prior run got further
+        # Snapshot "bytes" counts COMPLETE objects only (exactly the ones a
+        # resume will not re-fetch): monotonic, recomputable from the
+        # deterministic plan sizes, and immune to double counting when a
+        # holed object is re-fetched. Partial deliveries show up in each
+        # run's RunResult.bytes_total, not in the checkpoint.
+        size_prefix = [0]
+        for p in plans:
+            size_prefix.append(size_prefix[-1] + p.size)
+
+        def complete_bytes() -> int:
+            if prior is not None and resume_point > self.n_objects:
+                # A prior run completed more of the stream than this
+                # invocation can see; its own accounting stands.
+                return prior_bytes
+            return size_prefix[min(resume_point, self.n_objects)]
+
+        prior_bytes = int(prior.get("bytes", 0)) if prior else 0
         self._progress = {
             "objects_done": max(start_k, prior_done),
             "resume_point": resume_point,
-            "bytes": prior_bytes,
+            "bytes": complete_bytes(),
         }
 
         # Two host-buffer sets: fetch into one while the other stages.
@@ -271,7 +292,7 @@ class StreamedPodIngest:
                 self._progress = {
                     "objects_done": max(k + 1, prior_done),
                     "resume_point": resume_point,
-                    "bytes": prior_bytes + total_bytes,
+                    "bytes": complete_bytes(),
                     "fetch_seconds": fetch_s,
                     "stage_seconds": stage_s,
                     "gather_seconds": gather_s,

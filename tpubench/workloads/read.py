@@ -179,6 +179,13 @@ class ReadWorkload:
         )
         if session is not None:
             res.extra["metrics_export"] = session.summary()
+        # Native-receive connection accounting (connects/reuses/
+        # stale_retries) — read from the pool only if one was actually
+        # built, so this never constructs a pool as a side effect.
+        inner = getattr(self.backend, "inner", self.backend)
+        native_pool = getattr(inner, "_native_pool_obj", None)
+        if native_pool is not None:
+            res.extra["native_conn_stats"] = dict(native_pool.stats)
         if staged:
             res.extra["staging_zero_copy"] = all(zero_copy_used)
             res.extra["staged_bytes"] = staged
