@@ -379,3 +379,46 @@ def test_native_hpack_huffman_status_decoded():
     # unrelated huffman headers parse structurally, status stays unknown
     block = b"\x10" + hstr(b"grpc-message") + hstr(b"boom") + b"\x88"
     assert eng.hpack_scan_status(block) == -1
+
+
+@pytestmark_native
+def test_native_grpc_over_tls_alpn(jax_cpu_devices):
+    """The native h2 client over TLS against a REAL grpc server speaking
+    ALPN: handshake offers and requires h2, cert verified against the
+    server's self-signed PEM, bytes match. The Python secure channel
+    (stat for buffer sizing) trusts the same CA file."""
+    be = FakeBackend.prepopulated("bench/file_", count=2, size=1_000_000)
+    with FakeGcsGrpcServer(be, tls=True) as srv:
+        t = TransportConfig(
+            protocol="grpc", endpoint=srv.endpoint, directpath=False,
+            native_receive=True, tls_ca_file=srv.cafile,
+        )
+        c = GcsGrpcBackend(bucket="testbucket", transport=t)
+        expected = deterministic_bytes("bench/file_0", 1_000_000).tobytes()
+        r = c.open_read("bench/file_0")  # stat rides the secure channel
+        out = bytearray(1_000_000)
+        mv = memoryview(out)
+        got = 0
+        while got < len(out):
+            n = r.readinto(mv[got:])
+            if n == 0:
+                break
+            got += n
+        r.close()
+        assert got == 1_000_000 and bytes(out) == expected
+        c.close()
+
+
+@pytestmark_native
+def test_native_grpc_tls_untrusted_cert_rejected(jax_cpu_devices):
+    be = FakeBackend.prepopulated("bench/file_", count=1, size=100_000)
+    with FakeGcsGrpcServer(be, tls=True) as srv:
+        t = TransportConfig(
+            protocol="grpc", endpoint=srv.endpoint, directpath=False,
+            native_receive=True,  # no CA file: verification must fail
+        )
+        c = GcsGrpcBackend(bucket="testbucket", transport=t)
+        with pytest.raises(StorageError) as ei:
+            c.open_read("bench/file_0", length=1024)
+        assert ei.value.transient is False
+        c.close()

@@ -157,20 +157,47 @@ def _service(backend: FakeBackend) -> grpc.GenericRpcHandler:
 
 
 class FakeGcsGrpcServer:
-    """Threaded fake storage-v2 server; ``endpoint`` is insecure://host:port."""
+    """Threaded fake storage-v2 server.
 
-    def __init__(self, backend: Optional[FakeBackend] = None, port: int = 0):
+    ``endpoint`` is ``insecure://host:port`` (h2c) by default; ``tls=True``
+    serves over TLS with an ephemeral self-signed certificate (grpcio
+    negotiates ALPN h2) so TLS gRPC client paths — the secure channel and
+    the engine's native h2 client — test hermetically; ``cafile`` then
+    points at the PEM to trust.
+    """
+
+    def __init__(
+        self,
+        backend: Optional[FakeBackend] = None,
+        port: int = 0,
+        tls: bool = False,
+    ):
         self.backend = backend or FakeBackend()
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=16),
             options=[("grpc.max_send_message_length", 16 * 1024 * 1024)],
         )
         self._server.add_generic_rpc_handlers((_service(self.backend),))
-        self._port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+        self._tls = tls
+        self.cafile = ""
+        if tls:
+            from tpubench.storage.fake_server import make_self_signed_cert
+
+            self.cafile, keyfile = make_self_signed_cert()
+            with open(keyfile, "rb") as f:
+                key = f.read()
+            with open(self.cafile, "rb") as f:
+                cert = f.read()
+            creds = grpc.ssl_server_credentials([(key, cert)])
+            self._port = self._server.add_secure_port(f"127.0.0.1:{port}", creds)
+        else:
+            self._port = self._server.add_insecure_port(f"127.0.0.1:{port}")
         self._started = threading.Event()
 
     @property
     def endpoint(self) -> str:
+        if self._tls:
+            return f"127.0.0.1:{self._port}"  # no scheme = TLS (like real GCS)
         return f"insecure://127.0.0.1:{self._port}"
 
     def start(self) -> "FakeGcsGrpcServer":

@@ -242,7 +242,13 @@ class GcsGrpcBackend:
             )
         if endpoint.startswith("insecure://"):
             return grpc.insecure_channel(endpoint[len("insecure://"):], opts)
-        creds = grpc.ssl_channel_credentials()
+        root = None
+        if self.transport.tls_ca_file:
+            # Private CA (hermetic TLS test servers) — same knob as the
+            # HTTP pool and the native conn layer.
+            with open(self.transport.tls_ca_file, "rb") as f:
+                root = f.read()
+        creds = grpc.ssl_channel_credentials(root_certificates=root)
         if "googleapis.com" in endpoint:
             creds = grpc.composite_channel_credentials(
                 creds, self._call_credentials()

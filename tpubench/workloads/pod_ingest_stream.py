@@ -117,17 +117,28 @@ class StreamedPodIngest:
             plans.append(_ObjectPlan(name, size, ShardTable.build(size, n, align=lane)))
         shard_bytes = max(p.table.shard_bytes for p in plans)
 
+        # Multi-host: each process owns its snapshot/resume file (process 0
+        # keeps the bare path, so single-host usage is unchanged) — two
+        # hosts must never race on one checkpoint file.
+        def _host_path(path: Optional[str]) -> Optional[str]:
+            if not path or jax.process_count() == 1 or pid == 0:
+                return path
+            return f"{path}.p{pid}"
+
+        snapshot_path = _host_path(self.snapshot_path)
+        resume_path = _host_path(self.resume_from)
+
         start_k = 0
         prior: Optional[dict] = None
         prior_bytes = 0
         prior_done = 0
         prior_resume = 0
-        if self.resume_from:
+        if resume_path:
             import json as _json
             import os as _os
 
-            if _os.path.exists(self.resume_from):
-                with open(self.resume_from) as f:
+            if _os.path.exists(resume_path):
+                with open(resume_path) as f:
                     prior = _json.load(f)
                 # resume_point = consecutively COMPLETE objects from stream
                 # start (objects delivered with holes do not advance it, so
@@ -139,7 +150,19 @@ class StreamedPodIngest:
                     prior.get("resume_point", prior.get("objects_done", 0))
                 )
                 prior_done = int(prior.get("objects_done", prior_resume))
-                start_k = min(prior_resume, self.n_objects)
+        if jax.process_count() > 1:
+            # Every loop iteration runs pod collectives, so the resume
+            # point must be AGREED pod-wide: per-host snapshots are
+            # written on independent timers and can disagree after a
+            # crash. The pod resumes at the minimum (a host whose
+            # checkpoint is behind — or missing — forces a re-fetch of
+            # the difference; unmatched collectives would hang the pod).
+            from jax.experimental import multihost_utils
+
+            prior_resume = int(
+                np.min(multihost_utils.process_allgather(np.int64(prior_resume)))
+            )
+        start_k = min(prior_resume, self.n_objects)
         resume_point = max(
             prior_resume, start_k
         )  # > n_objects when a prior run got further
@@ -157,7 +180,10 @@ class StreamedPodIngest:
                 # A prior run completed more of the stream than this
                 # invocation can see; its own accounting stands.
                 return prior_bytes
-            return size_prefix[min(resume_point, self.n_objects)]
+            # Floor at the prior checkpoint value: counters must never
+            # regress even when the prior snapshot used a different
+            # accounting (older formats included partial deliveries).
+            return max(prior_bytes, size_prefix[min(resume_point, self.n_objects)])
 
         prior_bytes = int(prior.get("bytes", 0)) if prior else 0
         self._progress = {
@@ -199,8 +225,8 @@ class StreamedPodIngest:
             return dict(self._progress)
 
         snap_ctx = (
-            SnapshotWriter(snapshot, self.snapshot_path, interval_s=5.0, process_index=pid)
-            if self.snapshot_path
+            SnapshotWriter(snapshot, snapshot_path, interval_s=5.0, process_index=pid)
+            if snapshot_path
             else None
         )
 
@@ -320,7 +346,7 @@ class StreamedPodIngest:
         )
         if self.resume_from:
             res.extra["resume"] = {
-                "from": self.resume_from,
+                "from": resume_path,  # the file THIS process read
                 "objects_skipped": start_k,
                 "prior_bytes": prior_bytes,  # cumulative across prior runs
                 "prior_found": prior is not None,
