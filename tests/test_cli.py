@@ -278,3 +278,36 @@ def test_probe_subcommand(tmp_path, jax_cpu_devices):
     assert x["peak_gbps"] >= x["median_gbps"] >= x["floor_gbps"] > 0
     assert isinstance(x["shaped"], bool)
     assert x["slow_start"]["post_ramp_gbps"] > 0
+
+
+def test_cli_sweep_native_ab(tmp_path, capsys):
+    """--sweep-native adds the receive-path axis: each http cell runs the
+    Python client AND the C++ native receive against the same live fake
+    server, so the rows form the A/B the native path exists for."""
+    from tpubench.native.engine import get_engine
+    from tpubench.storage.fake import FakeBackend
+    from tpubench.storage.fake_server import FakeGcsServer
+
+    if get_engine() is None:
+        import pytest
+
+        pytest.skip("native engine unavailable")
+    be = FakeBackend()
+    with FakeGcsServer(be) as srv:
+        # sweep prepares nothing: create the objects the read loop expects.
+        from tpubench.storage.base import deterministic_bytes
+
+        for i in range(2):
+            name = f"bench/file_{i}"
+            be.write(name, deterministic_bytes(name, 256 * 1024).tobytes())
+        rc = main(
+            ["sweep", "--protocol", "http", "--endpoint", srv.endpoint,
+             "--bucket", "testbucket", "--object-name-prefix", "bench/file_",
+             "--sweep-protocols", "http", "--sweep-sizes", "256kb",
+             "--sweep-native", "--workers", "2", "--read-call-per-worker", "2",
+             "--staging", "none", "--results-dir", str(tmp_path)]
+        )
+    assert rc == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert [r.get("native_receive") for r in rows] == [False, True]
+    assert all(r["gbps"] > 0 for r in rows)

@@ -422,3 +422,39 @@ def test_native_grpc_tls_untrusted_cert_rejected(jax_cpu_devices):
             c.open_read("bench/file_0", length=1024)
         assert ei.value.transient is False
         c.close()
+
+
+@pytestmark_native
+def test_native_grpc_concurrent_workers(server):
+    """8 worker threads hammer the native h2 path concurrently: the shared
+    pool, the engine's ctx/huffman singletons, and per-connection h2 state
+    must hold up (engine calls run GIL-free)."""
+    import threading
+
+    c = _native_client(server)
+    errors: list[Exception] = []
+
+    def worker(i: int) -> None:
+        try:
+            for _ in range(4):
+                r = c.open_read(f"bench/file_{i % 3}")
+                out = bytearray(3_000_000)
+                mv = memoryview(out)
+                got = 0
+                while got < len(out):
+                    n = r.readinto(mv[got:])
+                    if n == 0:
+                        break
+                    got += n
+                r.close()
+                assert got == 3_000_000
+        except Exception as e:  # surfaced below
+            errors.append(e)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errors, errors
+    stats = c.native_conn_stats
+    assert stats["connects"] + stats["reuses"] == 8 * 4
+    c.close()

@@ -339,23 +339,34 @@ def cmd_sweep(cfg: BenchConfig, args, topo=None) -> None:
         "1gb": (1024 * MB, 1),
     }
     chosen = args.sweep_sizes.split(",") if args.sweep_sizes else list(sizes)
+    # --sweep-native adds a receive-path axis: every protocol × size cell
+    # runs once through the Python client and once through the C++ engine
+    # (same pooled keep-alive discipline on both, so the A/B isolates the
+    # receive loop — the comparison the native path exists for).
+    native_axis = [False, True] if getattr(args, "sweep_native", False) else [None]
     rows = []
     for proto in protocols:
         for sz in chosen:
-            size, count = sizes[sz]
-            c = BenchConfig.from_dict(cfg.to_dict())
-            c.transport.protocol = proto
-            c.workload.object_size = size
-            c.workload.read_calls_per_worker = min(
-                count, c.workload.read_calls_per_worker
-            )
-            res = cmd_read(c, args)
-            res.extra["sweep"] = {"protocol": proto, "size": sz}
-            path = write_result(res, cfg.obs.results_dir, tag=tag)
-            if cfg.obs.results_bucket:
-                upload_result(cfg, path)
-            rows.append(
-                {
+            for native in native_axis:
+                if native and proto not in ("http", "grpc"):
+                    continue  # no native path for fake/local protocols
+                size, count = sizes[sz]
+                c = BenchConfig.from_dict(cfg.to_dict())
+                c.transport.protocol = proto
+                c.workload.object_size = size
+                c.workload.read_calls_per_worker = min(
+                    count, c.workload.read_calls_per_worker
+                )
+                if native is not None:
+                    c.transport.native_receive = native
+                res = cmd_read(c, args)
+                res.extra["sweep"] = {"protocol": proto, "size": sz}
+                if native is not None:
+                    res.extra["sweep"]["native_receive"] = native
+                path = write_result(res, cfg.obs.results_dir, tag=tag)
+                if cfg.obs.results_bucket:
+                    upload_result(cfg, path)
+                row = {
                     "protocol": proto,
                     "size": sz,
                     "gbps": res.gbps,
@@ -363,7 +374,9 @@ def cmd_sweep(cfg: BenchConfig, args, topo=None) -> None:
                     "p99_ms": res.summaries["read"].p99_ms,
                     "result": path,
                 }
-            )
+                if native is not None:
+                    row["native_receive"] = native
+                rows.append(row)
     print(json.dumps(rows, indent=2))
 
 
@@ -411,6 +424,11 @@ def main(argv=None) -> int:
     sweep = add("sweep", "protocol A/B × size sweep (execute_pb.sh)")
     sweep.add_argument("--sweep-protocols", default="http,grpc")
     sweep.add_argument("--sweep-sizes", default="")
+    sweep.add_argument("--sweep-native", action="store_true",
+                       help="add a receive-path axis: every cell runs with "
+                            "the Python client AND the C++ native receive "
+                            "(same keep-alive discipline; isolates the "
+                            "receive loop)")
     add("info", "print effective config and environment")
 
     args = top.parse_args(argv)
