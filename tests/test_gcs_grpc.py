@@ -451,9 +451,13 @@ def test_native_grpc_concurrent_workers(server):
         except Exception as e:  # surfaced below
             errors.append(e)
 
-    ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    ts = [threading.Thread(target=worker, args=(i,), daemon=True) for i in range(8)]
     [t.start() for t in ts]
-    [t.join() for t in ts]
+    # Bounded join: the deadlock class this test exists to catch must show
+    # up as a red test, not an indefinite pytest hang.
+    for t in ts:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in ts), "worker threads hung"
     assert not errors, errors
     stats = c.native_conn_stats
     assert stats["connects"] + stats["reuses"] == 8 * 4

@@ -150,3 +150,70 @@ def test_cli_multihost_per_host_workload_reports_every_process(tmp_path):
     all_results = sorted(glob.glob(str(tmp_path / "read_*.json")))
     assert len(all_results) == 2, all_results
     assert any("read_p1_" in r for r in all_results), all_results
+
+
+def test_cli_two_process_stream_resume_divergent_snapshots(tmp_path):
+    """Multi-host resume safety: each process reads its own checkpoint
+    file, and when the per-host resume points DISAGREE (independent
+    snapshot timers + a crash), the pod agrees on the minimum — both
+    processes execute identical loop iterations (divergence would leave
+    collectives unmatched and hang the pod)."""
+    import glob
+    import json
+
+    port = _free_port()
+    snap = tmp_path / "snap.json"
+    # Process 0's checkpoint claims 2 complete objects; process 1's only 1.
+    snap.write_text(json.dumps(
+        {"objects_done": 2, "resume_point": 2, "bytes": 200000}))
+    (tmp_path / "snap.json.p1").write_text(json.dumps(
+        {"objects_done": 1, "resume_point": 1, "bytes": 100000}))
+    base_env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    base_env["JAX_PLATFORMS"] = "cpu"
+    base_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get("PYTHONPATH", "")
+    cmd = [
+        sys.executable, "-m", "tpubench.cli", "stream",
+        "--protocol", "fake", "--object-size", "100000", "--objects", "3",
+        "--resume-from", str(snap),
+        "--results-dir", str(tmp_path),
+    ]
+    cmds, envs = [], []
+    cmds.append(cmd + ["--num-processes", "2", "--process-id", "0",
+                       "--coordinator", f"127.0.0.1:{port}"])
+    envs.append(dict(base_env))
+    e1 = dict(base_env)
+    e1.update({
+        "TPUBENCH_NUM_PROCESSES": "2",
+        "TPUBENCH_PROCESS_ID": "1",
+        "TPUBENCH_COORDINATOR": f"127.0.0.1:{port}",
+    })
+    cmds.append(list(cmd))
+    envs.append(e1)
+    procs = [
+        subprocess.Popen(c, cwd=REPO, env=e, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True)
+        for c, e in zip(cmds, envs)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"CLI worker failed:\n{err[-3000:]}"
+    results = glob.glob(str(tmp_path / "pod_ingest_stream_*.json"))
+    assert len(results) == 1  # pod-collective: process 0 only
+    r = json.load(open(results[0]))
+    assert r["errors"] == 0
+    # Pod agreed on min(2, 1) = 1: objects 1 and 2 ran on BOTH processes.
+    assert r["extra"]["resume"]["objects_skipped"] == 1
+    assert r["extra"]["objects_this_run"] == 2
+    assert r["bytes_total"] == 2 * 100000

@@ -344,6 +344,16 @@ def cmd_sweep(cfg: BenchConfig, args, topo=None) -> None:
     # (same pooled keep-alive discipline on both, so the A/B isolates the
     # receive loop — the comparison the native path exists for).
     native_axis = [False, True] if getattr(args, "sweep_native", False) else [None]
+    if native_axis[0] is not None:
+        # Fail in milliseconds, not after the Python-path cells have run
+        # (same fail-at-start rule as the --results-bucket check).
+        from tpubench.native.engine import get_engine
+
+        if get_engine() is None:
+            raise SystemExit(
+                "--sweep-native: the native engine is unavailable "
+                "(C++ toolchain missing?)"
+            )
     rows = []
     for proto in protocols:
         for sz in chosen:

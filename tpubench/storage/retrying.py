@@ -17,7 +17,7 @@ import time
 from typing import Optional
 
 from tpubench.config import RetryConfig
-from tpubench.storage.base import ObjectMeta, StorageBackend, StorageError
+from tpubench.storage.base import ObjectMeta, StorageBackend
 from tpubench.storage.retry import Backoff, _is_retryable, retry_call
 
 
