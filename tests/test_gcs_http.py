@@ -354,8 +354,8 @@ def test_native_receive_connection_killed_mid_body(monkeypatch):
         # The engine's short-body code (TB_ESHORT), not a socket errno,
         # must be the classified cause — codes are the ABI, not wording.
         assert ei.value.__cause__.code == TB_ESHORT
+        c.close()  # failed-path buffers parked in the pool free here
         assert allocated and all(b._ptr == 0 for b in allocated)
-        c.close()
     finally:
         srv.close()
 
@@ -370,8 +370,8 @@ def test_native_receive_body_exceeds_buffer_is_permanent(monkeypatch):
         with pytest.raises(StorageError) as ei:
             c.open_read("bench/file_0", length=100)  # 4096-byte min buffer
         assert ei.value.transient is False
+        c.close()  # failed-path buffers parked in the pool free here
         assert allocated and all(b._ptr == 0 for b in allocated)
-        c.close()
     finally:
         srv.close()
 
@@ -389,9 +389,10 @@ def test_native_receive_connection_refused_is_transient(monkeypatch):
         c.open_read("bench/file_0", length=4096)
     assert ei.value.transient is True
     # The receive buffer is allocated before the connect attempt; the
-    # connect-failure path must free it.
-    assert allocated and all(b._ptr == 0 for b in allocated)
+    # connect-failure path returns it to the backend's buffer pool, and
+    # closing the backend frees the pool — nothing may leak.
     c.close()
+    assert allocated and all(b._ptr == 0 for b in allocated)
 
 
 @pytestmark_native
@@ -405,8 +406,8 @@ def test_native_receive_eof_mid_headers_is_transient(monkeypatch):
             c.open_read("bench/file_0", length=4096)
         assert ei.value.transient is True
         assert ei.value.__cause__.code == TB_ESHORT
+        c.close()  # failed-path buffers parked in the pool free here
         assert allocated and all(b._ptr == 0 for b in allocated)
-        c.close()
     finally:
         srv.close()
 
@@ -450,8 +451,8 @@ def test_native_receive_chunked_rejected(monkeypatch):
             c.open_read("bench/file_0", length=4096)
         assert ei.value.transient is False
         assert ei.value.__cause__.code == TB_ECHUNKED
+        c.close()  # failed-path buffers parked in the pool free here
         assert allocated and all(b._ptr == 0 for b in allocated)
-        c.close()
     finally:
         srv.close()
 
@@ -600,8 +601,8 @@ def test_native_receive_unknown_length_keepalive_errors_not_hangs(monkeypatch):
             c.open_read("bench/file_0", length=4096)
         assert time.monotonic() - t0 < 5.0  # failed fast, no FIN wait
         assert ei.value.transient is False
+        c.close()  # failed-path buffers parked in the pool free here
         assert allocated and all(b._ptr == 0 for b in allocated)
-        c.close()
     finally:
         srv.close()
 

@@ -34,6 +34,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <sys/stat.h>
 #include <sys/types.h>
 #include <unistd.h>
@@ -326,6 +327,14 @@ int tb_http_connect(const char* host, int port) {
   if (fd < 0) return -ECONNREFUSED;
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  // Bounded blocking I/O (the Python pool uses timeout=60 — same here):
+  // a hung peer surfaces as -EAGAIN (classified transient, retried under
+  // policy) instead of stalling a worker thread forever.
+  struct timeval tv;
+  tv.tv_sec = 60;
+  tv.tv_usec = 0;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
   return fd;
 }
 
@@ -587,8 +596,20 @@ int64_t tb_conn_tls(int fd, const char* sni, const char* cafile, int insecure,
       return TB_ETLS;
     }
   }
-  if (tls::SSL_set_fd_(ssl, fd) != 1 || tls::SSL_connect_(ssl) != 1) {
+  if (tls::SSL_set_fd_(ssl, fd) != 1) {
     tls::SSL_free_(ssl);
+    return TB_ETLS;
+  }
+  errno = 0;
+  if (tls::SSL_connect_(ssl) != 1) {
+    // Distinguish network conditions (socket timeout from SO_RCVTIMEO,
+    // reset, interrupt — transient, retried under policy) from
+    // protocol/trust failures (TB_ETLS, permanent: they reproduce).
+    int e = errno;
+    tls::SSL_free_(ssl);
+    if (e == EAGAIN || e == EWOULDBLOCK || e == ETIMEDOUT ||
+        e == ECONNRESET || e == EPIPE || e == EINTR)
+      return -e;
     return TB_ETLS;
   }
   if (alpn_h2) {

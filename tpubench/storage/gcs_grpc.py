@@ -169,6 +169,7 @@ class GcsGrpcBackend:
         # path), lazily built on first use.
         self._native_pool_obj = None
         self._native_pool_lock = threading.Lock()
+        self._native_bufpool = None
         self._native_tokens = None
         self._stat_cache: dict[str, int] = {}
         self._stat_cache_lock = threading.Lock()
@@ -197,6 +198,9 @@ class GcsGrpcBackend:
                 self._native_pool_obj = build_native_pool(
                     self.transport, host, port, tls=tls, alpn_h2=tls
                 )
+                from tpubench.storage.native_pool import BufferPool
+
+                self._native_bufpool = BufferPool(self._native_pool_obj.engine)
         return self._native_pool_obj
 
     def _native_auth_headers(self) -> str:
@@ -376,7 +380,7 @@ class GcsGrpcBackend:
             want = size - start
         else:
             want = length
-        buf = engine.alloc(max(4096, want))
+        buf = self._native_bufpool.acquire(max(4096, want))
         metadata = self._native_auth_headers()
 
         def do_request(conn: int) -> dict:
@@ -399,10 +403,10 @@ class GcsGrpcBackend:
                 retry_stale=lambda e: getattr(e, "grpc_status", -1) < 0,
             )
         except StorageError:
-            buf.free()  # connect failure, already classified
+            self._native_bufpool.release(buf)  # connect failure, classified
             raise
         except NativeError as e:
-            buf.free()
+            self._native_bufpool.release(buf)
             with self._stat_cache_lock:
                 self._stat_cache.pop(name, None)
             st = getattr(e, "grpc_status", -1)
@@ -422,7 +426,7 @@ class GcsGrpcBackend:
                 f"native ReadObject {name}: {e}", transient=transient
             ) from e
         except Exception:
-            buf.free()
+            self._native_bufpool.release(buf)
             raise
         # A short stream with no contradicting grpc-status (trailers may be
         # huffman-coded, which the structural HPACK parse skips) must never
@@ -435,14 +439,17 @@ class GcsGrpcBackend:
                 size = self._stat_cache.get(name)
             expected = min(want, max(0, size - start)) if size is not None else 0
         if r["grpc_status"] != 0 and r["length"] < expected:
-            buf.free()
+            self._native_bufpool.release(buf)
             with self._stat_cache_lock:
                 self._stat_cache.pop(name, None)
             raise StorageError(
                 f"native ReadObject {name}: short stream "
                 f"({r['length']} of {expected} bytes)", transient=True
             )
-        return _NativeBufReader(buf, r["length"], r["first_byte_ns"])
+        return _NativeBufReader(
+            buf, r["length"], r["first_byte_ns"],
+            release=self._native_bufpool.release,
+        )
 
     # ----------------------------------------------------------- backend --
     def open_read(self, name: str, start: int = 0, length: Optional[int] = None):
@@ -530,6 +537,8 @@ class GcsGrpcBackend:
                 ch.close()
         if self._native_pool_obj is not None:
             self._native_pool_obj.close()
+        if self._native_bufpool is not None:
+            self._native_bufpool.close()
 
 
 def _empty_deserializer(b: bytes):

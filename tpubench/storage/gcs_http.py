@@ -187,11 +187,14 @@ class _NativeBufReader:
     ``readinto`` serves granule-sized slices from the buffer.
     """
 
-    def __init__(self, buf, length: int, first_byte_ns: int):
+    def __init__(self, buf, length: int, first_byte_ns: int, release=None):
         self._buf = buf
         self._len = length
         self._pos = 0
         self.first_byte_ns: Optional[int] = first_byte_ns
+        # Buffer disposal: back to the backend's BufferPool when pooled
+        # (a fresh posix_memalign per GET is an mmap storm), else freed.
+        self._release = release
 
     def readinto(self, out: memoryview) -> int:
         n = min(len(out), self._len - self._pos)
@@ -203,7 +206,10 @@ class _NativeBufReader:
 
     def close(self) -> None:
         if self._buf is not None:
-            self._buf.free()
+            if self._release is not None:
+                self._release(self._buf)
+            else:
+                self._buf.free()
             self._buf = None
 
 
@@ -250,17 +256,22 @@ class GcsHttpBackend:
         # (locked: worker threads hit first use concurrently).
         self._native_pool_obj = None
         self._native_pool_lock = threading.Lock()
+        self._native_bufpool = None
 
     # ------------------------------------------------------- native pool --
     def _native_pool(self):
         with self._native_pool_lock:
             if self._native_pool_obj is None:
-                from tpubench.storage.native_pool import build_native_pool
+                from tpubench.storage.native_pool import (
+                    BufferPool,
+                    build_native_pool,
+                )
 
                 self._native_pool_obj = build_native_pool(
                     self.transport, self._host, self._port,
                     tls=self._scheme == "https",
                 )
+                self._native_bufpool = BufferPool(self._native_pool_obj.engine)
         return self._native_pool_obj
 
     @property
@@ -394,7 +405,7 @@ class GcsHttpBackend:
         # Buffer first, socket second: whichever acquisition fails, the
         # other resource is released on that path (no fd leak when a huge
         # alloc fails; no buffer leak when connect fails).
-        buf = engine.alloc(max(4096, want))
+        buf = self._native_bufpool.acquire(max(4096, want))
         # Keep-alive: reuse a pooled native connection when available. A
         # stale pooled socket (server timed it out, or trailing junk from
         # the previous response arrived after the reuse-time drain check)
@@ -417,7 +428,7 @@ class GcsHttpBackend:
         try:
             r = pool.run(do_request, reusable=lambda r: r["reusable"])
         except StorageError:
-            buf.free()  # connect/handshake failure, already classified
+            self._native_bufpool.release(buf)  # connect failure, classified
             raise
         except NativeError as e:
             # Module contract: this layer raises classified StorageErrors.
@@ -429,7 +440,7 @@ class GcsHttpBackend:
             # retry and are not. Exception: body-exceeds-buffer when the
             # buffer was sized from the (just-invalidated) stat cache — the
             # object may have grown, and one retry re-stats and re-sizes.
-            buf.free()
+            self._native_bufpool.release(buf)
             with self._stat_cache_lock:
                 self._stat_cache.pop(name, None)  # size may be stale
             transient = e.code not in PERMANENT_CODES
@@ -439,15 +450,18 @@ class GcsHttpBackend:
                 f"native GET {name}: {e}", transient=transient
             ) from e
         except Exception:
-            buf.free()
+            self._native_bufpool.release(buf)
             raise
         if r["status"] not in (200, 206):
-            buf.free()
+            self._native_bufpool.release(buf)
             raise StorageError(
                 f"GET {name}: HTTP {r['status']}", transient=r["status"] >= 500,
                 code=r["status"],
             )
-        return _NativeBufReader(buf, r["length"], r["first_byte_ns"])
+        return _NativeBufReader(
+            buf, r["length"], r["first_byte_ns"],
+            release=self._native_bufpool.release,
+        )
 
     def write(self, name: str, data: bytes) -> ObjectMeta:
         path = (
@@ -501,3 +515,5 @@ class GcsHttpBackend:
         self._pool.close()
         if self._native_pool_obj is not None:
             self._native_pool_obj.close()
+        if self._native_bufpool is not None:
+            self._native_bufpool.close()
