@@ -463,6 +463,14 @@ def main(argv=None) -> int:
 
     if args.cmd == "info":
         print(cfg.to_json())
+        from tpubench.native.engine import get_engine
+
+        eng = get_engine()
+        caps = {
+            "native_engine": eng is not None,
+            "native_tls": bool(eng and eng.tls_available()),
+        }
+        print(f"capabilities: {caps}", file=sys.stderr)
         try:
             pin_platform()
             import jax

@@ -198,9 +198,7 @@ class GcsGrpcBackend:
                 self._native_pool_obj = build_native_pool(
                     self.transport, host, port, tls=tls, alpn_h2=tls
                 )
-                from tpubench.storage.native_pool import BufferPool
-
-                self._native_bufpool = BufferPool(self._native_pool_obj.engine)
+                self._native_bufpool = self._native_pool_obj.buffers
         return self._native_pool_obj
 
     def _native_auth_headers(self) -> str:
@@ -425,7 +423,9 @@ class GcsGrpcBackend:
             raise StorageError(
                 f"native ReadObject {name}: {e}", transient=transient
             ) from e
-        except Exception:
+        except BaseException:
+            # Includes KeyboardInterrupt: an interrupted in-flight GET must
+            # not strand a multi-MB receive buffer.
             self._native_bufpool.release(buf)
             raise
         # A short stream with no contradicting grpc-status (trailers may be
@@ -536,9 +536,7 @@ class GcsGrpcBackend:
             for ch in self._channels:
                 ch.close()
         if self._native_pool_obj is not None:
-            self._native_pool_obj.close()
-        if self._native_bufpool is not None:
-            self._native_bufpool.close()
+            self._native_pool_obj.close()  # also drains its BufferPool
 
 
 def _empty_deserializer(b: bytes):

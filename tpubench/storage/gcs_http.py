@@ -262,16 +262,13 @@ class GcsHttpBackend:
     def _native_pool(self):
         with self._native_pool_lock:
             if self._native_pool_obj is None:
-                from tpubench.storage.native_pool import (
-                    BufferPool,
-                    build_native_pool,
-                )
+                from tpubench.storage.native_pool import build_native_pool
 
                 self._native_pool_obj = build_native_pool(
                     self.transport, self._host, self._port,
                     tls=self._scheme == "https",
                 )
-                self._native_bufpool = BufferPool(self._native_pool_obj.engine)
+                self._native_bufpool = self._native_pool_obj.buffers
         return self._native_pool_obj
 
     @property
@@ -449,7 +446,9 @@ class GcsHttpBackend:
             raise StorageError(
                 f"native GET {name}: {e}", transient=transient
             ) from e
-        except Exception:
+        except BaseException:
+            # Includes KeyboardInterrupt: an interrupted in-flight GET must
+            # not strand a multi-MB receive buffer.
             self._native_bufpool.release(buf)
             raise
         if r["status"] not in (200, 206):
@@ -514,6 +513,4 @@ class GcsHttpBackend:
     def close(self) -> None:
         self._pool.close()
         if self._native_pool_obj is not None:
-            self._native_pool_obj.close()
-        if self._native_bufpool is not None:
-            self._native_bufpool.close()
+            self._native_pool_obj.close()  # also drains its BufferPool

@@ -64,7 +64,9 @@ def build_native_pool(
                 transient=e.code not in PERMANENT_CODES,
             ) from e
 
-    return NativeConnPool(engine, connect, transport.max_idle_conns_per_host)
+    pool = NativeConnPool(engine, connect, transport.max_idle_conns_per_host)
+    pool.buffers = BufferPool(engine)
+    return pool
 
 
 class BufferPool:
@@ -125,6 +127,9 @@ class NativeConnPool:
         self._lock = threading.Lock()
         self._max_idle = max_idle
         self.stats = {"connects": 0, "reuses": 0, "stale_retries": 0}
+        # The receive BufferPool always accompanies the connection pool;
+        # build_native_pool attaches it so lifecycle wiring lives here.
+        self.buffers: "BufferPool | None" = None
 
     # Tests reach into the idle list to inject dead handles.
     @property
@@ -190,3 +195,5 @@ class NativeConnPool:
             conns, self._idle = self._idle, []
         for h in conns:
             self.engine.conn_close(h)
+        if self.buffers is not None:
+            self.buffers.close()
