@@ -1,0 +1,127 @@
+"""Direct unit tests of the shared native pool module (conn-handle pool
+discipline + receive BufferPool) — backends exercise these end-to-end; here
+the contracts are pinned in isolation with a scripted fake engine."""
+
+import pytest
+
+from tpubench.native.engine import NativeError
+from tpubench.storage.native_pool import BufferPool, NativeConnPool
+
+
+class _FakeBuf:
+    def __init__(self, size):
+        self.size = size
+        self.freed = False
+
+    def free(self):
+        self.freed = True
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.allocs = []
+        self.closed = []
+
+    def alloc(self, size, align=4096):
+        b = _FakeBuf(size)
+        self.allocs.append(b)
+        return b
+
+    def conn_close(self, h):
+        self.closed.append(h)
+
+
+def test_buffer_pool_reuses_exact_size():
+    eng = _FakeEngine()
+    p = BufferPool(eng)
+    a = p.acquire(1024)
+    p.release(a)
+    b = p.acquire(1024)
+    assert b is a  # exact-size bucket hit, no second alloc
+    assert len(eng.allocs) == 1
+    c = p.acquire(2048)  # different size: fresh alloc
+    assert c is not a and len(eng.allocs) == 2
+    p.release(b)
+    p.release(c)
+    p.close()
+    assert a.freed and c.freed
+
+
+def test_buffer_pool_caps_per_size():
+    eng = _FakeEngine()
+    p = BufferPool(eng, max_per_size=2)
+    bufs = [p.acquire(512) for _ in range(4)]
+    for b in bufs:
+        p.release(b)
+    kept = [b for b in bufs if not b.freed]
+    assert len(kept) == 2  # overflow freed immediately
+    p.close()
+    assert all(b.freed for b in bufs)
+
+
+def test_buffer_pool_release_after_close_frees():
+    eng = _FakeEngine()
+    p = BufferPool(eng)
+    straggler = p.acquire(4096)
+    p.close()
+    p.release(straggler)  # reader finishing during shutdown
+    assert straggler.freed  # freed now, never parked in a dead pool
+
+
+def test_conn_pool_stale_retry_once():
+    eng = _FakeEngine()
+    handles = iter([11, 12, 13])
+    pool = NativeConnPool(eng, lambda: next(handles), max_idle=4)
+    pool.idle.append(99)  # stale pooled handle
+
+    calls = []
+
+    def request(h):
+        calls.append(h)
+        if h == 99:
+            raise NativeError("stale", code=-104)
+        return {"ok": True}
+
+    r = pool.run(request)
+    assert r == {"ok": True}
+    assert calls == [99, 11]  # failed pooled use, one fresh retry
+    assert eng.closed == [99]
+    assert pool.stats == {"connects": 1, "reuses": 1, "stale_retries": 1}
+    assert pool.idle == [11]  # success pooled the fresh handle
+
+
+def test_conn_pool_retry_stale_predicate_blocks_server_answers():
+    eng = _FakeEngine()
+    pool = NativeConnPool(eng, lambda: 21, max_idle=4)
+    pool.idle.append(99)
+
+    def request(h):
+        e = NativeError("rpc failed", code=-1007)
+        e.grpc_status = 5
+        raise e
+
+    with pytest.raises(NativeError):
+        pool.run(request, retry_stale=lambda e: getattr(e, "grpc_status", -1) < 0)
+    assert pool.stats["stale_retries"] == 0  # server answered: not staleness
+    assert eng.closed == [99]
+
+
+def test_conn_pool_not_reusable_closes():
+    eng = _FakeEngine()
+    pool = NativeConnPool(eng, lambda: 31, max_idle=4)
+    r = pool.run(lambda h: {"reusable": False}, reusable=lambda r: r["reusable"])
+    assert r == {"reusable": False}
+    assert eng.closed == [31] and pool.idle == []
+
+
+def test_conn_pool_close_drains_buffer_pool():
+    """Backend close() relies on the conn pool draining its BufferPool —
+    pin it so a dropped buffers.close() call can't silently reintroduce
+    the shutdown leak."""
+    eng = _FakeEngine()
+    pool = NativeConnPool(eng, lambda: 41, max_idle=4)
+    buf = pool.buffers.acquire(8192)
+    pool.buffers.release(buf)
+    assert not buf.freed  # parked
+    pool.close()
+    assert buf.freed

@@ -463,13 +463,27 @@ def main(argv=None) -> int:
 
     if args.cmd == "info":
         print(cfg.to_json())
-        from tpubench.native.engine import get_engine
+        # Report engine capabilities WITHOUT triggering the first-use
+        # compile: a read-only diagnostic must not spawn g++ or write the
+        # .so (fresh checkouts, read-only installs, CI config inspection).
+        from tpubench.native.build import library_path
 
-        eng = get_engine()
-        caps = {
-            "native_engine": eng is not None,
-            "native_tls": bool(eng and eng.tls_available()),
-        }
+        lib = library_path()
+        src = os.path.join(os.path.dirname(lib), "engine.cc")
+        lib_fresh = os.path.exists(lib) and (
+            not os.path.exists(src)
+            or os.path.getmtime(lib) >= os.path.getmtime(src)
+        )
+        if lib_fresh:
+            from tpubench.native.engine import get_engine
+
+            eng = get_engine()
+            caps = {
+                "native_engine": eng is not None,
+                "native_tls": bool(eng and eng.tls_available()),
+            }
+        else:
+            caps = {"native_engine": "unbuilt (compiles on first use)"}
         print(f"capabilities: {caps}", file=sys.stderr)
         try:
             pin_platform()

@@ -64,9 +64,7 @@ def build_native_pool(
                 transient=e.code not in PERMANENT_CODES,
             ) from e
 
-    pool = NativeConnPool(engine, connect, transport.max_idle_conns_per_host)
-    pool.buffers = BufferPool(engine)
-    return pool
+    return NativeConnPool(engine, connect, transport.max_idle_conns_per_host)
 
 
 class BufferPool:
@@ -127,9 +125,9 @@ class NativeConnPool:
         self._lock = threading.Lock()
         self._max_idle = max_idle
         self.stats = {"connects": 0, "reuses": 0, "stale_retries": 0}
-        # The receive BufferPool always accompanies the connection pool;
-        # build_native_pool attaches it so lifecycle wiring lives here.
-        self.buffers: "BufferPool | None" = None
+        # The receive BufferPool always accompanies the connection pool
+        # (constructed here, drained by close()) — one lifecycle.
+        self.buffers = BufferPool(engine)
 
     # Tests reach into the idle list to inject dead handles.
     @property
@@ -177,7 +175,9 @@ class NativeConnPool:
                     conn = self._new()
                     continue
                 raise
-            except Exception:
+            except BaseException:
+                # Includes KeyboardInterrupt: an interrupted request must
+                # not strand the native connection either.
                 self.engine.conn_close(conn)
                 raise
             put_back = False
@@ -195,5 +195,4 @@ class NativeConnPool:
             conns, self._idle = self._idle, []
         for h in conns:
             self.engine.conn_close(h)
-        if self.buffers is not None:
-            self.buffers.close()
+        self.buffers.close()
