@@ -1,0 +1,142 @@
+"""Native fetch executor (C++ thread pool + completion queue): the
+reference's errgroup fan-out in native code (tb_pool_*)."""
+
+import urllib.parse
+
+import pytest
+
+from tpubench.config import BenchConfig
+from tpubench.storage.base import deterministic_bytes
+from tpubench.storage.fake import FakeBackend
+from tpubench.storage.fake_server import FakeGcsServer
+
+
+def _native_available() -> bool:
+    from tpubench.native.engine import get_engine
+
+    return get_engine() is not None
+
+
+pytestmark = pytest.mark.skipif(
+    not _native_available(), reason="native engine unavailable"
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    be = FakeBackend.prepopulated("bench/file_", count=4, size=500_000)
+    with FakeGcsServer(be) as srv:
+        yield srv
+
+
+def _hostport(server):
+    host, port = server.endpoint.replace("http://", "").split(":")
+    return host, int(port)
+
+
+def _media_path(name: str) -> str:
+    return (
+        "/storage/v1/b/testbucket/o/"
+        + urllib.parse.quote(name, safe="")
+        + "?alt=media"
+    )
+
+
+def test_pool_fanout_bytes_and_stamps(server):
+    from tpubench.native.engine import get_engine
+
+    eng = get_engine()
+    host, port = _hostport(server)
+    with eng.pool_create(4) as pool:
+        bufs = {}
+        for i in range(12):
+            name = f"bench/file_{i % 4}"
+            buf = eng.alloc(600_000)
+            bufs[i] = (buf, name)
+            pool.submit(host, port, _media_path(name), buf, tag=i)
+        for _ in range(12):
+            c = pool.next(timeout_ms=10_000)
+            assert c is not None
+            assert c["result"] == 500_000 and c["status"] == 200
+            # native stamps: start < first_byte, duration covers it
+            assert c["start_ns"] < c["first_byte_ns"]
+            assert c["first_byte_ns"] - c["start_ns"] <= c["total_ns"]
+            buf, name = bufs[c["tag"]]
+            want = deterministic_bytes(name, 500_000).tobytes()
+            assert bytes(buf.view(500_000)) == want
+        assert pool.next(timeout_ms=0) is None  # drained
+        for buf, _ in bufs.values():
+            buf.free()
+
+
+def test_pool_error_propagates_per_task(server):
+    """A failing task (404) reports its error in the completion; the pool
+    keeps serving other tasks."""
+    from tpubench.native.engine import get_engine
+
+    eng = get_engine()
+    host, port = _hostport(server)
+    with eng.pool_create(2) as pool:
+        good = eng.alloc(600_000)
+        bad = eng.alloc(4096)
+        pool.submit(host, port, _media_path("bench/file_0"), good, tag=1)
+        pool.submit(host, port, _media_path("bench/nope"), bad, tag=2)
+        seen = {}
+        for _ in range(2):
+            c = pool.next(timeout_ms=10_000)
+            seen[c["tag"]] = c
+        assert seen[1]["result"] == 500_000 and seen[1]["status"] == 200
+        assert seen[2]["status"] == 404
+        good.free()
+        bad.free()
+
+
+def test_read_workload_native_executor(server):
+    """run_read with fetch_executor='native': same reference semantics
+    (worker i owns object i, workers × read-calls reads), native fan-out;
+    percentile summaries from native stamps."""
+    from tpubench.workloads.read import run_read
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "http"
+    cfg.transport.endpoint = server.endpoint
+    cfg.workload.bucket = "testbucket"
+    cfg.workload.object_name_prefix = "bench/file_"
+    cfg.workload.workers = 4
+    cfg.workload.read_calls_per_worker = 5
+    cfg.workload.fetch_executor = "native"
+    cfg.staging.mode = "none"
+    res = run_read(cfg)
+    assert res.errors == 0
+    assert res.bytes_total == 4 * 5 * 500_000
+    assert res.extra["fetch_executor"] == "native"
+    assert res.summaries["read"].count == 20
+    assert res.summaries["first_byte"].count == 20
+    assert res.gbps > 0
+
+
+def test_native_executor_rejects_staging(server):
+    from tpubench.workloads.read import run_read
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "http"
+    cfg.transport.endpoint = server.endpoint
+    cfg.workload.bucket = "testbucket"
+    cfg.workload.object_name_prefix = "bench/file_"
+    cfg.workload.fetch_executor = "native"
+    cfg.staging.mode = "device_put"
+    with pytest.raises(ValueError, match="staging"):
+        run_read(cfg)
+
+
+def test_native_executor_rejects_fake_protocol():
+    from tpubench.workloads.read import run_read
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 1
+    cfg.workload.object_size = 1024  # tiny: the backend opens before the gate
+    cfg.workload.fetch_executor = "native"
+    cfg.staging.mode = "none"
+    with pytest.raises(ValueError, match="plain-http"):
+        run_read(cfg)

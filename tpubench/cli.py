@@ -95,6 +95,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--native-receive", action="store_true",
                    help="C++ HTTP receive path into pre-registered buffers "
                         "(pooled keep-alive; http and https endpoints)")
+    p.add_argument("--fetch-executor", choices=("python", "native"),
+                   help="read fan-out runtime: python worker threads, or "
+                        "the C++ fetch executor (pthreads + completion "
+                        "queue; plain-http endpoints, staging none)")
     p.add_argument("--no-direct", action="store_true", help="skip O_DIRECT")
     p.add_argument("--mount-cmd",
                    help="shell template run before FS workloads; {dir} "
@@ -175,6 +179,8 @@ def build_config(args) -> BenchConfig:
         o.results_bucket = args.results_bucket
     if args.no_abort_on_error:
         w.abort_on_error = False
+    if getattr(args, "fetch_executor", None):
+        w.fetch_executor = args.fetch_executor
     if args.fault_error_rate is not None:
         t.fault.error_rate = args.fault_error_rate
     if args.fault_read_error_rate is not None:

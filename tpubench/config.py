@@ -140,6 +140,13 @@ class WorkloadConfig:
     # False = per-worker failure domains; failures become holes in the result
     # (SURVEY §5.3 prescription) instead of a pod-wide abort.
     abort_on_error: bool = True
+    # Fan-out runtime for the read workload: "python" = worker threads
+    # (each GIL-releasing I/O call native); "native" = the C++ fetch
+    # executor (tb_pool_*) — N pthreads with per-thread keep-alive
+    # connections and a completion queue, so the per-request hot path
+    # never enters the interpreter. Native scope: plain-http endpoints,
+    # staging "none".
+    fetch_executor: str = "python"
 
 
 @dataclass

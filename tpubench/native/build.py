@@ -31,6 +31,7 @@ def build_library(force: bool = False) -> str:
             "-std=c++17",
             "-shared",
             "-fPIC",
+            "-pthread",
             "-Wall",
             "-o",
             _LIB + ".tmp",

@@ -238,6 +238,21 @@ class NativeEngine:
         ]
         lib.tb_hpack_scan_status.restype = c.c_int
         lib.tb_hpack_scan_status.argtypes = [c.c_char_p, c.c_int64]
+        lib.tb_pool_create.restype = c.c_int64
+        lib.tb_pool_create.argtypes = [c.c_int, c.c_int]
+        lib.tb_pool_submit.restype = c.c_int
+        lib.tb_pool_submit.argtypes = [
+            c.c_int64, c.c_char_p, c.c_int, c.c_char_p, c.c_char_p,
+            c.c_void_p, c.c_int64, c.c_uint64,
+        ]
+        lib.tb_pool_next.restype = c.c_int
+        lib.tb_pool_next.argtypes = [
+            c.c_int64, c.c_int, c.POINTER(c.c_uint64), c.POINTER(c.c_int64),
+            c.POINTER(c.c_int), c.POINTER(c.c_int64), c.POINTER(c.c_int64),
+            c.POINTER(c.c_int64),
+        ]
+        lib.tb_pool_destroy.restype = c.c_int
+        lib.tb_pool_destroy.argtypes = [c.c_int64]
         lib.tb_grpc_read.restype = c.c_int64
         lib.tb_grpc_read.argtypes = [
             c.c_int64, c.c_char_p, c.c_char_p, c.c_char_p, c.c_char_p,
@@ -533,6 +548,17 @@ class NativeEngine:
             _check(rc, "hpack_scan")
         return rc
 
+    def pool_create(self, threads: int, cap: int = 256) -> "NativeFetchPool":
+        """Native fetch executor (the errgroup analog in C++): ``threads``
+        workers run HTTP GETs into caller buffers over per-thread
+        keep-alive connections; completions drain through
+        :meth:`NativeFetchPool.next`. The per-request hot path never
+        enters the Python interpreter."""
+        h = self.lib.tb_pool_create(threads, cap)
+        if h == 0:
+            raise NativeError("tb_pool_create failed", code=-12)
+        return NativeFetchPool(self, h)
+
     def grpc_read(
         self,
         handle: int,
@@ -579,6 +605,73 @@ class NativeEngine:
             "total_ns": total_ns.value,
             "grpc_status": grpc_status.value,
         }
+
+
+class NativeFetchPool:
+    """Handle over the C++ fetch executor (``tb_pool_*``).
+
+    Contract: a buffer passed to :meth:`submit` is OWNED BY THE POOL until
+    its completion comes back from :meth:`next` (identified by ``tag``).
+    ``close()`` joins the workers after queued tasks finish.
+    """
+
+    def __init__(self, engine: NativeEngine, handle: int):
+        self._engine = engine
+        self._h = handle
+
+    def submit(
+        self,
+        host: str,
+        port: int,
+        path: str,
+        buf,
+        headers: str = "",
+        tag: int = 0,
+    ) -> None:
+        rc = self._engine.lib.tb_pool_submit(
+            self._h, host.encode(), port, path.encode(), headers.encode(),
+            buf.address, buf.size, tag,
+        )
+        if rc != 0:
+            _check(rc, "pool_submit")
+
+    def next(self, timeout_ms: int = -1) -> Optional[dict]:
+        """One completion, or None on timeout. ``result`` < 0 is the
+        engine error code for that task (the pool keeps running)."""
+        tag = ctypes.c_uint64(0)
+        result = ctypes.c_int64(0)
+        status = ctypes.c_int(0)
+        fb = ctypes.c_int64(0)
+        total = ctypes.c_int64(0)
+        start = ctypes.c_int64(0)
+        rc = self._engine.lib.tb_pool_next(
+            self._h, timeout_ms, ctypes.byref(tag), ctypes.byref(result),
+            ctypes.byref(status), ctypes.byref(fb), ctypes.byref(total),
+            ctypes.byref(start),
+        )
+        if rc < 0:
+            _check(rc, "pool_next")
+        if rc == 0:
+            return None
+        return {
+            "tag": tag.value,
+            "result": result.value,
+            "status": status.value,
+            "first_byte_ns": fb.value,
+            "total_ns": total.value,
+            "start_ns": start.value,
+        }
+
+    def close(self) -> None:
+        if self._h:
+            self._engine.lib.tb_pool_destroy(self._h)
+            self._h = 0
+
+    def __enter__(self) -> "NativeFetchPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 _engine: Optional[NativeEngine] = None

@@ -258,6 +258,22 @@ class GcsHttpBackend:
         self._native_pool_lock = threading.Lock()
         self._native_bufpool = None
 
+    @property
+    def scheme(self) -> str:
+        return self._scheme
+
+    def native_request_parts(self, name: str) -> tuple:
+        """(host, port, path, header-block) for a native-engine GET of
+        ``name`` — request construction lives here once, shared by the
+        backend's own native receive path and the fetch executor. Called
+        per request so bearer tokens stay fresh."""
+        headers = "".join(
+            f"{k}: {v}\r\n"
+            for k, v in self._headers().items()
+            if k.lower() != "host"  # the engine sets Host itself
+        )
+        return self._host, self._port, self._opath(name) + "?alt=media", headers
+
     # ------------------------------------------------------- native pool --
     def _native_pool(self):
         with self._native_pool_lock:
@@ -387,11 +403,7 @@ class GcsHttpBackend:
             want = size - start
         else:
             want = length
-        headers = "".join(
-            f"{k}: {v}\r\n"
-            for k, v in self._headers().items()
-            if k.lower() != "host"  # tb_http_get sets Host itself
-        )
+        _, _, req_path, headers = self.native_request_parts(name)
         if length is not None:
             headers += f"Range: bytes={start}-{start + want - 1}\r\n"
         elif start:
@@ -416,8 +428,8 @@ class GcsHttpBackend:
                 "gcs_http.get_native", object=name, bucket=self.bucket
             ) as sp:
                 r = engine.conn_request(
-                    conn, self._host, self._port,
-                    self._opath(name) + "?alt=media", buf, headers=headers,
+                    conn, self._host, self._port, req_path, buf,
+                    headers=headers,
                 )
                 sp.event("first_byte", native_ns=r["first_byte_ns"])
             return r
