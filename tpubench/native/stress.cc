@@ -15,8 +15,15 @@
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <atomic>
 #include <thread>
 #include <vector>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <sched.h>
+#include <unistd.h>
 
 extern "C" {
 int64_t tb_now_ns();
@@ -33,6 +40,156 @@ int64_t tb_pwrite_blocks(int fd, const void* buf, int64_t block_size,
 void tb_fill_random(void* buf, int64_t n, uint64_t seed);
 void* tb_dlpack_create(void* data, int64_t rows, int64_t cols, void* deleter);
 void tb_dlpack_free(void* managed);
+int64_t tb_pool_create(int threads, int cap);
+int tb_pool_submit(int64_t h, const char* host, int port, const char* path,
+                   const char* headers, void* buf, int64_t buf_len,
+                   uint64_t tag);
+int tb_pool_next(int64_t h, int timeout_ms, uint64_t* tag, int64_t* result,
+                 int* status, int64_t* fb, int64_t* total, int64_t* start);
+int tb_pool_destroy(int64_t h);
+}
+
+// Minimal single-purpose HTTP server for the pool stress: keep-alive —
+// each accepted connection serves up to 4 requests (so the pool workers'
+// per-thread connection REUSE path runs), then closes (so the reconnect
+// path runs too).
+static int g_srv_fd = -1;
+
+static void handle_conn(int c) {
+  for (int served = 0; served < 4; served++) {
+    char req[2048];
+    ssize_t n = 0, got = 0;
+    bool have = false;
+    while (got < static_cast<ssize_t>(sizeof req) &&
+           (n = recv(c, req + got, sizeof req - got, 0)) > 0) {
+      got += n;
+      if (memmem(req, got, "\r\n\r\n", 4)) {
+        have = true;
+        break;
+      }
+    }
+    if (!have) break;  // peer closed between requests
+    const bool last = served == 3;
+    char resp[256];
+    int m = snprintf(resp, sizeof resp,
+                     "HTTP/1.1 200 OK\r\nContent-Length: 16\r\n%s\r\n"
+                     "0123456789abcdef",
+                     last ? "Connection: close\r\n" : "");
+    send(c, resp, m, 0);
+    if (last) break;
+  }
+  close(c);
+}
+
+static void serve_loop() {
+  // One handler thread per connection: a serial server deadlocks with
+  // keep-alive pool workers (worker A idles between requests on its held
+  // connection while B/C/D block behind it in the backlog). Handlers are
+  // joined before returning; they unblock when the peer closes.
+  std::vector<std::thread> handlers;
+  for (;;) {
+    int c = accept(g_srv_fd, nullptr, nullptr);
+    if (c < 0) break;  // listener shut down
+    handlers.emplace_back(handle_conn, c);
+  }
+  for (auto& h : handlers) h.join();
+}
+
+// Fetch-pool stress: 2 submitter threads race 64 tasks into a 4-worker
+// pool against the in-process keep-alive server while the main thread
+// drains — the pool's mutex/condvar/ring accounting plus the workers'
+// connection-reuse and reconnect paths all run under TSAN.
+static int stress_fetch_pool() {
+  g_srv_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (g_srv_fd < 0) return 1;
+  int one = 1;
+  setsockopt(g_srv_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in a;
+  memset(&a, 0, sizeof a);
+  a.sin_family = AF_INET;
+  a.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  a.sin_port = 0;
+  if (bind(g_srv_fd, reinterpret_cast<struct sockaddr*>(&a), sizeof a) != 0) {
+    close(g_srv_fd);
+    return 2;
+  }
+  socklen_t alen = sizeof a;
+  getsockname(g_srv_fd, reinterpret_cast<struct sockaddr*>(&a), &alen);
+  int port = ntohs(a.sin_port);
+  listen(g_srv_fd, 16);
+  std::thread srv(serve_loop);
+
+  // Every exit path below must stop the listener and join srv — a
+  // joinable std::thread destroyed alive calls std::terminate.
+  auto stop_server = [&]() {
+    shutdown(g_srv_fd, SHUT_RDWR);  // close() alone does not wake accept()
+    close(g_srv_fd);
+    srv.join();
+  };
+
+  const int kTasks = 64;
+  int64_t pool = tb_pool_create(4, 32);
+  if (pool == 0) {
+    stop_server();
+    return 3;
+  }
+  std::vector<void*> bufs(kTasks);
+  for (int i = 0; i < kTasks; i++) bufs[i] = tb_alloc_aligned(4096, 4096);
+
+  std::atomic<int> submitted{0};
+  std::atomic<int> done_submitters{0};
+  std::atomic<bool> submit_failed{false};
+  std::vector<std::thread> submitters;
+  for (int si = 0; si < 2; si++) {
+    submitters.emplace_back([&, si]() {
+      for (int i = si; i < kTasks; i += 2) {
+        for (;;) {
+          int rc = tb_pool_submit(pool, "127.0.0.1", port, "/x", "",
+                                  bufs[i], 4096, i);
+          if (rc == 0) break;
+          if (rc == -EAGAIN) {
+            // Ring full (64 tasks vs cap 32): the MAIN thread drains
+            // concurrently; yield instead of hammering the pool mutex.
+            sched_yield();
+            continue;
+          }
+          submit_failed.store(true);  // hard error: stop submitting
+          done_submitters.fetch_add(1);
+          return;
+        }
+        submitted.fetch_add(1);
+      }
+      done_submitters.fetch_add(1);
+    });
+  }
+  // Drain CONCURRENTLY with submission (the ring is smaller than the
+  // task count, so a submit-then-drain sequence would deadlock). Done
+  // when everything submitted has drained and both submitters finished —
+  // a hard submit error just shrinks the total instead of turning into
+  // 30s-per-missing-task timeouts.
+  int drained = 0;
+  int bad = 0;
+  for (;;) {
+    if (drained == kTasks) break;
+    if (done_submitters.load() == 2 && drained >= submitted.load()) break;
+    uint64_t tag;
+    int64_t result, fb, total, start;
+    int status;
+    int rc = tb_pool_next(pool, 30000, &tag, &result, &status, &fb, &total,
+                          &start);
+    if (rc != 1) {  // stall: bail with a failure instead of hanging
+      bad++;
+      break;
+    }
+    if (result != 16 || status != 200) bad++;
+    drained++;
+  }
+  for (auto& t : submitters) t.join();
+  if (submit_failed.load()) bad++;
+  tb_pool_destroy(pool);
+  for (auto b : bufs) tb_free_aligned(b);
+  stop_server();
+  return bad ? 10 : 0;
 }
 
 int main(int argc, char** argv) {
@@ -87,6 +244,8 @@ int main(int argc, char** argv) {
   for (int t = 0; t < kThreads; ++t) {
     if (rc[t]) { std::fprintf(stderr, "thread %d failed rc=%d\n", t, rc[t]); return 1; }
   }
+  int prc = stress_fetch_pool();
+  if (prc) { std::fprintf(stderr, "fetch-pool stress failed rc=%d\n", prc); return 1; }
   std::puts("stress ok");
   return 0;
 }
