@@ -323,8 +323,10 @@ class _BrokenHttpServer:
 
 
 def _tracked_native_client(endpoint, monkeypatch):
-    """Native-receive client whose engine.alloc is spied so tests can assert
-    the receive buffer was freed on the failure path."""
+    """Native-receive client whose engine.alloc is spied: the streaming
+    receive lands bytes DIRECTLY in caller memory, so these tests assert the
+    engine allocates NO intermediate buffers at all (the round-2 full-body
+    buffer path is gone — a regression reintroducing it fails here)."""
     from tpubench.native.engine import get_engine
 
     eng = get_engine()
@@ -343,35 +345,58 @@ def _tracked_native_client(endpoint, monkeypatch):
 
 @pytestmark_native
 def test_native_receive_connection_killed_mid_body(monkeypatch):
-    """Peer dies mid-body: classified transient StorageError (TB_ESHORT),
-    never a NameError/raw NativeError, and the aligned buffer is freed."""
+    """Peer dies mid-body: the streaming reader raises a classified
+    transient StorageError (TB_ESHORT) from ``readinto`` — the same point
+    the Python client surfaces a mid-stream cut — never a raw NativeError."""
     srv = _BrokenHttpServer(body_len=64 * 1024, send_len=8 * 1024)
     try:
         c, allocated = _tracked_native_client(srv.endpoint, monkeypatch)
+        r = c.open_read("bench/file_0", length=64 * 1024)
         with pytest.raises(StorageError) as ei:
-            c.open_read("bench/file_0", length=64 * 1024)
+            r.readinto(memoryview(bytearray(64 * 1024)))
         assert ei.value.transient is True
         # The engine's short-body code (TB_ESHORT), not a socket errno,
         # must be the classified cause — codes are the ABI, not wording.
         assert ei.value.__cause__.code == TB_ESHORT
-        c.close()  # failed-path buffers parked in the pool free here
-        assert allocated and all(b._ptr == 0 for b in allocated)
+        r.close()
+        c.close()
+        assert allocated == []  # streaming: no intermediate buffers, ever
     finally:
         srv.close()
 
 
 @pytestmark_native
-def test_native_receive_body_exceeds_buffer_is_permanent(monkeypatch):
-    """Server ships more bytes than the requested range: protocol-shape
-    failure (TB_ETOOBIG) — permanent, because a retry reproduces it."""
+def test_native_receive_range_ignored_is_permanent(monkeypatch):
+    """Server announces more bytes than the requested range (it ignored
+    Range): protocol-shape failure — permanent, because a retry reproduces
+    it — rather than silently serving bytes the caller never asked for."""
     srv = _BrokenHttpServer(body_len=64 * 1024, send_len=64 * 1024)
     try:
         c, allocated = _tracked_native_client(srv.endpoint, monkeypatch)
         with pytest.raises(StorageError) as ei:
-            c.open_read("bench/file_0", length=100)  # 4096-byte min buffer
+            c.open_read("bench/file_0", length=100)
         assert ei.value.transient is False
-        c.close()  # failed-path buffers parked in the pool free here
-        assert allocated and all(b._ptr == 0 for b in allocated)
+        c.close()
+        assert allocated == []
+    finally:
+        srv.close()
+
+
+@pytestmark_native
+def test_native_receive_open_ended_range_answered_200_is_permanent(monkeypatch):
+    """A nonzero-start Range answered with 200 means the body starts at
+    offset 0, not `start` — serving it would hand back the WRONG bytes.
+    Must fail loudly (permanent), for open-ended ranges too (no length to
+    compare against; the 200-vs-206 status is the only tell)."""
+    srv = _BrokenHttpServer(body_len=4096, send_len=4096)  # always 200/full
+    try:
+        c, allocated = _tracked_native_client(srv.endpoint, monkeypatch)
+        with pytest.raises(StorageError) as ei:
+            c.open_read("bench/file_0", start=1000)  # open-ended
+        assert ei.value.transient is False
+        assert "Range" in str(ei.value)
+        c.close()
+        assert allocated == []
     finally:
         srv.close()
 
@@ -388,11 +413,8 @@ def test_native_receive_connection_refused_is_transient(monkeypatch):
     with pytest.raises(StorageError) as ei:
         c.open_read("bench/file_0", length=4096)
     assert ei.value.transient is True
-    # The receive buffer is allocated before the connect attempt; the
-    # connect-failure path returns it to the backend's buffer pool, and
-    # closing the backend frees the pool — nothing may leak.
     c.close()
-    assert allocated and all(b._ptr == 0 for b in allocated)
+    assert allocated == []  # nothing to leak: the path allocates no buffers
 
 
 @pytestmark_native
@@ -406,8 +428,8 @@ def test_native_receive_eof_mid_headers_is_transient(monkeypatch):
             c.open_read("bench/file_0", length=4096)
         assert ei.value.transient is True
         assert ei.value.__cause__.code == TB_ESHORT
-        c.close()  # failed-path buffers parked in the pool free here
-        assert allocated and all(b._ptr == 0 for b in allocated)
+        c.close()
+        assert allocated == []
     finally:
         srv.close()
 
@@ -451,8 +473,8 @@ def test_native_receive_chunked_rejected(monkeypatch):
             c.open_read("bench/file_0", length=4096)
         assert ei.value.transient is False
         assert ei.value.__cause__.code == TB_ECHUNKED
-        c.close()  # failed-path buffers parked in the pool free here
-        assert allocated and all(b._ptr == 0 for b in allocated)
+        c.close()
+        assert allocated == []
     finally:
         srv.close()
 
@@ -601,8 +623,8 @@ def test_native_receive_unknown_length_keepalive_errors_not_hangs(monkeypatch):
             c.open_read("bench/file_0", length=4096)
         assert time.monotonic() - t0 < 5.0  # failed fast, no FIN wait
         assert ei.value.transient is False
-        c.close()  # failed-path buffers parked in the pool free here
-        assert allocated and all(b._ptr == 0 for b in allocated)
+        c.close()
+        assert allocated == []
     finally:
         srv.close()
 

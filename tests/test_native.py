@@ -179,3 +179,114 @@ def test_native_http_connection_refused(engine):
     buf = engine.alloc(64)
     with pytest.raises(NativeError):
         engine.http_get("127.0.0.1", 1, "/", buf)
+
+
+# ------------------------------------------------------ streaming receive --
+# tb_conn_get_begin / tb_conn_body_read / tb_conn_get_end: socket→caller
+# memory with no intermediate buffer (the discipline main.go:140's granule
+# loop has — one reused buffer, bytes never staged twice).
+
+
+def test_conn_streaming_get_roundtrip(engine):
+    """begin → chunked body_read → end; bytes intact, connection reusable
+    and actually reused for a second GET on the same handle."""
+    be = FakeBackend.prepopulated("o/", count=1, size=100_000)
+    with FakeGcsServer(be) as srv:
+        host, port = srv.endpoint.removeprefix("http://").split(":")
+        h = engine.connect(host, int(port))
+        try:
+            for _ in range(2):  # second pass proves keep-alive reuse
+                r = engine.conn_get_begin(
+                    h, host, int(port), "/storage/v1/b/b/o/o%2F0?alt=media"
+                )
+                assert r["status"] == 200
+                assert r["content_len"] == 100_000
+                assert r["first_byte_ns"] > 0
+                out = bytearray(100_000)
+                got = 0
+                mv = memoryview(out)
+                while got < 100_000:
+                    n = engine.conn_body_read(h, mv[got:], 32 * 1024)
+                    assert n > 0
+                    got += n
+                assert engine.conn_body_read(h, mv, 1024) == 0  # EOF
+                assert engine.conn_get_end(h) is True
+                assert bytes(out) == deterministic_bytes("o/0", 100_000).tobytes()
+        finally:
+            engine.conn_close(h)
+
+
+def test_conn_streaming_fills_destination_fully(engine):
+    """One body_read call fills the whole destination (buffered-reader
+    semantics) — a multi-MB granule must not cost one Python call per TCP
+    segment."""
+    be = FakeBackend.prepopulated("o/", count=1, size=600_000)
+    with FakeGcsServer(be) as srv:
+        host, port = srv.endpoint.removeprefix("http://").split(":")
+        h = engine.connect(host, int(port))
+        try:
+            engine.conn_get_begin(
+                h, host, int(port), "/storage/v1/b/b/o/o%2F0?alt=media"
+            )
+            out = bytearray(600_000)
+            assert engine.conn_body_read(h, out, 600_000) == 600_000
+            assert engine.conn_get_end(h) is True
+        finally:
+            engine.conn_close(h)
+
+
+def test_conn_streaming_abandoned_body_not_reusable(engine):
+    """Ending a streaming GET mid-body leaves unread bytes on the wire:
+    end() must report not-reusable (the pool would serve junk otherwise)."""
+    be = FakeBackend.prepopulated("o/", count=1, size=200_000)
+    with FakeGcsServer(be) as srv:
+        host, port = srv.endpoint.removeprefix("http://").split(":")
+        h = engine.connect(host, int(port))
+        try:
+            engine.conn_get_begin(
+                h, host, int(port), "/storage/v1/b/b/o/o%2F0?alt=media"
+            )
+            out = bytearray(1024)
+            assert engine.conn_body_read(h, out, 1024) == 1024
+            assert engine.conn_get_end(h) is False  # 198 KB still unread
+        finally:
+            engine.conn_close(h)
+
+
+def test_http_get_close_delimited_exact_fit(engine):
+    """A close-delimited (no Content-Length) body that exactly fills the
+    receive buffer must succeed — the engine probes for EOF instead of
+    returning a spurious body-exceeds-buffer error."""
+    import socket
+    import threading
+
+    body = b"z" * 4096
+    raw = b"HTTP/1.0 200 OK\r\nConnection: close\r\n\r\n" + body
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+
+    def serve():
+        conn, _ = lsock.accept()
+        with conn:
+            req = b""
+            while b"\r\n\r\n" not in req:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return
+                req += chunk
+            conn.sendall(raw)
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        buf = engine.alloc(4096)  # exactly body-sized
+        r = engine.http_get("127.0.0.1", port, "/x", buf)
+        assert r["status"] == 200
+        assert r["length"] == 4096
+        assert bytes(buf.view(4096)) == body
+        buf.free()
+    finally:
+        lsock.close()
+        t.join(timeout=5)

@@ -487,6 +487,27 @@ static void* get_ctx(const char* cafile, int insecure) {
 
 int tb_tls_available() { return tls::load() ? 1 : 0; }
 
+// State of one in-progress HTTP/1.1 response (the streaming receive):
+// headers parsed by http_begin, body served incrementally by resp_read so
+// callers stream socket→destination with no full-body intermediate buffer
+// (the reference's hot loop streams through a 2 MB granule, main.go:140 —
+// an up-front full-body landing would be a different, worse program).
+struct tb_resp {
+  int active;       // body not yet fully consumed
+  int status;       // HTTP status code
+  int http_minor;   // 0 or 1
+  int server_close; // server announced Connection: close
+  int client_close; // we requested Connection: close
+  int junk;         // bytes beyond Content-Length arrived with the headers
+  int64_t content_len;  // -1 = close-delimited
+  int64_t body_got;
+  int64_t first_byte_ns;
+  // Body bytes that arrived in the same recv as the headers (bounded by
+  // the header scratch size).
+  int leftover_off, leftover_len;
+  uint8_t leftover[16384];
+};
+
 // Connection handle: plaintext (ssl == null) or TLS. Returned to Python as
 // an opaque int64 (heap pointer); every path through the receive loop goes
 // through the conn_* helpers so both transports share one implementation.
@@ -501,6 +522,9 @@ struct tb_conn {
   // tb_conn_close): a per-RPC 2 MiB malloc/free would sit inside the
   // timed window of the very path being benchmarked.
   uint8_t* scratch;
+  // Streaming-GET state (lazily allocated by tb_conn_get_begin, reused
+  // across sequential GETs on this connection, freed in tb_conn_close).
+  tb_resp* resp;
 };
 
 // SSL_read/SSL_write take int lengths: cap chunks well under INT_MAX so
@@ -641,6 +665,7 @@ int tb_conn_close(int64_t h) {
   }
   int rc = close(c->fd) == 0 ? 0 : -errno;
   free(c->scratch);
+  free(c->resp);
   free(c);
   return rc;
 }
@@ -653,14 +678,21 @@ int tb_conn_close(int64_t h) {
 // Content-Length body, no "Connection: close" from the server). On ANY
 // error return the caller must tb_http_close the fd — the stream state is
 // unknown.
-static int64_t request_on(tb_conn* cn, const char* host, int port,
+// Send one GET and parse the response headers into ``r``; body bytes that
+// arrived with the headers are stashed in ``r->leftover``. Body streams via
+// resp_read. Returns 0, or -errno / TB_* (the connection is then unusable).
+static int64_t http_begin(tb_conn* cn, const char* host, int port,
                           const char* path,
                           const char* extra_headers,  // "K: V\r\n..." or ""
-                          void* buf, int64_t buf_len, int* status_out,
-                          int64_t* first_byte_ns_out, int64_t* total_ns_out,
-                          int* reusable_out) {
-  int64_t t_start = tb_now_ns();
-  if (reusable_out) *reusable_out = 0;
+                          tb_resp* r) {
+  r->active = 0;
+  r->status = 0;
+  r->http_minor = 0;
+  r->server_close = r->client_close = r->junk = 0;
+  r->content_len = -1;
+  r->body_got = 0;
+  r->first_byte_ns = 0;
+  r->leftover_off = r->leftover_len = 0;
   char req[4096];
   int m = snprintf(req, sizeof req,
                    "GET %s HTTP/1.1\r\nHost: %s:%d\r\nUser-Agent: tpubench-native\r\n"
@@ -682,7 +714,6 @@ static int64_t request_on(tb_conn* cn, const char* host, int port,
   int hlen = 0;
   char* body_start = nullptr;
   int body_in_hdr = 0;
-  int64_t first_byte_ns = 0;
   while (hlen < hdr_cap) {
     ssize_t k = conn_recv(cn, hdr + hlen, hdr_cap - hlen);
     if (k < 0) {
@@ -690,7 +721,7 @@ static int64_t request_on(tb_conn* cn, const char* host, int port,
       return -errno;
     }
     if (k == 0) break;
-    if (first_byte_ns == 0) first_byte_ns = tb_now_ns();
+    if (r->first_byte_ns == 0) r->first_byte_ns = tb_now_ns();
     hlen += k;
     hdr[hlen] = 0;
     char* p = static_cast<char*>(memmem(hdr, hlen, "\r\n\r\n", 4));
@@ -707,13 +738,9 @@ static int64_t request_on(tb_conn* cn, const char* host, int port,
     return hlen >= hdr_cap ? TB_EPROTO : TB_ESHORT;
   }
 
-  int status = 0;
-  int http_minor = 0;
-  if (sscanf(hdr, "HTTP/1.%d %d", &http_minor, &status) != 2) return TB_EPROTO;
-  if (status_out) *status_out = status;
+  if (sscanf(hdr, "HTTP/1.%d %d", &r->http_minor, &r->status) != 2)
+    return TB_EPROTO;
 
-  int64_t content_len = -1;
-  int server_close = 0;
   // Case-insensitive Content-Length / Transfer-Encoding / Connection scan
   // over the header block. Chunked bodies are rejected (TB_ECHUNKED): this
   // receive path has no de-chunker, and copying chunk framing into the
@@ -722,7 +749,7 @@ static int64_t request_on(tb_conn* cn, const char* host, int port,
     char* eol = static_cast<char*>(memmem(line, body_start - line, "\r\n", 2));
     if (!eol) break;
     if (strncasecmp(line, "Content-Length:", 15) == 0)
-      content_len = strtoll(line + 15, nullptr, 10);
+      r->content_len = strtoll(line + 15, nullptr, 10);
     if (strncasecmp(line, "Transfer-Encoding:", 18) == 0) {
       // Transfer-coding names are case-insensitive (RFC 9112 §7).
       for (char* p = line + 18; p + 7 <= eol; p++) {
@@ -731,7 +758,7 @@ static int64_t request_on(tb_conn* cn, const char* host, int port,
     }
     if (strncasecmp(line, "Connection:", 11) == 0) {
       for (char* p = line + 11; p + 5 <= eol; p++) {
-        if (strncasecmp(p, "close", 5) == 0) server_close = 1;
+        if (strncasecmp(p, "close", 5) == 0) r->server_close = 1;
       }
     }
     line = eol + 2;
@@ -745,64 +772,161 @@ static int64_t request_on(tb_conn* cn, const char* host, int port,
   // neither Content-Length nor Transfer-Encoding leaves no way to find
   // the body end — recv would block forever — so that shape is a
   // protocol error, not a hang.
-  int client_close =
+  r->client_close =
       extra_headers && strcasestr(extra_headers, "connection: close") != nullptr;
-  if (content_len < 0 && !server_close && !client_close && http_minor >= 1)
+  if (r->content_len < 0 && !r->server_close && !r->client_close &&
+      r->http_minor >= 1)
     return TB_EPROTO;
 
-  // Read exactly Content-Length body bytes (standard HTTP-client semantics:
-  // bytes past Content-Length are never read as body; junk that has already
-  // arrived behind the body is caught by the reuse-time drain check below,
-  // and junk arriving later surfaces on the next request of a pooled
-  // connection, which the caller retries on a fresh socket).
-  char* out = static_cast<char*>(buf);
-  int64_t got = 0;
   if (body_in_hdr > 0) {
-    int64_t take = body_in_hdr;
-    if (content_len >= 0 && take > content_len) take = content_len;
-    if (take > buf_len) return TB_ETOOBIG;
-    memcpy(out, body_start, take);
+    memcpy(r->leftover, body_start, body_in_hdr);
+    r->leftover_len = body_in_hdr;
+    // Bytes beyond Content-Length arrived with the headers: pipelined
+    // junk — the stream is served correctly (consumption stops at
+    // Content-Length) but the connection must not be pooled.
+    if (r->content_len >= 0 && body_in_hdr > r->content_len) r->junk = 1;
+  }
+  r->active = !(r->content_len == 0);
+  return 0;
+}
+
+// Serve body bytes into ``dst``: fills ``want`` bytes completely unless
+// the body ends first (buffered-reader semantics — a 2 MB granule costs
+// ONE call, not one per TCP segment). Returns bytes served (0 = body
+// complete), or -errno / TB_ESHORT (peer FIN before Content-Length).
+static int64_t resp_read(tb_conn* cn, tb_resp* r, uint8_t* dst, int64_t want) {
+  if (!r->active || want <= 0) return 0;
+  if (r->content_len >= 0) {
+    int64_t left = r->content_len - r->body_got;
+    if (want > left) want = left;
+    if (want <= 0) {
+      r->active = 0;
+      return 0;
+    }
+  }
+  int64_t got = 0;
+  // Leftover body bytes from the header read serve first.
+  if (r->leftover_off < r->leftover_len) {
+    int64_t take = r->leftover_len - r->leftover_off;
+    if (take > want) take = want;
+    memcpy(dst, r->leftover + r->leftover_off, take);
+    r->leftover_off += static_cast<int>(take);
     got = take;
   }
-  for (;;) {
-    if (content_len >= 0 && got >= content_len) break;
-    int64_t want = buf_len - got;
-    if (content_len >= 0 && content_len - got < want) want = content_len - got;
-    if (want <= 0) {
-      // Buffer full: with known length the body doesn't fit; with unknown
-      // length (close-delimited) it's also an error for our use.
-      return TB_ETOOBIG;
-    }
-    ssize_t k = conn_recv(cn, out + got, want);
+  while (got < want) {
+    ssize_t k = conn_recv(cn, dst + got, static_cast<size_t>(want - got));
     if (k < 0) {
       if (errno == EINTR) continue;
       return -errno;
     }
-    if (k == 0) break;
-    if (first_byte_ns == 0) first_byte_ns = tb_now_ns();
+    if (k == 0) {
+      if (r->content_len < 0) {  // close-delimited: FIN ends the body
+        r->active = 0;
+        break;
+      }
+      return TB_ESHORT;  // peer FIN before Content-Length bytes arrived
+    }
+    if (r->first_byte_ns == 0) r->first_byte_ns = tb_now_ns();
     got += k;
   }
-  // Peer FIN before Content-Length bytes arrived: transient early close.
-  if (content_len >= 0 && got < content_len) return TB_ESHORT;
-  // Reusable only when the body boundary is known and fully consumed, the
-  // server speaks HTTP/1.1 (1.0 defaults to close) and didn't announce
-  // close; body_in_hdr beyond Content-Length (pipelined junk) poisons the
-  // stream — don't reuse. A nonblocking peek catches junk that arrived in
-  // a later packet than the header read (pk==0 means the peer already
-  // FIN'd — also not worth pooling).
-  if (reusable_out) {
-    int reusable = (content_len >= 0 && !server_close && !client_close &&
-                    http_minor >= 1 && body_in_hdr <= content_len)
-                       ? 1
-                       : 0;
-    // Pool only a provably idle connection: junk/FIN/dead sockets (and
-    // buffered TLS records) all fail the idle check.
-    if (reusable && !conn_idle(cn)) reusable = 0;
-    *reusable_out = reusable;
+  r->body_got += got;
+  if (r->content_len >= 0 && r->body_got >= r->content_len) r->active = 0;
+  return got;
+}
+
+// Keep-alive verdict after a response: body boundary known and fully
+// consumed, HTTP/1.1, no close announced either way, no pipelined junk,
+// and the socket provably idle.
+static int resp_reusable(tb_conn* cn, tb_resp* r) {
+  if (r->active || r->content_len < 0 || r->server_close || r->client_close ||
+      r->http_minor < 1 || r->junk)
+    return 0;
+  return conn_idle(cn);
+}
+
+static int64_t request_on(tb_conn* cn, const char* host, int port,
+                          const char* path,
+                          const char* extra_headers,  // "K: V\r\n..." or ""
+                          void* buf, int64_t buf_len, int* status_out,
+                          int64_t* first_byte_ns_out, int64_t* total_ns_out,
+                          int* reusable_out) {
+  int64_t t_start = tb_now_ns();
+  if (reusable_out) *reusable_out = 0;
+  tb_resp r;
+  int64_t rc = http_begin(cn, host, port, path, extra_headers, &r);
+  if (rc != 0) return rc;
+  if (status_out) *status_out = r.status;
+  uint8_t* out = static_cast<uint8_t*>(buf);
+  int64_t got = 0;
+  for (;;) {
+    int64_t want = buf_len - got;
+    if (want <= 0) {
+      if (r.content_len >= 0) {
+        if (r.active) return TB_ETOOBIG;  // known length doesn't fit
+        break;
+      }
+      // Close-delimited body that exactly fills the buffer: probe one
+      // byte — EOF proves an exact fit; more data is a real overflow.
+      uint8_t probe;
+      int64_t k = resp_read(cn, &r, &probe, 1);
+      if (k < 0) return k;
+      if (k > 0) return TB_ETOOBIG;
+      break;
+    }
+    int64_t k = resp_read(cn, &r, out + got, want);
+    if (k < 0) return k;
+    if (k == 0) break;
+    got += k;
   }
-  if (first_byte_ns_out) *first_byte_ns_out = first_byte_ns;
+  if (reusable_out) *reusable_out = resp_reusable(cn, &r);
+  if (first_byte_ns_out) *first_byte_ns_out = r.first_byte_ns;
   if (total_ns_out) *total_ns_out = tb_now_ns() - t_start;
   return got;
+}
+
+// ---- streaming GET on a connection handle ----
+// The zero-intermediate-copy receive path: begin parses headers, then the
+// caller pulls body bytes directly into its own memory (granule buffer or
+// staging slot) — the same socket→destination streaming discipline as the
+// Python client's readinto loop, with native header parse and timestamps.
+// Contract: begin → N× body_read → end. On any negative return the
+// connection is unusable and the caller must tb_conn_close it.
+
+int64_t tb_conn_get_begin(int64_t h, const char* host, int port,
+                          const char* path, const char* extra_headers,
+                          int* status_out, int64_t* content_len_out,
+                          int64_t* first_byte_ns_out) {
+  if (h <= 0) return -EINVAL;
+  tb_conn* cn = reinterpret_cast<tb_conn*>(h);
+  if (!cn->resp) {
+    cn->resp = static_cast<tb_resp*>(malloc(sizeof(tb_resp)));
+    if (!cn->resp) return -ENOMEM;
+  }
+  int64_t rc = http_begin(cn, host, port, path, extra_headers, cn->resp);
+  if (rc != 0) return rc;
+  if (status_out) *status_out = cn->resp->status;
+  if (content_len_out) *content_len_out = cn->resp->content_len;
+  if (first_byte_ns_out) *first_byte_ns_out = cn->resp->first_byte_ns;
+  return 0;
+}
+
+int64_t tb_conn_body_read(int64_t h, void* dst, int64_t want) {
+  if (h <= 0) return -EINVAL;
+  tb_conn* cn = reinterpret_cast<tb_conn*>(h);
+  if (!cn->resp) return -EINVAL;
+  return resp_read(cn, cn->resp, static_cast<uint8_t*>(dst), want);
+}
+
+// Finish the streaming GET: *reusable_out reports whether the connection
+// may carry another request (not reusable when the body was abandoned
+// mid-stream). Always safe to call once after begin succeeded.
+int tb_conn_get_end(int64_t h, int* reusable_out) {
+  if (h <= 0) return -EINVAL;
+  tb_conn* cn = reinterpret_cast<tb_conn*>(h);
+  if (!cn->resp) return -EINVAL;
+  if (reusable_out) *reusable_out = resp_reusable(cn, cn->resp);
+  cn->resp->active = 0;
+  return 0;
 }
 
 // Plain-fd wrapper (back-compat entry point; plaintext only).
@@ -810,7 +934,8 @@ int64_t tb_http_request(int fd, const char* host, int port, const char* path,
                         const char* extra_headers, void* buf, int64_t buf_len,
                         int* status_out, int64_t* first_byte_ns_out,
                         int64_t* total_ns_out, int* reusable_out) {
-  tb_conn c{fd, nullptr};
+  tb_conn c{};
+  c.fd = fd;
   return request_on(&c, host, port, path, extra_headers, buf, buf_len,
                     status_out, first_byte_ns_out, total_ns_out, reusable_out);
 }
@@ -1685,6 +1810,12 @@ int64_t tb_grpc_read(int64_t h, const char* authority, const char* bucket_path,
         uint32_t left = flen;
         uint32_t pad = 0;
         if (fflags & 0x8) {  // PADDED
+          // A PADDED frame carries at least the pad-length byte; flen == 0
+          // would otherwise consume a byte of the NEXT frame (RFC 9113
+          // §6.1: pad length is part of the frame payload).
+          if (flen < 1) {
+            return TB_EPROTO;
+          }
           uint8_t pl;
           if ((rc = h2::recv_all(c, &pl, 1)) != 0) {
             return rc;
@@ -1779,6 +1910,12 @@ int64_t tb_grpc_read(int64_t h, const char* authority, const char* bucket_path,
         size_t off = 0;
         uint32_t blen = flen;
         if (fflags & 0x8) {  // PADDED
+          // flen == 0 has no pad-length byte to read — hbuf[0] would be
+          // uninitialized memory (RFC 9113 §6.2 requires it).
+          if (blen < 1) {
+            free(hbuf);
+            return TB_EPROTO;
+          }
           uint8_t pad = hbuf[0];
           off = 1;
           if (pad + 1u > blen) {

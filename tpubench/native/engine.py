@@ -236,6 +236,15 @@ class NativeEngine:
             c.c_void_p, c.c_int64, c.POINTER(c.c_int),
             c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.POINTER(c.c_int),
         ]
+        lib.tb_conn_get_begin.restype = c.c_int64
+        lib.tb_conn_get_begin.argtypes = [
+            c.c_int64, c.c_char_p, c.c_int, c.c_char_p, c.c_char_p,
+            c.POINTER(c.c_int), c.POINTER(c.c_int64), c.POINTER(c.c_int64),
+        ]
+        lib.tb_conn_body_read.restype = c.c_int64
+        lib.tb_conn_body_read.argtypes = [c.c_int64, c.c_void_p, c.c_int64]
+        lib.tb_conn_get_end.restype = c.c_int
+        lib.tb_conn_get_end.argtypes = [c.c_int64, c.POINTER(c.c_int)]
         lib.tb_hpack_scan_status.restype = c.c_int
         lib.tb_hpack_scan_status.argtypes = [c.c_char_p, c.c_int64]
         lib.tb_pool_create.restype = c.c_int64
@@ -538,6 +547,58 @@ class NativeEngine:
             "total_ns": total_ns.value,
             "reusable": bool(reusable.value),
         }
+
+    def conn_get_begin(
+        self,
+        handle: int,
+        host: str,
+        port: int,
+        path: str,
+        headers: str = "",
+    ) -> dict:
+        """Streaming GET, phase 1: send the request and parse the response
+        headers. Body bytes stream via :meth:`conn_body_read` directly into
+        caller memory — no full-body intermediate buffer (the same
+        socket→destination discipline as the Python client's ``readinto``).
+        ``content_len`` is -1 for a close-delimited body. On NativeError the
+        caller must :meth:`conn_close` the handle."""
+        status = ctypes.c_int(0)
+        clen = ctypes.c_int64(-1)
+        fb = ctypes.c_int64(0)
+        rc = self.lib.tb_conn_get_begin(
+            handle, host.encode(), port, path.encode(), headers.encode(),
+            ctypes.byref(status), ctypes.byref(clen), ctypes.byref(fb),
+        )
+        _check(rc, f"conn_get_begin {host}:{port}{path}")
+        return {
+            "status": status.value,
+            "content_len": clen.value,
+            "first_byte_ns": fb.value,
+        }
+
+    def conn_body_read(self, handle: int, dst, want: int) -> int:
+        """Streaming GET, phase 2: up to ``want`` body bytes land directly
+        in ``dst`` (a writable buffer — memoryview/bytearray/numpy). Returns
+        0 at body end. The recv runs without the GIL (ctypes releases it).
+        ``want`` is clamped to the destination's byte size — the engine
+        fills ``want`` fully on close-delimited bodies, so an unclamped
+        over-ask would be a heap overflow, not a short read."""
+        mv = memoryview(dst)
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(mv))
+        return _check(
+            self.lib.tb_conn_body_read(handle, addr, min(want, mv.nbytes)),
+            "conn_body_read",
+        )
+
+    def conn_get_end(self, handle: int) -> bool:
+        """Streaming GET, phase 3: returns whether the connection may carry
+        another request (False when the body was abandoned mid-stream)."""
+        reusable = ctypes.c_int(0)
+        _check(
+            self.lib.tb_conn_get_end(handle, ctypes.byref(reusable)),
+            "conn_get_end",
+        )
+        return bool(reusable.value)
 
     def hpack_scan_status(self, block: bytes) -> int:
         """Test hook: structural HPACK parse of one header block; returns

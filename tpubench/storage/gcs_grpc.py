@@ -395,10 +395,16 @@ class GcsGrpcBackend:
 
         try:
             # An explicit grpc-status is a server ANSWER, not pool
-            # staleness — never burn a stale retry on it.
+            # staleness — never burn a stale retry on it; neither on
+            # permanent protocol-shape codes (they reproduce identically
+            # on a fresh socket — the pool default's invariant, composed
+            # here with the grpc-status rule).
             r = pool.run(
                 do_request,
-                retry_stale=lambda e: getattr(e, "grpc_status", -1) < 0,
+                retry_stale=lambda e: (
+                    e.code not in PERMANENT_CODES
+                    and getattr(e, "grpc_status", -1) < 0
+                ),
             )
         except StorageError:
             self._native_bufpool.release(buf)  # connect failure, classified

@@ -176,6 +176,89 @@ class _HttpReader:
             self._carrier.close()  # idempotent; failure paths closed it already
 
 
+class _NativeStreamReader:
+    """Streaming native receive (SURVEY §2.5.1): the C++ engine parsed the
+    response headers (``tb_conn_get_begin``); each ``readinto`` here hands
+    the caller's own memory — granule buffer or staging slot — to
+    ``tb_conn_body_read``, which recv()s straight into it without the GIL.
+    Same socket→destination streaming discipline as the Python client's
+    ``readinto`` loop (main.go:140's granule streaming), with native header
+    parse and CLOCK_MONOTONIC stamps; no full-body intermediate buffer and
+    no completion copy.
+
+    Holds its pooled connection until ``close()``: complete bodies return
+    it keep-alive; abandoned/failed ones discard it (stream state unknown).
+    """
+
+    _DRAIN_CAP = 1 << 20  # parity with _HttpReader: drain small remainders
+
+    def __init__(self, pool, conn: int, content_len: int, first_byte_ns: int,
+                 carrier=None):
+        # Bound once at construction (the engine module is necessarily
+        # imported by now): the per-granule readinto must not pay import
+        # machinery inside the very hot loop this path exists to win.
+        from tpubench.native.engine import PERMANENT_CODES, NativeError
+
+        self._permanent_codes = PERMANENT_CODES
+        self._native_error = NativeError
+        self._pool = pool
+        self._conn: Optional[int] = conn
+        self._content_len = content_len  # -1 = close-delimited
+        self._consumed = 0
+        self.first_byte_ns: Optional[int] = first_byte_ns or None
+        self._done = False
+        self._failed = False
+        self._carrier = carrier
+
+    def readinto(self, buf: memoryview) -> int:
+        if self._done or self._conn is None:
+            return 0
+        try:
+            n = self._pool.engine.conn_body_read(self._conn, buf, len(buf))
+        except self._native_error as e:
+            self._failed = True
+            self._done = True
+            err = StorageError(
+                f"mid-stream native read failed: {e}",
+                transient=e.code not in self._permanent_codes,
+            )
+            if self._carrier is not None:
+                self._carrier.close(err)
+            raise err from e
+        if n == 0:
+            self._done = True
+            return 0
+        if self.first_byte_ns is None:
+            self.first_byte_ns = time.perf_counter_ns()
+        self._consumed += n
+        return n
+
+    def close(self) -> None:
+        if self._conn is None:
+            return
+        conn, self._conn = self._conn, None
+        if self._failed:
+            self._pool.discard(conn)
+            return  # carrier already closed with the error
+        engine = self._pool.engine
+        try:
+            if not self._done and self._content_len >= 0:
+                # Drain small remainders so the connection stays reusable
+                # (same policy as the Python reader above).
+                left = self._content_len - self._consumed
+                if 0 < left <= self._DRAIN_CAP:
+                    sink = bytearray(65536)
+                    while engine.conn_body_read(conn, sink, len(sink)) > 0:
+                        pass
+            reusable = engine.conn_get_end(conn)
+        except Exception:
+            self._pool.discard(conn)
+        else:
+            self._pool.release(conn, reusable)
+        if self._carrier is not None:
+            self._carrier.close()  # idempotent
+
+
 class _NativeBufReader:
     """Reader over a natively received body (SURVEY §2.5.1: the streaming
     receive ran in C++ straight into a pre-registered aligned buffer).
@@ -247,16 +330,12 @@ class GcsHttpBackend:
         self._tokens = token_source or make_token_source(
             self.transport.key_file, self.transport.endpoint
         )
-        # Object sizes for the native receive path (buffer pre-sizing).
-        self._stat_cache: dict[str, int] = {}
-        self._stat_cache_lock = threading.Lock()
         # Keep-alive pool for the native receive path (same connection
         # discipline as the Python client's pool, so A/Bs isolate the
         # receive loop): shared pool machinery, lazily built on first use
         # (locked: worker threads hit first use concurrently).
         self._native_pool_obj = None
         self._native_pool_lock = threading.Lock()
-        self._native_bufpool = None
 
     @property
     def scheme(self) -> str:
@@ -284,7 +363,6 @@ class GcsHttpBackend:
                     self.transport, self._host, self._port,
                     tls=self._scheme == "https",
                 )
-                self._native_bufpool = self._native_pool_obj.buffers
         return self._native_pool_obj
 
     @property
@@ -375,103 +453,126 @@ class GcsHttpBackend:
             raise
 
     def _open_read_native(self, name: str, start: int, length: Optional[int]):
-        """Opt-in C++ receive path (``transport.native_receive``): the body
-        streams from the socket into a pre-registered posix_memalign'd
-        buffer with a native first-byte timestamp, over pooled keep-alive
-        connections — the same connection discipline as the Python path,
-        so A/Bs isolate the receive loop. https endpoints ride the
-        engine's TLS layer (verification against ``transport.tls_ca_file``
-        or the system store; ``transport.tls_insecure_skip_verify`` for
-        self-signed test endpoints)."""
-        from tpubench.native.engine import (
-            PERMANENT_CODES,
-            TB_ETOOBIG,
-            NativeError,
-        )
+        """Opt-in C++ receive path (``transport.native_receive``): the
+        engine sends the GET and parses the response headers
+        (``tb_conn_get_begin``); body bytes then stream from the socket
+        DIRECTLY into whatever memory the caller's ``readinto`` offers —
+        granule buffer or staging slot — with no full-body intermediate
+        buffer and no completion copy (the round-2 path landed the whole
+        body in a pool buffer first, which cost it the A/B against the
+        Python client). Pooled keep-alive connections, same discipline as
+        the Python path; https rides the engine's TLS layer (verification
+        against ``transport.tls_ca_file`` or the system store;
+        ``transport.tls_insecure_skip_verify`` for self-signed test
+        endpoints)."""
+        from tpubench.native.engine import PERMANENT_CODES, NativeError
 
         pool = self._native_pool()  # raises when engine/TLS unavailable
         engine = pool.engine
-        if length is None:
-            # Size the receive buffer from object metadata, cached per name
-            # (one extra stat on the first read of each object).
-            with self._stat_cache_lock:
-                size = self._stat_cache.get(name)
-            if size is None:
-                size = self.stat(name).size
-                with self._stat_cache_lock:
-                    self._stat_cache[name] = size
-            want = size - start
-        else:
-            want = length
         _, _, req_path, headers = self.native_request_parts(name)
         if length is not None:
-            headers += f"Range: bytes={start}-{start + want - 1}\r\n"
+            headers += f"Range: bytes={start}-{start + length - 1}\r\n"
         elif start:
-            # Open-ended: never derive the end from (possibly stale) stat —
-            # a grown object then fails loudly (body-exceeds-buffer) instead
-            # of being silently truncated by a too-short Range.
             headers += f"Range: bytes={start}-\r\n"
-        # Buffer first, socket second: whichever acquisition fails, the
-        # other resource is released on that path (no fd leak when a huge
-        # alloc fails; no buffer leak when connect fails).
-        buf = self._native_bufpool.acquire(max(4096, want))
-        # Keep-alive: reuse a pooled native connection when available. A
-        # stale pooled socket (server timed it out, or trailing junk from
-        # the previous response arrived after the reuse-time drain check)
-        # fails on first use — standard HTTP-client behavior is one
-        # immediate retransmit of the idempotent GET on a FRESH socket, so
-        # pool staleness never surfaces as a request failure.
-        def do_request(conn: int) -> dict:
-            # One span per attempt: a stale-pool retry shows as a failed
-            # span followed by the successful one.
-            with self._tracer.span(
-                "gcs_http.get_native", object=name, bucket=self.bucket
-            ) as sp:
-                r = engine.conn_request(
-                    conn, self._host, self._port, req_path, buf,
-                    headers=headers,
+        # A stale pooled socket (server timed it out, or trailing junk
+        # arrived after the reuse-time drain check) fails at begin() on
+        # first use — standard HTTP-client behavior is one immediate
+        # retransmit of the idempotent GET on a FRESH socket, so pool
+        # staleness never surfaces as a request failure. Permanent
+        # protocol-shape codes never burn the retransmit (they reproduce).
+        conn, reused = pool.acquire()
+        carrier = SpanCarrier(
+            self._tracer, "gcs_http.get_native", object=name, bucket=self.bucket
+        )
+        while True:
+            try:
+                r = engine.conn_get_begin(
+                    conn, self._host, self._port, req_path, headers=headers
                 )
-                sp.event("first_byte", native_ns=r["first_byte_ns"])
-            return r
-
-        try:
-            r = pool.run(do_request, reusable=lambda r: r["reusable"])
-        except StorageError:
-            self._native_bufpool.release(buf)  # connect failure, classified
-            raise
-        except NativeError as e:
-            # Module contract: this layer raises classified StorageErrors.
-            # Classification is on the engine's error-code ABI (engine.cc
-            # TB_* enum), not message text: socket-level failures (resets,
-            # refusals, timeouts, short bodies) are transient and retried
-            # under policy; protocol-shape errors (malformed response,
-            # chunked encoding, body too big for the buffer) reproduce on
-            # retry and are not. Exception: body-exceeds-buffer when the
-            # buffer was sized from the (just-invalidated) stat cache — the
-            # object may have grown, and one retry re-stats and re-sizes.
-            self._native_bufpool.release(buf)
-            with self._stat_cache_lock:
-                self._stat_cache.pop(name, None)  # size may be stale
-            transient = e.code not in PERMANENT_CODES
-            if e.code == TB_ETOOBIG and length is None:
-                transient = True
-            raise StorageError(
-                f"native GET {name}: {e}", transient=transient
-            ) from e
-        except BaseException:
-            # Includes KeyboardInterrupt: an interrupted in-flight GET must
-            # not strand a multi-MB receive buffer.
-            self._native_bufpool.release(buf)
-            raise
+                break
+            except NativeError as e:
+                pool.discard(conn)
+                if reused and e.code not in PERMANENT_CODES:
+                    reused = False
+                    pool.note_stale_retry()
+                    carrier.event("stale_retry")
+                    try:
+                        conn = pool.fresh()
+                    except BaseException as e2:
+                        carrier.close(e2)
+                        raise
+                    continue
+                # Module contract: this layer raises classified
+                # StorageErrors, on the engine's code ABI — socket-level
+                # failures transient, protocol-shape failures permanent.
+                err = StorageError(
+                    f"native GET {name}: {e}",
+                    transient=e.code not in PERMANENT_CODES,
+                )
+                carrier.close(err)
+                raise err from e
+            except BaseException as e:
+                # Includes KeyboardInterrupt: never strand the connection.
+                pool.discard(conn)
+                carrier.close(e)
+                raise
+        carrier.event("response_headers", status=r["status"])
+        if r["first_byte_ns"]:
+            # Begin() read the response headers, so the native first-byte
+            # stamp exists by now — surface it on the span like the Python
+            # reader's first_byte event (trace symmetry for A/Bs).
+            carrier.event("first_byte", native_ns=r["first_byte_ns"])
+        range_ignored = r["status"] in (200, 206) and (
+            # Too many bytes announced for a bounded range.
+            (
+                length is not None
+                and r["content_len"] >= 0
+                and r["content_len"] > length
+            )
+            # Any range from a nonzero start answered with 200: the body
+            # starts at offset 0, not `start` — serving it would silently
+            # hand back the wrong bytes (the round-2 buffer path caught
+            # this as TB_ETOOBIG; streaming has no buffer, so the check
+            # lives here). A conformant server honoring any Range answers
+            # 206.
+            or (start > 0 and r["status"] == 200)
+        )
+        if range_ignored:
+            # Protocol-shape failure — a retry reproduces it. Fail loudly
+            # rather than silently serving bytes the caller never asked
+            # for.
+            pool.discard(conn)
+            err = StorageError(
+                f"GET {name}: server ignored Range "
+                f"(status {r['status']}, announced {r['content_len']}, "
+                f"requested start={start} length={length})",
+                transient=False,
+            )
+            carrier.close(err)
+            raise err
         if r["status"] not in (200, 206):
-            self._native_bufpool.release(buf)
-            raise StorageError(
-                f"GET {name}: HTTP {r['status']}", transient=r["status"] >= 500,
+            # Error payload: drain it (bounded) so the connection can go
+            # back to the pool, then classify like the Python path.
+            msg = bytearray(4096)
+            n = 0
+            try:
+                n = engine.conn_body_read(conn, msg, len(msg))
+                sink = bytearray(65536)
+                while engine.conn_body_read(conn, sink, len(sink)) > 0:
+                    pass
+                pool.release(conn, engine.conn_get_end(conn))
+            except Exception:
+                pool.discard(conn)
+            err = StorageError(
+                f"GET {name}: HTTP {r['status']}: "
+                f"{msg[:n].decode('utf-8', 'replace')[:200]}",
+                transient=r["status"] in _TRANSIENT,
                 code=r["status"],
             )
-        return _NativeBufReader(
-            buf, r["length"], r["first_byte_ns"],
-            release=self._native_bufpool.release,
+            carrier.close(err)
+            raise err
+        return _NativeStreamReader(
+            pool, conn, r["content_len"], r["first_byte_ns"], carrier=carrier
         )
 
     def write(self, name: str, data: bytes) -> ObjectMeta:

@@ -22,7 +22,7 @@ from __future__ import annotations
 import threading
 from typing import Callable
 
-from tpubench.native.engine import NativeError
+from tpubench.native.engine import PERMANENT_CODES, NativeError
 from tpubench.storage.base import StorageError
 
 
@@ -140,11 +140,48 @@ class NativeConnPool:
             self.stats["connects"] += 1
         return h
 
+    def fresh(self) -> int:
+        """A guaranteed-fresh connection (stale-retry path: a second pooled
+        handle could be just as stale as the first)."""
+        return self._new()
+
+    def acquire(self) -> tuple[int, bool]:
+        """(handle, reused) — a pooled idle handle when available, else a
+        fresh connection. The caller owns it until :meth:`release` or
+        :meth:`discard` (streaming readers hold it across body reads)."""
+        with self._lock:
+            conn = self._idle.pop() if self._idle else 0
+            if conn:
+                self.stats["reuses"] += 1
+        if conn:
+            return conn, True
+        return self._new(), False
+
+    def release(self, conn: int, reusable: bool) -> None:
+        """Return a handle: back to the idle pool when ``reusable`` and
+        there is room, else closed."""
+        if reusable:
+            with self._lock:
+                if len(self._idle) < self._max_idle:
+                    self._idle.append(conn)
+                    return
+        self.engine.conn_close(conn)
+
+    def discard(self, conn: int) -> None:
+        """Close a handle whose stream state is unknown (request failed)."""
+        self.engine.conn_close(conn)
+
+    def note_stale_retry(self) -> None:
+        with self._lock:
+            self.stats["stale_retries"] += 1
+
     def run(
         self,
         request: Callable[[int], dict],
         reusable: Callable[[dict], bool] = lambda r: True,
-        retry_stale: Callable[[NativeError], bool] = lambda e: True,
+        retry_stale: Callable[[NativeError], bool] = (
+            lambda e: e.code not in PERMANENT_CODES
+        ),
     ) -> dict:
         """Run one request on a pooled (or fresh) handle.
 
@@ -152,17 +189,13 @@ class NativeConnPool:
         and the pool has room. On :class:`NativeError` the handle is closed
         (stream state unknown); if this was the first use of a POOLED
         handle and ``retry_stale(e)`` holds, the request retries once on a
-        fresh connection before the error propagates — ``retry_stale``
-        exists so errors that prove the server answered (an explicit
-        grpc-status) are never misread as pool staleness.
+        fresh connection before the error propagates — the default never
+        burns a stale retransmit on permanent protocol errors (TB_EPROTO/
+        TB_ETOOBIG/TB_ECHUNKED reproduce identically on a fresh socket);
+        callers override it so errors that prove the server answered (an
+        explicit grpc-status) are never misread as pool staleness either.
         """
-        with self._lock:
-            conn = self._idle.pop() if self._idle else 0
-            if conn:
-                self.stats["reuses"] += 1
-        reused = bool(conn)
-        if not reused:
-            conn = self._new()
+        conn, reused = self.acquire()
         while True:
             try:
                 r = request(conn)
