@@ -115,18 +115,57 @@ def test_read_workload_native_executor(server):
     assert res.gbps > 0
 
 
-def test_native_executor_rejects_staging(server):
-    from tpubench.workloads.read import run_read
-
+def _staged_cfg(server, **kw) -> BenchConfig:
     cfg = BenchConfig()
     cfg.transport.protocol = "http"
     cfg.transport.endpoint = server.endpoint
     cfg.workload.bucket = "testbucket"
     cfg.workload.object_name_prefix = "bench/file_"
+    cfg.workload.workers = kw.pop("workers", 2)
+    cfg.workload.read_calls_per_worker = kw.pop("reads", 2)
     cfg.workload.fetch_executor = "native"
     cfg.staging.mode = "device_put"
-    with pytest.raises(ValueError, match="staging"):
-        run_read(cfg)
+    cfg.staging.slot_bytes = kw.pop("slot_bytes", 128 * 1024)
+    cfg.staging.depth = kw.pop("depth", 3)
+    cfg.staging.validate_checksum = kw.pop("validate", True)
+    for k, v in kw.items():
+        raise AssertionError(f"unknown kw {k}={v}")
+    return cfg
+
+
+def test_native_executor_staged_ingest_checksummed(server):
+    """The flagship path on the executor: slot-sized byte ranges fetched by
+    C++ pthreads DIRECTLY into staging-slot buffers, shipped to the device
+    with one async device_put per slot. The on-device checksum against the
+    host-side sum proves the landed bytes are the fetched bytes — across
+    partial tail slots too (500 KB objects, 128 KB slots → 4 ranges, last
+    one short)."""
+    from tpubench.workloads.read import run_read
+
+    cfg = _staged_cfg(server)
+    res = run_read(cfg)
+    assert res.errors == 0
+    assert res.bytes_total == 2 * 2 * 500_000
+    assert res.extra["fetch_executor"] == "native"
+    assert res.extra["staging_zero_copy"] is True
+    assert res.extra["staged_bytes"] == res.bytes_total
+    assert res.extra["checksum_ok"] is True
+    assert res.summaries["read"].count == 4
+    assert res.summaries["first_byte"].count == 4
+    assert res.summaries["stage"].count >= 4 * 4  # >= one per slot-range
+    assert res.extra["staged_gbps_per_chip"] > 0
+
+
+def test_native_executor_staged_single_slot_object(server):
+    """Object smaller than one slot: one range, one transfer per read."""
+    from tpubench.workloads.read import run_read
+
+    cfg = _staged_cfg(server, slot_bytes=1 << 20, workers=1, reads=3)
+    res = run_read(cfg)
+    assert res.errors == 0
+    assert res.bytes_total == 3 * 500_000
+    assert res.extra["checksum_ok"] is True
+    assert res.summaries["stage"].count == 3
 
 
 def test_native_executor_rejects_fake_protocol():
@@ -140,3 +179,91 @@ def test_native_executor_rejects_fake_protocol():
     cfg.staging.mode = "none"
     with pytest.raises(ValueError, match="plain-http"):
         run_read(cfg)
+
+
+def _faulty_server_cfg(error_rate: float, staged: bool, max_attempts: int = 0):
+    """(server, cfg) with FaultPlan 503s injected server-side — the retry
+    policy over executor completions has something real to chew on."""
+    from tpubench.storage.fake import FaultPlan
+
+    be = FakeBackend.prepopulated("bench/file_", count=2, size=300_000)
+    be.fault = FaultPlan(error_rate=error_rate, seed=7)
+    srv = FakeGcsServer(be)
+    srv.start()
+    cfg = BenchConfig()
+    cfg.transport.protocol = "http"
+    cfg.transport.endpoint = srv.endpoint
+    cfg.transport.retry.initial_backoff_s = 0.005
+    cfg.transport.retry.max_backoff_s = 0.02
+    cfg.transport.retry.max_attempts = max_attempts
+    cfg.workload.bucket = "testbucket"
+    cfg.workload.object_name_prefix = "bench/file_"
+    cfg.workload.workers = 2
+    cfg.workload.read_calls_per_worker = 4
+    cfg.workload.fetch_executor = "native"
+    if staged:
+        cfg.staging.mode = "device_put"
+        cfg.staging.slot_bytes = 100_000
+        cfg.staging.validate_checksum = True
+    else:
+        cfg.staging.mode = "none"
+    return srv, cfg
+
+
+def test_native_executor_retries_injected_503s():
+    """VERDICT r2 #6: transient completions (injected 503s) re-enter the
+    submit queue under the gax policy — the run completes with ZERO errors,
+    exactly like the Python path under the same fault plan, not with the
+    executor's old one-stale-retransmit-only semantics."""
+    from tpubench.workloads.read import run_read
+
+    srv, cfg = _faulty_server_cfg(error_rate=0.3, staged=False)
+    try:
+        res = run_read(cfg)
+        assert res.errors == 0
+        assert res.bytes_total == 2 * 4 * 300_000
+        assert res.extra["retries"] > 0  # the fault plan really fired
+        assert srv.backend.injected_errors > 0
+    finally:
+        srv.stop()
+
+
+def test_native_executor_staged_retries_injected_503s():
+    """Same gax-retry semantics on the STAGED executor path, with the
+    checksum proving retried ranges landed intact in HBM."""
+    from tpubench.workloads.read import run_read
+
+    srv, cfg = _faulty_server_cfg(error_rate=0.3, staged=True)
+    try:
+        res = run_read(cfg)
+        assert res.errors == 0
+        assert res.bytes_total == 2 * 4 * 300_000
+        assert res.extra["checksum_ok"] is True
+        assert res.extra["retries"] > 0
+    finally:
+        srv.stop()
+
+
+def test_native_executor_retry_exhaustion_aborts():
+    """A permanent failure domain (404: no retry under 'idempotent')
+    aborts with errgroup semantics when abort_on_error is set."""
+    from tpubench.workloads.read import run_read
+
+    be = FakeBackend.prepopulated("bench/file_", count=1, size=10_000)
+    srv = FakeGcsServer(be)
+    srv.start()
+    try:
+        cfg = BenchConfig()
+        cfg.transport.protocol = "http"
+        cfg.transport.endpoint = srv.endpoint
+        cfg.transport.retry.policy = "idempotent"
+        cfg.workload.bucket = "testbucket"
+        cfg.workload.object_name_prefix = "bench/missing_"  # 404s
+        cfg.workload.workers = 1
+        cfg.workload.read_calls_per_worker = 1
+        cfg.workload.fetch_executor = "native"
+        cfg.staging.mode = "none"
+        with pytest.raises(Exception, match="read failed|stat|404|not found"):
+            run_read(cfg)
+    finally:
+        srv.stop()

@@ -689,9 +689,26 @@ class NativeFetchPool:
         headers: str = "",
         tag: int = 0,
     ) -> None:
+        self.submit_to(host, port, path, buf.address, buf.size,
+                       headers=headers, tag=tag)
+
+    def submit_to(
+        self,
+        host: str,
+        port: int,
+        path: str,
+        address: int,
+        nbytes: int,
+        headers: str = "",
+        tag: int = 0,
+    ) -> None:
+        """Submit a GET whose body lands at a raw (address, nbytes) region —
+        e.g. a staging slot's native buffer, so completed fetches sit in
+        slot memory with zero copies. The memory must stay valid until the
+        completion returns from :meth:`next`."""
         rc = self._engine.lib.tb_pool_submit(
             self._h, host.encode(), port, path.encode(), headers.encode(),
-            buf.address, buf.size, tag,
+            address, nbytes, tag,
         )
         if rc != 0:
             _check(rc, "pool_submit")

@@ -1,0 +1,716 @@
+"""Read workload on the C++ fetch executor (``tb_pool_*``) — the
+reference's errgroup fan-out (``main.go:200-212``) in native code, with the
+client-level retry policy (``main.go:179-184``) applied to completions and,
+in staged mode, the flagship GCS→HBM pipeline fed directly from the
+executor.
+
+Two runners:
+
+* :func:`run_read_native_executor` — staging "none": measures pure fetch
+  fan-out (host-RAM parity with ``io.Discard``, main.go:140). Worker *i*
+  owns object ``<prefix><i>`` with ONE outstanding read (the serial
+  per-worker loop's concurrency shape); dispatch, keep-alive, receive and
+  timing run on pool pthreads; Python only drains completions.
+
+* :func:`run_read_native_staged` — staging "device_put": the object is
+  range-sharded at STAGING-SLOT granularity; each pool task lands one
+  slot-sized byte range straight into a staging slot's posix_memalign'd
+  buffer (socket → slot, zero copies), and the slot ships to HBM with one
+  async ``jax.device_put``. Python's only per-slot work is that one launch
+  — one interpreter touch per ``slot_bytes`` (default 8-16 MB), not per
+  granule or per socket read. This is the executor equivalent of the
+  Python zero-copy sink path (``staging/device.py``), with the fetch hot
+  loop fully native.
+
+Retry semantics (both runners): completions that classify as failures
+re-enter the submit queue under the gax policy (``storage/retry.py``
+semantics: jittered exponential backoff, 30 s cap, x2.0; policy
+"always"/"idempotent"/"never"; optional attempt cap and deadline from
+``transport.retry``) — not just the executor's built-in one
+stale-connection retransmit. Backoff pauses are served by the completion
+wait's timeout, so a worker awaiting backoff never blocks the drain loop.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from typing import Optional
+
+from tpubench.config import BenchConfig, RetryConfig
+from tpubench.metrics import MetricSet
+from tpubench.metrics.report import RunResult
+from tpubench.storage.base import StorageBackend
+
+# Status codes the GCS client treats as transient (matches gcs_http).
+_TRANSIENT_HTTP = {408, 429, 500, 502, 503, 504}
+
+
+def _classify(result: int, status: int, permanent_codes) -> str:
+    """'ok' | 'transient' | 'permanent' for one executor completion.
+
+    Same classification the Python path applies via StorageError.transient:
+    negative engine codes split on the PERMANENT_CODES ABI (socket errnos
+    and short bodies transient, protocol-shape permanent); HTTP statuses
+    split on the 408/429/5xx set.
+    """
+    if result < 0:
+        return "permanent" if result in permanent_codes else "transient"
+    if status in (200, 206):
+        return "ok"
+    return "transient" if status in _TRANSIENT_HTTP else "permanent"
+
+
+class RetryScheduler:
+    """gax backoff over executor completions.
+
+    Tracks per-task attempt counts and Backoff state; failed tasks are
+    ``push()``ed and come back from ``pop_due()`` when their jittered pause
+    elapses. ``next_due_in_ms`` feeds the completion wait's timeout so
+    pauses cost no busy-waiting and never block other workers' completions.
+    """
+
+    def __init__(self, cfg: RetryConfig, clock=time.monotonic):
+        from tpubench.storage.retry import Backoff
+
+        self._cfg = cfg
+        self._clock = clock
+        self._backoff_cls = Backoff
+        # key -> (attempts, Backoff, chain_start): deadline_s is measured
+        # from each task's OWN first failure (retry_call measures from each
+        # call's start), not from run start — a long run must not stop
+        # retrying late tasks just because the run is old.
+        self._state: dict[int, tuple[int, object, float]] = {}
+        self._heap: list[tuple[float, int, object]] = []
+        self.retries = 0
+
+    def offer(self, key: int, verdict: str) -> Optional[float]:
+        """Decide whether task ``key`` (which failed with ``verdict``) may
+        retry. Returns the pause in seconds, or None = give up (policy
+        forbids, attempts exhausted, or deadline passed). Mirrors
+        ``retry_call``: policy "always" retries any storage-level failure,
+        "idempotent" only transient ones, "never" none.
+        """
+        cfg = self._cfg
+        if cfg.policy == "never":
+            return None
+        if cfg.policy == "idempotent" and verdict != "transient":
+            return None
+        now = self._clock()
+        attempts, backoff, chain_start = self._state.get(key, (0, None, now))
+        if backoff is None:
+            backoff = self._backoff_cls(cfg)
+        attempts += 1
+        if cfg.max_attempts and attempts >= cfg.max_attempts:
+            return None
+        pause = backoff.pause()
+        if cfg.deadline_s and (now - chain_start) + pause > cfg.deadline_s:
+            return None
+        self._state[key] = (attempts, backoff, chain_start)
+        return pause
+
+    def push(self, key: int, item, pause: float) -> None:
+        heapq.heappush(self._heap, (self._clock() + pause, key, item))
+        self.retries += 1
+
+    def done(self, key: int) -> None:
+        self._state.pop(key, None)
+
+    def pop_due(self) -> list:
+        now = self._clock()
+        due = []
+        while self._heap and self._heap[0][0] <= now:
+            _, _, item = heapq.heappop(self._heap)
+            due.append(item)
+        return due
+
+    @property
+    def waiting(self) -> int:
+        return len(self._heap)
+
+    def next_due_in_ms(self, cap_ms: int) -> int:
+        """Completion-wait timeout: min(cap, time to the next due retry)."""
+        if not self._heap:
+            return cap_ms
+        ms = int((self._heap[0][0] - self._clock()) * 1000) + 1
+        return max(1, min(cap_ms, ms))
+
+
+def _require_native_http(cfg: BenchConfig, backend: StorageBackend):
+    """Shared preconditions: the executor speaks plain HTTP (the hermetic
+    bench path); returns (engine, inner GcsHttpBackend)."""
+    from tpubench.native.engine import get_engine
+    from tpubench.storage.gcs_http import GcsHttpBackend
+
+    engine = get_engine()
+    if engine is None:
+        raise RuntimeError(
+            "workload.fetch_executor='native' but the native engine is "
+            "unavailable (C++ toolchain missing?)"
+        )
+    inner = getattr(backend, "inner", backend)
+    if not isinstance(inner, GcsHttpBackend) or inner.scheme != "http":
+        raise ValueError(
+            "fetch_executor='native' requires --protocol http with a "
+            "plain-http endpoint (the executor's scope)"
+        )
+    return engine, inner
+
+
+def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunResult:
+    """Fetch fan-out on the executor, bytes discarded in host RAM
+    (reference parity: ``io.Discard``, main.go:140). Client retry policy
+    applies to completions (see module docstring); the executor's one
+    stale-connection retransmit remains underneath as pool hygiene, exactly
+    like the Python path's NativeConnPool."""
+    from tpubench.native.engine import PERMANENT_CODES
+
+    engine, inner = _require_native_http(cfg, backend)
+    w = cfg.workload
+    if cfg.staging.mode != "none":
+        raise ValueError(
+            "run_read_native_executor is the staging='none' runner; staged "
+            "ingest uses run_read_native_staged"
+        )
+
+    names = [f"{w.object_name_prefix}{i}" for i in range(w.workers)]
+    sizes = {n: inner.stat(n).size for n in set(names)}
+    metrics = MetricSet()
+    recorders = [metrics.new_worker(f"w{i}") for i in range(w.workers)]
+    reads_per = w.read_calls_per_worker
+    total_reads = w.workers * reads_per
+    if total_reads <= 0:
+        res = RunResult(workload="read", config=cfg.to_dict(), summaries={})
+        res.extra["fetch_executor"] = "native"
+        return res
+    pool = engine.pool_create(threads=w.workers, cap=max(4, 2 * w.workers))
+    retry = RetryScheduler(cfg.transport.retry)
+    inflight: dict[int, tuple] = {}  # tag -> (buffer, worker_id, size)
+    free_bufs: dict[int, list] = {}
+    bytes_total = 0
+    errors = 0
+    first_error = ""
+
+    def submit(wid: int, seq: int) -> None:
+        name = names[wid]
+        size = max(4096, sizes[name])
+        bucket = free_bufs.setdefault(size, [])
+        buf = bucket.pop() if bucket else engine.alloc(size)
+        host, port, path, headers = inner.native_request_parts(name)
+        pool.submit(
+            host, port, path, buf, headers=headers,
+            tag=wid * reads_per + seq,
+        )
+        inflight[wid * reads_per + seq] = (buf, wid, size)
+
+    def resubmit(tag: int) -> None:
+        buf, wid, size = inflight[tag]
+        name = names[wid]
+        host, port, path, headers = inner.native_request_parts(name)
+        pool.submit(host, port, path, buf, headers=headers, tag=tag)
+
+    from tpubench.obs.exporters import metrics_session_from_config
+
+    session = metrics_session_from_config(
+        cfg, metrics, bytes_fn=lambda: bytes_total
+    )
+    metrics.ingest.start()
+    try:
+        if session is not None:
+            session.__enter__()
+        # One outstanding read per logical worker — the serial per-worker
+        # loop's concurrency shape; a completion of worker `wid`'s read
+        # refills the SAME worker (a fast object never accumulates extra
+        # in-flight reads while a slow one starves). A read awaiting a
+        # retry backoff keeps its worker serialized too: the next read of
+        # that worker submits only after this one finally settles.
+        per_worker_next = [1] * w.workers
+        for wid in range(w.workers):
+            submit(wid, 0)
+        completed = 0
+        idle_waits = 0
+        while completed < total_reads:
+            for tag in retry.pop_due():
+                resubmit(tag)
+            c = pool.next(timeout_ms=retry.next_due_in_ms(30_000))
+            if c is None:
+                if retry.waiting:
+                    continue  # timeout was just a backoff pause elapsing
+                idle_waits += 1
+                if idle_waits >= 4:  # 4 x 30 s with zero completions
+                    raise RuntimeError("native fetch executor stalled (120s)")
+                continue
+            idle_waits = 0
+            tag = c["tag"]
+            buf, wid, size = inflight[tag]
+            read_rec, fb_rec = recorders[wid]
+            verdict = _classify(c["result"], c["status"], PERMANENT_CODES)
+            if verdict != "ok":
+                pause = retry.offer(tag, verdict)
+                if pause is not None:
+                    retry.push(tag, tag, pause)
+                    continue  # slot for this read stays inflight
+                retry.done(tag)
+                errors += 1
+                if not first_error:
+                    first_error = (
+                        f"worker {wid}: result {c['result']} "
+                        f"status {c['status']}"
+                    )
+            else:
+                retry.done(tag)
+                read_rec.record_ns(c["total_ns"])
+                if c["first_byte_ns"]:
+                    fb_rec.record_ns(c["first_byte_ns"] - c["start_ns"])
+                bytes_total += c["result"]
+            del inflight[tag]
+            free_bufs.setdefault(size, []).append(buf)
+            completed += 1
+            if verdict != "ok" and w.abort_on_error:
+                # errgroup semantics (main.go:200-219): first (post-retry)
+                # error cancels the run — same contract as the Python path.
+                raise RuntimeError(
+                    f"native fetch executor: read failed ({first_error})"
+                )
+            if per_worker_next[wid] < reads_per:
+                submit(wid, per_worker_next[wid])
+                per_worker_next[wid] += 1
+    finally:
+        # Stop the clock BEFORE teardown (thread joins + multi-MB munmaps
+        # must not bias the measured window vs the Python path).
+        metrics.ingest.stop()
+        metrics.ingest.bytes = bytes_total
+        if session is not None:
+            session.__exit__(None, None, None)  # guaranteed final flush
+        pool.close()
+        for bucket in free_bufs.values():
+            for buf in bucket:
+                buf.free()
+        for buf, _, _ in inflight.values():
+            buf.free()
+
+    wall = metrics.ingest.seconds
+    res = RunResult(
+        workload="read",
+        config=cfg.to_dict(),
+        bytes_total=bytes_total,
+        wall_seconds=wall,
+        gbps=metrics.ingest.gbps(),
+        gbps_per_chip=metrics.ingest.gbps(),
+        n_chips=1,
+        summaries=metrics.summaries(),
+        errors=errors,
+    )
+    res.extra["fetch_executor"] = "native"
+    res.extra["executor_threads"] = w.workers
+    res.extra["client_retry"] = (
+        f"gax policy over completions (policy={cfg.transport.retry.policy}, "
+        f"retries={retry.retries})"
+    )
+    res.extra["retries"] = retry.retries
+    if session is not None:
+        res.extra["metrics_export"] = session.summary()
+    if first_error:
+        res.extra["first_error"] = first_error
+    return res
+
+
+class _SlotPipeline:
+    """Per-worker staging ring fed by the executor: ``depth`` native slots
+    cycle through FREE → FETCHING (a range GET lands in the slot's buffer)
+    → TRANSFER (async ``jax.device_put``) → FREE. The companion of
+    ``DevicePutStager`` for executor-driven fetch; same accounting surface
+    (stage histogram = submit→transfer-complete per slot, staged bytes,
+    transfer count, optional on-device checksum)."""
+
+    def __init__(self, worker_id: int, engine, slot_bytes: int, depth: int,
+                 lane: int, device, validate: bool):
+        import jax
+        import jax.numpy as jnp
+
+        from tpubench.metrics.recorder import LatencyRecorder
+
+        self.device = device
+        self._jax = jax
+        self._slot_bytes = slot_bytes
+        self.bufs = [engine.alloc(slot_bytes) for _ in range(depth)]
+        self.arrays = [b.as_2d(lane) for b in self.bufs]
+        self.free = list(range(depth))
+        self.stage_recorder = LatencyRecorder(f"w{worker_id}/stage")
+        self.staged_bytes = 0
+        self.transfers = 0
+        self._validate = validate
+        self._host_sum = 0
+        self._dev_sum = (
+            jax.device_put(jnp.zeros((), jnp.uint32), device) if validate else None
+        )
+
+    def launch(self, slot: int, nbytes: int):
+        """Async device_put of the slot; returns the in-flight future.
+        Partial slots (object tail) zero-pad so checksums and landed
+        shapes see only real bytes — steady-state full slots skip the
+        memset."""
+        import numpy as np
+
+        arr = self.arrays[slot]
+        if nbytes < self._slot_bytes:
+            self.bufs[slot].array[nbytes:] = 0
+        if self._validate:
+            chunk = self.bufs[slot].array[:nbytes]
+            self._host_sum += int(chunk.astype(np.uint32).sum())
+        submit_ns = time.perf_counter_ns()
+        fut = self._jax.device_put(arr, self.device)
+        self.transfers += 1
+        if self._validate:
+            from tpubench.staging.device import _accum_checksum
+
+            # Validation trades overlap for integrity (same contract as
+            # DevicePutStager): the accumulate must read the landed array
+            # before the slot can be reused, so complete it now.
+            self._dev_sum = _accum_checksum(self._dev_sum, fut)
+            self._dev_sum.block_until_ready()
+        return fut, submit_ns, nbytes
+
+    def complete(self, slot: int, submit_ns: int, nbytes: int) -> None:
+        self.stage_recorder.record_ns(time.perf_counter_ns() - submit_ns)
+        self.staged_bytes += nbytes
+        self.free.append(slot)
+
+    def checksum(self) -> Optional[bool]:
+        if not self._validate:
+            return None
+        dev = int(self._jax.device_get(self._dev_sum))
+        return dev == self._host_sum % (2**32)
+
+    def close(self) -> None:
+        for b in self.bufs:
+            b.free()
+        self.bufs = []
+        self.arrays = []
+
+
+def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResult:
+    """The flagship staged ingest with NO Python in the fetch hot loop.
+
+    Each worker's object is read as a sequence of slot-sized byte ranges
+    (``Range: bytes=a-b`` — the fake server and GCS JSON media GETs both
+    honor it); every range is one executor task landing bytes directly in
+    a staging slot's native buffer. On completion Python issues the one
+    async ``jax.device_put`` for that slot and immediately resubmits the
+    next range into a free slot — fetch (C++ pthreads) and host→HBM
+    transfers overlap continuously, bounded by ``staging.depth`` slots per
+    worker. Reads of one worker stay sequential (the reference's serial
+    per-worker loop, main.go:127-153); ranges WITHIN a read fetch
+    concurrently.
+    """
+    import jax
+
+    from tpubench.config import MB
+    from tpubench.native.engine import PERMANENT_CODES
+
+    engine, inner = _require_native_http(cfg, backend)
+    w = cfg.workload
+    s = cfg.staging
+    if s.mode != "device_put":
+        raise ValueError(
+            "fetch_executor='native' staged ingest supports staging "
+            "'device_put' (pallas staging rides the Python orchestration "
+            "paths)"
+        )
+    lane = s.lane
+    # The pipeline needs >= 2 slots per worker for fetch/transfer overlap
+    # (one slot would serialize them); config depth sets the ceiling.
+    depth = max(2, s.depth)
+    # Slot size under the host budget. Unlike budgeted_slot_bytes there is
+    # NO granule floor: this path has no granule buffer — the slot IS the
+    # fetch unit (one range GET per slot), so any lane multiple is legal.
+    budget = max(1, s.host_budget_mb) * MB
+    per_worker = budget // max(1, w.workers * depth)
+    slot_bytes = max(lane, min(s.slot_bytes, per_worker))
+    slot_bytes = (slot_bytes + lane - 1) // lane * lane
+
+    names = [f"{w.object_name_prefix}{i}" for i in range(w.workers)]
+    sizes = [inner.stat(n).size for n in names]
+    reads_per = w.read_calls_per_worker
+    total_reads = w.workers * reads_per
+    metrics = MetricSet()
+    recorders = [metrics.new_worker(f"w{i}") for i in range(w.workers)]
+    if total_reads <= 0 or sum(sizes) == 0:
+        res = RunResult(workload="read", config=cfg.to_dict(), summaries={})
+        res.extra["fetch_executor"] = "native"
+        return res
+
+    devices = jax.local_devices()
+    pipes = [
+        _SlotPipeline(
+            i, engine, slot_bytes, depth, lane,
+            devices[i % len(devices)], s.validate_checksum,
+        )
+        for i in range(w.workers)
+    ]
+
+    # Per-worker read-progress state machine.
+    class _W:
+        __slots__ = (
+            "call", "next_off", "ranges_out", "ranges_done", "t0",
+            "fetched", "first_fb", "failed",
+        )
+
+    ws = []
+    completed_upfront = 0
+    for i in range(w.workers):
+        st = _W()
+        st.call = 0          # current read-call index
+        st.next_off = 0      # next unsubmitted byte offset of this call
+        st.ranges_out = 0    # in-flight (or retrying) ranges of this call
+        st.ranges_done = 0
+        st.t0 = 0            # perf_counter_ns at first submit of this call
+        st.fetched = 0       # bytes fetched this call
+        st.first_fb = False  # first-byte recorded for this call
+        st.failed = False    # this call had a post-retry range failure
+        if sizes[i] == 0:
+            # Zero-length object: every read completes trivially (nothing
+            # to range-shard); without this the state machine would never
+            # see a completion for this worker.
+            st.call = reads_per
+            st.next_off = 0
+            completed_upfront += reads_per
+        ws.append(st)
+
+    pool = engine.pool_create(
+        threads=w.workers, cap=max(8, 2 * w.workers * depth)
+    )
+    retry = RetryScheduler(cfg.transport.retry)
+    inflight: dict[int, tuple] = {}  # tag -> (wid, slot, start, length)
+    transfers: list = []  # FIFO of (wid, slot, fut, submit_ns, nbytes)
+    next_tag = 0
+    bytes_total = 0
+    errors = 0
+    first_error = ""
+    completed_reads = completed_upfront
+
+    def submit_range(wid: int) -> None:
+        nonlocal next_tag
+        st = ws[wid]
+        pipe = pipes[wid]
+        slot = pipe.free.pop()
+        start = st.next_off
+        length = min(slot_bytes, sizes[wid] - start)
+        if st.next_off == 0 and st.ranges_out == 0:
+            st.t0 = time.perf_counter_ns()
+            st.first_fb = False
+        st.next_off += length
+        st.ranges_out += 1
+        host, port, path, headers = inner.native_request_parts(names[wid])
+        headers += f"Range: bytes={start}-{start + length - 1}\r\n"
+        tag = next_tag
+        next_tag += 1
+        pool.submit_to(
+            host, port, path, pipe.bufs[slot].address, length,
+            headers=headers, tag=tag,
+        )
+        inflight[tag] = (wid, slot, start, length)
+
+    def resubmit(tag: int) -> None:
+        wid, slot, start, length = inflight[tag]
+        # Headers rebuilt per attempt — native_request_parts keeps bearer
+        # tokens fresh across backoff windows (same as the unstaged runner
+        # and the Python path).
+        host, port, path, headers = inner.native_request_parts(names[wid])
+        headers += f"Range: bytes={start}-{start + length - 1}\r\n"
+        pool.submit_to(
+            host, port, path, pipes[wid].bufs[slot].address, length,
+            headers=headers, tag=tag,
+        )
+
+    def drain_ready_transfers() -> None:
+        # jax.Array.is_ready() is the non-blocking completion probe; a JAX
+        # build without it degrades to inline (blocking) drains — never to
+        # freeing a slot whose transfer might still be reading it.
+        while transfers:
+            fut = transfers[0][2]
+            if hasattr(fut, "is_ready"):
+                if not fut.is_ready():
+                    break
+            else:
+                fut.block_until_ready()
+            wid, slot, _, submit_ns, nbytes = transfers.pop(0)
+            pipes[wid].complete(slot, submit_ns, nbytes)
+
+    def drain_one_transfer_blocking() -> None:
+        wid, slot, fut, submit_ns, nbytes = transfers.pop(0)
+        fut.block_until_ready()
+        pipes[wid].complete(slot, submit_ns, nbytes)
+
+    def can_submit(wid: int) -> bool:
+        st = ws[wid]
+        if st.call >= reads_per or not pipes[wid].free:
+            return False
+        if st.next_off < sizes[wid]:
+            return True
+        # Current call fully submitted; the next call may start only when
+        # this one's fetches all settled (serial reads per worker).
+        return False
+
+    from tpubench.obs.exporters import metrics_session_from_config
+
+    session = metrics_session_from_config(
+        cfg, metrics, bytes_fn=lambda: bytes_total
+    )
+    metrics.ingest.start()
+    last_progress = time.monotonic()
+    try:
+        if session is not None:
+            session.__enter__()
+        while completed_reads < total_reads:
+            if inflight and time.monotonic() - last_progress > 120:
+                # Same wedged-completion-queue guard as the unstaged
+                # runner: fail loudly instead of polling forever.
+                raise RuntimeError("staged executor stalled (120s)")
+            for tag in retry.pop_due():
+                resubmit(tag)
+            drain_ready_transfers()
+            for wid in range(w.workers):
+                while can_submit(wid):
+                    submit_range(wid)
+            if not inflight and not retry.waiting:
+                if transfers:
+                    drain_one_transfer_blocking()
+                    continue
+                # Nothing in flight anywhere but reads remain — every
+                # remaining call must be startable; loop submits them.
+                if any(can_submit(i) for i in range(w.workers)):
+                    continue
+                raise RuntimeError("staged executor: no runnable work left")
+            # In-flight transfers drain via is_ready() polls at the top of
+            # the loop: keep the wait short while any are pending so the
+            # device-side pipeline is never starved behind a slow fetch.
+            cap_ms = 5 if transfers else 100
+            c = pool.next(timeout_ms=retry.next_due_in_ms(cap_ms))
+            if c is None:
+                continue
+            last_progress = time.monotonic()
+            tag = c["tag"]
+            wid, slot, start, length = inflight[tag][:4]
+            st = ws[wid]
+            pipe = pipes[wid]
+            verdict = _classify(c["result"], c["status"], PERMANENT_CODES)
+            if verdict == "ok" and c["result"] != length:
+                # Range honored means exactly `length` bytes; anything else
+                # is a protocol-shape failure (server ignored the range).
+                verdict = "permanent"
+            if verdict != "ok":
+                pause = retry.offer(tag, verdict)
+                if pause is not None:
+                    retry.push(tag, tag, pause)
+                    continue  # slot stays owned by the retrying task
+                if not st.failed:
+                    # One error per failed READ (not per failed range) —
+                    # RunResult.errors parity with the other paths.
+                    errors += 1
+                if not first_error:
+                    first_error = (
+                        f"worker {wid} range {start}+{length}: "
+                        f"result {c['result']} status {c['status']}"
+                    )
+                del inflight[tag]
+                retry.done(tag)
+                pipe.free.append(slot)
+                # Abandon this call: stop submitting its ranges; it
+                # completes (as a failed read) when in-flight ones settle.
+                st.next_off = sizes[wid]
+                st.failed = True
+                st.ranges_out -= 1
+                if w.abort_on_error:
+                    raise RuntimeError(
+                        f"staged executor: read failed ({first_error})"
+                    )
+            else:
+                retry.done(tag)
+                del inflight[tag]
+                if not st.first_fb and c["first_byte_ns"]:
+                    recorders[wid][1].record_ns(
+                        c["first_byte_ns"] - c["start_ns"]
+                    )
+                    st.first_fb = True
+                bytes_total += length
+                st.fetched += length
+                st.ranges_done += 1
+                st.ranges_out -= 1
+                transfers.append(
+                    (wid, slot) + pipe.launch(slot, length)
+                )
+            # Call complete when fully submitted and nothing outstanding.
+            if st.next_off >= sizes[wid] and st.ranges_out == 0:
+                if not st.failed:
+                    # Failed reads are counted in `errors`, not in the
+                    # latency histogram (Python-path parity).
+                    recorders[wid][0].record_ns(time.perf_counter_ns() - st.t0)
+                completed_reads += 1
+                st.call += 1
+                st.next_off = 0 if st.call < reads_per else sizes[wid]
+                st.ranges_done = 0
+                st.failed = False
+        # All fetches done; drain remaining transfers into the timed window
+        # (staged bandwidth counts transfer completion, same as the Python
+        # staged path's finish()).
+        while transfers:
+            drain_one_transfer_blocking()
+    finally:
+        metrics.ingest.stop()
+        metrics.ingest.bytes = bytes_total
+        for pipe in pipes:
+            metrics.stage_latency.append(pipe.stage_recorder)
+        if session is not None:
+            session.__exit__(None, None, None)
+        # Error/interrupt exits: the slot buffers may still be read by
+        # in-flight fetches (pool pthreads) AND in-flight device_put
+        # transfers (plain numpy views do not pin). Settle BOTH before any
+        # free — the same drain-before-free contract as
+        # DevicePutStager.finish().
+        pool.close()  # joins workers after queued tasks finish their writes
+        for _, _, fut, _, _ in transfers:
+            try:
+                fut.block_until_ready()
+            except Exception:
+                pass  # a failed transfer still settles; freeing is now safe
+        transfers.clear()
+        for pipe in pipes:
+            pipe.close()
+
+    wall = metrics.ingest.seconds
+    n_chips = len(devices)
+    staged = sum(p.staged_bytes for p in pipes)
+    gbps = metrics.ingest.gbps()
+    res = RunResult(
+        workload="read",
+        config=cfg.to_dict(),
+        bytes_total=bytes_total,
+        wall_seconds=wall,
+        gbps=gbps,
+        gbps_per_chip=gbps / max(1, n_chips),
+        n_chips=n_chips,
+        summaries=metrics.summaries(),
+        errors=errors,
+    )
+    res.extra["fetch_executor"] = "native"
+    res.extra["executor_threads"] = w.workers
+    res.extra["staging_zero_copy"] = True
+    res.extra["staged_bytes"] = staged
+    res.extra["staged_gbps"] = (staged / 1e9) / wall if wall > 0 else 0.0
+    res.extra["staged_gbps_per_chip"] = res.extra["staged_gbps"] / max(1, n_chips)
+    res.extra["slot_bytes"] = slot_bytes
+    res.extra["depth"] = depth
+    res.extra["retries"] = retry.retries
+    res.extra["client_retry"] = (
+        f"gax policy over completions (policy={cfg.transport.retry.policy}, "
+        f"retries={retry.retries})"
+    )
+    checks = [p.checksum() for p in pipes]
+    if s.validate_checksum:
+        res.extra["checksum_ok"] = all(c is True for c in checks)
+    if session is not None:
+        res.extra["metrics_export"] = session.summary()
+    if first_error:
+        res.extra["first_error"] = first_error
+    return res

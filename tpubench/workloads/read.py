@@ -210,7 +210,14 @@ def run_read(
     backend = backend or open_backend(cfg, tracer=tracer)
     try:
         if cfg.workload.fetch_executor == "native":
-            return _run_read_native_executor(cfg, backend)
+            from tpubench.workloads.fetch_executor import (
+                run_read_native_executor,
+                run_read_native_staged,
+            )
+
+            if cfg.staging.mode == "none":
+                return run_read_native_executor(cfg, backend)
+            return run_read_native_staged(cfg, backend)
         return ReadWorkload(
             cfg=cfg,
             backend=backend,
@@ -220,156 +227,3 @@ def run_read(
     finally:
         if owns_backend:
             backend.close()
-
-
-def _run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunResult:
-    """The read fan-out on the C++ fetch executor (``tb_pool_*``): the
-    reference's errgroup in native code. Worker *i* still owns object
-    ``<prefix><i>`` and the in-flight window equals ``--worker``, so each
-    logical worker has one outstanding read (the serial per-worker loop's
-    concurrency shape) — but dispatch, keep-alive, receive, and timing all
-    run on pool pthreads; Python only drains completions.
-
-    Scope (validated loudly): plain-http endpoints, ``staging.mode ==
-    "none"`` — the executor measures fetch fan-out; staged ingest uses the
-    Python-orchestrated paths. The client-level retry policy does NOT
-    apply here (the executor's only recovery is the one stale-connection
-    retransmit); ``extra["client_retry"]`` records that.
-    """
-    from tpubench.native.engine import get_engine
-    from tpubench.storage.gcs_http import GcsHttpBackend
-
-    w = cfg.workload
-    engine = get_engine()
-    if engine is None:
-        raise RuntimeError(
-            "workload.fetch_executor='native' but the native engine is "
-            "unavailable (C++ toolchain missing?)"
-        )
-    inner = getattr(backend, "inner", backend)
-    if not isinstance(inner, GcsHttpBackend) or inner.scheme != "http":
-        raise ValueError(
-            "fetch_executor='native' requires --protocol http with a "
-            "plain-http endpoint (the executor's scope)"
-        )
-    if cfg.staging.mode != "none":
-        raise ValueError(
-            "fetch_executor='native' supports staging 'none' only "
-            "(it measures fetch fan-out; staged ingest uses the Python "
-            "orchestration paths)"
-        )
-
-    names = [f"{w.object_name_prefix}{i}" for i in range(w.workers)]
-    sizes = {n: inner.stat(n).size for n in set(names)}
-    metrics = MetricSet()
-    recorders = [metrics.new_worker(f"w{i}") for i in range(w.workers)]
-    reads_per = w.read_calls_per_worker
-    total_reads = w.workers * reads_per
-    if total_reads <= 0:
-        # The Python path with zero reads does nothing; match it (and
-        # avoid a tag-collision degenerate submit loop).
-        res = RunResult(
-            workload="read", config=cfg.to_dict(), summaries={},
-        )
-        res.extra["fetch_executor"] = "native"
-        return res
-    pool = engine.pool_create(threads=w.workers, cap=max(4, 2 * w.workers))
-    inflight: dict[int, tuple] = {}  # tag -> (buffer, worker_id, size)
-    free_bufs: dict[int, list] = {}
-    bytes_total = 0
-    errors = 0
-    first_error = ""
-
-    def submit(wid: int, seq: int) -> None:
-        name = names[wid]
-        size = max(4096, sizes[name])
-        bucket = free_bufs.setdefault(size, [])
-        buf = bucket.pop() if bucket else engine.alloc(size)
-        host, port, path, headers = inner.native_request_parts(name)
-        pool.submit(
-            host, port, path, buf, headers=headers,
-            tag=wid * reads_per + seq,
-        )
-        inflight[wid * reads_per + seq] = (buf, wid, size)
-
-    from tpubench.obs.exporters import metrics_session_from_config
-
-    session = metrics_session_from_config(
-        cfg, metrics, bytes_fn=lambda: bytes_total
-    )
-    metrics.ingest.start()
-    try:
-        if session is not None:
-            session.__enter__()
-        # One outstanding read per logical worker — the serial per-worker
-        # loop's concurrency shape; a completion of worker `wid`'s read
-        # refills the SAME worker (a fast object never accumulates extra
-        # in-flight reads while a slow one starves).
-        per_worker_next = [1] * w.workers
-        for wid in range(w.workers):
-            submit(wid, 0)
-        completed = 0
-        while completed < total_reads:
-            c = pool.next(timeout_ms=120_000)
-            if c is None:
-                raise RuntimeError("native fetch executor stalled (120s)")
-            buf, wid, size = inflight.pop(c["tag"])
-            read_rec, fb_rec = recorders[wid]
-            failed = c["result"] < 0 or c["status"] not in (200, 206)
-            if failed:
-                errors += 1
-                if not first_error:
-                    first_error = (
-                        f"worker {wid}: result {c['result']} "
-                        f"status {c['status']}"
-                    )
-            else:
-                read_rec.record_ns(c["total_ns"])
-                if c["first_byte_ns"]:
-                    fb_rec.record_ns(c["first_byte_ns"] - c["start_ns"])
-                bytes_total += c["result"]
-            free_bufs.setdefault(size, []).append(buf)
-            completed += 1
-            if failed and w.abort_on_error:
-                # errgroup semantics (main.go:200-219): first error
-                # cancels the run — same contract as the Python path.
-                raise RuntimeError(
-                    f"native fetch executor: read failed ({first_error})"
-                )
-            if per_worker_next[wid] < reads_per:
-                submit(wid, per_worker_next[wid])
-                per_worker_next[wid] += 1
-    finally:
-        # Stop the clock BEFORE teardown (thread joins + multi-MB munmaps
-        # must not bias the measured window vs the Python path).
-        metrics.ingest.stop()
-        metrics.ingest.bytes = bytes_total
-        if session is not None:
-            session.__exit__(None, None, None)  # guaranteed final flush
-        pool.close()
-        for bucket in free_bufs.values():
-            for buf in bucket:
-                buf.free()
-        for buf, _, _ in inflight.values():
-            buf.free()
-
-    wall = metrics.ingest.seconds
-    res = RunResult(
-        workload="read",
-        config=cfg.to_dict(),
-        bytes_total=bytes_total,
-        wall_seconds=wall,
-        gbps=metrics.ingest.gbps(),
-        gbps_per_chip=metrics.ingest.gbps(),
-        n_chips=1,
-        summaries=metrics.summaries(),
-        errors=errors,
-    )
-    res.extra["fetch_executor"] = "native"
-    res.extra["executor_threads"] = w.workers
-    res.extra["client_retry"] = "not applied (executor scope: one stale-connection retransmit only)"
-    if session is not None:
-        res.extra["metrics_export"] = session.summary()
-    if first_error:
-        res.extra["first_error"] = first_error
-    return res
