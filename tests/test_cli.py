@@ -311,3 +311,34 @@ def test_cli_sweep_native_ab(tmp_path, capsys):
     rows = json.loads(capsys.readouterr().out)
     assert [r.get("native_receive") for r in rows] == [False, True]
     assert all(r["gbps"] > 0 for r in rows)
+
+
+def test_cli_sweep_http1_vs_http2(tmp_path, capsys):
+    """The h1-vs-h2 A/B the reference could run (CreateHttpClient's
+    ForceAttemptHTTP2 branch, main.go:76-80): sweep cells for both
+    protocols against one dual-protocol fake endpoint."""
+    from tpubench.native.engine import get_engine
+    from tpubench.storage.base import deterministic_bytes
+    from tpubench.storage.fake import FakeBackend
+    from tpubench.storage.fake_h2_server import FakeH2Server
+
+    if get_engine() is None:
+        import pytest
+
+        pytest.skip("native engine unavailable")
+    be = FakeBackend()
+    with FakeH2Server(be) as srv:
+        for i in range(2):
+            name = f"bench/file_{i}"
+            be.write(name, deterministic_bytes(name, 256 * 1024).tobytes())
+        rc = main(
+            ["sweep", "--protocol", "http", "--endpoint", srv.endpoint,
+             "--bucket", "testbucket", "--object-name-prefix", "bench/file_",
+             "--sweep-protocols", "http,http2", "--sweep-sizes", "256kb",
+             "--workers", "2", "--read-call-per-worker", "2",
+             "--staging", "none", "--results-dir", str(tmp_path)]
+        )
+    assert rc == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert [r["protocol"] for r in rows] == ["http", "http2"]
+    assert all(r["gbps"] > 0 for r in rows)

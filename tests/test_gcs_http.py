@@ -119,10 +119,24 @@ def test_connection_reuse(server):
     c.close()
 
 
-def test_user_agent_and_http2_rejected(server):
+def test_http2_requires_native_engine(server):
+    """http2=True rides the native h2 client; without the engine the first
+    read fails loudly (classified), never silently downgrades to h1.1.
+    (The full http2 path is covered in test_h2.py against the h2 fake.)"""
+    from tpubench.native.engine import get_engine
+
     t = TransportConfig(endpoint=server.endpoint, http2=True)
-    with pytest.raises(NotImplementedError):
-        GcsHttpBackend(bucket="b", transport=t)
+    c = GcsHttpBackend(bucket="b", transport=t)
+    if get_engine() is None:
+        with pytest.raises(StorageError, match="native engine"):
+            c.open_read("bench/file_0", length=1024)
+    else:
+        # Engine present: against an h1.1-only server the h2c handshake
+        # must fail loudly (the server answers the preface with garbage),
+        # not hand back h1.1 bytes as frames.
+        with pytest.raises(StorageError):
+            c.open_read("bench/file_0", length=1024)
+    c.close()
 
 
 def test_concurrent_readers(server):

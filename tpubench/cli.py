@@ -365,10 +365,18 @@ def cmd_sweep(cfg: BenchConfig, args, topo=None) -> None:
         for sz in chosen:
             for native in native_axis:
                 if native and proto not in ("http", "grpc"):
-                    continue  # no native path for fake/local protocols
+                    # No Python-vs-native axis for fake/local protocols,
+                    # nor for http2 (the h2 client IS the native engine —
+                    # its cell measures the protocol, not the runtime).
+                    continue
                 size, count = sizes[sz]
                 c = BenchConfig.from_dict(cfg.to_dict())
-                c.transport.protocol = proto
+                # "http2" = the reference's ForceAttemptHTTP2 branch
+                # (main.go:76-80): same JSON endpoint, h2 transport — the
+                # h1-vs-h2 A/B the reference could run (main.go:64).
+                c.transport.protocol = "http" if proto == "http2" else proto
+                if proto == "http2":
+                    c.transport.http2 = True
                 c.workload.object_size = size
                 c.workload.read_calls_per_worker = min(
                     count, c.workload.read_calls_per_worker
@@ -438,7 +446,10 @@ def main(argv=None) -> int:
     prep = add("prepare", "generate worker-indexed data files")
     prep.add_argument("--layout", choices=("flat", "ssd"), default="flat")
     sweep = add("sweep", "protocol A/B × size sweep (execute_pb.sh)")
-    sweep.add_argument("--sweep-protocols", default="http,grpc")
+    sweep.add_argument("--sweep-protocols", default="http,grpc",
+                       help="comma list of http,http2,grpc,fake — http2 is "
+                            "the reference's ForceAttemptHTTP2 branch "
+                            "(main.go:76-80) on the native h2 client")
     sweep.add_argument("--sweep-sizes", default="")
     sweep.add_argument("--sweep-native", action="store_true",
                        help="add a receive-path axis: every cell runs with "

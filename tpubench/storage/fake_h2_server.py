@@ -1,0 +1,464 @@
+"""A minimal HTTP/2 fake-GCS media server (h2c prior knowledge / TLS+ALPN).
+
+The h2 twin of :mod:`fake_server`'s HTTP/1.1 server, backing hermetic tests
+for the native HTTP/2 client (the reference's ``ForceAttemptHTTP2`` branch,
+``main.go:76-80``). Python's stdlib has no h2 server and the image ships no
+``h2`` package, so this implements exactly the slice the tests need:
+
+* connection preface + SETTINGS exchange, PING replies;
+* request HEADERS decoding via structural HPACK (indexed entries resolved
+  against the static table for the pseudo-headers clients commonly index;
+  literal entries with plain or static-table names). Huffman-coded request
+  strings are answered with a 400 — the in-repo native client never
+  huffman-encodes (engine.cc hp_header), and scoping the fake to its
+  traffic keeps this server small and predictable;
+* ``GET .../o/<object>?alt=media`` with ``Range`` support, served as a
+  literal ``:status`` + ``content-length`` HEADERS frame and 16 KB DATA
+  frames from the backing :class:`FakeBackend` (fault injection included);
+* concurrent streams: requests are served as their END_STREAM arrives;
+  responses for different streams interleave legally.
+
+Flow control: the server respects nothing fancier than the client's
+initial window (the native client advertises 2^31-1, so writes never
+stall in practice for test-sized objects).
+"""
+
+from __future__ import annotations
+
+import socket
+import ssl
+import struct
+import threading
+import urllib.parse
+from typing import Optional
+
+from tpubench.storage.base import StorageError
+from tpubench.storage.fake import FakeBackend
+
+_PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+# RFC 7541 Appendix A static-table entries this server resolves (the ones
+# clients commonly send indexed for a GET).
+_STATIC = {
+    2: (":method", "GET"),
+    3: (":method", "POST"),
+    4: (":path", "/"),
+    6: (":scheme", "http"),
+    7: (":scheme", "https"),
+}
+
+
+class _HpackError(Exception):
+    pass
+
+
+def _hpd_int(data: bytes, i: int, prefix: int) -> tuple[int, int]:
+    if i >= len(data):
+        raise _HpackError("truncated int")
+    maxp = (1 << prefix) - 1
+    v = data[i] & maxp
+    i += 1
+    if v == maxp:
+        m = 0
+        while True:
+            if i >= len(data) or m > 56:
+                raise _HpackError("truncated varint")
+            b = data[i]
+            i += 1
+            v += (b & 0x7F) << m
+            if not b & 0x80:
+                break
+            m += 7
+    return v, i
+
+
+def _hpd_str(data: bytes, i: int) -> tuple[str, int]:
+    if i >= len(data):
+        raise _HpackError("truncated string")
+    huff = data[i] & 0x80
+    n, i = _hpd_int(data, i, 7)
+    if i + n > len(data):
+        raise _HpackError("string past end")
+    if huff:
+        # Scoped out (see module docstring): reject rather than misparse.
+        raise _HpackError("huffman-coded request strings unsupported")
+    s = data[i : i + n].decode("latin-1")
+    return s, i + n
+
+
+def decode_request_headers(block: bytes) -> dict[str, str]:
+    """Structural HPACK decode of a request header block into a dict."""
+    out: dict[str, str] = {}
+    i = 0
+    while i < len(block):
+        b = block[i]
+        if b & 0x80:  # indexed
+            idx, i = _hpd_int(block, i, 7)
+            if idx in _STATIC:
+                k, v = _STATIC[idx]
+                out[k] = v
+            continue
+        if (b & 0xE0) == 0x20:  # dynamic table size update
+            _, i = _hpd_int(block, i, 5)
+            continue
+        prefix = 6 if b & 0x40 else 4
+        idx, i = _hpd_int(block, i, prefix)
+        if idx == 0:
+            name, i = _hpd_str(block, i)
+        else:
+            name = _STATIC.get(idx, (f"idx{idx}", ""))[0]
+        value, i = _hpd_str(block, i)
+        out[name.lower()] = value
+    return out
+
+
+def _hp_literal(name: str, value: str) -> bytes:
+    def _s(x: bytes) -> bytes:
+        if len(x) < 127:
+            return bytes([len(x)]) + x
+        n = len(x) - 127
+        out = bytearray([127])
+        while n >= 128:
+            out.append((n & 0x7F) | 0x80)
+            n >>= 7
+        out.append(n)
+        return bytes(out) + x
+
+    return b"\x10" + _s(name.encode()) + _s(value.encode())
+
+
+class _Conn:
+    def __init__(self, sock: socket.socket, backend: FakeBackend):
+        self.sock = sock
+        self.backend = backend
+        self.wlock = threading.Lock()
+
+    # ---------------------------------------------------------- frame io --
+    def _recv_all(self, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def send_frame(self, ftype: int, flags: int, stream: int, payload: bytes):
+        hdr = struct.pack("!I", len(payload))[1:] + bytes(
+            [ftype, flags]
+        ) + struct.pack("!I", stream & 0x7FFFFFFF)
+        with self.wlock:
+            self.sock.sendall(hdr + payload)
+
+    # ------------------------------------------------------------ serving --
+    def serve(self) -> None:
+        try:
+            first = self._recv_all(len(_PREFACE))
+            if first is None:
+                return
+            if first != _PREFACE:
+                # Not the h2 preface: an HTTP/1.1 request (real GCS serves
+                # both protocols on one port; metadata requests from an
+                # http2=True client ride h1.1). Serve it minimally.
+                return self._serve_h11(first)
+            self.send_frame(4, 0, 0, b"")  # empty SETTINGS
+            headers_by_stream: dict[int, dict] = {}
+            while True:
+                fh = self._recv_all(9)
+                if fh is None:
+                    return
+                flen = (fh[0] << 16) | (fh[1] << 8) | fh[2]
+                ftype, fflags = fh[3], fh[4]
+                stream = struct.unpack("!I", fh[5:9])[0] & 0x7FFFFFFF
+                payload = self._recv_all(flen) if flen else b""
+                if payload is None:
+                    return
+                if ftype == 4 and not fflags & 0x1:  # SETTINGS -> ACK
+                    self.send_frame(4, 0x1, 0, b"")
+                elif ftype == 6 and not fflags & 0x1:  # PING -> ACK
+                    self.send_frame(6, 0x1, 0, payload)
+                elif ftype == 1:  # HEADERS
+                    if not fflags & 0x4:
+                        return  # CONTINUATION unsupported: drop conn
+                    block = payload
+                    if fflags & 0x8:  # PADDED
+                        pad = block[0]
+                        block = block[1 : len(block) - pad]
+                    if fflags & 0x20:  # PRIORITY
+                        block = block[5:]
+                    try:
+                        headers_by_stream[stream] = decode_request_headers(block)
+                    except _HpackError as e:
+                        self._respond_error(stream, 400, str(e))
+                        continue
+                    if fflags & 0x1:  # END_STREAM: GET, serve now
+                        t = threading.Thread(
+                            target=self._handle,
+                            args=(stream, headers_by_stream.pop(stream)),
+                            daemon=True,
+                        )
+                        t.start()
+                elif ftype == 0:  # DATA (request bodies: ignored)
+                    if fflags & 0x1 and stream in headers_by_stream:
+                        h = headers_by_stream.pop(stream)
+                        threading.Thread(
+                            target=self._handle, args=(stream, h), daemon=True
+                        ).start()
+                elif ftype == 7:  # GOAWAY
+                    return
+        except OSError:
+            return
+        finally:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+    def _serve_h11(self, initial: bytes) -> None:
+        """Keep-alive HTTP/1.1 side: object metadata, media (with Range)
+        and list — enough for an ``http2=True`` client whose metadata
+        requests ride the HTTP/1.1 pool."""
+        import json
+
+        buf = initial
+        while True:
+            while b"\r\n\r\n" not in buf:
+                chunk = self.sock.recv(65536)
+                if not chunk:
+                    return
+                buf += chunk
+            head, _, buf = buf.partition(b"\r\n\r\n")
+            lines = head.decode("latin-1").split("\r\n")
+            try:
+                method, path, _ = lines[0].split(" ", 2)
+            except ValueError:
+                return
+            hdrs = {}
+            for ln in lines[1:]:
+                k, _, v = ln.partition(":")
+                hdrs[k.strip().lower()] = v.strip()
+
+            def send(status: int, body: bytes, ctype: str, extra: str = ""):
+                self.sock.sendall(
+                    (
+                        f"HTTP/1.1 {status} X\r\nContent-Type: {ctype}\r\n"
+                        f"Content-Length: {len(body)}\r\n{extra}\r\n"
+                    ).encode()
+                    + body
+                )
+
+            parsed = urllib.parse.urlsplit(path)
+            query = urllib.parse.parse_qs(parsed.query)
+            parts = parsed.path.split("/")
+            if (
+                method != "GET"
+                or len(parts) < 7
+                or parts[1] != "storage"
+                or parts[5] != "o"
+            ):
+                send(404, b'{"error":{"code":404}}', "application/json")
+                continue
+            name = urllib.parse.unquote("/".join(parts[6:]))
+            try:
+                meta = self.backend.stat(name)
+            except StorageError as e:
+                send(
+                    e.code or 404,
+                    json.dumps({"error": {"code": e.code or 404}}).encode(),
+                    "application/json",
+                )
+                continue
+            if query.get("alt", [""])[0] == "media":
+                start, end, status = 0, meta.size - 1, 200
+                rng = hdrs.get("range", "")
+                if rng.startswith("bytes="):
+                    a, _, b = rng[6:].partition("-")
+                    start = int(a)
+                    end = meta.size - 1 if not b else min(int(b), meta.size - 1)
+                    status = 206
+                length = max(0, end - start + 1)
+                reader = self.backend.open_read(name, start=start, length=length)
+                data = bytearray()
+                mv = memoryview(bytearray(65536))
+                while True:
+                    n = reader.readinto(mv)
+                    if n <= 0:
+                        break
+                    data += mv[:n]
+                reader.close()
+                cr = (
+                    f"Content-Range: bytes {start}-{end}/{meta.size}\r\n"
+                    if status == 206
+                    else ""
+                )
+                send(status, bytes(data), "application/octet-stream", cr)
+            else:
+                send(
+                    200,
+                    json.dumps(
+                        {
+                            "kind": "storage#object",
+                            "name": meta.name,
+                            "size": str(meta.size),
+                            "generation": str(meta.generation),
+                        }
+                    ).encode(),
+                    "application/json",
+                )
+
+    def _respond_error(self, stream: int, status: int, msg: str) -> None:
+        body = msg.encode()
+        hb = _hp_literal(":status", str(status)) + _hp_literal(
+            "content-length", str(len(body))
+        )
+        try:
+            self.send_frame(1, 0x4, stream, hb)
+            self.send_frame(0, 0x1, stream, body)
+        except OSError:
+            pass
+
+    def _handle(self, stream: int, h: dict) -> None:
+        fault = self.backend.fault
+        if fault.latency_s:
+            import time
+
+            time.sleep(fault.latency_s)
+        if fault.error_rate:
+            with self.backend._rng_lock:
+                r = self.backend._rng.random()
+            if r < fault.error_rate:
+                self.backend.injected_errors += 1
+                return self._respond_error(stream, 503, "injected unavailability")
+        path = h.get(":path", "/")
+        parsed = urllib.parse.urlsplit(path)
+        query = urllib.parse.parse_qs(parsed.query)
+        parts = parsed.path.split("/")
+        if (
+            len(parts) < 7
+            or parts[1] != "storage"
+            or parts[3] != "b"
+            or parts[5] != "o"
+            or query.get("alt", [""])[0] != "media"
+        ):
+            return self._respond_error(stream, 404, f"no route: {path}")
+        name = urllib.parse.unquote("/".join(parts[6:]))
+        try:
+            meta = self.backend.stat(name)
+        except StorageError as e:
+            return self._respond_error(stream, e.code or 404, str(e))
+        start, end, status = 0, meta.size - 1, 200
+        rng = h.get("range", "")
+        if rng.startswith("bytes="):
+            spec = rng[len("bytes=") :]
+            a, _, b = spec.partition("-")
+            start = int(a)
+            end = meta.size - 1 if not b else min(int(b), meta.size - 1)
+            status = 206
+        length = max(0, end - start + 1)
+        reader = self.backend.open_read(name, start=start, length=length)
+        hb = _hp_literal(":status", str(status)) + _hp_literal(
+            "content-length", str(length)
+        )
+        try:
+            # Zero-length bodies (empty object, clamped-empty range) end
+            # the stream on the HEADERS frame — there is no DATA frame to
+            # carry END_STREAM and the client would otherwise wait forever.
+            self.send_frame(1, 0x4 | (0x1 if length == 0 else 0), stream, hb)
+            buf = bytearray(16384)
+            mv = memoryview(buf)
+            sent = 0
+            while sent < length:
+                try:
+                    n = reader.readinto(mv)
+                except StorageError:
+                    # Mid-stream fault injection: RST the stream, exactly
+                    # the mid-body cut the h1.1 fake produces by closing.
+                    self.send_frame(3, 0, stream, struct.pack("!I", 2))
+                    return
+                if n <= 0:
+                    # Backend exhausted early: close the stream rather
+                    # than leaving it dangling short of content-length.
+                    self.send_frame(0, 0x1, stream, b"")
+                    break
+                sent += n
+                last = sent >= length
+                self.send_frame(0, 0x1 if last else 0, stream, bytes(mv[:n]))
+        except OSError:
+            pass
+        finally:
+            reader.close()
+
+
+class FakeH2Server:
+    """Threaded fake h2 media server; context-manager like the others.
+
+    Plain mode speaks h2c with prior knowledge (what an ``http://``
+    endpoint with ``http2=True`` means); ``tls=True`` wraps the listener
+    in TLS with ALPN ``h2`` and an ephemeral self-signed cert.
+    """
+
+    def __init__(
+        self,
+        backend: Optional[FakeBackend] = None,
+        port: int = 0,
+        tls: bool = False,
+    ):
+        self.backend = backend or FakeBackend()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", port))
+        self._sock.listen(16)
+        self._tls = tls
+        self.cafile = ""
+        self._ctx = None
+        if tls:
+            from tpubench.storage.fake_server import make_self_signed_cert
+
+            self.cafile, keyfile = make_self_signed_cert()
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.cafile, keyfile)
+            ctx.set_alpn_protocols(["h2"])
+            self._ctx = ctx
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self._sock.getsockname()[:2]
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://{host}:{port}"
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            if self._ctx is not None:
+                try:
+                    conn = self._ctx.wrap_socket(conn, server_side=True)
+                except ssl.SSLError:
+                    continue
+            threading.Thread(
+                target=_Conn(conn, self.backend).serve, daemon=True
+            ).start()
+
+    def start(self) -> "FakeH2Server":
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "FakeH2Server":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -42,8 +42,9 @@ from tpubench.metrics import MetricSet
 from tpubench.metrics.report import RunResult
 from tpubench.storage.base import StorageBackend
 
-# Status codes the GCS client treats as transient (matches gcs_http).
-_TRANSIENT_HTTP = {408, 429, 500, 502, 503, 504}
+# The one transient-status ABI, shared with the Python client path — a
+# second hand-maintained copy would drift.
+from tpubench.storage.gcs_http import _TRANSIENT as _TRANSIENT_HTTP
 
 
 def _classify(result: int, status: int, permanent_codes) -> str:
@@ -153,6 +154,13 @@ def _require_native_http(cfg: BenchConfig, backend: StorageBackend):
         raise ValueError(
             "fetch_executor='native' requires --protocol http with a "
             "plain-http endpoint (the executor's scope)"
+        )
+    if inner.transport.http2:
+        # The executor's pool speaks HTTP/1.1; running it under an
+        # http2=True config would silently mislabel the h1-vs-h2 A/B.
+        raise ValueError(
+            "fetch_executor='native' fetches over HTTP/1.1 (tb_pool_*); "
+            "combine http2=True with the Python orchestration paths"
         )
     return engine, inner
 
@@ -451,10 +459,7 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
 
     # Per-worker read-progress state machine.
     class _W:
-        __slots__ = (
-            "call", "next_off", "ranges_out", "ranges_done", "t0",
-            "fetched", "first_fb", "failed",
-        )
+        __slots__ = ("call", "next_off", "ranges_out", "t0", "first_fb", "failed")
 
     ws = []
     completed_upfront = 0
@@ -463,9 +468,7 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
         st.call = 0          # current read-call index
         st.next_off = 0      # next unsubmitted byte offset of this call
         st.ranges_out = 0    # in-flight (or retrying) ranges of this call
-        st.ranges_done = 0
         st.t0 = 0            # perf_counter_ns at first submit of this call
-        st.fetched = 0       # bytes fetched this call
         st.first_fb = False  # first-byte recorded for this call
         st.failed = False    # this call had a post-retry range failure
         if sizes[i] == 0:
@@ -482,7 +485,11 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
     )
     retry = RetryScheduler(cfg.transport.retry)
     inflight: dict[int, tuple] = {}  # tag -> (wid, slot, start, length)
-    transfers: list = []  # FIFO of (wid, slot, fut, submit_ns, nbytes)
+    # PER-WORKER transfer FIFOs: completion order is FIFO per device, not
+    # globally (workers round-robin across devices) — one global queue
+    # would head-of-line-block every worker behind one slow device_put.
+    transfers: list[list] = [[] for _ in range(w.workers)]
+    transfers_n = 0
     next_tag = 0
     bytes_total = 0
     errors = 0
@@ -527,20 +534,32 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
         # jax.Array.is_ready() is the non-blocking completion probe; a JAX
         # build without it degrades to inline (blocking) drains — never to
         # freeing a slot whose transfer might still be reading it.
-        while transfers:
-            fut = transfers[0][2]
-            if hasattr(fut, "is_ready"):
-                if not fut.is_ready():
-                    break
-            else:
-                fut.block_until_ready()
-            wid, slot, _, submit_ns, nbytes = transfers.pop(0)
-            pipes[wid].complete(slot, submit_ns, nbytes)
+        nonlocal transfers_n
+        for wid in range(w.workers):
+            q = transfers[wid]
+            while q:
+                fut = q[0][1]
+                if hasattr(fut, "is_ready"):
+                    if not fut.is_ready():
+                        break
+                else:
+                    fut.block_until_ready()
+                slot, _, submit_ns, nbytes = q.pop(0)
+                pipes[wid].complete(slot, submit_ns, nbytes)
+                transfers_n -= 1
 
     def drain_one_transfer_blocking() -> None:
-        wid, slot, fut, submit_ns, nbytes = transfers.pop(0)
+        # Block on the OLDEST in-flight transfer across workers (per-queue
+        # heads only — within a worker completion is FIFO).
+        nonlocal transfers_n
+        wid = min(
+            (i for i in range(w.workers) if transfers[i]),
+            key=lambda i: transfers[i][0][2],
+        )
+        slot, fut, submit_ns, nbytes = transfers[wid].pop(0)
         fut.block_until_ready()
         pipes[wid].complete(slot, submit_ns, nbytes)
+        transfers_n -= 1
 
     def can_submit(wid: int) -> bool:
         st = ws[wid]
@@ -574,7 +593,7 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
                 while can_submit(wid):
                     submit_range(wid)
             if not inflight and not retry.waiting:
-                if transfers:
+                if transfers_n:
                     drain_one_transfer_blocking()
                     continue
                 # Nothing in flight anywhere but reads remain — every
@@ -585,7 +604,7 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
             # In-flight transfers drain via is_ready() polls at the top of
             # the loop: keep the wait short while any are pending so the
             # device-side pipeline is never starved behind a slow fetch.
-            cap_ms = 5 if transfers else 100
+            cap_ms = 5 if transfers_n else 100
             c = pool.next(timeout_ms=retry.next_due_in_ms(cap_ms))
             if c is None:
                 continue
@@ -634,12 +653,9 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
                     )
                     st.first_fb = True
                 bytes_total += length
-                st.fetched += length
-                st.ranges_done += 1
                 st.ranges_out -= 1
-                transfers.append(
-                    (wid, slot) + pipe.launch(slot, length)
-                )
+                transfers[wid].append((slot,) + pipe.launch(slot, length))
+                transfers_n += 1
             # Call complete when fully submitted and nothing outstanding.
             if st.next_off >= sizes[wid] and st.ranges_out == 0:
                 if not st.failed:
@@ -649,12 +665,11 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
                 completed_reads += 1
                 st.call += 1
                 st.next_off = 0 if st.call < reads_per else sizes[wid]
-                st.ranges_done = 0
                 st.failed = False
         # All fetches done; drain remaining transfers into the timed window
         # (staged bandwidth counts transfer completion, same as the Python
         # staged path's finish()).
-        while transfers:
+        while transfers_n:
             drain_one_transfer_blocking()
     finally:
         metrics.ingest.stop()
@@ -669,12 +684,13 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
         # free — the same drain-before-free contract as
         # DevicePutStager.finish().
         pool.close()  # joins workers after queued tasks finish their writes
-        for _, _, fut, _, _ in transfers:
-            try:
-                fut.block_until_ready()
-            except Exception:
-                pass  # a failed transfer still settles; freeing is now safe
-        transfers.clear()
+        for q in transfers:
+            for _, fut, _, _ in q:
+                try:
+                    fut.block_until_ready()
+                except Exception:
+                    pass  # a failed transfer settles; freeing is now safe
+            q.clear()
         for pipe in pipes:
             pipe.close()
 
