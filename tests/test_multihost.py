@@ -217,3 +217,97 @@ def test_cli_two_process_stream_resume_divergent_snapshots(tmp_path):
     assert r["extra"]["resume"]["objects_skipped"] == 1
     assert r["extra"]["objects_this_run"] == 2
     assert r["bytes_total"] == 2 * 100000
+
+
+def test_cli_four_process_pod_ingest(tmp_path):
+    """Shard math and pod aggregation at non-trivial fan-out: the SAME
+    pod-ingest command on 4 localhost processes × 2 virtual chips (8-chip
+    pod). Byte-range shards split 4 ways; the ICI all-gather reassembly
+    verifies against the deterministic object bytes (verified=True)."""
+    import glob
+    import json
+
+    port = _free_port()
+    base_env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    base_env["JAX_PLATFORMS"] = "cpu"
+    base_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get("PYTHONPATH", "")
+    cmd = [
+        sys.executable, "-m", "tpubench.cli", "pod-ingest",
+        "--protocol", "fake", "--object-size", "200000",
+        "--results-dir", str(tmp_path),
+        "--num-processes", "4", "--coordinator", f"127.0.0.1:{port}",
+    ]
+    procs = [
+        subprocess.Popen(
+            cmd + ["--process-id", str(i)], cwd=REPO, env=dict(base_env),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(4)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"CLI worker failed:\n{err[-3000:]}"
+    results = glob.glob(str(tmp_path / "pod_ingest_*.json"))
+    assert len(results) == 1  # process 0 only
+    r = json.load(open(results[0]))
+    assert r["errors"] == 0
+    assert r["n_chips"] == 8
+    assert r["extra"]["topology"]["process_count"] == 4
+    assert r["extra"]["verified"] is True
+
+
+def test_cli_stream_snapshot_then_resume_8_virtual_devices(tmp_path):
+    """The full checkpoint/resume cycle at 8-device fan-out in one
+    process: run 1 streams with periodic snapshots (forced via a tiny
+    interval); run 2 resumes from the snapshot and must skip the recorded
+    objects, with cumulative byte accounting across the two runs."""
+    import glob
+    import json
+
+    snap = tmp_path / "snap.json"
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    base = [
+        sys.executable, "-m", "tpubench.cli", "stream",
+        "--protocol", "fake", "--object-size", "160000",
+        "--results-dir", str(tmp_path),
+    ]
+    # Run 1: 2 objects with snapshotting (the writer's close() does a
+    # guaranteed final write, so the snapshot reflects the full run).
+    p = subprocess.run(
+        base + ["--objects", "2", "--snapshot", str(snap)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert p.returncode == 0, p.stderr[-3000:]
+    s = json.loads(snap.read_text())
+    assert s["resume_point"] == 2 and s["bytes"] == 2 * 160000
+    # Run 2: 5 objects total, resuming — objects 0-1 skipped, 2-4 ingested.
+    p = subprocess.run(
+        base + ["--objects", "5", "--resume-from", str(snap)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert p.returncode == 0, p.stderr[-3000:]
+    results = sorted(glob.glob(str(tmp_path / "pod_ingest_stream_*.json")))
+    r = json.load(open(results[-1]))
+    assert r["errors"] == 0
+    assert r["n_chips"] == 8
+    assert r["extra"]["resume"]["objects_skipped"] == 2
+    assert r["extra"]["objects_this_run"] == 3
+    assert r["bytes_total"] == 3 * 160000

@@ -1,8 +1,15 @@
 """Tracing parity (reference trace_exporter.go + main.go:129-132): span per
-read with bucket/object attributes, first-byte events, sampling, and a real
-OTel export path verified with an in-memory exporter."""
+read with bucket/object attributes, first-byte events, sampling, and the
+real OTel export path verified with an in-memory exporter. When the image
+ships no opentelemetry-sdk, the in-repo double (tests/_otel_double.py)
+stands in for the SDK interface — OtelTracer's own code executes either
+way, so these tests never skip."""
 
 import pytest
+
+import _otel_double
+
+_otel_double.install()
 
 from tpubench.config import BenchConfig
 from tpubench.obs.tracing import NoopTracer, OtelTracer, RecordingTracer, make_tracer
@@ -42,10 +49,12 @@ def test_span_per_read_with_first_byte_event():
 
 
 def test_otel_tracer_exports_spans_and_events():
-    otel_sdk = pytest.importorskip("opentelemetry.sdk.trace.export.in_memory_span_exporter")
     from opentelemetry.sdk.trace.export import SimpleSpanProcessor
+    from opentelemetry.sdk.trace.export.in_memory_span_exporter import (
+        InMemorySpanExporter,
+    )
 
-    exporter = otel_sdk.InMemorySpanExporter()
+    exporter = InMemorySpanExporter()
     tracer = OtelTracer(
         sample_rate=1.0,
         service_name="tpubench",
@@ -70,7 +79,6 @@ def test_otel_tracer_exports_spans_and_events():
 
 
 def test_otel_console_exporter_constructs():
-    pytest.importorskip("opentelemetry.sdk")
     OtelTracer(
         sample_rate=1.0, service_name="t", transport="fake", exporter="console"
     ).shutdown()

@@ -95,6 +95,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--native-receive", action="store_true",
                    help="C++ HTTP receive path into pre-registered buffers "
                         "(pooled keep-alive; http and https endpoints)")
+    p.add_argument("--http2", action="store_true",
+                   help="media GETs over the native HTTP/2 client (the "
+                        "reference's ForceAttemptHTTP2 branch, "
+                        "main.go:76-80); h2c on http, TLS+ALPN on https")
     p.add_argument("--fetch-executor", choices=("python", "native"),
                    help="read fan-out runtime: python worker threads, or "
                         "the C++ fetch executor (pthreads + completion "
@@ -193,6 +197,8 @@ def build_config(args) -> BenchConfig:
         t.retry.max_attempts = args.retry_max_attempts
     if args.native_receive:
         t.native_receive = True
+    if getattr(args, "http2", False):
+        t.http2 = True
     if getattr(args, "tls_ca_file", None):
         t.tls_ca_file = args.tls_ca_file
     if getattr(args, "tls_insecure_skip_verify", False):

@@ -504,14 +504,22 @@ class GcsHttpBackend:
         status = r["http_status"]
         if r["result"] < 0:
             # Per-stream failure: the connection survived (it went back to
-            # the pool); classify the stream's code.
+            # the pool); classify the stream's code. One carve-out, same
+            # as the round-2 native path: body-exceeds-buffer when the
+            # buffer was sized from the (just-invalidated) stat cache —
+            # the object may have grown, and one retry re-stats.
+            from tpubench.native.engine import TB_ETOOBIG
+
             pool.buffers.release(buf)
             with self._h2_pool_lock:
                 self._h2_stat_cache.pop(name, None)
+            transient = r["result"] not in PERMANENT_CODES
+            if r["result"] == TB_ETOOBIG and length is None:
+                transient = True
             raise StorageError(
                 f"h2 GET {name}: stream error {r['result']} "
                 f"(status {status})",
-                transient=r["result"] not in PERMANENT_CODES,
+                transient=transient,
             )
         if status not in (200, 206):
             msg = bytes(buf.view(min(r["result"], 200))).decode(
@@ -523,11 +531,17 @@ class GcsHttpBackend:
                 transient=status in _TRANSIENT,
                 code=status,
             )
-        if start > 0 and status == 200:
+        if (start > 0 and status == 200) or (
+            length is not None and r["result"] > want
+        ):
+            # Server ignored the Range: 200 to a nonzero-start request
+            # (bytes would be misaligned), or more bytes than the bounded
+            # range asked for — same protocol-shape rule as the h1 path.
             pool.buffers.release(buf)
             raise StorageError(
-                f"h2 GET {name}: server ignored Range (200 to a "
-                f"nonzero-start request)", transient=False,
+                f"h2 GET {name}: server ignored Range "
+                f"(status {status}, got {r['result']}, asked {want})",
+                transient=False,
             )
         return _NativeBufReader(
             buf, r["result"], r["first_byte_ns"], release=pool.buffers.release
@@ -660,16 +674,22 @@ class GcsHttpBackend:
             carrier.close(err)
             raise err
         if r["status"] not in (200, 206):
-            # Error payload: drain it (bounded) so the connection can go
-            # back to the pool, then classify like the Python path.
+            # Error payload: read the message head, then drain the rest
+            # ONLY when it is small and bounded (same _DRAIN_CAP rule as
+            # the reader's close()) — a hostile/huge error body must not
+            # stall the worker; discarding the connection is cheaper.
             msg = bytearray(4096)
             n = 0
             try:
                 n = engine.conn_body_read(conn, msg, len(msg))
-                sink = bytearray(65536)
-                while engine.conn_body_read(conn, sink, len(sink)) > 0:
-                    pass
-                pool.release(conn, engine.conn_get_end(conn))
+                clen = r["content_len"]
+                if 0 <= clen <= _NativeStreamReader._DRAIN_CAP:
+                    sink = bytearray(65536)
+                    while engine.conn_body_read(conn, sink, len(sink)) > 0:
+                        pass
+                    pool.release(conn, engine.conn_get_end(conn))
+                else:
+                    pool.discard(conn)
             except Exception:
                 pool.discard(conn)
             err = StorageError(
