@@ -104,3 +104,61 @@ def test_non_storage_error_not_retried_under_always():
     cfg = RetryConfig(policy="always")
     with pytest.raises(ValueError):
         retry_call(lambda: (_ for _ in ()).throw(ValueError("logic bug")), cfg)
+
+
+# ------------------------------------------------ executor retry scheduler --
+
+
+def test_retry_scheduler_policy_and_backoff():
+    """RetryScheduler mirrors retry_call: policy gates, attempt cap with
+    the same off-by-one, per-task deadline anchored at the task's own
+    first failure (not run start)."""
+    from tpubench.config import RetryConfig
+    from tpubench.workloads.fetch_executor import RetryScheduler
+
+    clock = [0.0]
+    cfg = RetryConfig(policy="idempotent", initial_backoff_s=1.0,
+                      max_backoff_s=4.0, multiplier=2.0, jitter=False,
+                      max_attempts=3)
+    rs = RetryScheduler(cfg, clock=lambda: clock[0])
+    # permanent verdicts never retry under "idempotent"
+    assert rs.offer(1, "permanent") is None
+    # transient: attempts 1 and 2 retry with growing pauses, 3rd gives up
+    assert rs.offer(2, "transient") == 1.0
+    assert rs.offer(2, "transient") == 2.0
+    assert rs.offer(2, "transient") is None  # attempt 3 >= max_attempts
+    # "never" forbids everything
+    rs2 = RetryScheduler(RetryConfig(policy="never"), clock=lambda: clock[0])
+    assert rs2.offer(1, "transient") is None
+
+
+def test_retry_scheduler_deadline_per_task_chain():
+    from tpubench.config import RetryConfig
+    from tpubench.workloads.fetch_executor import RetryScheduler
+
+    clock = [100.0]  # the "run" is already old at the task's first failure
+    cfg = RetryConfig(policy="always", initial_backoff_s=1.0, jitter=False,
+                      multiplier=1.0, max_backoff_s=1.0, deadline_s=2.5)
+    rs = RetryScheduler(cfg, clock=lambda: clock[0])
+    assert rs.offer(7, "transient") == 1.0   # chain t=0
+    clock[0] += 1.0
+    assert rs.offer(7, "transient") == 1.0   # chain t=1 (+1 pause = 2 < 2.5)
+    clock[0] += 1.0
+    assert rs.offer(7, "transient") is None  # chain t=2 (+1 pause > 2.5)
+
+
+def test_retry_scheduler_heap_ordering():
+    from tpubench.config import RetryConfig
+    from tpubench.workloads.fetch_executor import RetryScheduler
+
+    clock = [0.0]
+    rs = RetryScheduler(RetryConfig(), clock=lambda: clock[0])
+    rs.push(1, "a", pause=2.0)
+    rs.push(2, "b", pause=1.0)
+    assert rs.pop_due() == []
+    assert rs.next_due_in_ms(30_000) == 1001
+    clock[0] = 1.5
+    assert rs.pop_due() == ["b"]
+    clock[0] = 2.5
+    assert rs.pop_due() == ["a"]
+    assert rs.waiting == 0

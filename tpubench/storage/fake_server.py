@@ -140,9 +140,13 @@ class _Handler(BaseHTTPRequestHandler):
         if code == 206:
             self.send_header("Content-Range", f"bytes {start}-{end}/{meta.size}")
         self.end_headers()
-        # Stream in 256 KB chunks — the server is not the component under
-        # test; the client's granule size governs the benchmark.
-        buf = bytearray(256 * 1024)
+        # Stream in chunks — the server is not the component under test;
+        # the client's granule size governs the benchmark. On single-core
+        # hosts the server's Python loop competes with the client for the
+        # CPU, so bench-scale runs raise chunk_bytes (fewer interpreter
+        # iterations per MB; sendall of a big memoryview is one syscall
+        # path either way).
+        buf = bytearray(getattr(self.server, "chunk_bytes", 256 * 1024))
         mv = memoryview(buf)
         while True:
             n = reader.readinto(mv)
@@ -196,10 +200,12 @@ class FakeGcsServer:
         backend: Optional[FakeBackend] = None,
         port: int = 0,
         tls: bool = False,
+        chunk_bytes: int = 256 * 1024,
     ):
         self.backend = backend or FakeBackend()
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
         self._httpd.backend = self.backend  # type: ignore[attr-defined]
+        self._httpd.chunk_bytes = chunk_bytes  # type: ignore[attr-defined]
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
         self._tls = tls
