@@ -429,3 +429,74 @@ def test_grpc_compressed_message_rejected_loudly():
     finally:
         lsock.close()
         t.join(timeout=5)
+
+
+def test_h2_continuation_frames_reassembled():
+    """Header blocks split across HEADERS + CONTINUATION frames (RFC 9113
+    §6.10) are reassembled: a scripted server fragments the response
+    headers (:status in the SECOND fragment) and the body still lands."""
+    import socket
+    import struct
+    import threading
+
+    from tpubench.native.engine import get_engine
+
+    eng = get_engine()
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+    body = b"q" * 1000
+
+    def frame(ftype, flags, stream, payload):
+        return (
+            struct.pack("!I", len(payload))[1:]
+            + bytes([ftype, flags])
+            + struct.pack("!I", stream)
+            + payload
+        )
+
+    def hp_lit(name: bytes, value: bytes) -> bytes:
+        return b"\x10" + bytes([len(name)]) + name + bytes([len(value)]) + value
+
+    def serve():
+        conn, _ = lsock.accept()
+        with conn:
+            conn.settimeout(5)
+            got = b""
+            while len(got) < 24:
+                got += conn.recv(4096)
+            conn.sendall(frame(4, 0, 0, b""))
+            try:
+                conn.settimeout(0.3)
+                while True:
+                    if not conn.recv(65536):
+                        break
+            except socket.timeout:
+                pass
+            conn.settimeout(5)
+            blk = hp_lit(b"x-filler", b"f" * 40) + hp_lit(b":status", b"200")
+            half = len(blk) // 2
+            # HEADERS without END_HEADERS, then two CONTINUATIONs; the
+            # last carries END_HEADERS.
+            conn.sendall(frame(1, 0x0, 1, blk[:half]))
+            conn.sendall(frame(9, 0x0, 1, blk[half : half + 10]))
+            conn.sendall(frame(9, 0x4, 1, blk[half + 10 :]))
+            conn.sendall(frame(0, 0x1, 1, body))
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        h = eng.connect("127.0.0.1", port)
+        buf = eng.alloc(4096)
+        eng.h2_submit_get(h, "a", "/x", buf)
+        c = eng.h2_poll(h)
+        assert c is not None
+        assert c["http_status"] == 200
+        assert c["result"] == len(body)
+        assert bytes(buf.view(len(body))) == body
+        buf.free()
+        eng.conn_close(h)
+    finally:
+        lsock.close()
+        t.join(timeout=5)

@@ -2137,7 +2137,6 @@ int64_t tb_grpc_poll(int64_t h, uint64_t* tag_out, int64_t* result_out,
         break;
       }
       case 1: {  // HEADERS (response headers or trailers)
-        if (!(fflags & 0x4)) return TB_EPROTO;  // CONTINUATION unsupported
         h2_stream* s = h2_find_stream(c, fstream);
         uint8_t* hbuf = static_cast<uint8_t*>(malloc(flen ? flen : 1));
         if (!hbuf) return -ENOMEM;
@@ -2170,9 +2169,61 @@ int64_t tb_grpc_poll(int64_t h, uint64_t* tag_out, int64_t* result_out,
           off += 5;
           blen -= 5;
         }
+        // Header blocks larger than one frame arrive as HEADERS +
+        // CONTINUATION frames (RFC 9113 §6.10): until END_HEADERS, the
+        // very next frames MUST be CONTINUATIONs on the same stream —
+        // append their fragments. Bounded: a block past 64 KB is not a
+        // storage-endpoint response.
+        static const size_t kHdrBlockCap = 64 * 1024;
+        static const int kMaxContinuations = 64;  // byte cap alone doesn't
+        // bound the loop: zero-length CONTINUATIONs never advance bn.
+        uint8_t* block = hbuf + off;  // view into hbuf while single-frame
+        uint8_t* owned = nullptr;     // reassembly buffer once continuing
+        size_t bn = blen;
+        uint8_t hflags = fflags;
+        int fragments = 0;
+        while (!(hflags & 0x4)) {  // no END_HEADERS yet
+          if (++fragments > kMaxContinuations) {
+            free(hbuf);
+            free(owned);
+            return TB_EPROTO;
+          }
+          uint8_t ch[9];
+          if ((rc = h2::recv_all(c, ch, 9)) != 0) {
+            free(hbuf);
+            free(owned);
+            return rc;
+          }
+          uint32_t clen2 = (ch[0] << 16) | (ch[1] << 8) | ch[2];
+          uint32_t cstream = ((ch[5] & 0x7f) << 24) | (ch[6] << 16) |
+                             (ch[7] << 8) | ch[8];
+          if (ch[3] != 9 /*CONTINUATION*/ || cstream != fstream ||
+              bn + clen2 > kHdrBlockCap) {
+            free(hbuf);
+            free(owned);
+            return TB_EPROTO;
+          }
+          if (!owned) {
+            owned = static_cast<uint8_t*>(malloc(kHdrBlockCap));
+            if (!owned) {
+              free(hbuf);
+              return -ENOMEM;
+            }
+            memcpy(owned, block, bn);
+            block = owned;
+          }
+          if (clen2 && (rc = h2::recv_all(c, owned + bn, clen2)) != 0) {
+            free(hbuf);
+            free(owned);
+            return rc;
+          }
+          bn += clen2;
+          hflags = ch[4];  // only END_HEADERS (0x4) is defined here
+        }
         int gs = -1, hs = -1;
-        rc = h2::parse_header_block(hbuf + off, blen, &gs, &hs);
+        rc = h2::parse_header_block(block, bn, &gs, &hs);
         free(hbuf);
+        free(owned);
         if (rc != 0) return rc;
         if (s) {
           if (s->first_byte_ns == 0) s->first_byte_ns = tb_now_ns();
