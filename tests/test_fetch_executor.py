@@ -177,7 +177,7 @@ def test_native_executor_rejects_fake_protocol():
     cfg.workload.object_size = 1024  # tiny: the backend opens before the gate
     cfg.workload.fetch_executor = "native"
     cfg.staging.mode = "none"
-    with pytest.raises(ValueError, match="plain-http"):
+    with pytest.raises(ValueError, match="protocol http"):
         run_read(cfg)
 
 
@@ -267,3 +267,35 @@ def test_native_executor_retry_exhaustion_aborts():
             run_read(cfg)
     finally:
         srv.stop()
+
+
+def test_native_executor_tls_endpoint():
+    """The executor faces https endpoints too: per-thread TLS keep-alive
+    connections verified against the test CA, on both runners."""
+    from tpubench.native.engine import get_engine
+    from tpubench.workloads.read import run_read
+
+    if not get_engine().tls_available():
+        pytest.skip("OpenSSL unavailable")
+    be = FakeBackend.prepopulated("bench/file_", count=2, size=300_000)
+    with FakeGcsServer(be, tls=True) as srv:
+        cfg = BenchConfig()
+        cfg.transport.protocol = "http"
+        cfg.transport.endpoint = srv.endpoint
+        cfg.transport.tls_ca_file = srv.cafile
+        cfg.workload.bucket = "testbucket"
+        cfg.workload.object_name_prefix = "bench/file_"
+        cfg.workload.workers = 2
+        cfg.workload.read_calls_per_worker = 3
+        cfg.workload.fetch_executor = "native"
+        cfg.staging.mode = "none"
+        res = run_read(cfg)
+        assert res.errors == 0
+        assert res.bytes_total == 2 * 3 * 300_000
+        # staged over TLS too
+        cfg.staging.mode = "device_put"
+        cfg.staging.slot_bytes = 128 * 1024
+        cfg.staging.validate_checksum = True
+        res = run_read(cfg)
+        assert res.errors == 0
+        assert res.extra["checksum_ok"] is True

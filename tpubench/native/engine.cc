@@ -1480,20 +1480,31 @@ struct Pool {
   int shutdown;
   pthread_t* threads;
   int n_threads;
+  // Endpoint transport (pool-level: a pool serves one endpoint class):
+  // tls wraps each worker connection via the tb_conn TLS layer, verified
+  // against `cafile`/system store with the task host as SNI.
+  int tls;
+  int insecure;
+  char cafile[512];
 };
 
 struct WorkerConn {
   char host[256];
   int port;
-  int fd;  // -1 = none
+  int64_t h;  // tb_conn handle; 0 = none
 };
+
+static void wc_close(WorkerConn* wc) {
+  if (wc->h > 0) tb_conn_close(wc->h);
+  wc->h = 0;
+}
 
 static void* worker_main(void* arg) {
   Pool* p = static_cast<Pool*>(arg);
   WorkerConn wc;
   wc.host[0] = 0;
   wc.port = -1;
-  wc.fd = -1;
+  wc.h = 0;
   for (;;) {
     pthread_mutex_lock(&p->mu);
     while (p->sub_len == 0 && !p->shutdown)
@@ -1509,38 +1520,40 @@ static void* worker_main(void* arg) {
 
     // Per-thread keep-alive: reuse the connection while the target
     // matches (the benchmark pattern: one endpoint, many GETs).
-    if (wc.fd >= 0 && (strcmp(wc.host, t->host) != 0 || wc.port != t->port)) {
-      close(wc.fd);
-      wc.fd = -1;
-    }
+    if (wc.h > 0 && (strcmp(wc.host, t->host) != 0 || wc.port != t->port))
+      wc_close(&wc);
     int attempt = 0;
     for (;;) {
       int fresh = 0;
-      if (wc.fd < 0) {
+      if (wc.h <= 0) {
         int fd = tb_http_connect(t->host, t->port);
         if (fd < 0) {
           t->result = fd;
           break;
         }
-        wc.fd = fd;
+        int64_t h = p->tls
+                        ? tb_conn_tls(fd, t->host, p->cafile, p->insecure, 0)
+                        : tb_conn_plain(fd);
+        if (h <= 0) {
+          close(fd);  // handshake failed: fd still ours
+          t->result = h;
+          break;
+        }
+        wc.h = h;
         snprintf(wc.host, sizeof wc.host, "%s", t->host);
         wc.port = t->port;
         fresh = 1;
       }
       int reusable = 0;
       t->start_ns = tb_now_ns();
-      t->result = tb_http_request(wc.fd, t->host, t->port, t->path,
+      t->result = tb_conn_request(wc.h, t->host, t->port, t->path,
                                   t->headers, t->buf, t->buf_len, &t->status,
                                   &t->first_byte_ns, &t->total_ns, &reusable);
       if (t->result >= 0) {
-        if (!reusable) {
-          close(wc.fd);
-          wc.fd = -1;
-        }
+        if (!reusable) wc_close(&wc);
         break;
       }
-      close(wc.fd);
-      wc.fd = -1;
+      wc_close(&wc);
       // One retransmit when the FIRST use of a kept-alive connection
       // failed (stale pool socket) — same discipline as NativeConnPool.
       if (!fresh && attempt == 0) {
@@ -1556,19 +1569,28 @@ static void* worker_main(void* arg) {
     pthread_cond_signal(&p->done_cv);
     pthread_mutex_unlock(&p->mu);
   }
-  if (wc.fd >= 0) close(wc.fd);
+  wc_close(&wc);
   return nullptr;
 }
 
 }  // namespace fp
 
 // Create a fetch pool: `threads` workers, submission/completion capacity
-// `cap` tasks. Returns an opaque handle (or 0 on failure).
-int64_t tb_pool_create(int threads, int cap) {
+// `cap` tasks; `tls` makes every worker connection TLS (verified against
+// `cafile` or the system store, task host as SNI; `insecure` skips
+// verification for self-signed test endpoints). Returns an opaque handle
+// (or 0 on failure — including TLS requested but OpenSSL unavailable).
+int64_t tb_pool_create(int threads, int cap, int tls, const char* cafile,
+                       int insecure) {
   if (threads <= 0 || cap <= 0) return 0;
+  if (tls && !tb_tls_available()) return 0;
+  if (cafile && strlen(cafile) >= sizeof(fp::Pool{}.cafile)) return 0;
   fp::Pool* p = static_cast<fp::Pool*>(calloc(1, sizeof(fp::Pool)));
   if (!p) return 0;
   p->cap = cap;
+  p->tls = tls;
+  p->insecure = insecure;
+  snprintf(p->cafile, sizeof p->cafile, "%s", cafile ? cafile : "");
   p->subq = static_cast<fp::Task**>(calloc(cap, sizeof(fp::Task*)));
   p->doneq = static_cast<fp::Task**>(calloc(cap, sizeof(fp::Task*)));
   p->threads = static_cast<pthread_t*>(calloc(threads, sizeof(pthread_t)));

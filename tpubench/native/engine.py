@@ -248,7 +248,9 @@ class NativeEngine:
         lib.tb_hpack_scan_status.restype = c.c_int
         lib.tb_hpack_scan_status.argtypes = [c.c_char_p, c.c_int64]
         lib.tb_pool_create.restype = c.c_int64
-        lib.tb_pool_create.argtypes = [c.c_int, c.c_int]
+        lib.tb_pool_create.argtypes = [
+            c.c_int, c.c_int, c.c_int, c.c_char_p, c.c_int,
+        ]
         lib.tb_pool_submit.restype = c.c_int
         lib.tb_pool_submit.argtypes = [
             c.c_int64, c.c_char_p, c.c_int, c.c_char_p, c.c_char_p,
@@ -625,15 +627,31 @@ class NativeEngine:
             _check(rc, "hpack_scan")
         return rc
 
-    def pool_create(self, threads: int, cap: int = 256) -> "NativeFetchPool":
+    def pool_create(
+        self,
+        threads: int,
+        cap: int = 256,
+        *,
+        tls: bool = False,
+        cafile: str = "",
+        insecure: bool = False,
+    ) -> "NativeFetchPool":
         """Native fetch executor (the errgroup analog in C++): ``threads``
         workers run HTTP GETs into caller buffers over per-thread
-        keep-alive connections; completions drain through
-        :meth:`NativeFetchPool.next`. The per-request hot path never
-        enters the Python interpreter."""
-        h = self.lib.tb_pool_create(threads, cap)
+        keep-alive connections — plaintext or TLS (verified against
+        ``cafile``/system store, task host as SNI); completions drain
+        through :meth:`NativeFetchPool.next`. The per-request hot path
+        never enters the Python interpreter."""
+        h = self.lib.tb_pool_create(
+            threads, cap, 1 if tls else 0, cafile.encode(),
+            1 if insecure else 0,
+        )
         if h == 0:
-            raise NativeError("tb_pool_create failed", code=-12)
+            raise NativeError(
+                "tb_pool_create failed"
+                + (" (TLS requested but OpenSSL unavailable?)" if tls else ""),
+                code=-12,
+            )
         return NativeFetchPool(self, h)
 
     def grpc_submit(

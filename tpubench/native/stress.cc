@@ -40,7 +40,8 @@ int64_t tb_pwrite_blocks(int fd, const void* buf, int64_t block_size,
 void tb_fill_random(void* buf, int64_t n, uint64_t seed);
 void* tb_dlpack_create(void* data, int64_t rows, int64_t cols, void* deleter);
 void tb_dlpack_free(void* managed);
-int64_t tb_pool_create(int threads, int cap);
+int64_t tb_pool_create(int threads, int cap, int tls,
+                       const char* cafile, int insecure);
 int tb_pool_submit(int64_t h, const char* host, int port, const char* path,
                    const char* headers, void* buf, int64_t buf_len,
                    uint64_t tag);
@@ -128,7 +129,7 @@ static int stress_fetch_pool() {
   };
 
   const int kTasks = 64;
-  int64_t pool = tb_pool_create(4, 32);
+  int64_t pool = tb_pool_create(4, 32, 0, "", 0);
   if (pool == 0) {
     stop_server();
     return 3;

@@ -138,8 +138,8 @@ class RetryScheduler:
 
 
 def _require_native_http(cfg: BenchConfig, backend: StorageBackend):
-    """Shared preconditions: the executor speaks plain HTTP (the hermetic
-    bench path); returns (engine, inner GcsHttpBackend)."""
+    """Shared preconditions: the executor speaks HTTP/1.1 over plaintext
+    or TLS; returns (engine, inner GcsHttpBackend)."""
     from tpubench.native.engine import get_engine
     from tpubench.storage.gcs_http import GcsHttpBackend
 
@@ -150,10 +150,17 @@ def _require_native_http(cfg: BenchConfig, backend: StorageBackend):
             "unavailable (C++ toolchain missing?)"
         )
     inner = getattr(backend, "inner", backend)
-    if not isinstance(inner, GcsHttpBackend) or inner.scheme != "http":
+    if not isinstance(inner, GcsHttpBackend) or inner.scheme not in (
+        "http", "https",
+    ):
         raise ValueError(
-            "fetch_executor='native' requires --protocol http with a "
-            "plain-http endpoint (the executor's scope)"
+            "fetch_executor='native' requires --protocol http (plain or "
+            "https endpoint)"
+        )
+    if inner.scheme == "https" and not engine.tls_available():
+        raise RuntimeError(
+            "fetch_executor='native' on an https endpoint, but the engine "
+            "could not load OpenSSL (libssl.so.3)"
         )
     if inner.transport.http2:
         # The executor's pool speaks HTTP/1.1; running it under an
@@ -163,6 +170,18 @@ def _require_native_http(cfg: BenchConfig, backend: StorageBackend):
             "combine http2=True with the Python orchestration paths"
         )
     return engine, inner
+
+
+def _make_pool(engine, inner, threads: int, cap: int):
+    """Executor pool matching the backend's endpoint transport."""
+    t = inner.transport
+    return engine.pool_create(
+        threads=threads,
+        cap=cap,
+        tls=inner.scheme == "https",
+        cafile=t.tls_ca_file,
+        insecure=t.tls_insecure_skip_verify,
+    )
 
 
 def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunResult:
@@ -191,7 +210,7 @@ def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunRe
         res = RunResult(workload="read", config=cfg.to_dict(), summaries={})
         res.extra["fetch_executor"] = "native"
         return res
-    pool = engine.pool_create(threads=w.workers, cap=max(4, 2 * w.workers))
+    pool = _make_pool(engine, inner, w.workers, max(4, 2 * w.workers))
     retry = RetryScheduler(cfg.transport.retry)
     inflight: dict[int, tuple] = {}  # tag -> (buffer, worker_id, size)
     free_bufs: dict[int, list] = {}
@@ -480,9 +499,7 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
             completed_upfront += reads_per
         ws.append(st)
 
-    pool = engine.pool_create(
-        threads=w.workers, cap=max(8, 2 * w.workers * depth)
-    )
+    pool = _make_pool(engine, inner, w.workers, max(8, 2 * w.workers * depth))
     retry = RetryScheduler(cfg.transport.retry)
     inflight: dict[int, tuple] = {}  # tag -> (wid, slot, start, length)
     # PER-WORKER transfer FIFOs: completion order is FIFO per device, not
