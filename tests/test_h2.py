@@ -500,3 +500,108 @@ def test_h2_continuation_frames_reassembled():
     finally:
         lsock.close()
         t.join(timeout=5)
+
+
+def test_grpc_read_ranges_backend(grpcsrv):
+    """Backend-level multiplexed ranges: every shard of one object rides
+    ONE connection as concurrent streams, landing in numpy buffers."""
+    import numpy as np
+
+    from tpubench.config import TransportConfig
+    from tpubench.storage.gcs_grpc import GcsGrpcBackend
+
+    t = TransportConfig(protocol="grpc", endpoint=grpcsrv.endpoint,
+                        native_receive=True, directpath=False)
+    c = GcsGrpcBackend(bucket="b", transport=t)
+    size = 3_000_000
+    n = 6
+    shard = size // n
+    ranges = [(i * shard, shard) for i in range(n)]
+    bufs = [np.zeros(shard, dtype=np.uint8) for _ in range(n)]
+    errs = c.read_ranges("bench/file_0", ranges, bufs)
+    assert errs == [None] * n
+    want = deterministic_bytes("bench/file_0", size)
+    for i in range(n):
+        assert bytes(bufs[i].tobytes()) == want[i * shard:(i + 1) * shard].tobytes()
+    # Connection went back to the pool: a second batch reuses it.
+    errs = c.read_ranges("bench/file_1", ranges, bufs)
+    assert errs == [None] * n
+    stats = c._native_pool().stats
+    assert stats["connects"] == 1 and stats["reuses"] == 1
+    c.close()
+
+
+def test_grpc_read_ranges_per_range_failure_isolated(grpcsrv):
+    """A NOT_FOUND on one range classifies onto THAT range only; the
+    others land intact on the same connection."""
+    import numpy as np
+
+    from tpubench.config import TransportConfig
+    from tpubench.storage.gcs_grpc import GcsGrpcBackend
+
+    t = TransportConfig(protocol="grpc", endpoint=grpcsrv.endpoint,
+                        native_receive=True, directpath=False)
+    c = GcsGrpcBackend(bucket="b", transport=t)
+    bufs = [np.zeros(1000, dtype=np.uint8) for _ in range(3)]
+    # middle range targets a missing object via a separate call; instead:
+    # fetch same object thrice, middle with an impossible range length
+    # would short-read — use a per-range short check by asking past EOF.
+    errs = c.read_ranges(
+        "bench/file_0",
+        [(0, 1000), (3_000_000 - 500, 1000), (2000, 1000)],
+        bufs,
+    )
+    assert errs[0] is None and errs[2] is None
+    assert errs[1] is not None and errs[1].transient is True  # short stream
+    want = deterministic_bytes("bench/file_0", 3_000_000)
+    assert bytes(bufs[0].tobytes()) == want[:1000].tobytes()
+    assert bytes(bufs[2].tobytes()) == want[2000:3000].tobytes()
+    c.close()
+
+
+def test_pod_ingest_multiplexed_native_grpc(grpcsrv):
+    """pod-ingest's fetch stage rides multiplexed native streams when the
+    backend is native gRPC: full reassembly verification passes on the
+    8-virtual-device mesh with all shards from one connection."""
+    from tpubench.workloads.pod_ingest import run_pod_ingest
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "grpc"
+    cfg.transport.endpoint = grpcsrv.endpoint
+    cfg.transport.native_receive = True
+    cfg.transport.directpath = False
+    cfg.workload.bucket = "b"
+    cfg.workload.object_name_prefix = "bench/file_"
+    cfg.workload.object_size = 3_000_000
+    res = run_pod_ingest(cfg)
+    assert res.errors == 0
+    assert res.extra["verified"] is True
+    assert res.bytes_total == 3_000_000
+
+
+def test_pod_ingest_mux_retries_injected_faults():
+    """The mux fetch path applies the gax policy to failed ranges (policy
+    parity with the RetryingBackend-wrapped threaded path): injected
+    UNAVAILABLEs heal and the pod verifies."""
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from tpubench.storage.fake import FaultPlan
+    from tpubench.storage.fake_grpc_server import FakeGcsGrpcServer
+    from tpubench.workloads.pod_ingest import run_pod_ingest
+
+    be = FakeBackend.prepopulated("bench/file_", count=1, size=2_000_000)
+    be.fault = FaultPlan(error_rate=0.4, seed=11)
+    with FakeGcsGrpcServer(be) as srv:
+        cfg = BenchConfig()
+        cfg.transport.protocol = "grpc"
+        cfg.transport.endpoint = srv.endpoint
+        cfg.transport.native_receive = True
+        cfg.transport.directpath = False
+        cfg.transport.retry.initial_backoff_s = 0.005
+        cfg.transport.retry.max_backoff_s = 0.02
+        cfg.workload.bucket = "b"
+        cfg.workload.object_name_prefix = "bench/file_"
+        cfg.workload.object_size = 2_000_000
+        res = run_pod_ingest(cfg)
+        assert res.errors == 0
+        assert res.extra["verified"] is True
+        assert be.injected_errors > 0  # the plan really fired

@@ -1446,8 +1446,9 @@ static int64_t pb_extract_content(const uint8_t* msg, size_t n, uint8_t* dst,
 // pull GET tasks from a queue, run the streaming receive into the task's
 // caller-owned aligned buffer over a per-thread keep-alive connection, and
 // push completions to a ring the caller drains — the per-request hot path
-// never touches the Python interpreter. Plaintext HTTP scope (the hermetic
-// bench path); TLS/gRPC fan-out rides the Python-orchestrated pools.
+// never touches the Python interpreter. HTTP/1.1 over plaintext or TLS
+// (pool-level transport config); gRPC fan-out rides the Python-orchestrated
+// pools or the multiplexed h2 stream machinery above.
 namespace fp {
 
 struct Task {

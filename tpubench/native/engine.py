@@ -671,10 +671,33 @@ class NativeEngine:
         native equivalent). Up to 32 streams per connection; raises
         NativeError(-EAGAIN) when the table is full (poll a completion
         first). Completions come back from :meth:`h2_poll` by ``tag``."""
+        self.grpc_submit_to(
+            handle, authority, bucket_path, object_name,
+            buf.address, buf.size,
+            read_offset=read_offset, read_limit=read_limit,
+            headers=headers, tag=tag,
+        )
+
+    def grpc_submit_to(
+        self,
+        handle: int,
+        authority: str,
+        bucket_path: str,
+        object_name: str,
+        address: int,
+        nbytes: int,
+        read_offset: int = 0,
+        read_limit: int = 0,
+        headers: str = "",
+        tag: int = 0,
+    ) -> None:
+        """Raw-destination variant of :meth:`grpc_submit`: content bytes
+        land at (address, nbytes) — e.g. a numpy shard buffer — which must
+        stay valid until the stream's completion comes back."""
         rc = self.lib.tb_grpc_submit(
             handle, authority.encode(), bucket_path.encode(),
             object_name.encode(), headers.encode(),
-            read_offset, read_limit, buf.address, buf.size, tag,
+            read_offset, read_limit, address, nbytes, tag,
         )
         if rc != 0:
             _check(int(rc), f"grpc_submit {object_name}")
