@@ -605,3 +605,22 @@ def test_pod_ingest_mux_retries_injected_faults():
         assert res.errors == 0
         assert res.extra["verified"] is True
         assert be.injected_errors > 0  # the plan really fired
+
+
+def test_stream_pipeline_multiplexed_native_grpc(grpcsrv):
+    """The streamed pipeline's fetch stage also rides multiplexed native
+    streams (shared fetch_shards_mux helper): multi-object stream over
+    native gRPC verifies with reused double-buffer sets."""
+    from tpubench.workloads.pod_ingest_stream import run_pod_ingest_stream
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "grpc"
+    cfg.transport.endpoint = grpcsrv.endpoint
+    cfg.transport.native_receive = True
+    cfg.transport.directpath = False
+    cfg.workload.bucket = "b"
+    cfg.workload.object_name_prefix = "bench/file_"
+    cfg.workload.object_size = 3_000_000
+    res = run_pod_ingest_stream(cfg, n_objects=3, verify=True)
+    assert res.errors == 0
+    assert res.bytes_total == 3 * 3_000_000

@@ -475,17 +475,19 @@ class GcsGrpcBackend:
 
         from tpubench.native.engine import PERMANENT_CODES, NativeError
 
-        pool = self._native_pool()  # raises when the engine is unavailable
-        engine = pool.engine
-        host, port, _ = self._native_endpoint()
-        authority = f"{host}:{port}"
-        metadata = self._native_auth_headers()
         n = len(ranges)
         done: list[bool] = [False] * n
         errs: list = [None] * n
         addrs: list[int] = []
         for i, ((start, length), b) in enumerate(zip(ranges, buffers)):
             arr = b if isinstance(b, np.ndarray) else np.frombuffer(b, np.uint8)
+            # The engine writes `length` contiguous bytes through the raw
+            # pointer: a read-only view (bytes) or a strided slice would
+            # be silent memory corruption, not an error.
+            if not (arr.flags.writeable and arr.flags.c_contiguous):
+                raise ValueError(
+                    f"range {i}: buffer must be writable and C-contiguous"
+                )
             if arr.nbytes < length:
                 raise ValueError(
                     f"range {i}: buffer {arr.nbytes} < length {length}"
@@ -527,13 +529,24 @@ class GcsGrpcBackend:
             return errs
 
         window = 16  # submit waves below the 32-stream connection cap
+        # Setup + connect failures classify onto every range (contract:
+        # this method reports per-range outcomes, it doesn't throw for
+        # conditions the threaded path would record as holes — and the
+        # caller's gax loop can then heal transient ones, e.g. a token
+        # refresh hiccup).
         try:
+            pool = self._native_pool()  # raises when engine unavailable
+            engine = pool.engine
+            host, port, _ = self._native_endpoint()
+            authority = f"{host}:{port}"
+            metadata = self._native_auth_headers()
             conn, reused = pool.acquire()
         except StorageError as e:
-            # Connect failure: classified onto every range (contract: this
-            # method reports per-range outcomes, it doesn't throw for
-            # conditions the threaded path would record as holes).
             return fail_all(e)
+        except Exception as e:  # noqa: BLE001 — e.g. auth library errors
+            return fail_all(
+                StorageError(f"read_ranges setup: {e}", transient=True)
+            )
         with self._tracer.span(
             "gcs_grpc.read_ranges", object=name, bucket=self.bucket,
             ranges=n,

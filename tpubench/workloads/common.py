@@ -138,3 +138,72 @@ def global_hole_totals(holes: dict) -> dict:
         "shards": int(all_counts[:, 0].sum()),
         "bytes": int(all_counts[:, 1].sum()),
     }
+
+
+def fetch_shards_mux(backend, cfg, name, table, local_idx, buffers):
+    """Multiplexed shard fetch: on the native gRPC path, all of this
+    host's byte-range shards ride ONE connection as concurrent h2 streams
+    (grpc-go's default shape) instead of a thread per shard — no fan-out
+    threads, one socket, per-stream failure isolation. Failed ranges
+    re-fetch under the configured gax policy (the same ``transport.retry``
+    the threaded path gets from RetryingBackend — bypassing the wrapper
+    must not bypass the policy). Returns a GroupResult (raising the first
+    error under ``abort_on_error``, WorkerGroup parity), or None when the
+    backend/config doesn't support it — the caller falls back to the
+    thread fan-out. Shared by pod-ingest and the streamed pipeline.
+    """
+    import time as _time
+
+    from tpubench.storage.gcs_grpc import GcsGrpcBackend
+    from tpubench.storage.retry import Backoff, _is_retryable
+
+    inner = getattr(backend, "inner", backend)
+    if not (
+        isinstance(inner, GcsGrpcBackend)
+        and inner.transport.native_receive
+        and len(local_idx) > 0
+    ):
+        return None
+    rngs = []
+    for k, gi in enumerate(local_idx):
+        sh = table.shard(gi)
+        buffers[k][sh.length:] = 0  # pad tail (fetch_shard parity)
+        rngs.append((sh.start, sh.length))
+
+    rcfg = cfg.transport.retry
+    backoff = Backoff(rcfg)
+    start_t = _time.monotonic()
+    final: list = [None] * len(rngs)
+    remaining = list(range(len(rngs)))
+    attempt = 0
+    while remaining:
+        sub_errs = inner.read_ranges(
+            name,
+            [rngs[i] for i in remaining],
+            [buffers[i] for i in remaining],
+        )
+        for j, e in enumerate(sub_errs):
+            final[remaining[j]] = e
+        retryable = [
+            remaining[j]
+            for j, e in enumerate(sub_errs)
+            if e is not None and _is_retryable(e, rcfg.policy)
+        ]
+        if not retryable:
+            break
+        attempt += 1
+        if rcfg.max_attempts and attempt >= rcfg.max_attempts:
+            break
+        pause = backoff.pause()
+        if rcfg.deadline_s and (
+            _time.monotonic() - start_t
+        ) + pause > rcfg.deadline_s:
+            break
+        _time.sleep(pause)
+        remaining = retryable
+    gres = GroupResult(
+        errors=[WorkerError(k, e) for k, e in enumerate(final) if e is not None]
+    )
+    if gres.errors and cfg.workload.abort_on_error:
+        raise gres.errors[0]  # errgroup semantics (WorkerGroup parity)
+    return gres

@@ -39,6 +39,7 @@ from tpubench.storage.base import StorageBackend
 from tpubench.workloads.common import (
     WorkerGroup,
     fetch_shard,
+    fetch_shards_mux,
     global_hole_totals,
     zero_failed_shards,
 )
@@ -50,73 +51,6 @@ class PodIngestWorkload:
     backend: StorageBackend
     ring: bool = False  # explicit ppermute ring instead of XLA all_gather
     verify: bool = True
-
-    def _fetch_shards_mux(self, name, table, local_idx, buffers):
-        """Multiplexed shard fetch: on the native gRPC path, all of this
-        host's byte-range shards ride ONE connection as concurrent h2
-        streams (grpc-go's default shape) instead of a thread per shard —
-        no fan-out threads, one socket, per-stream failure isolation.
-        Failed ranges re-fetch under the configured gax policy (the same
-        ``transport.retry`` the threaded path gets from RetryingBackend —
-        bypassing the wrapper must not bypass the policy). Returns a
-        GroupResult, or None when the backend/config doesn't support it
-        (caller falls back to the thread fan-out)."""
-        from tpubench.storage.gcs_grpc import GcsGrpcBackend
-        from tpubench.storage.retry import Backoff, _is_retryable
-        from tpubench.workloads.common import GroupResult, WorkerError
-
-        inner = getattr(self.backend, "inner", self.backend)
-        if not (
-            isinstance(inner, GcsGrpcBackend)
-            and inner.transport.native_receive
-            and len(local_idx) > 0
-        ):
-            return None
-        rngs = []
-        for k, gi in enumerate(local_idx):
-            sh = table.shard(gi)
-            buffers[k][sh.length:] = 0  # pad tail (fetch_shard parity)
-            rngs.append((sh.start, sh.length))
-
-        rcfg = self.cfg.transport.retry
-        backoff = Backoff(rcfg)
-        start_t = time.monotonic()
-        final: list = [None] * len(rngs)
-        remaining = list(range(len(rngs)))
-        attempt = 0
-        while remaining:
-            sub_errs = inner.read_ranges(
-                name,
-                [rngs[i] for i in remaining],
-                [buffers[i] for i in remaining],
-            )
-            for j, e in enumerate(sub_errs):
-                final[remaining[j]] = e
-            retryable = [
-                remaining[j]
-                for j, e in enumerate(sub_errs)
-                if e is not None and _is_retryable(e, rcfg.policy)
-            ]
-            if not retryable:
-                break
-            attempt += 1
-            if rcfg.max_attempts and attempt >= rcfg.max_attempts:
-                break
-            pause = backoff.pause()
-            if rcfg.deadline_s and (
-                time.monotonic() - start_t
-            ) + pause > rcfg.deadline_s:
-                break
-            time.sleep(pause)
-            remaining = retryable
-        gres = GroupResult(
-            errors=[
-                WorkerError(k, e) for k, e in enumerate(final) if e is not None
-            ]
-        )
-        if gres.errors and self.cfg.workload.abort_on_error:
-            raise gres.errors[0]  # errgroup semantics (WorkerGroup parity)
-        return gres
 
     def run(self, object_name: Optional[str] = None) -> RunResult:
         from tpubench.obs.exporters import cloud_exporter_from_config
@@ -146,7 +80,9 @@ class PodIngestWorkload:
             fetch_shard(self.backend, name, table, local_idx[k], buffers[k])
 
         t0 = time.perf_counter()
-        gres = self._fetch_shards_mux(name, table, local_idx, buffers)
+        gres = fetch_shards_mux(
+            self.backend, self.cfg, name, table, local_idx, buffers
+        )
         if gres is None:
             gres = WorkerGroup(abort_on_error=w.abort_on_error).run(
                 len(local_idx), fetch, name="fetch"

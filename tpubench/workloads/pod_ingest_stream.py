@@ -48,6 +48,7 @@ from tpubench.storage.base import StorageBackend
 from tpubench.workloads.common import (
     WorkerGroup,
     fetch_shard,
+    fetch_shards_mux,
     global_hole_totals,
     zero_failed_shards,
 )
@@ -92,9 +93,13 @@ class StreamedPodIngest:
             # sizes; stale bytes would otherwise be gathered as padding.
             fetch_shard(self.backend, plan.name, plan.table, local_idx[k], buffers[k])
 
-        gres = WorkerGroup(abort_on_error=w.abort_on_error).run(
-            len(local_idx), fetch, name="stream-fetch"
+        gres = fetch_shards_mux(
+            self.backend, self.cfg, plan.name, plan.table, local_idx, buffers
         )
+        if gres is None:
+            gres = WorkerGroup(abort_on_error=w.abort_on_error).run(
+                len(local_idx), fetch, name="stream-fetch"
+            )
         # Failure domains (SURVEY §5.3): zero failed shards (deterministic
         # holes — critical with reused buffers, which would otherwise leak
         # the PREVIOUS object's bytes into this one) and report them in the
