@@ -235,6 +235,45 @@ def test_backend_http2_fault_injected_503_transient(h2srv):
         c.close()
 
 
+def test_h2_truncated_body_is_short_stream():
+    """A stream that END_STREAMs cleanly SHORT of its announced
+    content-length (proxy died mid-stream, backend exhausted) must fail
+    with TB_ESHORT, not report the partial byte count as success — the
+    h1 path's rule (tb_resp content_len) applied to h2 (ADVICE r3
+    medium: the h2 path silently accepted truncated bodies)."""
+    from tpubench.native.engine import TB_ESHORT, get_engine
+
+    eng = get_engine()
+    be = FakeBackend.prepopulated("bench/file_", count=1, size=400_000)
+    with FakeH2Server(be, truncate_body_bytes=32_768) as srv:
+        host, port = _hostport(srv)
+        h = eng.connect(host, port)
+        try:
+            buf = eng.alloc(500_000)
+            eng.h2_submit_get(h, f"{host}:{port}", _media("bench/file_0"), buf)
+            c = eng.h2_poll(h)
+            assert c is not None
+            assert c["http_status"] == 200
+            assert c["result"] == TB_ESHORT, c
+            buf.free()
+        finally:
+            eng.conn_close(h)
+
+
+def test_backend_http2_truncated_body_transient_error():
+    """Backend-level: the truncated h2 media read surfaces as a transient
+    StorageError (retryable under gax, same as the h1 TB_ESHORT path),
+    never as a short successful read."""
+    be = FakeBackend.prepopulated("bench/file_", count=1, size=400_000)
+    with FakeH2Server(be, truncate_body_bytes=32_768) as srv:
+        c = _h2_client(srv)
+        with pytest.raises(StorageError) as ei:
+            c.open_read("bench/file_0", length=400_000)
+        assert ei.value.transient is True
+        assert "-1004" in str(ei.value) or "short" in str(ei.value).lower()
+        c.close()
+
+
 # --------------------------------------------- multiplexed gRPC receive --
 
 
@@ -557,6 +596,100 @@ def test_grpc_read_ranges_per_range_failure_isolated(grpcsrv):
     assert bytes(bufs[0].tobytes()) == want[:1000].tobytes()
     assert bytes(bufs[2].tobytes()) == want[2000:3000].tobytes()
     c.close()
+
+
+def test_grpc_read_ranges_eof_short_is_permanent(grpcsrv):
+    """A short stream that ends AT the known object size is a server
+    clamp of a past-EOF range: every retry reproduces it, so it must be
+    permanent (hole now) rather than transient (gax backoff burned on a
+    condition that cannot heal) — ADVICE r3. Without a cached stat the
+    same shape stays transient (can't distinguish truncation)."""
+    import numpy as np
+
+    from tpubench.config import TransportConfig
+    from tpubench.storage.gcs_grpc import GcsGrpcBackend
+
+    t = TransportConfig(protocol="grpc", endpoint=grpcsrv.endpoint,
+                        native_receive=True, directpath=False)
+    c = GcsGrpcBackend(bucket="b", transport=t)
+    c.stat("bench/file_0")  # primes the size cache (3_000_000)
+    bufs = [np.zeros(1000, dtype=np.uint8) for _ in range(2)]
+    errs = c.read_ranges(
+        "bench/file_0",
+        [(0, 1000), (3_000_000 - 400, 1000)],  # 2nd range 600 B past EOF
+        bufs,
+    )
+    assert errs[0] is None
+    assert errs[1] is not None
+    assert errs[1].transient is False  # EOF clamp: permanent
+    assert "EOF" in str(errs[1])
+    c.close()
+
+
+def test_mux_retry_chains_are_per_range():
+    """fetch_shards_mux grants each range its FULL gax allowance: a range
+    failing for the first time in a later round still gets max_attempts
+    tries of its own (ADVICE r3: one shared round counter starved
+    late-failing ranges)."""
+    import numpy as np
+
+    from tpubench.config import BenchConfig
+    from tpubench.dist.shard import ShardTable
+    from tpubench.storage.base import StorageError
+    from tpubench.storage.fake_grpc_server import FakeGcsGrpcServer
+    from tpubench.workloads.common import fetch_shards_mux
+
+    be = FakeBackend.prepopulated("bench/file_", count=1, size=4000)
+    with FakeGcsGrpcServer(be) as srv:
+        from tpubench.config import TransportConfig
+        from tpubench.storage.gcs_grpc import GcsGrpcBackend
+
+        t = TransportConfig(protocol="grpc", endpoint=srv.endpoint,
+                            native_receive=True, directpath=False)
+        backend = GcsGrpcBackend(bucket="b", transport=t)
+        cfg = BenchConfig()
+        cfg.transport.retry.max_attempts = 3
+        cfg.transport.retry.initial_backoff_s = 0.001
+        cfg.transport.retry.max_backoff_s = 0.002
+        cfg.workload.abort_on_error = False
+
+        # Script the inner read_ranges: range 0 (flaky-a) fails rounds
+        # 1-2 then heals — 3rd attempt of ITS chain; range 1 (flaky-b)
+        # fails rounds 1-3 and exhausts its 3-attempt chain. With the old
+        # shared round counter, flaky-a's healing round would never run
+        # once any other range had burned the shared budget.
+        calls = {"n": 0}
+        real_read_ranges = backend.read_ranges
+
+        def scripted(name, ranges, buffers):
+            calls["n"] += 1
+            rnd = calls["n"]
+            errs = real_read_ranges(name, ranges, buffers)
+            out = []
+            for rng, e in zip(ranges, errs):
+                start = rng[0]
+                if start == 0 and rnd <= 2:
+                    out.append(StorageError("flaky-a", transient=True))
+                elif start == 1000 and rnd <= 3:
+                    out.append(StorageError("flaky-b", transient=True))
+                else:
+                    out.append(e)
+            return out
+
+        backend.read_ranges = scripted  # type: ignore[method-assign]
+        table = ShardTable.build(object_size=4000, n_shards=4, align=1)
+        buffers = [np.zeros(1000, dtype=np.uint8) for _ in range(4)]
+        res = fetch_shards_mux(
+            backend, cfg, "bench/file_0", table, [0, 1, 2, 3], buffers
+        )
+        assert res is not None
+        # flaky-b fails rounds 1,2,3 = 3 attempts exhausted → hole;
+        # flaky-a fails rounds 1,2 then heals (attempt 3 of 3) → ok.
+        errs = {e.worker_id for e in res.errors}
+        assert 0 not in errs, "range 0 should heal within its own chain"
+        assert 1 in errs, "range 1 exhausts its own 3-attempt chain"
+        backend.read_ranges = real_read_ranges  # type: ignore[method-assign]
+        backend.close()
 
 
 def test_pod_ingest_multiplexed_native_grpc(grpcsrv):

@@ -523,6 +523,7 @@ struct h2_stream {
   uint8_t prefix[5];
   int grpc_status;   // -1 until a trailer carries one
   int http_status;   // -1 until response HEADERS carry :status
+  int64_t content_len;  // -1 until response HEADERS carry content-length
   int got_headers;
   int done;          // END_STREAM (or RST_STREAM) seen
   int64_t err;       // terminal per-stream error (0 = none)
@@ -1242,6 +1243,16 @@ static void parse_int_value(const uint8_t* v, int64_t n, int* out) {
   *out = st;
 }
 
+static void parse_int64_value(const uint8_t* v, int64_t n, int64_t* out) {
+  if (n <= 0) return;
+  int64_t st = 0;
+  for (int64_t j = 0; j < n; j++) {
+    if (v[j] < '0' || v[j] > '9') return;
+    st = st * 10 + (v[j] - '0');
+  }
+  *out = st;
+}
+
 // h2 static-table :status entries (RFC 7541 Appendix A, indices 8-14):
 // responses commonly encode the status as a single indexed byte (0x88 =
 // ":status 200").
@@ -1254,10 +1265,15 @@ static int static_status(uint64_t idx) {
 // literals; indexed entries cannot carry it — grpc-status is not in the
 // h2 static table and we advertise a zero-size dynamic table) and, when
 // ``http_status`` is given, :status (indexed static-table entries 8-14,
-// literal-with-name-index 8, or a literal ":status" name). Returns 0 on
-// success, TB_EPROTO on a malformed block.
+// literal-with-name-index 8, or a literal ":status" name). When
+// ``content_len`` is given, content-length is extracted the same two
+// ways (literal name, or literal with static name-index 28) so the raw
+// h2 GET path can detect under-delivery — the h1 path's TB_ESHORT rule
+// (tb_resp.content_len, above). Returns 0 on success, TB_EPROTO on a
+// malformed block.
 static int parse_header_block(const uint8_t* p, size_t n, int* grpc_status,
-                              int* http_status = nullptr) {
+                              int* http_status = nullptr,
+                              int64_t* content_len = nullptr) {
   size_t i = 0;
   while (i < n) {
     uint8_t b = p[i];
@@ -1299,16 +1315,22 @@ static int parse_header_block(const uint8_t* p, size_t n, int* grpc_status,
     k = hpd_str(p + i, n - i, &val, &val_len, &val_huff);
     if (k == 0) return TB_EPROTO;
     i += k;
-    if (name && (grpc_status || http_status)) {
+    if (name && (grpc_status || http_status || content_len)) {
       uint8_t nbuf[32];
       int64_t nl = hp_resolve(name, name_len, name_huff, nbuf, sizeof nbuf);
       int is_grpc = grpc_status && nl == 11 &&
                     memcmp(nbuf, "grpc-status", 11) == 0;
       int is_http = http_status && nl == 7 && memcmp(nbuf, ":status", 7) == 0;
+      int is_clen = content_len && nl == 14 &&
+                    memcmp(nbuf, "content-length", 14) == 0;
       if (is_grpc || is_http) {
         uint8_t vbuf[16];
         int64_t vl = hp_resolve(val, val_len, val_huff, vbuf, sizeof vbuf);
         parse_int_value(vbuf, vl, is_grpc ? grpc_status : http_status);
+      } else if (is_clen) {
+        uint8_t vbuf[24];
+        int64_t vl = hp_resolve(val, val_len, val_huff, vbuf, sizeof vbuf);
+        parse_int64_value(vbuf, vl, content_len);
       }
     } else if (!name && http_status && idx >= 8 && idx <= 14) {
       // Literal with an indexed NAME (static entries 8-14 all carry the
@@ -1317,6 +1339,12 @@ static int parse_header_block(const uint8_t* p, size_t n, int* grpc_status,
       uint8_t vbuf[16];
       int64_t vl = hp_resolve(val, val_len, val_huff, vbuf, sizeof vbuf);
       parse_int_value(vbuf, vl, http_status);
+    } else if (!name && content_len && idx == 28) {
+      // Static entry 28 is "content-length" (empty value) — servers
+      // emit the header as literal-with-name-index 28 + literal value.
+      uint8_t vbuf[24];
+      int64_t vl = hp_resolve(val, val_len, val_huff, vbuf, sizeof vbuf);
+      parse_int64_value(vbuf, vl, content_len);
     }
   }
   return 0;
@@ -1556,8 +1584,13 @@ static void* worker_main(void* arg) {
       }
       wc_close(&wc);
       // One retransmit when the FIRST use of a kept-alive connection
-      // failed (stale pool socket) — same discipline as NativeConnPool.
-      if (!fresh && attempt == 0) {
+      // failed (stale pool socket) — same discipline as NativeConnPool,
+      // including its permanent-code carve-out: protocol-shape failures
+      // (TB_EPROTO/TB_ETOOBIG/TB_ECHUNKED/TB_ETLS) reproduce on a fresh
+      // socket, so a retransmit only re-measures the failure.
+      int permanent = t->result == TB_EPROTO || t->result == TB_ETOOBIG ||
+                      t->result == TB_ECHUNKED || t->result == TB_ETLS;
+      if (!fresh && attempt == 0 && !permanent) {
         attempt = 1;
         continue;
       }
@@ -1839,6 +1872,7 @@ static h2_stream* h2_open_stream(tb_conn* c, uint64_t tag, void* buf,
   s->out_cap = buf_len;
   s->grpc_status = -1;
   s->http_status = -1;
+  s->content_len = -1;
   s->t_start = tb_now_ns();
   if (!raw_body) {
     s->scratch = c->scratch_pool_n
@@ -2066,6 +2100,14 @@ static void h2_stream_finish(h2_stream* s) {
     else if (s->grpc_status > 0) s->err = TB_EGRPC;
   } else if (!s->got_headers) {
     s->err = TB_EPROTO;
+  } else if ((s->http_status == 200 || s->http_status == 206) &&
+             s->content_len >= 0 && s->out_len < s->content_len) {
+    // Cleanly END_STREAMed short of the announced content-length: a
+    // truncated success is still a failure (proxy died mid-stream,
+    // backend exhausted). Same rule as the h1 path's TB_ESHORT and
+    // gcs_grpc read_ranges' short-stream rejection; scoped to success
+    // statuses so error bodies keep their existing reporting path.
+    s->err = TB_ESHORT;
   }
 }
 
@@ -2244,7 +2286,8 @@ int64_t tb_grpc_poll(int64_t h, uint64_t* tag_out, int64_t* result_out,
           hflags = ch[4];  // only END_HEADERS (0x4) is defined here
         }
         int gs = -1, hs = -1;
-        rc = h2::parse_header_block(block, bn, &gs, &hs);
+        int64_t cl = -1;
+        rc = h2::parse_header_block(block, bn, &gs, &hs, &cl);
         free(hbuf);
         free(owned);
         if (rc != 0) return rc;
@@ -2252,6 +2295,9 @@ int64_t tb_grpc_poll(int64_t h, uint64_t* tag_out, int64_t* result_out,
           if (s->first_byte_ns == 0) s->first_byte_ns = tb_now_ns();
           if (gs >= 0) s->grpc_status = gs;
           if (hs >= 0) s->http_status = hs;
+          // Only the response HEADERS' announcement counts: trailers
+          // (got_headers already set) must not retroactively change it.
+          if (cl >= 0 && !s->got_headers) s->content_len = cl;
           s->got_headers = 1;
           if (fflags & 0x1) h2_stream_finish(s);
         }

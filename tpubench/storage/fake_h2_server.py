@@ -128,9 +128,19 @@ def _hp_literal(name: str, value: str) -> bytes:
 
 
 class _Conn:
-    def __init__(self, sock: socket.socket, backend: FakeBackend):
+    def __init__(
+        self,
+        sock: socket.socket,
+        backend: FakeBackend,
+        truncate_body_bytes: Optional[int] = None,
+    ):
         self.sock = sock
         self.backend = backend
+        # Fault knob: cleanly END_STREAM media bodies after this many
+        # bytes, SHORT of the announced content-length — the
+        # proxy-died-mid-stream shape a correct client must reject
+        # (distinct from RST_STREAM: the stream "succeeds" on the wire).
+        self.truncate_body_bytes = truncate_body_bytes
         self.wlock = threading.Lock()
 
     # ---------------------------------------------------------- frame io --
@@ -367,7 +377,13 @@ class _Conn:
             buf = bytearray(16384)
             mv = memoryview(buf)
             sent = 0
+            cap = self.truncate_body_bytes
             while sent < length:
+                if cap is not None and sent >= cap:
+                    # Truncation fault: clean END_STREAM short of the
+                    # announced content-length.
+                    self.send_frame(0, 0x1, stream, b"")
+                    break
                 try:
                     n = reader.readinto(mv)
                 except StorageError:
@@ -402,8 +418,10 @@ class FakeH2Server:
         backend: Optional[FakeBackend] = None,
         port: int = 0,
         tls: bool = False,
+        truncate_body_bytes: Optional[int] = None,
     ):
         self.backend = backend or FakeBackend()
+        self.truncate_body_bytes = truncate_body_bytes
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("127.0.0.1", port))
@@ -440,7 +458,11 @@ class FakeH2Server:
                 except ssl.SSLError:
                     continue
             threading.Thread(
-                target=_Conn(conn, self.backend).serve, daemon=True
+                target=_Conn(
+                    conn, self.backend,
+                    truncate_body_bytes=self.truncate_body_bytes,
+                ).serve,
+                daemon=True,
             ).start()
 
     def start(self) -> "FakeH2Server":

@@ -515,9 +515,20 @@ class GcsGrpcBackend:
             if c["result"] != length:
                 # The server must deliver the bounded range exactly; a
                 # short stream with unreadable trailers must never pass.
+                # Classification: when a cached stat shows the delivery
+                # ended at EOF (server clamped a past-the-end range and
+                # closed cleanly), every retry reproduces the clamp —
+                # permanent, recorded as a hole without burning the gax
+                # budget. Otherwise (mid-object truncation) transient.
+                start = ranges[i][0]
+                with self._stat_cache_lock:
+                    size = self._stat_cache.get(name)
+                at_eof = size is not None and start + c["result"] >= size
                 return StorageError(
                     f"ReadObject {name} range {i}: short stream "
-                    f"({c['result']} of {length} bytes)", transient=True,
+                    f"({c['result']} of {length} bytes)"
+                    + (" at EOF" if at_eof else ""),
+                    transient=not at_eof,
                 )
             return None
 
@@ -685,6 +696,11 @@ class GcsGrpcBackend:
             o = self._stub()["get"](req)
         except grpc.RpcError as e:
             raise _wrap_rpc_error(e, f"GetObject {name}") from e
+        # Feed the size cache: read paths use it to bound ranged reads
+        # and to classify an at-EOF short stream as permanent (a clamp
+        # reproduces on every retry) instead of burning the gax budget.
+        with self._stat_cache_lock:
+            self._stat_cache[name] = int(o.size)
         return ObjectMeta(o.name, int(o.size), int(o.generation))
 
     def delete(self, name: str) -> None:

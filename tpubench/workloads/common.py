@@ -171,11 +171,16 @@ def fetch_shards_mux(backend, cfg, name, table, local_idx, buffers):
         rngs.append((sh.start, sh.length))
 
     rcfg = cfg.transport.retry
-    backoff = Backoff(rcfg)
     start_t = _time.monotonic()
     final: list = [None] * len(rngs)
     remaining = list(range(len(rngs)))
-    attempt = 0
+    # Per-range attempt chains: a range failing for the FIRST time in
+    # round N still gets the full gax allowance (max_attempts, its own
+    # backoff progression) — one shared round counter would grant it
+    # only the leftovers (RetryScheduler tracks per-tag chains and
+    # RetryingBackend per-call; this batch path must match).
+    attempts = [0] * len(rngs)
+    backoffs = [Backoff(rcfg) for _ in rngs]
     while remaining:
         sub_errs = inner.read_ranges(
             name,
@@ -184,22 +189,30 @@ def fetch_shards_mux(backend, cfg, name, table, local_idx, buffers):
         )
         for j, e in enumerate(sub_errs):
             final[remaining[j]] = e
-        retryable = [
-            remaining[j]
-            for j, e in enumerate(sub_errs)
-            if e is not None and _is_retryable(e, rcfg.policy)
-        ]
+        retryable = []
+        for j, e in enumerate(sub_errs):
+            i = remaining[j]
+            if e is None or not _is_retryable(e, rcfg.policy):
+                continue
+            attempts[i] += 1
+            if rcfg.max_attempts and attempts[i] >= rcfg.max_attempts:
+                continue
+            retryable.append(i)
         if not retryable:
             break
-        attempt += 1
-        if rcfg.max_attempts and attempt >= rcfg.max_attempts:
-            break
-        pause = backoff.pause()
-        if rcfg.deadline_s and (
-            _time.monotonic() - start_t
-        ) + pause > rcfg.deadline_s:
-            break
-        _time.sleep(pause)
+        # One sleep per round, long enough for every surviving chain's
+        # own pause; ranges whose deadline that pause would cross are
+        # abandoned (their last error stands), not slept past.
+        pauses = {i: backoffs[i].pause() for i in retryable}
+        if rcfg.deadline_s:
+            elapsed = _time.monotonic() - start_t
+            retryable = [
+                i for i in retryable
+                if elapsed + pauses[i] <= rcfg.deadline_s
+            ]
+            if not retryable:
+                break
+        _time.sleep(max(pauses[i] for i in retryable))
         remaining = retryable
     gres = GroupResult(
         errors=[WorkerError(k, e) for k, e in enumerate(final) if e is not None]
