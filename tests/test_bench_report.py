@@ -1,0 +1,206 @@
+"""bench_report: the bench's verdict/efficiency/note derivations are pure
+functions — these tests pin the round-4 verdict's #2 contract (the note can
+never contradict the measured verdicts printed beside it) and #1/#7
+(efficiency pairs, gap breakdown, probe-regime divergence)."""
+
+import pytest
+
+from tpubench import bench_report as br
+
+
+# ------------------------------------------------------------- verdicts --
+
+
+def test_shaped_verdict_from_probe():
+    assert br.shaped_verdict(True, [1.0, 1.0, 1.0]) is True
+
+
+def test_shaped_verdict_from_cycle_spread():
+    # probe says unshaped (drained budget) but the bench's own identical
+    # cycles spread >3x: shaped.
+    assert br.shaped_verdict(False, [1.2, 0.3, 1.1]) is True
+
+
+def test_unshaped_when_both_quiet():
+    assert br.shaped_verdict(False, [1.0, 1.1, 0.9]) is False
+
+
+def test_headline_peak_when_shaped_median_when_not():
+    samples = [0.5, 1.5, 1.0]
+    assert br.headline_value(samples, shaped=True) == 1.5
+    assert br.headline_value(samples, shaped=False) == 1.0
+    assert br.headline_value([], shaped=True) == 0.0
+
+
+# ------------------------------------------------------------ efficiency --
+
+
+def test_pair_efficiency_best_and_median():
+    pairs = [
+        {"tunnel": 1.5, "staged": 1.2},   # 0.8
+        {"tunnel": 1.0, "staged": 0.95},  # 0.95
+        {"tunnel": 0.1, "staged": 1.0},   # floored tunnel: excluded
+    ]
+    best, med = br.pair_efficiency(pairs)
+    assert best == pytest.approx(0.95)
+    assert med == pytest.approx((0.8 + 0.95) / 2)
+
+
+def test_pair_efficiency_all_floored_is_none():
+    best, med = br.pair_efficiency([{"tunnel": 0.2, "staged": 0.9}])
+    assert best is None and med is None
+
+
+def test_serial_model_is_harmonic_composition():
+    # fetch 6.9, tunnel 1.5 → 1/(1/6.9+1/1.5) ≈ 1.232: the depth-1 sync
+    # config's structural ceiling.
+    m = br.serial_model_gbps(6.9, 1.5)
+    assert m == pytest.approx(1.2321, abs=1e-3)
+    assert br.serial_model_gbps(0.0, 1.5) == 0.0
+
+
+def test_gap_breakdown_sync_has_serial_model():
+    pair = {
+        "tunnel": 1.5, "staged": 1.1, "mode": "sync",
+        "breakdown": {"wall_s": 2.0, "transfer_wait_s": 1.2,
+                      "put_submit_s": 0.3},
+    }
+    g = br.gap_breakdown(pair, host_fetch_gbps=6.9)
+    assert g["efficiency"] == pytest.approx(1.1 / 1.5, abs=1e-4)
+    assert g["transfer_wait_frac"] == pytest.approx(0.6)
+    assert g["put_submit_frac"] == pytest.approx(0.15)
+    assert g["fetch_and_overhead_frac"] == pytest.approx(0.25)
+    assert g["serial_model_gbps"] == pytest.approx(1.2321, abs=1e-3)
+    # measured against its OWN structural ceiling, not the tunnel's
+    assert g["vs_serial_model"] == pytest.approx(1.1 / 1.2321, abs=1e-3)
+
+
+def test_gap_breakdown_overlap_has_no_serial_model():
+    g = br.gap_breakdown(
+        {"tunnel": 1.5, "staged": 1.4, "mode": "overlap", "breakdown": {}},
+        host_fetch_gbps=6.9,
+    )
+    assert "serial_model_gbps" not in g
+    assert g["efficiency"] == pytest.approx(1.4 / 1.5, abs=1e-4)
+
+
+# ------------------------------------------------------ probe divergence --
+
+
+def test_probe_divergence_flags_drained_probe():
+    assert br.probe_divergence(1.05, 0.21) == 5.0
+
+
+def test_probe_divergence_none_when_consistent():
+    assert br.probe_divergence(1.0, 0.8) is None
+    assert br.probe_divergence(1.0, None) is None
+    assert br.probe_divergence(0.0, 0.5) is None
+
+
+# ------------------------------------------------------------------ note --
+
+
+def _fields(**kw):
+    f = {
+        "shaped_verdict": False,
+        "staging_efficiency": 0.93,
+        "best_pair_mode": "sync",
+        "probe_divergence_factor": None,
+        "nexec_median": 0.6,
+        "sync_median": 1.0,
+        "nexec_deconfounded": True,
+    }
+    f.update(kw)
+    return f
+
+
+def test_note_never_contradicts_shaped_verdict():
+    """Round-4 verdict #2: BENCH_r04 had shaped_verdict=false beside a
+    hardcoded note asserting "the tunnel is externally shaped"."""
+    n_false = br.build_note(_fields(shaped_verdict=False))
+    assert "shaped_verdict=false" in n_false
+    assert "MEDIAN" in n_false
+    assert "is externally shaped" not in n_false
+    n_true = br.build_note(_fields(shaped_verdict=True))
+    assert "shaped_verdict=true" in n_true
+    assert "PEAK" in n_true
+
+
+def test_note_reports_null_efficiency_honestly():
+    n = br.build_note(_fields(staging_efficiency=None))
+    assert "staging_efficiency=null" in n
+    assert "floored" in n
+
+
+def test_note_mentions_probe_divergence_only_when_measured():
+    n = br.build_note(_fields(probe_divergence_factor=5.1))
+    assert "5.1x" in n and "drained" in n and "BELOW" in n
+    n2 = br.build_note(_fields(probe_divergence_factor=None))
+    assert "drained transfer budget" not in n2
+
+
+def test_note_probe_divergence_direction():
+    """A probe FASTER than the bench windows is a fast window the bench
+    never got — the note must not explain it as a drained floor, and
+    must print the INVERTED factor (a reader parses '0.2x ABOVE' as
+    below)."""
+    n = br.build_note(_fields(probe_divergence_factor=0.2))
+    assert "5.0x ABOVE" in n and "fast window" in n
+    assert "drained" not in n
+
+
+def test_note_nexec_sentence_tracks_measurement():
+    behind = br.build_note(_fields(nexec_median=0.6, sync_median=1.0))
+    assert "behind" in behind
+    ahead = br.build_note(_fields(nexec_median=1.2, sync_median=1.0))
+    assert "ahead of" in ahead
+    confounded = br.build_note(_fields(nexec_deconfounded=False))
+    assert "confound" in confounded
+    clean = br.build_note(_fields(nexec_deconfounded=True))
+    assert "no Python competing" in clean
+
+
+# ------------------------------------------------------- probe hardening --
+
+
+def test_analyze_sweep_flags_stalled_cell():
+    """Round-4 verdict #7: a stalled/floored cell must be flagged and
+    never feed fixed_cost_speedup."""
+    from tpubench.workloads.probe import analyze_sweep
+
+    anomalies, fixed = analyze_sweep(
+        {"2MB": 0.13, "8MB": 1.5, "16MB": 1.7, "32MB": 1.8}
+    )
+    assert "2MB" in anomalies
+    assert fixed is None  # 2MB cell stalled: no fixed-cost physics
+
+
+def test_analyze_sweep_fixed_cost_dominated_2mb_is_not_a_stall():
+    """A 2MB cell at half the line rate is exactly the per-transfer
+    fixed-cost physics the sweep measures — it must NOT be screened as a
+    stall (only a >6x deficit is)."""
+    from tpubench.workloads.probe import analyze_sweep
+
+    anomalies, fixed = analyze_sweep(
+        {"2MB": 0.5, "8MB": 1.5, "16MB": 1.7, "32MB": 1.7}
+    )
+    assert anomalies == []
+    assert fixed == pytest.approx(3.0)
+
+
+def test_analyze_sweep_clean_computes_fixed_cost():
+    from tpubench.workloads.probe import analyze_sweep
+
+    anomalies, fixed = analyze_sweep(
+        {"2MB": 1.4, "8MB": 1.8, "16MB": 1.75, "32MB": 1.7}
+    )
+    assert anomalies == []
+    assert fixed == pytest.approx(1.8 / 1.4)
+
+
+def test_analyze_sweep_all_dead():
+    from tpubench.workloads.probe import analyze_sweep
+
+    anomalies, fixed = analyze_sweep({"2MB": 0.0, "8MB": 0.0})
+    assert set(anomalies) == {"2MB", "8MB"}
+    assert fixed is None

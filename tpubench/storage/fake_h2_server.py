@@ -133,6 +133,7 @@ class _Conn:
         sock: socket.socket,
         backend: FakeBackend,
         truncate_body_bytes: Optional[int] = None,
+        send_interim_1xx: bool = False,
     ):
         self.sock = sock
         self.backend = backend
@@ -141,6 +142,11 @@ class _Conn:
         # proxy-died-mid-stream shape a correct client must reject
         # (distinct from RST_STREAM: the stream "succeeds" on the wire).
         self.truncate_body_bytes = truncate_body_bytes
+        # Knob: precede every response with an informational `:status 103`
+        # HEADERS block (RFC 9113 §8.1) — a client that mistakes it for
+        # the response discards the real block's content-length and its
+        # truncation check goes blind.
+        self.send_interim_1xx = send_interim_1xx
         self.wlock = threading.Lock()
 
     # ---------------------------------------------------------- frame io --
@@ -303,18 +309,10 @@ class _Conn:
                 )
                 send(status, bytes(data), "application/octet-stream", cr)
             else:
-                send(
-                    200,
-                    json.dumps(
-                        {
-                            "kind": "storage#object",
-                            "name": meta.name,
-                            "size": str(meta.size),
-                            "generation": str(meta.generation),
-                        }
-                    ).encode(),
-                    "application/json",
-                )
+                from tpubench.storage.base import object_meta_dict
+
+                send(200, json.dumps(object_meta_dict(meta)).encode(),
+                     "application/json")
 
     def _respond_error(self, stream: int, status: int, msg: str) -> None:
         body = msg.encode()
@@ -348,7 +346,6 @@ class _Conn:
             or parts[1] != "storage"
             or parts[3] != "b"
             or parts[5] != "o"
-            or query.get("alt", [""])[0] != "media"
         ):
             return self._respond_error(stream, 404, f"no route: {path}")
         name = urllib.parse.unquote("/".join(parts[6:]))
@@ -356,6 +353,26 @@ class _Conn:
             meta = self.backend.stat(name)
         except StorageError as e:
             return self._respond_error(stream, e.code or 404, str(e))
+        if query.get("alt", [""])[0] != "media":
+            # Object metadata over h2: the whole-client http2 mode
+            # (reference ForceAttemptHTTP2, main.go:76-80) sends stat
+            # requests on this connection too.
+            import json
+
+            from tpubench.storage.base import object_meta_dict
+
+            body = json.dumps(object_meta_dict(meta)).encode()
+            hb = _hp_literal(":status", "200") + _hp_literal(
+                "content-length", str(len(body))
+            )
+            try:
+                if self.send_interim_1xx:
+                    self.send_frame(1, 0x4, stream, _hp_literal(":status", "103"))
+                self.send_frame(1, 0x4, stream, hb)
+                self.send_frame(0, 0x1, stream, body)
+            except OSError:
+                pass
+            return None
         start, end, status = 0, meta.size - 1, 200
         rng = h.get("range", "")
         if rng.startswith("bytes="):
@@ -370,6 +387,10 @@ class _Conn:
             "content-length", str(length)
         )
         try:
+            if self.send_interim_1xx:
+                # Informational block first: END_HEADERS, no END_STREAM,
+                # no content-length — the response block follows.
+                self.send_frame(1, 0x4, stream, _hp_literal(":status", "103"))
             # Zero-length bodies (empty object, clamped-empty range) end
             # the stream on the HEADERS frame — there is no DATA frame to
             # carry END_STREAM and the client would otherwise wait forever.
@@ -419,9 +440,11 @@ class FakeH2Server:
         port: int = 0,
         tls: bool = False,
         truncate_body_bytes: Optional[int] = None,
+        send_interim_1xx: bool = False,
     ):
         self.backend = backend or FakeBackend()
         self.truncate_body_bytes = truncate_body_bytes
+        self.send_interim_1xx = send_interim_1xx
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("127.0.0.1", port))
@@ -461,6 +484,7 @@ class FakeH2Server:
                 target=_Conn(
                     conn, self.backend,
                     truncate_body_bytes=self.truncate_body_bytes,
+                    send_interim_1xx=self.send_interim_1xx,
                 ).serve,
                 daemon=True,
             ).start()

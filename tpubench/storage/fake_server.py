@@ -103,15 +103,9 @@ class _Handler(BaseHTTPRequestHandler):
                 if query.get("alt", [""])[0] == "media":
                     return self._get_media(name)
                 meta = self.backend.stat(name)
-                return self._send_json(
-                    200,
-                    {
-                        "kind": "storage#object",
-                        "name": meta.name,
-                        "size": str(meta.size),
-                        "generation": str(meta.generation),
-                    },
-                )
+                from tpubench.storage.base import object_meta_dict
+
+                return self._send_json(200, object_meta_dict(meta))
             if len(parts) >= 6 and parts[3] == "b" and parts[5] == "o":  # list
                 prefix = query.get("prefix", [""])[0]
                 items = [

@@ -523,6 +523,16 @@ class GcsGrpcBackend:
                 start = ranges[i][0]
                 with self._stat_cache_lock:
                     size = self._stat_cache.get(name)
+                if size is None:
+                    # Bare read_ranges caller (no prior stat primed the
+                    # cache): one stat here decides whether the short
+                    # stream is a reproducible EOF clamp — worth a
+                    # metadata RTT to avoid burning the whole gax budget
+                    # re-fetching a clamp that reproduces every attempt.
+                    try:
+                        size = self.stat(name).size
+                    except StorageError:
+                        size = None  # can't classify: stay transient
                 at_eof = size is not None and start + c["result"] >= size
                 return StorageError(
                     f"ReadObject {name} range {i}: short stream "
@@ -678,6 +688,11 @@ class GcsGrpcBackend:
             resp = self._stub()["write"](requests())
         except grpc.RpcError as e:
             raise _wrap_rpc_error(e, f"WriteObject {name}") from e
+        # Keep the size cache coherent: a stale (smaller) cached size
+        # would let the short-stream classifier call a genuine transient
+        # truncation of the rewritten object "at EOF" and skip the retry.
+        with self._stat_cache_lock:
+            self._stat_cache[name] = int(resp.resource.size)
         return ObjectMeta(resp.resource.name, int(resp.resource.size))
 
     def list(self, prefix: str = "") -> list[ObjectMeta]:
@@ -709,6 +724,8 @@ class GcsGrpcBackend:
             self._stub()["delete"](req)
         except grpc.RpcError as e:
             raise _wrap_rpc_error(e, f"DeleteObject {name}") from e
+        with self._stat_cache_lock:
+            self._stat_cache.pop(name, None)
 
     def close(self) -> None:
         if self._owns_channels:

@@ -205,11 +205,13 @@ def fetch_shards_mux(backend, cfg, name, table, local_idx, buffers):
         # abandoned (their last error stands), not slept past.
         pauses = {i: backoffs[i].pause() for i in retryable}
         if rcfg.deadline_s:
-            elapsed = _time.monotonic() - start_t
-            retryable = [
-                i for i in retryable
-                if elapsed + pauses[i] <= rcfg.deadline_s
-            ]
+            # Deadline contract: the round's shared sleep is max(pause)
+            # over the survivors, and since the max itself belongs to a
+            # survivor that passed this filter, max(survivor pauses) <=
+            # budget — no range is ever reissued past the deadline.
+            # (test_mux_retry_deadline_never_oversleeps pins this.)
+            budget = rcfg.deadline_s - (_time.monotonic() - start_t)
+            retryable = [i for i in retryable if pauses[i] <= budget]
             if not retryable:
                 break
         _time.sleep(max(pauses[i] for i in retryable))

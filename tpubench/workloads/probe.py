@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import statistics
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -34,6 +35,40 @@ from tpubench.metrics.report import RunResult
 def _mk(size: int) -> np.ndarray:
     rng = np.random.default_rng(seed=size)
     return rng.integers(0, 255, size=(size // 128, 128), dtype=np.uint8)
+
+
+def analyze_sweep(sweep: dict[str, float]) -> tuple[list[str], Optional[float]]:
+    """Anomaly screen over the size-sweep cells (pure, test-injectable).
+
+    A cell measuring < 1/3 of the sweep's best cell hit a stall or the
+    shaped floor mid-sweep — deriving per-transfer fixed-cost physics
+    from it would present a budget artifact as physics (round-4: the 2 MB
+    cell measured 0.13 GB/s on a drained budget and
+    ``fixed_cost_speedup`` was computed from it anyway). The smallest
+    (2 MB) cell gets a looser 1/6 threshold: per-transfer fixed cost
+    legitimately halves small-transfer throughput (that deficit IS the
+    physics this sweep exists to measure), but a >6x deficit is beyond
+    plausible fixed cost — a stall. Returns (anomalous_cells,
+    fixed_cost_speedup_8MB_over_2MB or None when either input cell is
+    anomalous/missing)."""
+    vals = [v for v in sweep.values() if v > 0]
+    if not vals:
+        return list(sweep.keys()), None
+    best = max(vals)
+
+    def _thresh(k: str) -> float:
+        return best / 6 if k == "2MB" else best / 3
+
+    anomalies = [k for k, v in sweep.items() if v <= 0 or v < _thresh(k)]
+    fixed_cost = None
+    if (
+        sweep.get("2MB")
+        and sweep.get("8MB")
+        and "2MB" not in anomalies
+        and "8MB" not in anomalies
+    ):
+        fixed_cost = sweep["8MB"] / sweep["2MB"]
+    return anomalies, fixed_cost
 
 
 def _put_rate(dev, arr: np.ndarray, reps: int) -> float:
@@ -95,9 +130,13 @@ def run_probe(cfg: BenchConfig, cycles: int = 8, sleep_s: float = 2.0) -> RunRes
     # floor): a single transient stall depresses one sample but not the
     # median, so it does not flip the verdict.
     shaped = peak > 3 * floor and med < peak / 2
-    fixed_cost_ratio = (
-        sweep["8MB"] / sweep["2MB"] if sweep.get("2MB") else 0.0
-    )
+    sweep_anomalies, fixed_cost_ratio = analyze_sweep(sweep)
+    # A cold-first sample FASTER than post-ramp is backwards (ramping
+    # should help, not hurt): the classic signature of the budget
+    # draining between the two measurements — flag it rather than
+    # presenting it as slow-start physics (round-4: 4.39 cold vs 1.75
+    # post-ramp went unflagged).
+    slow_start_anomalous = cold_first > 1.5 * warm_first
 
     res = RunResult(
         workload="probe",
@@ -115,9 +154,15 @@ def run_probe(cfg: BenchConfig, cycles: int = 8, sleep_s: float = 2.0) -> RunRes
             "slow_start": {
                 "cold_first_gbps": round(cold_first, 4),
                 "post_ramp_gbps": round(warm_first, 4),
+                "anomalous": slow_start_anomalous,
             },
             "size_sweep_gbps": sweep,
-            "fixed_cost_speedup_8MB_over_2MB": round(fixed_cost_ratio, 3),
+            "sweep_anomalies": sweep_anomalies,
+            "fixed_cost_speedup_8MB_over_2MB": (
+                round(fixed_cost_ratio, 3)
+                if fixed_cost_ratio is not None
+                else None
+            ),
             "cycle_samples_gbps": samples,
             "peak_gbps": round(peak, 4),
             "median_gbps": round(med, 4),

@@ -191,6 +191,23 @@ class ReadWorkload:
             res.extra["staged_bytes"] = staged
             res.extra["staged_gbps"] = (staged / 1e9) / wall if wall > 0 else 0.0
             res.extra["staged_gbps_per_chip"] = res.extra["staged_gbps"] / n_chips
+            # Phase breakdown (averaged per worker, seconds): how much of
+            # the wall the fetch threads spent blocked on transfers vs in
+            # device_put submission — the rest is fetch + pipeline
+            # overhead. Feeds the bench's gap root-cause fields.
+            live = [st for st in sink_stats if "transfer_wait_ns" in st]
+            if live:
+                k = len(live)
+                res.extra["staging_breakdown"] = {
+                    "workers": k,
+                    "wall_s": wall,
+                    "transfer_wait_s": sum(
+                        st["transfer_wait_ns"] for st in live
+                    ) / 1e9 / k,
+                    "put_submit_s": sum(
+                        st["put_submit_ns"] for st in live
+                    ) / 1e9 / k,
+                }
         checks = [st["checksum_ok"] for st in sink_stats if "checksum_ok" in st]
         if checks:
             res.extra["checksum_ok"] = all(checks)
