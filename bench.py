@@ -179,12 +179,15 @@ def main() -> int:
     from tpubench.storage.base import deterministic_bytes
     from tpubench.workloads.probe import run_probe
 
-    dev = jax.local_devices()[0]
+    # NOTE: no jax call may precede the fetch-only A/B below —
+    # jax.local_devices() brings up the PJRT runtime and its background
+    # threads, which is exactly the CPU confound the A/B must avoid.
 
     # Executor window's local source: the all-native C loopback server
     # (tb_srv_*) — serving happens on native threads, so the single-core
     # confound of a Python loopback server (round-4 verdict #3) is gone.
     exec_srv = None
+    fetch_ab: dict = {}
     try:
         from tpubench.native.engine import NativeSourceServer, get_engine
 
@@ -194,6 +197,26 @@ def main() -> int:
             exec_srv = NativeSourceServer(eng, "tpubench/file_0", body)
     except Exception as e:  # engine unavailable: window C reports skipped
         print(f"# native source server unavailable: {e}", file=sys.stderr)
+
+    # Fetch-only A/B FIRST, before any jax work: it never touches the
+    # transfer tunnel, and the live tunnel runtime's background threads
+    # depress CPU-bound measurements on this single-core host (measured:
+    # the same A/B read 0.10 GB/s mid-bench vs 1.1+ on a quiet CPU).
+    if exec_srv is not None:
+        try:
+            fetch_ab = {
+                "native_executor_gbps": round(
+                    _fetch_only_run(exec_srv.endpoint, 96, "native"), 4
+                ),
+                "python_fetch_gbps": round(
+                    _fetch_only_run(exec_srv.endpoint, 96, "python"), 4
+                ),
+                "source": "native_c_server",
+            }
+        except Exception as e:
+            print(f"# fetch-only A/B failed: {e}", file=sys.stderr)
+
+    dev = jax.local_devices()[0]  # first jax touch: AFTER the quiet-CPU A/B
 
     # Let the tunnel's byte budget recover from whatever ran before the
     # bench (test suites, compiles): the budget refills over minutes.
@@ -214,6 +237,7 @@ def main() -> int:
     staged: dict[str, list[float]] = {
         "sync_s8_w2": [],
         "overlap_s8_w2": [],
+        "pallas_s8_w2": [],
         "nexec_w1_d4_s8": [],
     }
     tunnel: list[float] = []
@@ -236,21 +260,38 @@ def main() -> int:
             staged["sync_s8_w2"].append(_staged_run(best_cfg)[0])
         tunnel.append(t_check)
 
-    # ---- Windows B1-B4 (refill): efficiency pairings, tunnel FIRST so
-    # the pipeline takes the later (harder) budget position. Four pairs
-    # (round-4 verdict #1: two carried too much window variance),
-    # alternating the sync and overlapped configs; each staged half
-    # carries its phase breakdown for the gap root-cause fields.
-    for i in range(4):
+    # ---- Windows B1-B5 (refill): efficiency pairings, tunnel FIRST so
+    # the pipeline takes the later (harder) budget position. Five pairs
+    # (round-4 verdict #1: two carried too much window variance) cycling
+    # sync / overlapped / pallas-landing configs; each staged half
+    # carries its phase breakdown for the gap root-cause fields. The
+    # pallas row is the A/B SURVEY §7 step 7 promised (its ring always
+    # validates: the checksum is fused into the landing pass).
+    pair_key = {
+        "sync": "sync_s8_w2",
+        "overlap": "overlap_s8_w2",
+        "pallas": "pallas_s8_w2",
+    }
+    for mode in ("sync", "overlap", "sync", "overlap", "pallas"):
         time.sleep(45)
         _ramp()
         # Small samples: the pair must fit the granted window together —
         # a big tunnel sample drains the budget the staged half then pays.
-        mode = "sync" if i % 2 == 0 else "overlap"
         t_b = _tunnel_run(16, 16)
-        g_b, bd = _staged_run(_cfg(32, 2, 8, sync=(mode == "sync")))
+        c = _cfg(32, 2, 8, sync=(mode == "sync"))
+        if mode == "pallas":
+            c.staging.mode = "pallas"
+        try:
+            g_b, bd = _staged_run(c)
+        except Exception as e:
+            # One failing config (e.g. a Mosaic compile error in the
+            # pallas row) must not discard the whole bench's prior
+            # windows: skip the pair, keep the tunnel sample.
+            print(f"# pair ({mode}) skipped: {e}", file=sys.stderr)
+            tunnel.append(t_b)
+            continue
         tunnel.append(t_b)
-        staged["sync_s8_w2" if mode == "sync" else "overlap_s8_w2"].append(g_b)
+        staged[pair_key[mode]].append(g_b)
         eff_pairs.append(
             {
                 "tunnel": round(t_b, 3),
@@ -263,8 +304,7 @@ def main() -> int:
         )
 
     # ---- Window C (refill): the native-executor staged config, n=3
-    # against the C source server, plus the fetch-only A/B.
-    fetch_ab: dict = {}
+    # against the C source server.
     if exec_srv is not None:
         time.sleep(45)
         _ramp()
@@ -273,17 +313,6 @@ def main() -> int:
                 staged["nexec_w1_d4_s8"].append(
                     _exec_staged_run(48, 1, 8, 4, exec_srv.endpoint)
                 )
-            # Fetch-only A/B (staging stubbed): C++ executor fan-out vs
-            # the Python-orchestrated fetch loop, same C server source.
-            fetch_ab = {
-                "native_executor_gbps": round(
-                    _fetch_only_run(exec_srv.endpoint, 96, "native"), 4
-                ),
-                "python_fetch_gbps": round(
-                    _fetch_only_run(exec_srv.endpoint, 96, "python"), 4
-                ),
-                "source": "native_c_server",
-            }
         except Exception as e:  # engine hiccup: report, don't die
             print(f"# executor window degraded: {e}", file=sys.stderr)
 
@@ -306,7 +335,7 @@ def main() -> int:
     shaped = br.shaped_verdict(bool(probe.get("shaped", True)), key_samples)
     best = br.headline_value(key_samples, shaped)
     headline_cfg = "sync_s8_w2"
-    for alt in ("overlap_s8_w2", "nexec_w1_d4_s8"):
+    for alt in ("overlap_s8_w2", "pallas_s8_w2", "nexec_w1_d4_s8"):
         # Alt configs compete under the SAME peak-vs-median semantics the
         # verdict dictates — promoting an alt config's peak on an
         # unshaped run would contradict the note's "value is the MEDIAN".
@@ -316,6 +345,9 @@ def main() -> int:
             headline_cfg = alt
     host_gbps = statistics.median(host)  # host RAM fetch is stable
     eff_best, eff_median = br.pair_efficiency(eff_pairs)
+    sync_best, sync_med = br.pair_efficiency(eff_pairs, mode="sync")
+    over_best, over_med = br.pair_efficiency(eff_pairs, mode="overlap")
+    pallas_best, _pallas_med = br.pair_efficiency(eff_pairs, mode="pallas")
     lp = br.live_pairs(eff_pairs)
     best_pair = (
         max(lp, key=lambda p: p["staged"] / p["tunnel"]) if lp else None
@@ -332,6 +364,21 @@ def main() -> int:
     sync_median = (
         round(statistics.median(key_samples), 4) if key_samples else None
     )
+    over_pairs = [
+        p for p in lp if p.get("mode") == "overlap" and p.get("breakdown")
+    ]
+    over_put_frac = (
+        round(
+            statistics.median(
+                p["breakdown"]["put_submit_s"] / p["breakdown"]["wall_s"]
+                for p in over_pairs
+                if p["breakdown"].get("wall_s")
+            ),
+            3,
+        )
+        if any(p["breakdown"].get("wall_s") for p in over_pairs)
+        else None
+    )
     note = br.build_note(
         {
             "shaped_verdict": shaped,
@@ -343,6 +390,15 @@ def main() -> int:
             "nexec_median": nexec_median,
             "sync_median": sync_median,
             "nexec_deconfounded": exec_srv is not None,
+            "sync_best": round(sync_best, 4) if sync_best is not None else None,
+            "overlap_best": (
+                round(over_best, 4) if over_best is not None else None
+            ),
+            "overlap_put_submit_frac": over_put_frac,
+            "pallas_best": (
+                round(pallas_best, 4) if pallas_best is not None else None
+            ),
+            "fetch_ab": fetch_ab,
         }
     )
 
@@ -371,6 +427,22 @@ def main() -> int:
                 "staging_efficiency_median": (
                     round(eff_median, 4) if eff_median is not None else None
                 ),
+                "efficiency_by_mode": {
+                    "sync": {
+                        "best": round(sync_best, 4) if sync_best is not None else None,
+                        "median": round(sync_med, 4) if sync_med is not None else None,
+                    },
+                    "overlap": {
+                        "best": round(over_best, 4) if over_best is not None else None,
+                        "median": round(over_med, 4) if over_med is not None else None,
+                    },
+                    "pallas": {
+                        "best": (
+                            round(pallas_best, 4)
+                            if pallas_best is not None else None
+                        ),
+                    },
+                },
                 "efficiency_pairs": eff_pairs,
                 "gap_breakdown": gap,
                 "fetch_only_ab": fetch_ab,

@@ -51,6 +51,18 @@ def test_pair_efficiency_all_floored_is_none():
     assert best is None and med is None
 
 
+def test_pair_efficiency_mode_filter():
+    pairs = [
+        {"tunnel": 1.0, "staged": 0.8, "mode": "sync"},
+        {"tunnel": 1.0, "staged": 0.3, "mode": "overlap"},
+    ]
+    sb, _ = br.pair_efficiency(pairs, mode="sync")
+    ob, _ = br.pair_efficiency(pairs, mode="overlap")
+    assert sb == pytest.approx(0.8) and ob == pytest.approx(0.3)
+    none_b, none_m = br.pair_efficiency(pairs, mode="pallas")
+    assert none_b is None and none_m is None
+
+
 def test_serial_model_is_harmonic_composition():
     # fetch 6.9, tunnel 1.5 → 1/(1/6.9+1/1.5) ≈ 1.232: the depth-1 sync
     # config's structural ceiling.
@@ -147,6 +159,41 @@ def test_note_probe_divergence_direction():
     n = br.build_note(_fields(probe_divergence_factor=0.2))
     assert "5.0x ABOVE" in n and "fast window" in n
     assert "drained" not in n
+
+
+def test_note_explains_overlap_loss_from_measured_put_frac():
+    n = br.build_note(_fields(
+        sync_best=0.81, overlap_best=0.28, overlap_put_submit_frac=0.62,
+    ))
+    assert "sync config wins" in n
+    assert "0.62" in n and "inside submission" in n
+    # overlap winning: no loss explanation
+    n2 = br.build_note(_fields(sync_best=0.7, overlap_best=0.9))
+    assert "sync config wins" not in n2
+
+
+def test_note_pallas_sentence_tracks_measurement():
+    close = br.build_note(_fields(pallas_best=0.78, sync_best=0.81))
+    assert "pallas landing-path" in close and "within 4% of" in close
+    behind = br.build_note(_fields(pallas_best=0.4, sync_best=0.8))
+    assert "50% behind" in behind
+    ahead = br.build_note(_fields(pallas_best=0.9, sync_best=0.8))
+    assert "ahead of" in ahead
+    absent = br.build_note(_fields(pallas_best=None))
+    assert "pallas landing-path" not in absent
+
+
+def test_note_fetch_ab_sentence_tracks_measurement():
+    n = br.build_note(_fields(fetch_ab={
+        "native_executor_gbps": 1.1, "python_fetch_gbps": 1.5,
+    }))
+    assert "fetch-only A/B" in n and "behind" in n and "handoff" in n
+    n2 = br.build_note(_fields(fetch_ab={
+        "native_executor_gbps": 2.0, "python_fetch_gbps": 1.5,
+    }))
+    assert "fetch-only A/B" in n2 and "ahead of" in n2
+    n3 = br.build_note(_fields(fetch_ab={}))
+    assert "fetch-only A/B" not in n3
 
 
 def test_note_nexec_sentence_tracks_measurement():

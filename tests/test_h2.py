@@ -197,6 +197,29 @@ def test_backend_http2_read_workload(h2srv):
     assert res.summaries["first_byte"].count == 6
 
 
+def test_backend_http2_metadata_rides_h2(h2srv):
+    """Whole-client h2 (reference ForceAttemptHTTP2, main.go:76-80):
+    under http2=True, stat and list ride the native h2 client too — the
+    h1-vs-h2 A/B covers the FULL read path, not just media (round-4
+    verdict #5). Proven by pool accounting: every request lands on the
+    h2 pool, and the h1.1 pool never opens a connection."""
+    c = _h2_client(h2srv)
+    m = c.stat("bench/file_0")
+    assert m.size == 400_000 and m.generation == 1
+    items = c.list("bench/")
+    assert {i.name for i in items} == {f"bench/file_{k}" for k in range(4)}
+    # a full read: stat (sizes the buffer) + media GET, all h2
+    r = c.open_read("bench/file_1", length=1000)
+    out = memoryview(bytearray(1000))
+    assert r.readinto(out) == 1000
+    r.close()
+    stats = c._h2_pool().stats
+    assert stats["connects"] >= 1
+    assert stats["connects"] + stats["reuses"] >= 3  # stat+list+media legs
+    assert c._pool.stats["connects"] == 0  # h1.1 pool never touched
+    c.close()
+
+
 def test_backend_http2_tls_alpn():
     """https + http2: TLS with ALPN h2 against the TLS fake."""
     from tpubench.config import TransportConfig

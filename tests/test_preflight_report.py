@@ -1,0 +1,182 @@
+"""`tpubench preflight` (per-failure-mode env validation, round-4 verdict
+task #8) and `tpubench report` (offline result post-processing replacing
+the reference's matplotlib recipe, README.md:15-36 — task #9)."""
+
+import json
+
+import pytest
+
+from tpubench.cli import main
+from tpubench.config import BenchConfig
+from tpubench.storage.fake import FakeBackend
+from tpubench.storage.fake_server import FakeGcsServer
+from tpubench.workloads.preflight import format_preflight, run_preflight
+
+
+def _checks(result):
+    return {c["name"]: c for c in result["checks"]}
+
+
+# -------------------------------------------------------------- preflight --
+
+
+def test_preflight_fake_protocol_all_green():
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    r = run_preflight(cfg)
+    c = _checks(r)
+    assert r["ok"] is True
+    assert c["auth"]["skipped"] is True  # no credentials needed
+    assert c["bucket"]["ok"] is True and c["bucket"]["skipped"] is True
+    assert c["directpath"]["skipped"] is True
+    assert "preflight: OK" in format_preflight(r)
+
+
+def test_preflight_custom_endpoint_anonymous_auth_and_live_bucket():
+    be = FakeBackend.prepopulated("bench/file_", count=3, size=1000)
+    with FakeGcsServer(be) as srv:
+        cfg = BenchConfig()
+        cfg.transport.protocol = "http"
+        cfg.transport.endpoint = srv.endpoint
+        cfg.workload.bucket = "testbucket"
+        cfg.workload.object_name_prefix = "bench/file_"
+        r = run_preflight(cfg)
+        c = _checks(r)
+        assert r["ok"] is True
+        assert c["auth"]["ok"] and "anonymous" in c["auth"]["detail"]
+        assert c["bucket"]["ok"] and "3 object(s)" in c["bucket"]["detail"]
+
+
+def test_preflight_unreachable_bucket_fails():
+    cfg = BenchConfig()
+    cfg.transport.protocol = "http"
+    cfg.transport.endpoint = "http://127.0.0.1:9"  # discard port: refused
+    cfg.workload.bucket = "nope"
+    r = run_preflight(cfg, probe_timeout_s=5.0)
+    c = _checks(r)
+    assert r["ok"] is False
+    assert c["bucket"]["ok"] is False
+    assert "failed" in c["bucket"]["detail"] or "exceeded" in c["bucket"]["detail"]
+
+
+def test_preflight_bad_key_file_fails_auth():
+    cfg = BenchConfig()
+    cfg.transport.protocol = "http"  # default endpoint -> Google auth path
+    cfg.transport.key_file = "/nonexistent/sa-key.json"
+    r = run_preflight(cfg, probe_timeout_s=5.0)
+    c = _checks(r)
+    assert c["auth"]["ok"] is False
+    assert "token source construction" in c["auth"]["detail"]
+    assert r["ok"] is False
+
+
+def test_preflight_directpath_off_gcp_or_custom_endpoint():
+    # Custom endpoint: ineligible with the precise reason.
+    cfg = BenchConfig()
+    cfg.transport.protocol = "grpc"
+    cfg.transport.directpath = True
+    cfg.transport.endpoint = "insecure://127.0.0.1:1"
+    r = run_preflight(cfg, probe_timeout_s=5.0)
+    c = _checks(r)
+    assert c["directpath"]["ok"] is False
+    assert "custom endpoint" in c["directpath"]["detail"]
+    # Default endpoint off-GCP: metadata server unreachable (this CI host
+    # is not a GCP VM; if it ever runs on one, the check flips to ok —
+    # both outcomes are legitimate, the reason string is what we pin).
+    cfg2 = BenchConfig()
+    cfg2.transport.protocol = "grpc"
+    cfg2.transport.directpath = True
+    r2 = run_preflight(cfg2, probe_timeout_s=5.0)
+    c2 = _checks(r2)["directpath"]
+    assert c2["skipped"] is False
+    if not c2["ok"]:
+        assert "metadata server" in c2["detail"] or "exceeded" in c2["detail"]
+
+
+def test_preflight_cli_exit_codes(capsys):
+    rc = main(["preflight", "--protocol", "fake"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "preflight: OK" in out
+    assert '"ok": true' in out
+    rc = main(
+        ["preflight", "--protocol", "http",
+         "--endpoint", "http://127.0.0.1:9"]
+    )
+    assert rc == 1
+
+
+# ----------------------------------------------------------------- report --
+
+
+def _result_doc(proto="http", gbps=1.0, p50=10.0, p99=20.0, **cfg_extra):
+    transport = {"protocol": proto}
+    transport.update(cfg_extra.pop("transport", {}))
+    return {
+        "workload": "read",
+        "config": {
+            "transport": transport,
+            "workload": {"fetch_executor": "python"},
+            "staging": {"mode": cfg_extra.pop("staging", "none")},
+        },
+        "bytes_total": 1000,
+        "wall_seconds": 1.0,
+        "gbps": gbps,
+        "gbps_per_chip": gbps,
+        "n_chips": 1,
+        "errors": 0,
+        "summaries": {
+            "read": {
+                "count": 5, "avg_ms": p50, "p20_ms": p50, "p50_ms": p50,
+                "p90_ms": p99, "p99_ms": p99, "min_ms": p50, "max_ms": p99,
+            }
+        },
+        "extra": {},
+    }
+
+
+def test_report_single_run_percentile_block(tmp_path):
+    p = tmp_path / "a.json"
+    p.write_text(json.dumps(_result_doc()))
+    from tpubench.workloads.report_cmd import run_report
+
+    out = run_report([str(p)])
+    assert "P50: 10.000 ms" in out and "p99: 20.000 ms" in out
+    assert "GB/s=1.0000" in out
+
+
+def test_report_ab_deltas(tmp_path):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(_result_doc(proto="http", gbps=1.0)))
+    b.write_text(
+        json.dumps(
+            _result_doc(
+                proto="http", gbps=1.5, p50=8.0, p99=16.0,
+                transport={"http2": True},
+            )
+        )
+    )
+    from tpubench.workloads.report_cmd import run_report
+
+    out = run_report([str(a), str(b)])
+    assert "A/B vs baseline [http]" in out
+    assert "http+h2" in out
+    assert "1.500x baseline" in out
+    assert "p50 8.000 ms (-2.000)" in out
+
+
+def test_report_sweep_table_and_cli(tmp_path, capsys):
+    rows = [
+        {"protocol": "http", "size": "100M", "gbps": 1.0,
+         "p50_ms": 9.0, "p99_ms": 20.0},
+        {"protocol": "grpc", "size": "100M", "gbps": 1.4,
+         "p50_ms": 7.0, "p99_ms": 15.0, "native_receive": True},
+    ]
+    p = tmp_path / "sweep.json"
+    p.write_text(json.dumps(rows))
+    rc = main(["report", str(p)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "sweep:" in out
+    assert "grpc/native" in out and "GB/s=1.4000" in out

@@ -41,10 +41,16 @@ def live_pairs(eff_pairs: list[dict]) -> list[dict]:
     return [p for p in eff_pairs if p.get("tunnel", 0) > 0.5]
 
 
-def pair_efficiency(eff_pairs: list[dict]) -> tuple[Optional[float], Optional[float]]:
+def pair_efficiency(
+    eff_pairs: list[dict], mode: Optional[str] = None
+) -> tuple[Optional[float], Optional[float]]:
     """(best, median) staged/tunnel quotient over the live same-window
-    pairs; (None, None) when every pair was floored."""
+    pairs — optionally restricted to one config ``mode`` (a median across
+    MIXED configs would average different pipelines); (None, None) when
+    every matching pair was floored."""
     lp = live_pairs(eff_pairs)
+    if mode is not None:
+        lp = [p for p in lp if p.get("mode", "sync") == mode]
     if not lp:
         return None, None
     qs = [p["staged"] / p["tunnel"] for p in lp]
@@ -117,7 +123,9 @@ def build_note(f: dict) -> str:
     shaped_verdict (bool), staging_efficiency (float|None),
     best_pair_mode (str|None), probe_divergence_factor (float|None),
     nexec_median (float|None), sync_median (float|None),
-    nexec_deconfounded (bool)."""
+    nexec_deconfounded (bool); optional: overlap_best (float|None),
+    sync_best (float|None), overlap_put_submit_frac (float|None),
+    fetch_ab (dict with native_executor_gbps/python_fetch_gbps)."""
     parts: list[str] = []
     if f.get("shaped_verdict"):
         parts.append(
@@ -169,6 +177,34 @@ def build_note(f: dict) -> str:
                 "bench's cycles never got — the headline understates the "
                 "pipeline's regime, not the reverse."
             )
+    pb, sb0 = f.get("pallas_best"), f.get("sync_best")
+    if pb is not None and sb0 is not None:
+        gap_pct = round((1 - pb / sb0) * 100) if sb0 > 0 else 0
+        rel = (
+            f"within {gap_pct}% of" if 0 <= gap_pct <= 10
+            else ("ahead of" if pb > sb0 else f"{gap_pct}% behind")
+        )
+        parts.append(
+            f"pallas landing-path pair best {pb} vs device_put sync best "
+            f"{sb0}: the fused copy+checksum landing ring measures {rel} "
+            "the plain device_put config (its checksum validation is "
+            "fused into the landing pass, not skipped)."
+        )
+    ob, sb = f.get("overlap_best"), f.get("sync_best")
+    if ob is not None and sb is not None and ob < sb:
+        frac = f.get("overlap_put_submit_frac")
+        why = (
+            f" — measured put_submit_frac {frac} in the overlap pairs: "
+            "device_put completes its transfer inside submission on this "
+            "runtime, so a drain thread has nothing to overlap and only "
+            "adds handoff cost"
+            if frac is not None
+            else ""
+        )
+        parts.append(
+            f"overlap (drain-thread) best pair {ob} vs sync best {sb}: "
+            f"the depth-1 sync config wins on this host{why}."
+        )
     nm, sm = f.get("nexec_median"), f.get("sync_median")
     if nm:
         src = (
@@ -182,6 +218,22 @@ def build_note(f: dict) -> str:
             f"nexec (C++ fetch hot loop) median {nm} vs in-process-fetch "
             f"{sm}: measured against {src}, reporting {rel} the "
             "in-process-fetch config on this host."
+        )
+    ab = f.get("fetch_ab") or {}
+    if ab.get("native_executor_gbps") and ab.get("python_fetch_gbps"):
+        ng, pg = ab["native_executor_gbps"], ab["python_fetch_gbps"]
+        rel = "ahead of" if ng >= pg else "behind"
+        parts.append(
+            f"fetch-only A/B (staging stubbed, quiet CPU, C server "
+            f"source): executor {ng} vs Python fetch {pg} GB/s — the "
+            f"native fan-out measures {rel} the Python hot loop on this "
+            "single-core host"
+            + (
+                "; the per-completion queue handoff costs more than the "
+                "native receive saves with only one core to share."
+                if ng < pg
+                else "."
+            )
         )
     parts.append(
         "vs_baseline divides by an in-process host-RAM memcpy fetch "

@@ -430,6 +430,17 @@ def main(argv=None) -> int:
     gb = add("gather-bench", "ICI collective bandwidth vs mesh size")
     gb.add_argument("--shard-mb", type=float, default=4.0)
     gb.add_argument("--reps", type=int, default=5)
+    mcs = sub.add_parser(
+        "multichip-sweep",
+        help="pod-ingest + collective sweep over simulated meshes "
+             "(one subprocess per size; writes MULTICHIP_SWEEP.json)",
+    )
+    # Flags/defaults/parsing live in ONE place: tpubench.dist.sweep.main
+    # (this subcommand forwards only what the user typed).
+    mcs.add_argument("--sizes")
+    mcs.add_argument("--shard-mb")
+    mcs.add_argument("--reps")
+    mcs.add_argument("--out")
     gb.add_argument("--collective",
                     choices=("all_gather", "ring", "reduce_scatter", "psum"),
                     default="",
@@ -463,8 +474,35 @@ def main(argv=None) -> int:
                             "(same keep-alive discipline; isolates the "
                             "receive loop)")
     add("info", "print effective config and environment")
+    add("preflight", "validate auth/bucket/DirectPath/engine before a run")
+    rep = sub.add_parser(
+        "report",
+        help="summarize/compare result JSONs (percentile blocks, A/B "
+             "deltas, sweep tables — replaces the reference's matplotlib "
+             "recipe, README.md:15-36)",
+    )
+    rep.add_argument("results", nargs="+", help="result/sweep JSON paths")
 
     args = top.parse_args(argv)
+    if args.cmd == "report":
+        # Offline post-processing: no jax, no common config needed.
+        from tpubench.workloads.report_cmd import run_report
+
+        print(run_report(args.results))
+        return 0
+    if args.cmd == "multichip-sweep":
+        # Parent needs no jax (children bring their own simulated mesh)
+        # and no common config — handled before build_config, which
+        # requires the common flag set this subcommand doesn't carry.
+        # Delegated so the flag surface exists in one place.
+        from tpubench.dist.sweep import main as sweep_main
+
+        fwd = []
+        for flag in ("sizes", "shard_mb", "reps", "out"):
+            v = getattr(args, flag)
+            if v is not None:
+                fwd += [f"--{flag.replace('_', '-')}", str(v)]
+        return sweep_main(fwd)
     cfg = build_config(args)
 
     def pin_platform() -> None:
@@ -516,6 +554,15 @@ def main(argv=None) -> int:
         except Exception as e:  # noqa: BLE001
             print(f"jax unavailable: {e}", file=sys.stderr)
         return 0
+    if args.cmd == "preflight":
+        # Deliberately jax-free: a misconfigured VM should fail this in
+        # seconds, before any device bringup.
+        from tpubench.workloads.preflight import format_preflight, run_preflight
+
+        result = run_preflight(cfg)
+        print(format_preflight(result))
+        print(json.dumps(result))
+        return 0 if result["ok"] else 1
     if args.cmd == "prepare":
         # Prepare writes THROUGH the mount when hooks are configured —
         # writing into the unmounted shadow directory would hide the files

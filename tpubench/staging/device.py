@@ -364,13 +364,10 @@ class DevicePutStager(GranuleAggregator):
 def budgeted_slot_bytes(cfg: BenchConfig) -> int:
     """slot_bytes scaled so ALL workers' slots fit the host budget (never
     below one granule): 48 reference-default workers must not pin gigabytes
-    of aligned memory before the first byte is fetched. The pallas stager
-    holds exactly one slot per worker; the device_put ring holds depth."""
+    of aligned memory before the first byte is fetched. Both stagers hold
+    a depth-slot ring per worker (pallas gained its ring in round 5)."""
     s = cfg.staging
-    if s.mode == "pallas":
-        depth = 1
-    else:
-        depth = max(1, s.depth) if s.double_buffer else 1
+    depth = max(1, s.depth) if s.double_buffer else 1
     workers = max(1, cfg.workload.workers)
     budget = max(1, s.host_budget_mb) * (1 << 20)
     per_worker = budget // (workers * depth)

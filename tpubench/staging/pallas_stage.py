@@ -110,11 +110,17 @@ def pallas_land(x: jax.Array, block_rows: int = BLOCK_ROWS):
 class PallasStager(GranuleAggregator):
     """Staging sink: slot → device_put → fused pallas land (copy+checksum).
 
-    Same sink contract as DevicePutStager — granules aggregate into
-    ``slot_bytes`` slots (one transfer + one landing pass per slot),
-    ``acquire`` guarantees granule-sized free space — but synchronous
-    single-slot, and always validates (the checksum is free inside the
-    landing pass).
+    Same sink contract and RING shape as DevicePutStager — granules
+    aggregate into ``slot_bytes`` slots; a slot's launch is one async
+    ``device_put`` + one async landing-pass dispatch; the ring rotates and
+    the PREVIOUS in-flight slot drains lazily at the next ``acquire`` (the
+    backpressure point), so fetch and landing overlap up to ``depth``
+    slots (round-4 verdict #6: the synchronous single-slot form blocked
+    per landing pass and could never contend in the bench A/B). Always
+    validates: the checksum is free inside the landing pass.
+
+    ``depth`` follows StagingConfig like the device_put ring
+    (``double_buffer``/``depth``; 1 = fully synchronous).
     """
 
     def __init__(
@@ -124,6 +130,7 @@ class PallasStager(GranuleAggregator):
         cfg: Optional[StagingConfig] = None,
         device=None,
         slot_bytes: Optional[int] = None,
+        depth: Optional[int] = None,
     ):
         cfg = cfg or StagingConfig()
         devices = jax.local_devices()
@@ -132,6 +139,9 @@ class PallasStager(GranuleAggregator):
         lane = cfg.lane
         assert lane == LANE, "pallas path is lane-128 only"
         self._granule = granule_bytes
+        if depth is None:
+            depth = max(1, cfg.depth) if cfg.double_buffer else 1
+        self.depth = depth
         # Round the aggregation target up so rows divide the kernel block.
         if slot_bytes is None:
             slot_bytes = cfg.slot_bytes
@@ -139,46 +149,92 @@ class PallasStager(GranuleAggregator):
         block_bytes = BLOCK_ROWS * LANE
         self._slot_bytes = -(-slot_bytes // block_bytes) * block_bytes
         self._shape = (self._slot_bytes // LANE, LANE)
-        self._slot = np.zeros(self._shape, dtype=np.uint8)
+        self._slots = [np.zeros(self._shape, dtype=np.uint8) for _ in range(depth)]
+        # Per-slot in-flight landing: (landed, csum, submit_ns, true_bytes).
+        self._inflight: list[Optional[tuple]] = [None] * depth
+        self._k = 0
         self._fill = 0
         self.staged_bytes = 0
         self.transfers = 0
         self.stage_recorder = LatencyRecorder(f"w{worker_id}/pallas_stage")
+        # Phase accounting, DevicePutStager parity (gap breakdown).
+        self.transfer_wait_ns = 0
+        self.put_submit_ns = 0
         self._host_sum = 0
-        self._dev_sum = 0
+        # Per-slot device checksums stay ON DEVICE until finish(): an
+        # int(csum) per drain is a host readback — a full transfer-path
+        # round trip per slot (measured ~0.12 s on a tunneled device,
+        # dwarfing the 8 MB landing pass itself). One stacked device-side
+        # reduction at finish costs a single readback for the whole run.
+        self._csums: list[jax.Array] = []
+
+    def _drain(self, k: int) -> None:
+        item = self._inflight[k]
+        if item is None:
+            return
+        landed, csum, submit_ns, n = item
+        t0 = time.perf_counter_ns()
+        landed.block_until_ready()
+        self.transfer_wait_ns += time.perf_counter_ns() - t0
+        self.stage_recorder.record_ns(time.perf_counter_ns() - submit_ns)
+        # The landing pass read its input (which may alias the host slot
+        # on zero-copy backends); with it complete the slot is reusable.
+        self._csums.append(csum)
+        self.staged_bytes += n
+        self._inflight[k] = None
 
     def _free_view(self) -> memoryview:
-        """The single slot is synchronous — by the time the aggregator asks
-        again, the previous landing pass has completed."""
-        return memoryview(self._slot.reshape(-1))[self._fill :]
+        k = self._k
+        self._drain(k)  # backpressure: previous landing of THIS slot
+        return memoryview(self._slots[k].reshape(-1))[self._fill :]
 
     def _launch(self) -> None:
-        flat = self._slot.reshape(-1)
+        k = self._k
+        slot = self._slots[k]
+        flat = slot.reshape(-1)
         n = self._fill
         if n < self._slot_bytes:
             flat[n:] = 0
-        t0 = time.perf_counter_ns()
-        staged = jax.device_put(self._slot, self.device)
-        landed, csum = pallas_land(staged)
-        landed.block_until_ready()
-        self.stage_recorder.record_ns(time.perf_counter_ns() - t0)
-        self._dev_sum = (self._dev_sum + int(csum)) % (1 << 32)
+        # Host-side sum BEFORE rotation: the slot still holds the payload
+        # (the device_put may alias it; the drain gate protects reuse).
         self._host_sum = (
             self._host_sum + int(flat[:n].astype(np.uint32).sum())
         ) % (1 << 32)
-        self.staged_bytes += n
+        t0 = time.perf_counter_ns()
+        staged = jax.device_put(slot, self.device)
+        landed, csum = pallas_land(staged)
+        self.put_submit_ns += time.perf_counter_ns() - t0
+        self._inflight[k] = (landed, csum, t0, n)
         self.transfers += 1
         self._fill = 0
+        self._k = (k + 1) % self.depth
+        if self.depth == 1:
+            self._drain(k)
 
     def finish(self) -> dict:
         self.flush()
+        for k in range(self.depth):
+            self._drain(k)
+        self._slots = []
+        # One device-side reduction + ONE readback for the whole run
+        # (uint32 sum wraps mod 2^32 natively).
+        dev_sum = (
+            int(jnp.sum(jnp.stack(self._csums), dtype=jnp.uint32))
+            if self._csums
+            else 0
+        )
+        self._csums = []
+        self._dev_sum = dev_sum % (1 << 32)
         return {
             "staged_bytes": self.staged_bytes,
             "transfers": self.transfers,
             "slot_bytes": self._slot_bytes,
             "n_chips": self.n_chips,
+            "depth": self.depth,
             "stage_recorder": self.stage_recorder,
             "device": str(self.device),
+            "transfer_wait_ns": self.transfer_wait_ns,
+            "put_submit_ns": self.put_submit_ns,
             "checksum_ok": self._dev_sum == self._host_sum,
             "checksum_device": self._dev_sum,
             "checksum_host": self._host_sum,

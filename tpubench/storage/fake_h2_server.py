@@ -342,12 +342,39 @@ class _Conn:
         query = urllib.parse.parse_qs(parsed.query)
         parts = parsed.path.split("/")
         if (
-            len(parts) < 7
+            len(parts) < 6
             or parts[1] != "storage"
             or parts[3] != "b"
             or parts[5] != "o"
         ):
             return self._respond_error(stream, 404, f"no route: {path}")
+        if len(parts) == 6 or not "/".join(parts[6:]):
+            # List route over h2 (`.../o?prefix=`): the whole-client
+            # http2 mode sends list requests here too.
+            import json
+
+            from tpubench.storage.base import object_meta_dict
+
+            prefix = query.get("prefix", [""])[0]
+            body = json.dumps(
+                {
+                    "kind": "storage#objects",
+                    "items": [
+                        object_meta_dict(m) for m in self.backend.list(prefix)
+                    ],
+                }
+            ).encode()
+            hb = _hp_literal(":status", "200") + _hp_literal(
+                "content-length", str(len(body))
+            )
+            try:
+                if self.send_interim_1xx:
+                    self.send_frame(1, 0x4, stream, _hp_literal(":status", "103"))
+                self.send_frame(1, 0x4, stream, hb)
+                self.send_frame(0, 0x1, stream, body)
+            except OSError:
+                pass
+            return None
         name = urllib.parse.unquote("/".join(parts[6:]))
         try:
             meta = self.backend.stat(name)

@@ -59,6 +59,10 @@ class _ConnectionPool:
         self._max_idle = transport.max_idle_conns_per_host
         self._idle: list[http.client.HTTPConnection] = []
         self._lock = threading.Lock()
+        # Connection accounting (native-pool parity): lets tests assert
+        # which pool a request actually rode (e.g. that http2=True never
+        # opens an h1.1 connection).
+        self.stats = {"connects": 0}
         self._ctx = None
         if scheme == "https":
             self._ctx = ssl.create_default_context(
@@ -69,6 +73,8 @@ class _ConnectionPool:
                 self._ctx.verify_mode = ssl.CERT_NONE
 
     def _new_conn(self) -> http.client.HTTPConnection:
+        with self._lock:
+            self.stats["connects"] += 1
         if self._scheme == "https":
             return http.client.HTTPSConnection(
                 self._host, self._port, context=self._ctx, timeout=60
@@ -314,13 +320,14 @@ class GcsHttpBackend:
         # workload's ReadObject span when the tracer propagates context
         # (OTel); NoopTracer costs nothing.
         self._tracer = tracer or NoopTracer()
-        # http2=True: media GETs ride the native h2 client (engine.cc's
-        # frame/HPACK machinery — Python's http.client cannot speak h2),
-        # reproducing the reference's HTTP/2 branch (ForceAttemptHTTP2,
+        # http2=True: ALL GETs — media and metadata (stat/list) — ride
+        # the native h2 client (engine.cc's frame/HPACK machinery;
+        # Python's http.client cannot speak h2), reproducing the
+        # reference's WHOLE-CLIENT HTTP/2 branch (ForceAttemptHTTP2,
         # main.go:76-80) so the "http1 is more performant" claim
-        # (main.go:64) is measurable instead of assumed. Metadata
-        # (stat/list/write/delete) stays on the HTTP/1.1 pool — the A/B
-        # isolates the media hot path, which is where the bytes are.
+        # (main.go:64) is measurable on the full read path. The h2
+        # client is GET-only; write/delete stay on the HTTP/1.1 pool
+        # (the reference's hot path issues no writes, main.go:121-148).
         self._h2_pool_obj = None
         self._h2_pool_lock = threading.Lock()
         self._h2_stat_cache: dict[str, int] = {}
@@ -442,6 +449,81 @@ class GcsHttpBackend:
                     alpn_h2=self._scheme == "https",
                 )
         return self._h2_pool_obj
+
+    def _meta_get_h2(self, path: str, what: str) -> bytes:
+        """Metadata GET over the native HTTP/2 client: under ``http2=True``
+        the WHOLE read path rides h2 — stat and media alike — matching the
+        reference's whole-client branch (``ForceAttemptHTTP2``,
+        main.go:76-80) instead of isolating half the A/B. (The native h2
+        client is GET-only, so write/delete stay on the HTTP/1.1 pool;
+        the reference's hot path issues no writes, main.go:121-148.)
+        Returns the response body bytes; raises classified StorageError."""
+        from tpubench.native.engine import TB_ETOOBIG, PERMANENT_CODES, NativeError
+
+        pool = self._h2_pool()
+        engine = pool.engine
+        headers = "".join(
+            f"{k}: {v}\r\n"
+            for k, v in self._headers().items()
+            if k.lower() != "host"
+        )
+        authority = f"{self._host}:{self._port}"
+        # Metadata bodies are usually tiny, but a big bucket's list JSON
+        # can run to megabytes (several hundred bytes per object): grow
+        # the buffer on TB_ETOOBIG rather than failing permanently where
+        # the h1.1 path would have succeeded.
+        for cap in (256 * 1024, 16 * 1024 * 1024):
+            buf = pool.buffers.acquire(cap)
+
+            def do_request(conn: int) -> dict:
+                with self._tracer.span(
+                    "gcs_http.meta_h2", path=path, bucket=self.bucket
+                ) as sp:
+                    engine.h2_submit_get(
+                        conn, authority, path, buf, headers=headers
+                    )
+                    c = engine.h2_poll(conn)
+                    if c is None:
+                        raise NativeError("h2 stream vanished", code=-1001)
+                    sp.event("first_byte", native_ns=c["first_byte_ns"])
+                return c
+
+            try:
+                r = pool.run(do_request)
+            except StorageError:
+                pool.buffers.release(buf)
+                raise
+            except NativeError as e:
+                pool.buffers.release(buf)
+                raise StorageError(
+                    f"h2 {what}: {e}", transient=e.code not in PERMANENT_CODES
+                ) from e
+            except BaseException:
+                pool.buffers.release(buf)
+                raise
+            status = r["http_status"]
+            if r["result"] == TB_ETOOBIG and cap == 256 * 1024:
+                pool.buffers.release(buf)
+                continue  # body outgrew the small buffer: one big retry
+            if r["result"] < 0:
+                pool.buffers.release(buf)
+                raise StorageError(
+                    f"h2 {what}: stream error {r['result']} (status {status})",
+                    transient=r["result"] not in PERMANENT_CODES,
+                )
+            body = bytes(buf.view(r["result"]))
+            pool.buffers.release(buf)
+            if status != 200:
+                raise StorageError(
+                    f"h2 {what} -> {status}: "
+                    f"{body[:200].decode('utf-8', 'replace')}",
+                    transient=status in _TRANSIENT,
+                    code=status,
+                )
+            return body
+        raise StorageError(  # pragma: no cover — loop always returns/raises
+            f"h2 {what}: body exceeded 16 MiB metadata buffer", transient=False
+        )
 
     def _open_read_h2(self, name: str, start: int, length: Optional[int]):
         """Media GET over the native HTTP/2 client. The response body
@@ -728,21 +810,29 @@ class GcsHttpBackend:
             f"/storage/v1/b/{urllib.parse.quote(self.bucket, safe='')}/o"
             f"?prefix={urllib.parse.quote(prefix, safe='')}"
         )
-        conn, resp = self._checked("GET", path)
-        try:
-            payload = json.loads(resp.read())
-        finally:
-            self._pool.release(conn, reusable=True)
+        if self.transport.http2:
+            payload = json.loads(self._meta_get_h2(path, f"LIST {prefix!r}"))
+        else:
+            conn, resp = self._checked("GET", path)
+            try:
+                payload = json.loads(resp.read())
+            finally:
+                self._pool.release(conn, reusable=True)
         return [
             ObjectMeta(it["name"], int(it["size"])) for it in payload.get("items", [])
         ]
 
     def stat(self, name: str) -> ObjectMeta:
-        conn, resp = self._checked("GET", self._opath(name))
-        try:
-            meta = json.loads(resp.read())
-        finally:
-            self._pool.release(conn, reusable=True)
+        if self.transport.http2:
+            meta = json.loads(
+                self._meta_get_h2(self._opath(name), f"STAT {name}")
+            )
+        else:
+            conn, resp = self._checked("GET", self._opath(name))
+            try:
+                meta = json.loads(resp.read())
+            finally:
+                self._pool.release(conn, reusable=True)
         return ObjectMeta(
             meta["name"], int(meta["size"]), int(meta.get("generation", 0))
         )

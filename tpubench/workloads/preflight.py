@@ -1,0 +1,255 @@
+"""``tpubench preflight`` — validate the run environment BEFORE burning a
+benchmark window.
+
+The reference's de-facto validation is "run it against real infrastructure
+and see" (`/root/reference/README.md:4-9`, `execute_pb.sh:3-9`: a GCP VM,
+a bucket, credentials, and optionally a DirectPath-eligible network). One
+shot here checks each precondition separately and prints the env the run
+would use, so a misconfiguration costs seconds, not a benchmark slot:
+
+* **auth** — resolve the token source the config implies (service-account
+  key / ADC / anonymous-for-custom-endpoint) and actually mint a token;
+* **bucket** — open the configured backend and list it (auth + network +
+  permission in one probe);
+* **directpath** — eligibility screen for the gRPC DirectPath path: grpc
+  importable, default endpoint, AND the GCE metadata server reachable
+  (off-GCP the google-c2p resolver can never pick DirectPath backends);
+* **native engine** — the C++ engine builds/loads, TLS availability;
+* **env echo** — the exact endpoint/protocol/credential env the run
+  would execute with.
+
+Each check reports ``{name, ok, skipped?, detail}``; overall ``ok`` is the
+AND of non-skipped checks. Exit code 1 on any failure (CLI).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Callable
+
+from tpubench.config import BenchConfig
+
+_ENV_KEYS = (
+    "GOOGLE_APPLICATION_CREDENTIALS",
+    "GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS",
+    "JAX_PLATFORMS",
+    "XLA_FLAGS",
+    "TPUBENCH_NUM_PROCESSES",
+    "TPUBENCH_PROCESS_ID",
+    "TPUBENCH_COORDINATOR",
+)
+
+_METADATA_HOST = "metadata.google.internal"
+
+
+def _check(name: str, ok: bool, detail: str, skipped: bool = False) -> dict:
+    return {"name": name, "ok": ok, "skipped": skipped, "detail": detail}
+
+
+def _bounded(name: str, fn: Callable[[], dict], timeout_s: float) -> dict:
+    """Run a probe with a HARD wall-clock bound: preflight exists to fail
+    in seconds, and several failure modes (zero-egress DNS lookups, TCP
+    connects to unreachable networks) hang far past any library timeout —
+    getaddrinfo has none at all. A plain DAEMON thread, not a
+    ThreadPoolExecutor: executor workers are non-daemon and joined at
+    interpreter exit, so one hung resolver would block process shutdown
+    long after the probe was reported failed."""
+    box: dict = {}
+
+    def _run() -> None:
+        try:
+            box["result"] = fn()
+        except Exception as e:  # noqa: BLE001
+            box["error"] = str(e)
+
+    t = threading.Thread(target=_run, daemon=True, name=f"preflight-{name}")
+    t.start()
+    t.join(timeout=timeout_s)
+    if t.is_alive():
+        return _check(
+            name, False,
+            f"probe exceeded {timeout_s:.0f}s (network unreachable or "
+            "hanging resolver)",
+        )
+    if "error" in box:
+        return _check(name, False, f"probe raised: {box['error']}")
+    return box["result"]
+
+
+def _auth_check(cfg: BenchConfig) -> dict:
+    from tpubench.storage.auth import (
+        AnonymousTokenSource,
+        make_token_source,
+    )
+
+    t = cfg.transport
+    if t.protocol in ("fake", "local"):
+        return _check(
+            "auth", True,
+            f"protocol {t.protocol!r} needs no credentials", skipped=True,
+        )
+    try:
+        src = make_token_source(t.key_file, t.endpoint)
+    except Exception as e:  # noqa: BLE001 — bad key file, no ADC
+        return _check("auth", False, f"token source construction: {e}")
+    if isinstance(src, AnonymousTokenSource):
+        return _check(
+            "auth", True,
+            f"custom endpoint {t.endpoint!r}: anonymous (no Authorization "
+            "header) — hermetic/fake-server mode",
+        )
+    try:
+        tok = src.token()
+    except Exception as e:  # noqa: BLE001 — refresh failure
+        return _check("auth", False, f"token refresh failed: {e}")
+    if not tok:
+        return _check("auth", False, "token source returned no token")
+    kind = "service-account key" if t.key_file else "ADC"
+    return _check("auth", True, f"{kind} minted a bearer token (not shown)")
+
+
+def _bucket_check(cfg: BenchConfig) -> dict:
+    from tpubench.storage import open_backend
+
+    w = cfg.workload
+    if cfg.transport.protocol == "fake":
+        # In-process backend: nothing to reach (and constructing it
+        # prepopulates workers × object_size of deterministic bytes —
+        # gigabytes under the reference-default config).
+        return _check(
+            "bucket", True, "in-process fake backend: always reachable",
+            skipped=True,
+        )
+    try:
+        backend = open_backend(cfg)
+    except Exception as e:  # noqa: BLE001
+        return _check("bucket", False, f"backend construction: {e}")
+    try:
+        objs = backend.list(w.object_name_prefix)
+        return _check(
+            "bucket", True,
+            f"list({w.object_name_prefix!r}) on {w.bucket!r}: "
+            f"{len(objs)} object(s) visible",
+        )
+    except Exception as e:  # noqa: BLE001 — 403/404/network
+        return _check(
+            "bucket", False, f"list on {w.bucket!r} failed: {e}"
+        )
+    finally:
+        backend.close()
+
+
+def _metadata_server_reachable(timeout_s: float = 0.6) -> bool:
+    try:
+        with socket.create_connection((_METADATA_HOST, 80), timeout=timeout_s):
+            return True
+    except OSError:
+        return False
+
+
+def _directpath_check(cfg: BenchConfig) -> dict:
+    t = cfg.transport
+    if t.protocol != "grpc" or not t.directpath:
+        return _check(
+            "directpath", True,
+            "not requested (protocol!=grpc or transport.directpath=False)",
+            skipped=True,
+        )
+    try:
+        import grpc  # noqa: F401
+    except Exception as e:  # noqa: BLE001
+        return _check("directpath", False, f"grpcio unavailable: {e}")
+    default_ep = not t.endpoint or "googleapis.com" in t.endpoint
+    if not default_ep:
+        return _check(
+            "directpath", False,
+            f"custom endpoint {t.endpoint!r}: the google-c2p resolver "
+            "only applies to the default endpoint (gcs_grpc rejects this "
+            "loudly at run time)",
+        )
+    if not _metadata_server_reachable():
+        return _check(
+            "directpath", False,
+            f"GCE metadata server ({_METADATA_HOST}) unreachable: not a "
+            "GCP VM, DirectPath backends cannot be selected",
+        )
+    xds = os.environ.get("GOOGLE_CLOUD_ENABLE_DIRECT_PATH_XDS", "")
+    return _check(
+        "directpath", True,
+        "on-GCP (metadata server reachable); google-c2p resolver will "
+        f"probe eligibility at channel build (DIRECT_PATH_XDS={xds!r})",
+    )
+
+
+def _engine_check(cfg: BenchConfig) -> dict:
+    need = (
+        cfg.transport.native_receive
+        or cfg.transport.http2
+        or cfg.workload.fetch_executor == "native"
+    )
+    err = ""
+    try:
+        from tpubench.native.engine import get_engine
+
+        eng = get_engine()
+    except Exception as e:  # noqa: BLE001
+        eng = None
+        err = str(e)
+    if eng is None:
+        detail = "native engine unavailable" + (f": {err}" if err else "")
+        return _check("native_engine", not need, detail, skipped=not need)
+    return _check(
+        "native_engine", True,
+        f"engine loaded (tls={'yes' if eng.tls_available() else 'no'})",
+    )
+
+
+def run_preflight(cfg: BenchConfig, probe_timeout_s: float = 15.0) -> dict:
+    checks = [
+        _bounded("auth", lambda: _auth_check(cfg), probe_timeout_s),
+        _bounded("bucket", lambda: _bucket_check(cfg), probe_timeout_s),
+        _bounded("directpath", lambda: _directpath_check(cfg), probe_timeout_s),
+        _engine_check(cfg),
+    ]
+    t = cfg.transport
+    endpoint = t.endpoint or (
+        "https://storage.googleapis.com" if t.protocol == "http"
+        else "storage.googleapis.com:443" if t.protocol == "grpc"
+        else "(in-process)"
+    )
+    env = {
+        "protocol": t.protocol,
+        "endpoint": endpoint,
+        "bucket": cfg.workload.bucket,
+        "object_name_prefix": cfg.workload.object_name_prefix,
+        "http2": t.http2,
+        "native_receive": t.native_receive,
+        "directpath": t.directpath,
+        "fetch_executor": cfg.workload.fetch_executor,
+        "key_file": t.key_file or "(ADC)",
+        "env": {k: os.environ.get(k, "") for k in _ENV_KEYS},
+    }
+    ok = all(c["ok"] for c in checks if not c["skipped"])
+    return {"ok": ok, "checks": checks, "effective": env}
+
+
+def format_preflight(result: dict) -> str:
+    lines = []
+    for c in result["checks"]:
+        mark = "SKIP" if c["skipped"] else ("ok " if c["ok"] else "FAIL")
+        lines.append(f"[{mark}] {c['name']}: {c['detail']}")
+    e = result["effective"]
+    lines.append(
+        f"run would use: protocol={e['protocol']} endpoint={e['endpoint']} "
+        f"bucket={e['bucket']} prefix={e['object_name_prefix']} "
+        f"http2={e['http2']} native_receive={e['native_receive']} "
+        f"directpath={e['directpath']} executor={e['fetch_executor']} "
+        f"creds={e['key_file']}"
+    )
+    for k, v in e["env"].items():
+        if v:
+            lines.append(f"  {k}={v}")
+    lines.append("preflight: " + ("OK" if result["ok"] else "FAILED"))
+    return "\n".join(lines)

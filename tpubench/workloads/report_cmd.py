@@ -1,0 +1,142 @@
+"""``tpubench report`` — summarize and compare result JSONs offline.
+
+The reference's post-processing is a matplotlib recipe pasted in its
+README (`/root/reference/README.md:15-36`: read per-read latency lines,
+print the average, show a histogram). This replaces it with a
+dependency-free report over the framework's own result files
+(``write_result`` JSONs): the ssd_test percentile block per summary
+(Avg/P20/P50/P90/p99/Min/Max — ``ssd_test/main.go:157-163`` format), a
+throughput line per run, and — given two or more runs — pairwise deltas
+grouped by config axis (protocol, staging mode, fetch executor), which is
+the h1-vs-h2 / python-vs-native A/B table the sweep produces.
+
+Pure functions over parsed dicts; the CLI wires file loading around them.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+_PCT_KEYS = ("avg_ms", "p20_ms", "p50_ms", "p90_ms", "p99_ms", "min_ms", "max_ms")
+_PCT_HEAD = ("Avg", "P20", "P50", "P90", "p99", "Min", "Max")
+
+
+def _axis(run: dict) -> str:
+    """The config axis label an A/B varies: protocol(+http2/native), the
+    staging mode, and the fetch executor."""
+    cfg = run.get("config", {})
+    t = cfg.get("transport", {})
+    w = cfg.get("workload", {})
+    s = cfg.get("staging", {})
+    proto = t.get("protocol", "?")
+    if t.get("http2"):
+        proto += "+h2"
+    elif t.get("native_receive"):
+        proto += "+native"
+    bits = [proto]
+    if s.get("mode") and s.get("mode") != "none":
+        bits.append(f"staging={s['mode']}")
+    if w.get("fetch_executor") and w.get("fetch_executor") != "python":
+        bits.append(f"executor={w['fetch_executor']}")
+    sweep = run.get("extra", {}).get("sweep")
+    if sweep:
+        bits.append(f"size={sweep.get('size')}")
+    return " ".join(bits)
+
+
+def percentile_block(name: str, s: dict) -> str:
+    """One summary in the ssd_test block format."""
+    cells = "  ".join(
+        f"{h}: {s.get(k, 0.0):.3f} ms" for h, k in zip(_PCT_HEAD, _PCT_KEYS)
+    )
+    return f"{name} (n={s.get('count', 0)}): {cells}"
+
+
+def summarize_run(run: dict, label: str = "") -> str:
+    lines = [
+        f"== {label or _axis(run)} — {run.get('workload', '?')} ==",
+        (
+            f"bytes={run.get('bytes_total', 0)} "
+            f"wall={run.get('wall_seconds', 0.0):.3f}s "
+            f"GB/s={run.get('gbps', 0.0):.4f} "
+            f"GB/s/chip={run.get('gbps_per_chip', 0.0):.4f} "
+            f"errors={run.get('errors', 0)}"
+        ),
+    ]
+    for name, s in (run.get("summaries") or {}).items():
+        lines.append("  " + percentile_block(name, s))
+    extra = run.get("extra", {})
+    staged = extra.get("staged_gbps_per_chip")
+    if staged is not None:
+        lines.append(f"  staged GB/s/chip={staged:.4f}")
+    if "checksum_ok" in extra:
+        lines.append(f"  checksum_ok={extra['checksum_ok']}")
+    return "\n".join(lines)
+
+
+def compare_runs(runs: list[dict]) -> str:
+    """Pairwise A/B table vs the FIRST run (the baseline): throughput
+    ratio and p50/p99 deltas per summary, labeled by config axis."""
+    if len(runs) < 2:
+        return ""
+    base = runs[0]
+    base_label = _axis(base)
+    lines = [f"A/B vs baseline [{base_label}]:"]
+    for other in runs[1:]:
+        label = _axis(other)
+        bg, og = base.get("gbps", 0.0), other.get("gbps", 0.0)
+        ratio = og / bg if bg > 0 else 0.0
+        lines.append(
+            f"  [{label}] GB/s {og:.4f} vs {bg:.4f} "
+            f"({ratio:.3f}x baseline)"
+        )
+        for name, s in (other.get("summaries") or {}).items():
+            b = (base.get("summaries") or {}).get(name)
+            if not b:
+                continue
+            d50 = s.get("p50_ms", 0.0) - b.get("p50_ms", 0.0)
+            d99 = s.get("p99_ms", 0.0) - b.get("p99_ms", 0.0)
+            lines.append(
+                f"    {name}: p50 {s.get('p50_ms', 0.0):.3f} ms "
+                f"({d50:+.3f}), p99 {s.get('p99_ms', 0.0):.3f} ms "
+                f"({d99:+.3f})"
+            )
+    return "\n".join(lines)
+
+
+def sweep_table(rows: list[dict]) -> str:
+    """Table form of a ``tpubench sweep`` output (the list of cells the
+    sweep command prints/writes)."""
+    if not rows:
+        return ""
+    lines = ["sweep:"]
+    for r in rows:
+        cell = f"  {r.get('protocol', '?'):>8}"
+        if "native_receive" in r:
+            cell += f"/{'native' if r['native_receive'] else 'python'}"
+        cell += (
+            f"  size={r.get('size', '?'):>6}  GB/s={r.get('gbps', 0.0):.4f}"
+            f"  p50={r.get('p50_ms', 0.0):.3f} ms"
+            f"  p99={r.get('p99_ms', 0.0):.3f} ms"
+        )
+        lines.append(cell)
+    return "\n".join(lines)
+
+
+def run_report(paths: list[str]) -> str:
+    """Load result/sweep JSONs and render the full report."""
+    runs: list[dict] = []
+    chunks: list[str] = []
+    for p in paths:
+        with open(p) as f:
+            doc = json.load(f)
+        if isinstance(doc, list):  # a sweep cells file
+            chunks.append(sweep_table(doc))
+            continue
+        runs.append(doc)
+        chunks.append(summarize_run(doc, label=f"{_axis(doc)} ({p})"))
+    cmp_block = compare_runs(runs)
+    if cmp_block:
+        chunks.append(cmp_block)
+    return "\n\n".join(c for c in chunks if c)
