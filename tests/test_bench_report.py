@@ -138,6 +138,16 @@ def test_note_never_contradicts_shaped_verdict():
     assert "PEAK" in n_true
 
 
+def test_note_explains_quotient_above_one():
+    """A pair quotient >1 is within-window variance (the tunnel half
+    understated the grant), not the pipeline beating raw device_put —
+    the note must say so rather than publish an impossible number bare."""
+    n = br.build_note(_fields(staging_efficiency=1.25))
+    assert "UNDERSTATED" in n and "≈1.0" in n
+    n2 = br.build_note(_fields(staging_efficiency=0.93))
+    assert "UNDERSTATED" not in n2
+
+
 def test_note_reports_null_efficiency_honestly():
     n = br.build_note(_fields(staging_efficiency=None))
     assert "staging_efficiency=null" in n

@@ -148,6 +148,13 @@ def build_note(f: dict) -> str:
             "measurements showed cross-window quotients are dominated by "
             "budget position, not pipeline cost)."
         )
+        if eff > 1:
+            s += (
+                " A quotient >1 means the tunnel half UNDERSTATED the "
+                "window's grant (within-window variance), not that the "
+                "pipeline beat raw device_put — read it as ≈1.0, pipeline "
+                "at the ceiling."
+            )
         if mode == "sync":
             s += (
                 " The best pair ran the depth-1 sync config, whose "

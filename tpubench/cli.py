@@ -430,6 +430,11 @@ def main(argv=None) -> int:
     gb = add("gather-bench", "ICI collective bandwidth vs mesh size")
     gb.add_argument("--shard-mb", type=float, default=4.0)
     gb.add_argument("--reps", type=int, default=5)
+    gb.add_argument("--collective",
+                    choices=("all_gather", "ring", "reduce_scatter", "psum"),
+                    default="",
+                    help="which collective to benchmark (default "
+                         "all_gather; --ring is shorthand for ring)")
     mcs = sub.add_parser(
         "multichip-sweep",
         help="pod-ingest + collective sweep over simulated meshes "
@@ -441,11 +446,6 @@ def main(argv=None) -> int:
     mcs.add_argument("--shard-mb")
     mcs.add_argument("--reps")
     mcs.add_argument("--out")
-    gb.add_argument("--collective",
-                    choices=("all_gather", "ring", "reduce_scatter", "psum"),
-                    default="",
-                    help="which collective to benchmark (default "
-                         "all_gather; --ring is shorthand for ring)")
     probe = add("probe", "host→HBM transfer-physics probe (fixed cost, "
                          "size sweep, burst/floor shaping, slow start)")
     probe.add_argument("--cycles", type=int, default=8,

@@ -64,15 +64,24 @@ def summarize_ns(latencies_ns: Sequence[int] | np.ndarray) -> LatencySummary:
     return summarize(np.asarray(latencies_ns, dtype=np.float64) / 1e6)
 
 
+# The ssd_test percentile block's field order (``ssd_test/main.go:157-163``)
+# — ONE definition shared by format_summary and the offline ``tpubench
+# report`` renderer so the two can't drift.
+PCT_FIELDS = (
+    ("Avg", "avg_ms"),
+    ("P20", "p20_ms"),
+    ("P50", "p50_ms"),
+    ("P90", "p90_ms"),
+    ("p99", "p99_ms"),
+    ("Min", "min_ms"),
+    ("Max", "max_ms"),
+)
+
+
 def format_summary(label: str, s: LatencySummary) -> str:
     """Human block in the ssd_test stdout shape (``ssd_test/main.go:157-163``)."""
-    return (
-        f"[{label}] n={s.count}\n"
-        f"Average: {s.avg_ms:.3f} ms\n"
-        f"P20: {s.p20_ms:.3f} ms\n"
-        f"P50: {s.p50_ms:.3f} ms\n"
-        f"P90: {s.p90_ms:.3f} ms\n"
-        f"p99: {s.p99_ms:.3f} ms\n"
-        f"Min: {s.min_ms:.3f} ms\n"
-        f"Max: {s.max_ms:.3f} ms"
-    )
+    lines = [f"[{label}] n={s.count}"]
+    for head, key in PCT_FIELDS:
+        name = "Average" if head == "Avg" else head  # reference stdout label
+        lines.append(f"{name}: {getattr(s, key):.3f} ms")
+    return "\n".join(lines)

@@ -42,6 +42,16 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+@jax.jit
+def _add_u32(a, b):
+    # uint32 add wraps mod 2^32 natively — the running device-side
+    # checksum accumulator. Jitted: EAGER scalar ops on a tunneled
+    # backend dispatch pathologically slowly (measured 6.2 s for one
+    # eager stack+sum), while a jitted add compiles once and dispatches
+    # async.
+    return a + b
+
+
 def _checksum_kernel(x_ref, out_ref):
     # Mosaic has no unsigned reductions; int32 two's-complement wraparound is
     # exactly mod-2^32 arithmetic, so accumulate signed and bitcast outside.
@@ -160,13 +170,16 @@ class PallasStager(GranuleAggregator):
         # Phase accounting, DevicePutStager parity (gap breakdown).
         self.transfer_wait_ns = 0
         self.put_submit_ns = 0
+        self.checksum_reduce_ns = 0
         self._host_sum = 0
-        # Per-slot device checksums stay ON DEVICE until finish(): an
-        # int(csum) per drain is a host readback — a full transfer-path
-        # round trip per slot (measured ~0.12 s on a tunneled device,
-        # dwarfing the 8 MB landing pass itself). One stacked device-side
-        # reduction at finish costs a single readback for the whole run.
-        self._csums: list[jax.Array] = []
+        # The per-slot device checksums accumulate ON DEVICE via a jitted
+        # running add: an int(csum) per drain would be a host readback —
+        # a full transfer-path round trip per slot (measured ~0.12 s on a
+        # tunneled device, dwarfing the 8 MB landing pass itself) — and
+        # an eager stack+sum at finish dispatches even worse (6.2 s
+        # measured). The jitted add dispatches async per drain; finish
+        # pays ONE readback.
+        self._dev_acc: Optional[jax.Array] = None
 
     def _drain(self, k: int) -> None:
         item = self._inflight[k]
@@ -179,7 +192,9 @@ class PallasStager(GranuleAggregator):
         self.stage_recorder.record_ns(time.perf_counter_ns() - submit_ns)
         # The landing pass read its input (which may alias the host slot
         # on zero-copy backends); with it complete the slot is reusable.
-        self._csums.append(csum)
+        self._dev_acc = (
+            csum if self._dev_acc is None else _add_u32(self._dev_acc, csum)
+        )
         self.staged_bytes += n
         self._inflight[k] = None
 
@@ -216,14 +231,13 @@ class PallasStager(GranuleAggregator):
         for k in range(self.depth):
             self._drain(k)
         self._slots = []
-        # One device-side reduction + ONE readback for the whole run
-        # (uint32 sum wraps mod 2^32 natively).
-        dev_sum = (
-            int(jnp.sum(jnp.stack(self._csums), dtype=jnp.uint32))
-            if self._csums
-            else 0
-        )
-        self._csums = []
+        # ONE readback for the whole run (the accumulator already summed
+        # on device). Timed separately: a stall here (device queue,
+        # compile) would otherwise show up only as unexplained wall.
+        t0 = time.perf_counter_ns()
+        dev_sum = int(self._dev_acc) if self._dev_acc is not None else 0
+        self.checksum_reduce_ns = time.perf_counter_ns() - t0
+        self._dev_acc = None
         self._dev_sum = dev_sum % (1 << 32)
         return {
             "staged_bytes": self.staged_bytes,
@@ -235,6 +249,7 @@ class PallasStager(GranuleAggregator):
             "device": str(self.device),
             "transfer_wait_ns": self.transfer_wait_ns,
             "put_submit_ns": self.put_submit_ns,
+            "checksum_reduce_ns": self.checksum_reduce_ns,
             "checksum_ok": self._dev_sum == self._host_sum,
             "checksum_device": self._dev_sum,
             "checksum_host": self._host_sum,

@@ -314,16 +314,25 @@ class _Conn:
                 send(200, json.dumps(object_meta_dict(meta)).encode(),
                      "application/json")
 
-    def _respond_error(self, stream: int, status: int, msg: str) -> None:
-        body = msg.encode()
+    def _respond_body(self, stream: int, status: int, body: bytes) -> None:
+        """One complete response: optional interim 103 block (the
+        ``send_interim_1xx`` knob precedes EVERY response), then
+        :status + content-length HEADERS and the body as one DATA frame
+        with END_STREAM (the client advertises a 2^24-1 max frame size,
+        engine.cc)."""
         hb = _hp_literal(":status", str(status)) + _hp_literal(
             "content-length", str(len(body))
         )
         try:
+            if self.send_interim_1xx:
+                self.send_frame(1, 0x4, stream, _hp_literal(":status", "103"))
             self.send_frame(1, 0x4, stream, hb)
             self.send_frame(0, 0x1, stream, body)
         except OSError:
             pass
+
+    def _respond_error(self, stream: int, status: int, msg: str) -> None:
+        self._respond_body(stream, status, msg.encode())
 
     def _handle(self, stream: int, h: dict) -> None:
         fault = self.backend.fault
@@ -364,17 +373,7 @@ class _Conn:
                     ],
                 }
             ).encode()
-            hb = _hp_literal(":status", "200") + _hp_literal(
-                "content-length", str(len(body))
-            )
-            try:
-                if self.send_interim_1xx:
-                    self.send_frame(1, 0x4, stream, _hp_literal(":status", "103"))
-                self.send_frame(1, 0x4, stream, hb)
-                self.send_frame(0, 0x1, stream, body)
-            except OSError:
-                pass
-            return None
+            return self._respond_body(stream, 200, body)
         name = urllib.parse.unquote("/".join(parts[6:]))
         try:
             meta = self.backend.stat(name)
@@ -389,17 +388,7 @@ class _Conn:
             from tpubench.storage.base import object_meta_dict
 
             body = json.dumps(object_meta_dict(meta)).encode()
-            hb = _hp_literal(":status", "200") + _hp_literal(
-                "content-length", str(len(body))
-            )
-            try:
-                if self.send_interim_1xx:
-                    self.send_frame(1, 0x4, stream, _hp_literal(":status", "103"))
-                self.send_frame(1, 0x4, stream, hb)
-                self.send_frame(0, 0x1, stream, body)
-            except OSError:
-                pass
-            return None
+            return self._respond_body(stream, 200, body)
         start, end, status = 0, meta.size - 1, 200
         rng = h.get("range", "")
         if rng.startswith("bytes="):

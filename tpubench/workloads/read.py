@@ -208,6 +208,10 @@ class ReadWorkload:
                         st["put_submit_ns"] for st in live
                     ) / 1e9 / k,
                 }
+                if any("checksum_reduce_ns" in st for st in live):
+                    res.extra["staging_breakdown"]["checksum_reduce_s"] = sum(
+                        st.get("checksum_reduce_ns", 0) for st in live
+                    ) / 1e9 / k
         checks = [st["checksum_ok"] for st in sink_stats if "checksum_ok" in st]
         if checks:
             res.extra["checksum_ok"] = all(checks)

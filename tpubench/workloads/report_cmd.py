@@ -16,10 +16,8 @@ Pure functions over parsed dicts; the CLI wires file loading around them.
 from __future__ import annotations
 
 import json
-from typing import Optional
 
-_PCT_KEYS = ("avg_ms", "p20_ms", "p50_ms", "p90_ms", "p99_ms", "min_ms", "max_ms")
-_PCT_HEAD = ("Avg", "P20", "P50", "P90", "p99", "Min", "Max")
+from tpubench.metrics.percentiles import PCT_FIELDS
 
 
 def _axis(run: dict) -> str:
@@ -46,9 +44,10 @@ def _axis(run: dict) -> str:
 
 
 def percentile_block(name: str, s: dict) -> str:
-    """One summary in the ssd_test block format."""
+    """One summary in the ssd_test block format (one line; field order
+    shared with the live-run renderer via PCT_FIELDS)."""
     cells = "  ".join(
-        f"{h}: {s.get(k, 0.0):.3f} ms" for h, k in zip(_PCT_HEAD, _PCT_KEYS)
+        f"{h}: {s.get(k, 0.0):.3f} ms" for h, k in PCT_FIELDS
     )
     return f"{name} (n={s.get('count', 0)}): {cells}"
 
