@@ -356,3 +356,28 @@ def test_native_executor_against_native_source_server():
         assert res.bytes_total == 2 * 1_500_000
         assert res.extra["checksum_ok"] is True
         assert res.extra["staged_bytes"] == res.bytes_total
+
+
+def test_pool_discard_mode(server):
+    """NULL-buffer tasks stream the body through a per-thread scratch and
+    report the byte count — io.Discard parity for fetch-only A/Bs (the
+    landing path would charge DRAM-write bandwidth the discard comparison
+    paths never pay)."""
+    from tpubench.native.engine import get_engine
+
+    eng = get_engine()
+    host, port = _hostport(server)
+    with eng.pool_create(threads=2, cap=8) as pool:
+        for i in range(4):
+            pool.submit_to(
+                host, port, _media_path(f"bench/file_{i}"), 0, 0, tag=i
+            )
+        seen = {}
+        for _ in range(4):
+            c = pool.next(timeout_ms=10_000)
+            assert c is not None
+            seen[c["tag"]] = c
+        for i in range(4):
+            assert seen[i]["status"] == 200
+            assert seen[i]["result"] == 500_000  # counted, not landed
+            assert seen[i]["first_byte_ns"] > 0

@@ -1535,12 +1535,41 @@ static void wc_close(WorkerConn* wc) {
   wc->h = 0;
 }
 
+// Discard-mode GET (task buf == NULL): stream the body through one hot
+// granule-sized scratch window and drop it — the reference's io.Discard
+// hot loop (main.go:140) and the Python staging-"none" path both discard
+// this way, so the fetch-only A/B compares like with like (landing a
+// whole 48 MB body through DRAM costs real memory bandwidth the discard
+// path never pays). Returns total body bytes or a negative code.
+static const int64_t kDiscardScratch = 2 << 20;  // reference granule
+
+static int64_t discard_get(WorkerConn* wc, Task* t, uint8_t* scratch,
+                           int* reusable_out) {
+  int status = 0;
+  int64_t clen = -1, fb = 0;
+  int64_t rc = tb_conn_get_begin(wc->h, t->host, t->port, t->path,
+                                 t->headers, &status, &clen, &fb);
+  if (rc != 0) return rc;
+  t->status = status;
+  t->first_byte_ns = fb;
+  int64_t total = 0;
+  for (;;) {
+    int64_t k = tb_conn_body_read(wc->h, scratch, kDiscardScratch);
+    if (k < 0) return k;
+    if (k == 0) break;
+    total += k;
+  }
+  tb_conn_get_end(wc->h, reusable_out);
+  return total;
+}
+
 static void* worker_main(void* arg) {
   Pool* p = static_cast<Pool*>(arg);
   WorkerConn wc;
   wc.host[0] = 0;
   wc.port = -1;
   wc.h = 0;
+  uint8_t* scratch = nullptr;  // lazily allocated, discard tasks only
   for (;;) {
     pthread_mutex_lock(&p->mu);
     while (p->sub_len == 0 && !p->shutdown)
@@ -1582,9 +1611,18 @@ static void* worker_main(void* arg) {
       }
       int reusable = 0;
       t->start_ns = tb_now_ns();
-      t->result = tb_conn_request(wc.h, t->host, t->port, t->path,
-                                  t->headers, t->buf, t->buf_len, &t->status,
-                                  &t->first_byte_ns, &t->total_ns, &reusable);
+      if (t->buf == nullptr) {
+        if (!scratch)
+          scratch = static_cast<uint8_t*>(malloc(kDiscardScratch));
+        t->result = scratch ? discard_get(&wc, t, scratch, &reusable)
+                            : -ENOMEM;
+        t->total_ns = tb_now_ns() - t->start_ns;
+      } else {
+        t->result = tb_conn_request(wc.h, t->host, t->port, t->path,
+                                    t->headers, t->buf, t->buf_len,
+                                    &t->status, &t->first_byte_ns,
+                                    &t->total_ns, &reusable);
+      }
       if (t->result >= 0) {
         if (!reusable) wc_close(&wc);
         break;
@@ -1611,6 +1649,7 @@ static void* worker_main(void* arg) {
     pthread_mutex_unlock(&p->mu);
   }
   wc_close(&wc);
+  free(scratch);
   return nullptr;
 }
 
