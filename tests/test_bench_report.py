@@ -217,6 +217,24 @@ def test_note_nexec_sentence_tracks_measurement():
     assert "no Python competing" in clean
 
 
+# ------------------------------------------------------ bench cfg wiring --
+
+
+def test_bench_cfg_modes_wire_the_right_pipeline():
+    """Pins the config each bench mode label actually runs (a round-5
+    review caught 'overlap' measuring the inline-drain ring because the
+    drain knob was never set)."""
+    import bench
+
+    sync = bench._cfg(32, 2, 8, sync=True)
+    assert sync.staging.double_buffer is False  # depth-1 inline ring
+    assert sync.staging.mode == "device_put"
+    ov = bench._cfg(32, 2, 8, sync=False)
+    assert ov.staging.double_buffer is True
+    assert ov.staging.drain == "thread"  # the drain-THREAD pipeline
+    assert ov.staging.depth == 3
+
+
 # ------------------------------------------------------- probe hardening --
 
 

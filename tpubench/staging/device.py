@@ -302,7 +302,7 @@ class DevicePutStager(GranuleAggregator):
         if self._validate:
             k = self._k
             chunk = self._slots[k].reshape(-1)[self._fill : self._fill + n]
-            self._host_sum += np.uint64(int(chunk.astype(np.uint32).sum()))
+            self._host_sum += chunk.sum(dtype=np.uint64)
 
     def finish(self) -> dict:
         # Slot buffers are released even when a drain failed (a failed

@@ -213,7 +213,7 @@ class PallasStager(GranuleAggregator):
         # Host-side sum BEFORE rotation: the slot still holds the payload
         # (the device_put may alias it; the drain gate protects reuse).
         self._host_sum = (
-            self._host_sum + int(flat[:n].astype(np.uint32).sum())
+            self._host_sum + int(flat[:n].sum(dtype=np.uint64))
         ) % (1 << 32)
         t0 = time.perf_counter_ns()
         staged = jax.device_put(slot, self.device)

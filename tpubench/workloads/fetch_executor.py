@@ -386,7 +386,7 @@ class _SlotPipeline:
             self.bufs[slot].array[nbytes:] = 0
         if self._validate:
             chunk = self.bufs[slot].array[:nbytes]
-            self._host_sum += int(chunk.astype(np.uint32).sum())
+            self._host_sum += int(chunk.sum(dtype=np.uint64))
         submit_ns = time.perf_counter_ns()
         fut = self._jax.device_put(arr, self.device)
         self.transfers += 1

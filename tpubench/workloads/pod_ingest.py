@@ -119,7 +119,7 @@ class PodIngestWorkload:
             if jax.process_count() == 1:
                 # Single controller: full equality + global checksum.
                 host_sum = sum(
-                    int(b.astype(np.uint32).sum()) for b in buffers
+                    int(b.sum(dtype=np.uint64)) for b in buffers
                 ) % (1 << 32)
                 ok = int(jax.device_get(csum)) % (1 << 32) == host_sum
                 got = gathered_to_bytes(gathered, size)

@@ -318,7 +318,7 @@ class StreamedPodIngest:
                     # host-vs-device comparison is blind to).
                     dev_sum = int(jax.device_get(csum))
                     object_checksums.append(dev_sum)
-                    host = sum(int(s.astype(np.uint32).sum()) for s in shards)
+                    host = sum(int(s.sum(dtype=np.uint64)) for s in shards)
                     checks_ok = checks_ok and dev_sum == host % (1 << 32)
                 self._progress = {
                     "objects_done": max(k + 1, prior_done),
