@@ -166,6 +166,40 @@ def test_report_ab_deltas(tmp_path):
     assert "p50 8.000 ms (-2.000)" in out
 
 
+def test_report_bench_files(tmp_path, capsys):
+    """`report` understands bench.py output lines and the driver's
+    BENCH_rN.json wrapper ({"parsed": {...}}) — the files a reviewer has
+    side by side with the run results."""
+    bench_line = {
+        "metric": "staged_ingest_bandwidth_per_chip", "value": 1.12,
+        "unit": "GB/s/chip", "vs_baseline": 0.18,
+        "vs_tunnel_ceiling": 0.98, "staging_efficiency": 0.98,
+        "shaped_verdict": True, "config": "sync_s8_w2",
+        "efficiency_by_mode": {"sync": {"best": 0.98, "median": 0.92}},
+        "fetch_only_ab": {"native_executor_gbps": 1.9,
+                          "python_fetch_gbps": 1.7, "source": "native_c_server"},
+        "samples": {"sync_s8_w2": [1.1, 1.12]},
+    }
+    raw = tmp_path / "bench.json"
+    raw.write_text(json.dumps(bench_line))
+    wrapped = tmp_path / "BENCH_r05.json"
+    wrapped.write_text(
+        json.dumps({"n": 5, "rc": 0, "tail": "…", "parsed": bench_line})
+    )
+    failed = tmp_path / "BENCH_r06.json"
+    failed.write_text(json.dumps({"n": 6, "rc": 1, "tail": "Traceback…"}))
+    rc = main(["report", str(raw), str(wrapped), str(failed)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("vs_tunnel_ceiling=0.98") == 2
+    assert "native 1.9 vs python 1.7" in out
+    assert "sync: best=0.98 median=0.92" in out
+    # A failed driver wrapper is reported as failed, never as a bogus
+    # zero-throughput run that would poison the A/B baseline.
+    assert "run failed or unparsed (rc=1)" in out
+    assert "0.000x" not in out
+
+
 def test_report_sweep_table_and_cli(tmp_path, capsys):
     rows = [
         {"protocol": "http", "size": "100M", "gbps": 1.0,

@@ -123,8 +123,44 @@ def sweep_table(rows: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def bench_block(d: dict, label: str = "") -> str:
+    """Summary of a bench.py output line (or a driver BENCH_rN.json's
+    ``parsed`` object): the headline with its honest comparables and the
+    per-config sample sets."""
+    lines = [
+        f"== bench {label} ==".rstrip(),
+        (
+            f"{d.get('metric', '?')}: {d.get('value', 0.0)} "
+            f"{d.get('unit', '')}  config={d.get('config', '?')}  "
+            f"shaped={d.get('shaped_verdict')}"
+        ),
+        (
+            f"  vs_baseline={d.get('vs_baseline')}  "
+            f"vs_tunnel_ceiling={d.get('vs_tunnel_ceiling')}  "
+            f"staging_efficiency={d.get('staging_efficiency')}"
+        ),
+    ]
+    ebm = d.get("efficiency_by_mode")
+    if ebm:
+        cells = "  ".join(
+            f"{mode}: best={v.get('best')}"
+            + (f" median={v['median']}" if v.get("median") is not None else "")
+            for mode, v in ebm.items()
+        )
+        lines.append(f"  efficiency_by_mode: {cells}")
+    ab = d.get("fetch_only_ab") or {}
+    if ab.get("native_executor_gbps") and ab.get("python_fetch_gbps"):
+        lines.append(
+            f"  fetch A/B: native {ab['native_executor_gbps']} vs "
+            f"python {ab['python_fetch_gbps']} GB/s ({ab.get('source', '')})"
+        )
+    for cfg, samples in (d.get("samples") or {}).items():
+        lines.append(f"  {cfg}: {samples}")
+    return "\n".join(lines)
+
+
 def run_report(paths: list[str]) -> str:
-    """Load result/sweep JSONs and render the full report."""
+    """Load result/sweep/bench JSONs and render the full report."""
     runs: list[dict] = []
     chunks: list[str] = []
     for p in paths:
@@ -132,6 +168,23 @@ def run_report(paths: list[str]) -> str:
             doc = json.load(f)
         if isinstance(doc, list):  # a sweep cells file
             chunks.append(sweep_table(doc))
+            continue
+        if "metric" in doc:  # a bench.py output line saved to a file
+            chunks.append(bench_block(doc, label=f"({p})"))
+            continue
+        if "rc" in doc and "tail" in doc:
+            # Driver BENCH_rN.json wrapper: summarize the parsed bench
+            # line when there is one; a failed run (no usable `parsed`)
+            # is reported as such — never fed to the A/B comparison as a
+            # bogus zero-throughput baseline.
+            if isinstance(doc.get("parsed"), dict) and "metric" in doc["parsed"]:
+                chunks.append(bench_block(doc["parsed"], label=f"({p})"))
+            else:
+                chunks.append(
+                    f"== bench ({p}) ==\n"
+                    f"  run failed or unparsed (rc={doc.get('rc')}); "
+                    "see its `tail` for the crash output"
+                )
             continue
         runs.append(doc)
         chunks.append(summarize_run(doc, label=f"{_axis(doc)} ({p})"))
