@@ -53,6 +53,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 from __future__ import annotations
 
 import json
+import os
 import statistics
 import sys
 import time
@@ -332,7 +333,8 @@ def main() -> int:
                 "staged": round(g_b, 3),
                 "mode": mode,
                 "breakdown": {
-                    k: round(v, 4) for k, v in bd.items() if k != "workers"
+                    k: (round(v, 4) if isinstance(v, float) else v)
+                    for k, v in bd.items() if k != "workers"
                 },
             }
         )
@@ -416,6 +418,7 @@ def main() -> int:
                 round(over_best, 4) if over_best is not None else None
             ),
             "overlap_put_submit_frac": over_put_frac,
+            "host_cores": os.cpu_count(),
             "pallas_best": (
                 round(pallas_best, 4) if pallas_best is not None else None
             ),

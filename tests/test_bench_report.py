@@ -96,6 +96,25 @@ def test_gap_breakdown_overlap_has_no_serial_model():
     assert g["efficiency"] == pytest.approx(1.4 / 1.5, abs=1e-4)
 
 
+def test_gap_breakdown_drain_thread_keeps_concurrent_time_separate():
+    """With drain='thread' the drainer's submit+transfer time runs
+    CONCURRENTLY with fetch: it must get its own field and never be
+    subtracted from the fetch thread's wall (fracs would sum past 1 and
+    misattribute fetch time)."""
+    g = br.gap_breakdown(
+        {
+            "tunnel": 1.5, "staged": 0.6, "mode": "overlap",
+            "breakdown": {"drain": "thread", "wall_s": 10.0,
+                          "transfer_wait_s": 1.0, "put_submit_s": 5.0},
+        },
+        host_fetch_gbps=6.9,
+    )
+    assert g["drainer_submit_frac"] == pytest.approx(0.5)
+    assert "put_submit_frac" not in g
+    # fetch-side remainder excludes ONLY the backpressure wait
+    assert g["fetch_and_overhead_frac"] == pytest.approx(0.9)
+
+
 # ------------------------------------------------------ probe divergence --
 
 
@@ -174,9 +193,18 @@ def test_note_probe_divergence_direction():
 def test_note_explains_overlap_loss_from_measured_put_frac():
     n = br.build_note(_fields(
         sync_best=0.81, overlap_best=0.28, overlap_put_submit_frac=0.62,
+        host_cores=1,
     ))
     assert "sync config wins" in n
-    assert "0.62" in n and "inside submission" in n
+    assert "0.62" in n and "share one core" in n
+    # The single-core causal claim is gated on the MEASURED core count:
+    # a multi-core host gets the measured-fields pointer instead.
+    n_mc = br.build_note(_fields(
+        sync_best=0.81, overlap_best=0.28, overlap_put_submit_frac=0.62,
+        host_cores=8,
+    ))
+    assert "share one core" not in n_mc
+    assert "host_cores=8" in n_mc
     # overlap winning: no loss explanation
     n2 = br.build_note(_fields(sync_best=0.7, overlap_best=0.9))
     assert "sync config wins" not in n2

@@ -201,3 +201,33 @@ def test_budgeted_slot_bytes_scales_with_workers():
     assert capped * 48 * 3 <= 1024 * MB
     cfg.workload.workers = 4096  # absurd fan-out: floor at one granule
     assert budgeted_slot_bytes(cfg) == 2 * MB
+
+
+def test_thread_drain_error_aborts_fetch_promptly(jax_cpu_devices, monkeypatch):
+    """A transfer failure in the drainer must abort the fetch at the next
+    acquire — not park the error until finish() while the fetch burns the
+    whole stream (the drainer frees failed slots, so without the acquire
+    check backpressure would never engage)."""
+    import pytest as _pytest
+
+    from tpubench.config import StagingConfig
+    from tpubench.staging import device as dev_mod
+
+    cfg = StagingConfig()
+    cfg.double_buffer = True
+    cfg.depth = 2
+    cfg.drain = "thread"
+    st = dev_mod.DevicePutStager(
+        0, granule_bytes=1024, cfg=cfg, slot_bytes=2048
+    )
+    assert st._drain_thread
+
+    def boom(*a, **k):
+        raise RuntimeError("device gone")
+
+    monkeypatch.setattr(dev_mod.jax, "device_put", boom)
+    data = memoryview(bytes(64 * 1024))  # many slots: must fail EARLY
+    with _pytest.raises(RuntimeError, match="device gone"):
+        st.submit(data)
+    with _pytest.raises(RuntimeError, match="device gone"):
+        st.finish()

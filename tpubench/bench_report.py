@@ -88,10 +88,20 @@ def gap_breakdown(pair: dict, host_fetch_gbps: float) -> dict:
         put = bd.get("put_submit_s", 0.0)
         out["wall_s"] = round(wall, 4)
         out["transfer_wait_frac"] = round(wait / wall, 4)
-        out["put_submit_frac"] = round(put / wall, 4)
-        out["fetch_and_overhead_frac"] = round(
-            max(0.0, wall - wait - put) / wall, 4
-        )
+        if bd.get("drain") == "thread":
+            # The DRAINER owns submission+completion: its time runs
+            # concurrently with fetch, so it gets its own name and is
+            # never subtracted from the fetch thread's wall (doing so
+            # would make the fractions sum past 1 and lie about fetch).
+            out["drainer_submit_frac"] = round(put / wall, 4)
+            out["fetch_and_overhead_frac"] = round(
+                max(0.0, wall - wait) / wall, 4
+            )
+        else:
+            out["put_submit_frac"] = round(put / wall, 4)
+            out["fetch_and_overhead_frac"] = round(
+                max(0.0, wall - wait - put) / wall, 4
+            )
     if pair.get("mode", "sync") == "sync":
         model = serial_model_gbps(host_fetch_gbps, pair.get("tunnel", 0.0))
         out["serial_model_gbps"] = round(model, 4)
@@ -200,14 +210,26 @@ def build_note(f: dict) -> str:
     ob, sb = f.get("overlap_best"), f.get("sync_best")
     if ob is not None and sb is not None and ob < sb:
         frac = f.get("overlap_put_submit_frac")
-        why = (
-            f" — measured put_submit_frac {frac} in the overlap pairs: "
-            "device_put completes its transfer inside submission on this "
-            "runtime, so a drain thread has nothing to overlap and only "
-            "adds handoff cost"
-            if frac is not None
-            else ""
-        )
+        cores = f.get("host_cores")
+        why = ""
+        if frac is not None:
+            why = (
+                f" — the drain thread owns submission AND completion "
+                f"(drainer submit frac {frac}), so the loss is not "
+                "fetch-thread serialization"
+            )
+            if cores == 1:
+                # Causal claim gated on the MEASURED core count.
+                why += (
+                    "; with host_cores=1 the CPU-mediated transfer and "
+                    "the fetch share one core, and pipelining adds "
+                    "thread-handoff cost instead of hiding transfer time"
+                )
+            else:
+                why += (
+                    f"; host_cores={cores} — see gap_breakdown for where "
+                    "the overlap pairs' wall went"
+                )
         parts.append(
             f"overlap (drain-thread) best pair {ob} vs sync best {sb}: "
             f"the depth-1 sync config wins on this host{why}."

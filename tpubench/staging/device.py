@@ -180,11 +180,14 @@ class DevicePutStager(GranuleAggregator):
         self.staged_bytes = 0
         self.transfers = 0
         # Phase accounting for the pipeline-gap breakdown (round-5 task
-        # #1): time the FETCH thread spends blocked on transfers
-        # (backpressure + inline drains) and inside device_put submission.
-        # wall − transfer_wait − put_submit ≈ fetch+overhead time; for the
-        # depth-1 sync config the serial model staged = 1/(1/fetch_rate +
-        # 1/transfer_rate) falls straight out of these numbers.
+        # #1). transfer_wait_ns is always FETCH-THREAD time blocked on
+        # transfers (backpressure waits + inline drains). put_submit_ns
+        # semantics depend on the drain mode: inline → fetch-thread time
+        # inside device_put (wall − wait − submit ≈ fetch+overhead, and
+        # the depth-1 serial model falls out); thread → DRAINER-thread
+        # time in submit+start, CONCURRENT with fetch (never subtract it
+        # from the fetch thread's wall — gap_breakdown branches on the
+        # reported drain mode).
         self.transfer_wait_ns = 0
         self.put_submit_ns = 0
         self.stage_recorder = LatencyRecorder(f"w{worker_id}/stage")
@@ -218,20 +221,35 @@ class DevicePutStager(GranuleAggregator):
 
     # ------------------------------------------------------------ pipeline --
     def _drain_loop(self) -> None:
-        """Drainer thread: completes transfers in submission order. All
-        mutation of staged_bytes/transfers accounting it does is read by
-        the fetch thread only after :meth:`finish` joins this thread."""
+        """Drainer thread: SUBMITS and completes transfers in launch
+        order. Submission lives here, not in ``_launch``, because on some
+        runtimes (measured: the tunneled axon backend) ``device_put``
+        performs the whole transfer inside the submission call — a
+        fetch-thread submit would serialize fetch and transfer exactly
+        like the depth-1 ring and the "overlap" label would buy nothing.
+        Both sides release the GIL in their hot paths (numpy/socket copies
+        here, PJRT transfer there), so fetch ∥ transfer is real. All
+        accounting this thread mutates is read by the fetch thread only
+        after :meth:`finish` joins it."""
         assert self._drain_q is not None
         while True:
             item = self._drain_q.get()
             if item is None:
                 return
-            k, fut, submit_ns, nbytes = item
+            k, nbytes, enqueue_ns = item
             try:
+                submit_ns = time.perf_counter_ns()
+                fut = jax.device_put(self._slots[k], self.device)
+                self.put_submit_ns += time.perf_counter_ns() - submit_ns
                 fut.block_until_ready()
-                self.stage_recorder.record_ns(time.perf_counter_ns() - submit_ns)
+                # Stage latency from ENQUEUE, not dequeue: with overlap
+                # the queueing behind earlier slots is part of the
+                # quantity that sizes the pipeline (module docstring).
+                self.stage_recorder.record_ns(
+                    time.perf_counter_ns() - enqueue_ns
+                )
                 self.staged_bytes += nbytes
-            except BaseException as e:  # surfaced from finish()
+            except BaseException as e:  # re-raised at the next acquire
                 if self._drain_err is None:
                     self._drain_err = e
             finally:
@@ -266,14 +284,17 @@ class DevicePutStager(GranuleAggregator):
             # the tail so checksum/pad semantics stay exact. Full slots —
             # the steady state — skip this memset.
             slot.reshape(-1)[self._fill :] = 0
-        submit_ns = time.perf_counter_ns()
-        fut = jax.device_put(slot, self.device)
-        self.put_submit_ns += time.perf_counter_ns() - submit_ns
         self.transfers += 1
         if self._drain_thread:
+            # Hand the FILLED slot to the drainer, which submits AND
+            # completes the transfer (see _drain_loop): the fetch thread
+            # pays neither, only the slot_free backpressure wait.
             self._slot_free[k].clear()
-            self._drain_q.put((k, fut, submit_ns, self._fill))
+            self._drain_q.put((k, self._fill, time.perf_counter_ns()))
         else:
+            submit_ns = time.perf_counter_ns()
+            fut = jax.device_put(slot, self.device)
+            self.put_submit_ns += time.perf_counter_ns() - submit_ns
             self._submit_ns[k] = submit_ns
             self._futures[k] = fut
             self._true_bytes[k] = self._fill
@@ -294,6 +315,12 @@ class DevicePutStager(GranuleAggregator):
                 t0 = time.perf_counter_ns()
                 self._slot_free[k].wait()
                 self.transfer_wait_ns += time.perf_counter_ns() - t0
+            if self._drain_err is not None:
+                # A failed transfer must abort the fetch NOW: the drainer
+                # frees slots on failure (no deadlock), so without this
+                # check backpressure never engages and a dead device
+                # would let the fetch burn the whole measurement window.
+                raise self._drain_err
         else:
             self._drain_slot(k)
         return self._slot_views[k][self._fill :]

@@ -200,6 +200,9 @@ class ReadWorkload:
                 k = len(live)
                 res.extra["staging_breakdown"] = {
                     "workers": k,
+                    # put_submit semantics differ by drain mode (drainer
+                    # time is CONCURRENT with fetch) — consumers branch.
+                    "drain": live[0].get("drain", "inline"),
                     "wall_s": wall,
                     "transfer_wait_s": sum(
                         st["transfer_wait_ns"] for st in live
