@@ -418,7 +418,7 @@ def main() -> int:
                 round(over_best, 4) if over_best is not None else None
             ),
             "overlap_put_submit_frac": over_put_frac,
-            "host_cores": os.cpu_count(),
+            "host_cores": len(os.sched_getaffinity(0)),
             "pallas_best": (
                 round(pallas_best, 4) if pallas_best is not None else None
             ),

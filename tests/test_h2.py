@@ -244,6 +244,52 @@ def test_backend_http2_tls_alpn():
         c.close()
 
 
+def test_backend_http2_tls_metadata():
+    """stat/list ride h2 over TLS too (the whole-client branch is not
+    plaintext-only)."""
+    from tpubench.config import TransportConfig
+    from tpubench.native.engine import get_engine
+    from tpubench.storage.gcs_http import GcsHttpBackend
+
+    if not get_engine().tls_available():
+        pytest.skip("OpenSSL unavailable")
+    be = FakeBackend.prepopulated("bench/file_", count=2, size=70_000)
+    with FakeH2Server(be, tls=True) as srv:
+        t = TransportConfig(
+            endpoint=srv.endpoint, http2=True, tls_ca_file=srv.cafile
+        )
+        c = GcsHttpBackend(bucket="b", transport=t)
+        assert c.stat("bench/file_1").size == 70_000
+        assert len(c.list("bench/")) == 2
+        assert c._pool.stats["connects"] == 0  # h1.1 pool never touched
+        c.close()
+
+
+def test_backend_http2_metadata_with_interim_1xx():
+    """Informational 103 blocks precede EVERY response under the fault
+    knob — metadata GETs included: the h2 client must treat them as
+    transparent on the stat/list path too."""
+    be = FakeBackend.prepopulated("bench/file_", count=2, size=60_000)
+    with FakeH2Server(be, send_interim_1xx=True) as srv:
+        c = _h2_client(srv)
+        assert c.stat("bench/file_0").size == 60_000
+        assert {m.name for m in c.list("bench/")} == {
+            "bench/file_0", "bench/file_1"
+        }
+        r = c.open_read("bench/file_1", length=60_000)
+        out = memoryview(bytearray(60_000))
+        got = 0
+        while got < 60_000:
+            n = r.readinto(out[got:])
+            assert n > 0
+            got += n
+        assert bytes(out) == deterministic_bytes(
+            "bench/file_1", 60_000
+        ).tobytes()
+        r.close()
+        c.close()
+
+
 def test_backend_http2_fault_injected_503_transient(h2srv):
     from tpubench.storage.fake import FaultPlan
 

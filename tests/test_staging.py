@@ -208,8 +208,6 @@ def test_thread_drain_error_aborts_fetch_promptly(jax_cpu_devices, monkeypatch):
     acquire — not park the error until finish() while the fetch burns the
     whole stream (the drainer frees failed slots, so without the acquire
     check backpressure would never engage)."""
-    import pytest as _pytest
-
     from tpubench.config import StagingConfig
     from tpubench.staging import device as dev_mod
 
@@ -227,7 +225,7 @@ def test_thread_drain_error_aborts_fetch_promptly(jax_cpu_devices, monkeypatch):
 
     monkeypatch.setattr(dev_mod.jax, "device_put", boom)
     data = memoryview(bytes(64 * 1024))  # many slots: must fail EARLY
-    with _pytest.raises(RuntimeError, match="device gone"):
+    with pytest.raises(RuntimeError, match="device gone"):
         st.submit(data)
-    with _pytest.raises(RuntimeError, match="device gone"):
+    with pytest.raises(RuntimeError, match="device gone"):
         st.finish()
