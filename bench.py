@@ -62,6 +62,24 @@ from tpubench.config import MB  # jax-free module, safe at import time
 
 from tpubench import bench_report as br
 
+# Refill sleeps scale for hermetic testing (TPUBENCH_BENCH_SLEEP_SCALE=0
+# lets a CPU smoke test drive the WHOLE protocol in seconds): the real
+# runs keep the full refill pauses. Empty string counts as unset.
+_SLEEP_SCALE = float(os.environ.get("TPUBENCH_BENCH_SLEEP_SCALE") or 1)
+
+
+def _sleep(seconds: float) -> None:
+    if _SLEEP_SCALE > 0:
+        time.sleep(seconds * _SLEEP_SCALE)
+
+
+def _usable_cores() -> int:
+    """Cores this PROCESS may use (affinity/cgroup-aware where the OS
+    exposes it) — the number the single-core causal claims gate on."""
+    if hasattr(os, "sched_getaffinity"):
+        return len(os.sched_getaffinity(0))
+    return os.cpu_count() or 1
+
 
 def _cfg(total_mb: int, workers: int, slot_mb: int, sync: bool = True):
     from tpubench.config import BenchConfig
@@ -181,6 +199,13 @@ def main() -> int:
 
     import jax
 
+    # Honor JAX_PLATFORMS even when a device plugin rewrites it at import:
+    # the hermetic smoke test sets JAX_PLATFORMS=cpu and must NOT silently
+    # run against the real chip.
+    from tpubench.config import pin_jax_platform
+
+    pin_jax_platform()
+
     from tpubench.config import BenchConfig
     from tpubench.storage.base import deterministic_bytes
     from tpubench.workloads.probe import run_probe
@@ -239,7 +264,7 @@ def main() -> int:
 
     # Let the tunnel's byte budget recover from whatever ran before the
     # bench (test suites, compiles): the budget refills over minutes.
-    time.sleep(30)
+    _sleep(30)
 
     # Ramp past the post-idle slow start and initialize the transfer path
     # — kept small: warmup bytes come out of window A's budget.
@@ -274,7 +299,7 @@ def main() -> int:
     if max(staged["sync_s8_w2"]) < 0.5:
         t_check = _tunnel_run(16, 16)
         if t_check > 2 * max(staged["sync_s8_w2"]):
-            time.sleep(45)
+            _sleep(45)
             _ramp()
             staged["sync_s8_w2"].append(_staged_run(best_cfg)[0])
         tunnel.append(t_check)
@@ -284,7 +309,7 @@ def main() -> int:
     # in the r5 dry run it ran last, after five pair windows had
     # drained the budget, and measured only the floor.
     if exec_srv is not None:
-        time.sleep(45)
+        _sleep(45)
         _ramp()
         try:
             for _ in range(3):
@@ -308,7 +333,7 @@ def main() -> int:
         "pallas": "pallas_s8_w2",
     }
     for mode in ("sync", "overlap", "sync", "overlap", "pallas"):
-        time.sleep(45)
+        _sleep(45)
         _ramp()
         # Small samples: the pair must fit the granted window together —
         # a big tunnel sample drains the budget the staged half then pays.
@@ -341,10 +366,10 @@ def main() -> int:
 
     # ---- Phase 2: floor documentation — identical spaced cycles.
     for _ in range(2):
-        time.sleep(2.0)
+        _sleep(2.0)
         _ramp()
         staged["sync_s8_w2"].append(_staged_run(best_cfg)[0])
-        time.sleep(2.0)
+        _sleep(2.0)
         _ramp()
         tunnel.append(_tunnel_run(48, 16))
         host.append(_host_ram_run(96, 2))
@@ -418,7 +443,7 @@ def main() -> int:
                 round(over_best, 4) if over_best is not None else None
             ),
             "overlap_put_submit_frac": over_put_frac,
-            "host_cores": len(os.sched_getaffinity(0)),
+            "host_cores": _usable_cores(),
             "pallas_best": (
                 round(pallas_best, 4) if pallas_best is not None else None
             ),
@@ -472,6 +497,7 @@ def main() -> int:
                 "fetch_only_ab": fetch_ab,
                 "shaped_verdict": shaped,
                 "probe_divergence_factor": pdf,
+                "host_cores": _usable_cores(),
                 "probe": {
                     "shaped": probe.get("shaped"),
                     "peak_gbps": probe.get("peak_gbps"),

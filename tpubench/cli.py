@@ -507,14 +507,12 @@ def main(argv=None) -> int:
 
     def pin_platform() -> None:
         # Honor JAX_PLATFORMS even when a device plugin rewrites it at
-        # import (this image's TPU plugin does): the config knob wins over
-        # the plugin, so JAX_PLATFORMS=cpu + forced host device count
-        # reliably yields the simulated mesh the README documents. Called
+        # import (this image's TPU plugin does) — shared discipline in
+        # config.pin_jax_platform (bench.py uses the same one). Called
         # only on jax-using paths — save-config/prepare stay jax-free.
-        if os.environ.get("JAX_PLATFORMS"):
-            import jax
+        from tpubench.config import pin_jax_platform
 
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        pin_jax_platform()
 
     if args.save_config:
         with open(args.save_config, "w") as f:

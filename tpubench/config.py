@@ -15,8 +15,26 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 from dataclasses import dataclass, field
 from typing import Any
+
+# Captured at import of this (jax-free, imported-early) module: the TPU
+# plugin in some images REWRITES the env var during `import jax`, so the
+# value must be read before any jax import to honor the user's intent.
+_JAX_PLATFORMS_AT_IMPORT = os.environ.get("JAX_PLATFORMS", "")
+
+
+def pin_jax_platform() -> None:
+    """Make JAX_PLATFORMS win over a device plugin that rewrites it at
+    import (the one pin discipline, shared by cli.py and bench.py): env
+    var captured before jax import, applied via jax.config so the knob
+    reliably yields e.g. the simulated CPU mesh the README documents.
+    No-op when the env var was unset."""
+    if _JAX_PLATFORMS_AT_IMPORT:
+        import jax
+
+        jax.config.update("jax_platforms", _JAX_PLATFORMS_AT_IMPORT)
 
 KB = 1024
 MB = 1024 * 1024
