@@ -2466,11 +2466,11 @@ struct server {
   int64_t body_len;
   char* meta_json;
   pthread_t accept_thread;
-  volatile int stop;
+  int stop;  // cross-thread: access ONLY via __atomic builtins
   pthread_mutex_t mu;
   int conn_fds[256];  // live connection fds, for shutdown on stop
   int n_conns;
-  volatile int active;  // live connection-thread count
+  int active;  // live connection-thread count (atomic access only)
 };
 
 struct srv_conn_arg {
@@ -2514,7 +2514,7 @@ static void* srv_conn_main(void* argp) {
   free(a);
   char req[8192];
   size_t have = 0;
-  while (!s->stop) {
+  while (!__atomic_load_n(&s->stop, __ATOMIC_ACQUIRE)) {
     // Accumulate one request head (these clients send no bodies).
     char* end = nullptr;
     while (!(end = static_cast<char*>(
@@ -2589,7 +2589,7 @@ done:
 
 static void* srv_accept_main(void* argp) {
   server* s = static_cast<server*>(argp);
-  while (!s->stop) {
+  while (!__atomic_load_n(&s->stop, __ATOMIC_ACQUIRE)) {
     int fd = accept(s->listen_fd, nullptr, nullptr);
     if (fd < 0) {
       if (errno == EINTR) continue;
@@ -2678,16 +2678,19 @@ void* tb_srv_start(const void* body, int64_t body_len, const char* meta_json,
 int tb_srv_stop(void* handle) {
   if (!handle) return 0;
   srv::server* s = static_cast<srv::server*>(handle);
-  s->stop = 1;
+  __atomic_store_n(&s->stop, 1, __ATOMIC_RELEASE);
   shutdown(s->listen_fd, SHUT_RDWR);
   close(s->listen_fd);
   pthread_join(s->accept_thread, nullptr);
   pthread_mutex_lock(&s->mu);
   for (int i = 0; i < s->n_conns; i++) shutdown(s->conn_fds[i], SHUT_RDWR);
   pthread_mutex_unlock(&s->mu);
-  for (int spins = 0; s->active > 0 && spins < 2000; spins++)
+  for (int spins = 0;
+       __atomic_load_n(&s->active, __ATOMIC_ACQUIRE) > 0 && spins < 2000;
+       spins++)
     usleep(1000);  // connection threads close their own fds
-  if (s->active > 0) return 1;  // leak: never free under a live thread
+  if (__atomic_load_n(&s->active, __ATOMIC_ACQUIRE) > 0)
+    return 1;  // leak: never free under a live thread
   free(s->meta_json);
   pthread_mutex_destroy(&s->mu);
   free(s);

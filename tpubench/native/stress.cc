@@ -48,6 +48,9 @@ int tb_pool_submit(int64_t h, const char* host, int port, const char* path,
 int tb_pool_next(int64_t h, int timeout_ms, uint64_t* tag, int64_t* result,
                  int* status, int64_t* fb, int64_t* total, int64_t* start);
 int tb_pool_destroy(int64_t h);
+void* tb_srv_start(const void* body, int64_t body_len, const char* meta_json,
+                   int* port_out);
+int tb_srv_stop(void* handle);
 }
 
 // Minimal single-purpose HTTP server for the pool stress: keep-alive —
@@ -193,6 +196,94 @@ static int stress_fetch_pool() {
   return bad ? 10 : 0;
 }
 
+// C loopback server + discard-mode stress: the fetch pool's 4 workers
+// hammer tb_srv_* with a mix of ranged media GETs (landed + content-
+// checked), discard tasks (NULL buffer → per-thread scratch), and
+// metadata GETs — both new concurrency surfaces (server conn threads,
+// worker discard scratch) race under TSAN, and the stop protocol's
+// tracked-connection shutdown runs at the end.
+static int stress_srv_and_discard() {
+  const int64_t kBody = 1 << 20;
+  uint8_t* body = static_cast<uint8_t*>(tb_alloc_aligned(kBody, 4096));
+  if (!body) return 1;
+  tb_fill_random(body, kBody, 77);
+  int port = 0;
+  void* srv = tb_srv_start(body, kBody, "{\"size\": \"1048576\"}", &port);
+  if (!srv) {
+    tb_free_aligned(body);
+    return 2;
+  }
+  const int kTasks = 48;
+  int64_t pool = tb_pool_create(4, 64, 0, "", 0);
+  if (!pool) {
+    tb_srv_stop(srv);
+    tb_free_aligned(body);
+    return 3;
+  }
+  std::vector<void*> bufs(kTasks, nullptr);
+  std::vector<int> starts(kTasks, 0);
+  const char* media = "/storage/v1/b/b/o/x?alt=media";
+  int bad = 0;
+  int submitted_ok = 0;  // drain exactly what actually enqueued
+  for (int i = 0; i < kTasks; i++) {
+    int rc;
+    if (i % 3 == 0) {  // discard full-media (NULL buffer)
+      rc = tb_pool_submit(pool, "127.0.0.1", port, media, "", nullptr, 0, i);
+    } else if (i % 3 == 1) {  // ranged media, landed + verified below
+      bufs[i] = tb_alloc_aligned(65536, 4096);
+      if (!bufs[i]) {  // NULL means DISCARD to the pool: never submit it
+        bad++;
+        continue;
+      }
+      starts[i] = (i * 4096) % (1 << 19);
+      char hdrs[64];
+      snprintf(hdrs, sizeof hdrs, "Range: bytes=%d-%d\r\n", starts[i],
+               starts[i] + 65535);
+      rc = tb_pool_submit(pool, "127.0.0.1", port, media, hdrs, bufs[i],
+                          65536, i);
+    } else {  // metadata JSON
+      bufs[i] = tb_alloc_aligned(4096, 4096);
+      if (!bufs[i]) {
+        bad++;
+        continue;
+      }
+      rc = tb_pool_submit(pool, "127.0.0.1", port, "/storage/v1/b/b/o/x", "",
+                          bufs[i], 4096, i);
+    }
+    if (rc)
+      bad++;
+    else
+      submitted_ok++;
+  }
+  for (int n = 0; n < submitted_ok; n++) {
+    uint64_t tag;
+    int64_t result, fb, total, start;
+    int status;
+    int rc = tb_pool_next(pool, 30000, &tag, &result, &status, &fb, &total,
+                          &start);
+    if (rc != 1) {
+      bad++;
+      break;
+    }
+    int i = static_cast<int>(tag);
+    if (i % 3 == 0) {
+      if (result != kBody || status != 200) bad++;
+    } else if (i % 3 == 1) {
+      if (result != 65536 || status != 206 ||
+          memcmp(bufs[i], body + starts[i], 65536) != 0)
+        bad++;
+    } else {
+      if (result <= 0 || status != 200) bad++;
+    }
+  }
+  tb_pool_destroy(pool);
+  int leaked = tb_srv_stop(srv);
+  for (auto b : bufs)
+    if (b) tb_free_aligned(b);
+  if (!leaked) tb_free_aligned(body);  // leak contract: keep body pinned
+  return bad ? 20 : 0;
+}
+
 int main(int argc, char** argv) {
   if (argc < 2) {
     std::fprintf(stderr, "usage: %s <scratch-dir>\n", argv[0]);
@@ -247,6 +338,8 @@ int main(int argc, char** argv) {
   }
   int prc = stress_fetch_pool();
   if (prc) { std::fprintf(stderr, "fetch-pool stress failed rc=%d\n", prc); return 1; }
+  int src = stress_srv_and_discard();
+  if (src) { std::fprintf(stderr, "srv/discard stress failed rc=%d\n", src); return 1; }
   std::puts("stress ok");
   return 0;
 }
