@@ -720,9 +720,27 @@ class NativeEngine:
         """Open one plain HTTP/2 GET stream (the reference's HTTP/2 client
         branch, main.go:76-80): DATA payload bytes land in ``buf``
         verbatim; the completion's ``http_status`` carries :status."""
+        self.h2_submit_get_to(
+            handle, authority, path, buf.address, buf.size,
+            headers=headers, tag=tag,
+        )
+
+    def h2_submit_get_to(
+        self,
+        handle: int,
+        authority: str,
+        path: str,
+        address: int,
+        nbytes: int,
+        headers: str = "",
+        tag: int = 0,
+    ) -> None:
+        """Raw-destination variant of :meth:`h2_submit_get`: DATA bytes
+        land at (address, nbytes) — e.g. a numpy shard buffer — which must
+        stay valid until the stream's completion comes back."""
         rc = self.lib.tb_h2_submit_get(
             handle, authority.encode(), path.encode(), headers.encode(),
-            buf.address, buf.size, tag,
+            address, nbytes, tag,
         )
         if rc != 0:
             _check(int(rc), f"h2_submit_get {path}")

@@ -473,7 +473,7 @@ class GcsGrpcBackend:
         """
         import numpy as np
 
-        from tpubench.native.engine import PERMANENT_CODES, NativeError
+        from tpubench.native.engine import PERMANENT_CODES
 
         n = len(ranges)
         done: list[bool] = [False] * n
@@ -542,98 +542,48 @@ class GcsGrpcBackend:
                 )
             return None
 
-        def fail_all(err: StorageError) -> list:
-            for i in range(n):
-                if not done[i]:
-                    errs[i] = err
-                    done[i] = True
-            return errs
+        # Setup failures classify onto every range (contract: this method
+        # reports per-range outcomes, it doesn't throw for conditions the
+        # threaded path would record as holes — and the caller's gax loop
+        # can then heal transient ones, e.g. a token refresh hiccup).
+        from tpubench.storage.native_pool import (
+            fail_unfinished,
+            run_multiplexed_batch,
+        )
 
-        window = 16  # submit waves below the 32-stream connection cap
-        # Setup + connect failures classify onto every range (contract:
-        # this method reports per-range outcomes, it doesn't throw for
-        # conditions the threaded path would record as holes — and the
-        # caller's gax loop can then heal transient ones, e.g. a token
-        # refresh hiccup).
         try:
             pool = self._native_pool()  # raises when engine unavailable
             engine = pool.engine
             host, port, _ = self._native_endpoint()
             authority = f"{host}:{port}"
             metadata = self._native_auth_headers()
-            conn, reused = pool.acquire()
         except StorageError as e:
-            return fail_all(e)
+            return fail_unfinished(done, errs, e)
         except Exception as e:  # noqa: BLE001 — e.g. auth library errors
-            return fail_all(
-                StorageError(f"read_ranges setup: {e}", transient=True)
+            return fail_unfinished(
+                done, errs,
+                StorageError(f"read_ranges setup: {e}", transient=True),
             )
+
+        def submit(conn: int, i: int) -> None:
+            start, length = ranges[i]
+            engine.grpc_submit_to(
+                conn, authority, self._bucket_path, name,
+                addrs[i], length,
+                read_offset=start, read_limit=length,
+                headers=metadata, tag=i,
+            )
+
         with self._tracer.span(
             "gcs_grpc.read_ranges", object=name, bucket=self.bucket,
             ranges=n,
         ):
-            while True:
-                submitted = 0
-                completed = 0
-                got_any = False
-                pending = [i for i in range(n) if not done[i]]
-                try:
-                    while completed < len(pending):
-                        while (
-                            submitted < len(pending)
-                            and submitted - completed < window
-                        ):
-                            i = pending[submitted]
-                            start, length = ranges[i]
-                            engine.grpc_submit_to(
-                                conn, authority, self._bucket_path, name,
-                                addrs[i], length,
-                                read_offset=start, read_limit=length,
-                                headers=metadata, tag=i,
-                            )
-                            submitted += 1
-                        c = engine.h2_poll(conn)
-                        if c is None:
-                            raise StorageError(
-                                f"read_ranges {name}: stream vanished",
-                                transient=True,
-                            )
-                        got_any = True
-                        i = c["tag"]
-                        errs[i] = classify(i, c)
-                        done[i] = True
-                        completed += 1
-                    pool.release(conn, True)
-                    return errs
-                except NativeError as e:
-                    pool.discard(conn)
-                    stale = (
-                        reused
-                        and not got_any
-                        and e.code not in PERMANENT_CODES
-                        and getattr(e, "grpc_status", -1) < 0
-                    )
-                    if stale:
-                        # Whole-batch retransmit on a fresh connection.
-                        reused = False
-                        pool.note_stale_retry()
-                        try:
-                            conn = pool.fresh()
-                        except StorageError as e2:
-                            return fail_all(e2)
-                        continue
-                    return fail_all(
-                        StorageError(
-                            f"read_ranges {name}: {e}",
-                            transient=e.code not in PERMANENT_CODES,
-                        )
-                    )
-                except StorageError as e:
-                    pool.discard(conn)
-                    return fail_all(e)
-                except BaseException:
-                    pool.discard(conn)
-                    raise
+            return run_multiplexed_batch(
+                pool, n, done, errs, submit, classify, name,
+                # An explicit grpc-status proves the server answered —
+                # never retried as pool staleness.
+                answered=lambda e: getattr(e, "grpc_status", -1) >= 0,
+            )
 
     # ----------------------------------------------------------- backend --
     def open_read(self, name: str, start: int = 0, length: Optional[int] = None):

@@ -629,6 +629,124 @@ class GcsHttpBackend:
             buf, r["result"], r["first_byte_ns"], release=pool.buffers.release
         )
 
+    def read_ranges(self, name: str, ranges, buffers) -> list:
+        """Concurrent ranged GETs multiplexed on ONE native h2 connection
+        (up to 32 streams — the h2 twin of ``GcsGrpcBackend.read_ranges``,
+        same per-range contract): range *i* (``(start, length)``) lands in
+        ``buffers[i]``; returns per-range ``None`` or a classified
+        :class:`StorageError`. Per-stream failures touch only their range;
+        connection-fatal failures classify onto every unfinished range;
+        one whole-batch retransmit when the first use of a pooled
+        connection fails before any completion. Requires
+        ``transport.http2`` (the reference's whole-client h2 branch is
+        where multiplexing exists, main.go:76-80)."""
+        import numpy as np
+
+        from tpubench.native.engine import PERMANENT_CODES
+
+        if not self.transport.http2:
+            raise ValueError("read_ranges requires transport.http2")
+        n = len(ranges)
+        done: list[bool] = [False] * n
+        errs: list = [None] * n
+        addrs: list[int] = []
+        for i, ((start, length), b) in enumerate(zip(ranges, buffers)):
+            arr = b if isinstance(b, np.ndarray) else np.frombuffer(b, np.uint8)
+            if not (arr.flags.writeable and arr.flags.c_contiguous):
+                raise ValueError(
+                    f"range {i}: buffer must be writable and C-contiguous"
+                )
+            if arr.nbytes < length:
+                raise ValueError(
+                    f"range {i}: buffer {arr.nbytes} < length {length}"
+                )
+            addrs.append(arr.ctypes.data)
+            if length == 0:
+                done[i] = True
+        if all(done):
+            return errs
+
+        def classify(i: int, c: dict):
+            length = ranges[i][1]
+            status = c["http_status"]
+            if c["result"] < 0:
+                return StorageError(
+                    f"h2 GET {name} range {i}: stream error {c['result']} "
+                    f"(status {status})",
+                    transient=c["result"] not in PERMANENT_CODES,
+                )
+            if status not in (200, 206):
+                return StorageError(
+                    f"h2 GET {name} range {i}: HTTP {status}",
+                    transient=status in _TRANSIENT,
+                    code=status,
+                )
+            if status == 200 and ranges[i][0] > 0:
+                # Server ignored the Range: bytes would be misaligned.
+                return StorageError(
+                    f"h2 GET {name} range {i}: server ignored Range",
+                    transient=False,
+                )
+            if c["result"] != length:
+                # Same EOF-clamp discipline as the gRPC twin: a short
+                # delivery ending at the known object size reproduces on
+                # every retry — permanent; stat inline on a cache miss.
+                start = ranges[i][0]
+                with self._h2_pool_lock:
+                    size = self._h2_stat_cache.get(name)
+                if size is None:
+                    try:
+                        size = self.stat(name).size
+                        with self._h2_pool_lock:
+                            self._h2_stat_cache[name] = size
+                    except StorageError:
+                        size = None
+                at_eof = size is not None and start + c["result"] >= size
+                return StorageError(
+                    f"h2 GET {name} range {i}: short stream "
+                    f"({c['result']} of {length} bytes)"
+                    + (" at EOF" if at_eof else ""),
+                    transient=not at_eof,
+                )
+            return None
+
+        from tpubench.storage.native_pool import (
+            fail_unfinished,
+            run_multiplexed_batch,
+        )
+
+        try:
+            pool = self._h2_pool()
+            engine = pool.engine
+            _, _, req_path, base_headers = self.native_request_parts(name)
+            authority = f"{self._host}:{self._port}"
+        except StorageError as e:
+            return fail_unfinished(done, errs, e)
+        except Exception as e:  # noqa: BLE001 — e.g. auth library errors
+            return fail_unfinished(
+                done, errs,
+                StorageError(f"read_ranges setup: {e}", transient=True),
+            )
+
+        def submit(conn: int, i: int) -> None:
+            start, length = ranges[i]
+            hdrs = (
+                base_headers
+                + f"Range: bytes={start}-{start + length - 1}\r\n"
+            )
+            engine.h2_submit_get_to(
+                conn, authority, req_path, addrs[i], length,
+                headers=hdrs, tag=i,
+            )
+
+        with self._tracer.span(
+            "gcs_http.read_ranges_h2", object=name, bucket=self.bucket,
+            ranges=n,
+        ):
+            return run_multiplexed_batch(
+                pool, n, done, errs, submit, classify, name
+            )
+
     def open_read(self, name: str, start: int = 0, length: Optional[int] = None):
         if self.transport.http2:
             return self._open_read_h2(name, start, length)

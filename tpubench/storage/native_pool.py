@@ -229,3 +229,112 @@ class NativeConnPool:
         for h in conns:
             self.engine.conn_close(h)
         self.buffers.close()
+
+
+def fail_unfinished(done: list, errs: list, err: StorageError) -> list:
+    """Classify ``err`` onto every unfinished range (the batch readers'
+    per-range contract: report, don't throw). Shared by the batch loop's
+    fail_all and the backends' setup-failure paths."""
+    for i in range(len(done)):
+        if not done[i]:
+            errs[i] = err
+            done[i] = True
+    return errs
+
+
+def run_multiplexed_batch(
+    pool: "NativeConnPool",
+    n: int,
+    done: list,
+    errs: list,
+    submit: Callable[[int, int], None],
+    classify: Callable[[int, dict], object],
+    name: str,
+    window: int = 16,
+    answered: Callable[[NativeError], bool] = lambda e: False,
+) -> list:
+    """The multiplexed-stream batch loop + stale-retransmit machine, written
+    ONCE for both h2-stream batch readers (gRPC ReadObject streams and
+    whole-client-http2 ranged GETs — they diverged the moment there were
+    two copies; the gRPC twin's answered-guard was structurally missing
+    from the http one).
+
+    ``submit(conn, i)`` opens range *i*'s stream on ``conn``;
+    ``classify(i, completion)`` maps a completion to ``None`` or a
+    classified StorageError; ``answered(e)`` returns True when a
+    connection-fatal error PROVES the server answered (e.g. an explicit
+    grpc-status) — those must never be retried as pool staleness. Submit
+    runs in ``window``-sized waves below the 32-stream connection cap; one
+    whole-batch retransmit on a fresh connection when the FIRST use of a
+    pooled handle fails before any completion. Fills ``errs`` in place and
+    returns it; setup/connect failures classify onto every unfinished
+    range (the caller's per-range contract: report, don't throw).
+    """
+
+    def fail_all(err: StorageError) -> list:
+        return fail_unfinished(done, errs, err)
+
+    try:
+        conn, reused = pool.acquire()
+    except StorageError as e:
+        return fail_all(e)
+    except Exception as e:  # noqa: BLE001 — e.g. auth library errors
+        return fail_all(
+            StorageError(f"read_ranges setup: {e}", transient=True)
+        )
+    engine = pool.engine
+    while True:
+        submitted = 0
+        completed = 0
+        got_any = False
+        pending = [i for i in range(n) if not done[i]]
+        try:
+            while completed < len(pending):
+                while (
+                    submitted < len(pending)
+                    and submitted - completed < window
+                ):
+                    submit(conn, pending[submitted])
+                    submitted += 1
+                c = engine.h2_poll(conn)
+                if c is None:
+                    raise StorageError(
+                        f"read_ranges {name}: stream vanished",
+                        transient=True,
+                    )
+                got_any = True
+                i = c["tag"]
+                errs[i] = classify(i, c)
+                done[i] = True
+                completed += 1
+            pool.release(conn, True)
+            return errs
+        except NativeError as e:
+            pool.discard(conn)
+            stale = (
+                reused
+                and not got_any
+                and e.code not in PERMANENT_CODES
+                and not answered(e)
+            )
+            if stale:
+                # Whole-batch retransmit on a fresh connection.
+                reused = False
+                pool.note_stale_retry()
+                try:
+                    conn = pool.fresh()
+                except StorageError as e2:
+                    return fail_all(e2)
+                continue
+            return fail_all(
+                StorageError(
+                    f"read_ranges {name}: {e}",
+                    transient=e.code not in PERMANENT_CODES,
+                )
+            )
+        except StorageError as e:
+            pool.discard(conn)
+            return fail_all(e)
+        except BaseException:
+            pool.discard(conn)
+            raise

@@ -141,28 +141,30 @@ def global_hole_totals(holes: dict) -> dict:
 
 
 def fetch_shards_mux(backend, cfg, name, table, local_idx, buffers):
-    """Multiplexed shard fetch: on the native gRPC path, all of this
-    host's byte-range shards ride ONE connection as concurrent h2 streams
-    (grpc-go's default shape) instead of a thread per shard — no fan-out
-    threads, one socket, per-stream failure isolation. Failed ranges
-    re-fetch under the configured gax policy (the same ``transport.retry``
-    the threaded path gets from RetryingBackend — bypassing the wrapper
-    must not bypass the policy). Returns a GroupResult (raising the first
-    error under ``abort_on_error``, WorkerGroup parity), or None when the
+    """Multiplexed shard fetch: all of this host's byte-range shards ride
+    ONE connection as concurrent h2 streams instead of a thread per shard
+    — no fan-out threads, one socket, per-stream failure isolation. Two
+    backends support it: native gRPC (grpc-go's default multiplexing
+    shape) and the whole-client http2 mode (ranged GETs multiplexed by
+    the same h2 machinery). Failed ranges re-fetch under the configured
+    gax policy (the same ``transport.retry`` the threaded path gets from
+    RetryingBackend — bypassing the wrapper must not bypass the policy).
+    Returns a GroupResult (raising the first error under
+    ``abort_on_error``, WorkerGroup parity), or None when the
     backend/config doesn't support it — the caller falls back to the
     thread fan-out. Shared by pod-ingest and the streamed pipeline.
     """
     import time as _time
 
     from tpubench.storage.gcs_grpc import GcsGrpcBackend
+    from tpubench.storage.gcs_http import GcsHttpBackend
     from tpubench.storage.retry import Backoff, _is_retryable
 
     inner = getattr(backend, "inner", backend)
-    if not (
-        isinstance(inner, GcsGrpcBackend)
-        and inner.transport.native_receive
-        and len(local_idx) > 0
-    ):
+    supported = (
+        isinstance(inner, GcsGrpcBackend) and inner.transport.native_receive
+    ) or (isinstance(inner, GcsHttpBackend) and inner.transport.http2)
+    if not (supported and len(local_idx) > 0):
         return None
     rngs = []
     for k, gi in enumerate(local_idx):
