@@ -424,6 +424,39 @@ def test_backend_http2_read_ranges_eof_clamp_permanent(h2srv):
     c.close()
 
 
+def test_backend_http2_read_ranges_stale_batch_retransmit(h2srv):
+    """A pooled h2 connection that died while idle fails the batch's
+    FIRST use before any completion: run_multiplexed_batch retransmits
+    the WHOLE batch once on a fresh connection (the shared stale
+    discipline, now written once for both twins)."""
+    import socket as socket_mod
+
+    import numpy as np
+
+    from tpubench.native.engine import get_engine
+
+    c = _h2_client(h2srv)
+    pool = c._h2_pool()
+    lst = socket_mod.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    s = socket_mod.socket()
+    s.connect(lst.getsockname())
+    conn, _ = lst.accept()
+    conn.close()
+    lst.close()
+    pool.idle.append(get_engine().conn_plain(s.detach()))  # dead handle
+    want = deterministic_bytes("bench/file_2", 400_000)
+    ranges = [(0, 1000), (5000, 1000)]
+    bufs = [np.zeros(1000, dtype=np.uint8) for _ in ranges]
+    errs = c.read_ranges("bench/file_2", ranges, bufs)
+    assert errs == [None, None]
+    for (start, ln), b in zip(ranges, bufs):
+        assert b.tobytes() == want[start : start + ln].tobytes()
+    assert pool.stats["stale_retries"] == 1
+    c.close()
+
+
 def test_pod_ingest_multiplexed_http2(h2srv):
     """pod-ingest's mux shard fetch rides the whole-client h2 mode too:
     one multiplexed connection fetches every local shard, the gather
