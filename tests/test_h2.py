@@ -1055,6 +1055,24 @@ def test_pod_ingest_mux_retries_injected_faults():
         assert be.injected_errors > 0  # the plan really fired
 
 
+def test_stream_pipeline_multiplexed_http2(h2srv):
+    """The streamed pipeline's fetch stage rides the h2 mux too (shared
+    fetch_shards_mux helper, http2 branch): multi-object stream over the
+    whole-client h2 mode verifies with reused double-buffer sets."""
+    from tpubench.workloads.pod_ingest_stream import run_pod_ingest_stream
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "http"
+    cfg.transport.endpoint = h2srv.endpoint
+    cfg.transport.http2 = True
+    cfg.workload.bucket = "b"
+    cfg.workload.object_name_prefix = "bench/file_"
+    cfg.workload.object_size = 400_000
+    res = run_pod_ingest_stream(cfg, n_objects=3, verify=True)
+    assert res.errors == 0
+    assert res.bytes_total == 3 * 400_000
+
+
 def test_stream_pipeline_multiplexed_native_grpc(grpcsrv):
     """The streamed pipeline's fetch stage also rides multiplexed native
     streams (shared fetch_shards_mux helper): multi-object stream over
