@@ -882,6 +882,41 @@ def test_grpc_stat_cache_invalidated_by_write_and_delete(grpcsrv):
     c.close()
 
 
+def test_fetch_shards_mux_gate(h2srv):
+    """The mux gate admits exactly the two capable configs (native-receive
+    gRPC, whole-client h2) and declines everything else with None so the
+    caller falls back to the thread fan-out — a too-eager gate would send
+    read_ranges to a backend without it."""
+    import numpy as np
+
+    from tpubench.config import BenchConfig, TransportConfig
+    from tpubench.dist.shard import ShardTable
+    from tpubench.storage.gcs_http import GcsHttpBackend
+    from tpubench.workloads.common import fetch_shards_mux
+
+    cfg = BenchConfig()
+    table = ShardTable.build(object_size=2000, n_shards=2, align=1)
+    bufs = [np.zeros(1000, dtype=np.uint8) for _ in range(2)]
+
+    # Plain h1.1 http: no mux support → None (fallback).
+    plain = GcsHttpBackend(
+        bucket="b", transport=TransportConfig(endpoint=h2srv.endpoint)
+    )
+    assert fetch_shards_mux(plain, cfg, "bench/file_0", table, [0, 1], bufs) is None
+    plain.close()
+
+    # http2: supported → a real GroupResult with the shards landed.
+    c = _h2_client(h2srv)
+    res = fetch_shards_mux(c, cfg, "bench/file_0", table, [0, 1], bufs)
+    assert res is not None and res.error_count == 0
+    want = deterministic_bytes("bench/file_0", 400_000)
+    assert bufs[0].tobytes() == want[:1000].tobytes()
+    c.close()
+
+    # Empty local shard list: nothing to multiplex → None.
+    assert fetch_shards_mux(c, cfg, "bench/file_0", table, [], []) is None
+
+
 def test_mux_retry_chains_are_per_range():
     """fetch_shards_mux grants each range its FULL gax allowance: a range
     failing for the first time in a later round still gets max_attempts
