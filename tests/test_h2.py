@@ -882,6 +882,40 @@ def test_grpc_stat_cache_invalidated_by_write_and_delete(grpcsrv):
     c.close()
 
 
+def test_backend_http2_read_ranges_concurrent_batches(h2srv):
+    """Two threads each run their own multiplexed batch on ONE backend:
+    each batch holds its own pooled connection, content lands exactly
+    (the streamed pipeline overlaps object fetches this way)."""
+    import threading
+
+    import numpy as np
+
+    c = _h2_client(h2srv)
+    results = {}
+
+    def batch(tid: int, obj: str) -> None:
+        want = deterministic_bytes(obj, 400_000)
+        ranges = [(i * 50_000, 50_000) for i in range(8)]
+        bufs = [np.zeros(50_000, dtype=np.uint8) for _ in ranges]
+        errs = c.read_ranges(obj, ranges, bufs)
+        ok = errs == [None] * 8 and all(
+            b.tobytes() == want[s : s + 50_000].tobytes()
+            for (s, _), b in zip(ranges, bufs)
+        )
+        results[tid] = ok
+
+    ts = [
+        threading.Thread(target=batch, args=(k, f"bench/file_{k}"))
+        for k in range(2)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert results == {0: True, 1: True}
+    c.close()
+
+
 def test_fetch_shards_mux_gate(h2srv):
     """The mux gate admits exactly the two capable configs (native-receive
     gRPC, whole-client h2) and declines everything else with None so the
