@@ -200,6 +200,38 @@ def test_report_bench_files(tmp_path, capsys):
     assert "0.000x" not in out
 
 
+def test_report_multichip_artifact(capsys):
+    """`report` renders the committed MULTICHIP_SWEEP.json (per-size
+    stage split + per-collective bests + ring-algebra verdict) instead
+    of degrading it into a bogus run block."""
+    import os
+
+    art = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "MULTICHIP_SWEEP.json",
+    )
+    if not os.path.exists(art):
+        pytest.skip("artifact not generated yet")
+    rc = main(["report", art])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "multichip sweep" in out and "ring_algebra_ok=True" in out
+    assert "n=16" in out and "verified=True" in out
+    # BOTH gather variants rendered per size — the ring rows win in the
+    # committed artifact; dropping them would hide the faster strategy.
+    assert "all_gather:" in out and " ring:" in out
+    assert "all_gather: best" in out
+    assert "== ? " not in out  # never the bogus-run rendering
+    # Partial artifacts degrade gracefully (module-wide contract).
+    from tpubench.workloads.report_cmd import multichip_block
+
+    out2 = multichip_block(
+        {"ring_algebra_ok": True, "pod_ingest": [{}],
+         "collectives": {"psum": [{"devices": 2}]}}
+    )
+    assert "psum: best n=2" in out2
+
+
 def test_report_sweep_table_and_cli(tmp_path, capsys):
     rows = [
         {"protocol": "http", "size": "100M", "gbps": 1.0,

@@ -159,6 +159,41 @@ def bench_block(d: dict, label: str = "") -> str:
     return "\n".join(lines)
 
 
+def multichip_block(d: dict, label: str = "") -> str:
+    """Summary of a MULTICHIP_SWEEP.json artifact: per-size pod-ingest
+    stage split and the per-collective best rows, with the ring-algebra
+    verdict."""
+    lines = [f"== multichip sweep {label} ==".rstrip()]
+    lines.append(
+        f"sizes={d.get('sizes')}  shard_mb={d.get('shard_mb')}  "
+        f"ring_algebra_ok={d.get('ring_algebra_ok')}"
+    )
+    for entry in d.get("pod_ingest") or []:
+        for key, tag in (
+            ("pod_ingest_all_gather", "all_gather"),
+            ("pod_ingest_ring", "ring"),
+        ):
+            pi = entry.get(key) or {}
+            if not pi:
+                continue
+            lines.append(
+                f"  n={entry.get('devices', '?'):>2} {tag:>10}:"
+                f" fetch {pi.get('fetch_seconds', 0):.3f}s"
+                f"  stage {pi.get('stage_seconds', 0):.3f}s"
+                f"  gather {pi.get('gather_seconds', 0):.3f}s"
+                f"  ingest {pi.get('ingest_gbps', 0):.3f} GB/s"
+                f"  verified={pi.get('verified')}"
+            )
+    for mode, rows in (d.get("collectives") or {}).items():
+        if rows:
+            best = max(rows, key=lambda r: r.get("per_chip_rx_gbps", 0))
+            lines.append(
+                f"  {mode}: best n={best.get('devices', '?')} "
+                f"{best.get('per_chip_rx_gbps', 0):.3f} GB/s/chip rx"
+            )
+    return "\n".join(lines)
+
+
 def run_report(paths: list[str]) -> str:
     """Load result/sweep/bench JSONs and render the full report."""
     runs: list[dict] = []
@@ -171,6 +206,9 @@ def run_report(paths: list[str]) -> str:
             continue
         if "metric" in doc:  # a bench.py output line saved to a file
             chunks.append(bench_block(doc, label=f"({p})"))
+            continue
+        if "ring_algebra_ok" in doc:  # a MULTICHIP_SWEEP.json artifact
+            chunks.append(multichip_block(doc, label=f"({p})"))
             continue
         if "rc" in doc and "tail" in doc:
             # Driver BENCH_rN.json wrapper: summarize the parsed bench
