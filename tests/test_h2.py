@@ -1124,6 +1124,32 @@ def test_pod_ingest_mux_retries_injected_faults():
         assert be.injected_errors > 0  # the plan really fired
 
 
+def test_pod_ingest_h2_mux_retries_injected_faults():
+    """The h2 branch of the mux fetch applies the same gax policy:
+    injected 503s heal per-range and the pod verifies (policy parity
+    with both the gRPC mux twin and the RetryingBackend-wrapped
+    threaded path)."""
+    from tpubench.storage.fake import FaultPlan
+    from tpubench.workloads.pod_ingest import run_pod_ingest
+
+    be = FakeBackend.prepopulated("bench/file_", count=1, size=2_000_000)
+    be.fault = FaultPlan(error_rate=0.4, seed=11)
+    with FakeH2Server(be) as srv:
+        cfg = BenchConfig()
+        cfg.transport.protocol = "http"
+        cfg.transport.endpoint = srv.endpoint
+        cfg.transport.http2 = True
+        cfg.transport.retry.initial_backoff_s = 0.005
+        cfg.transport.retry.max_backoff_s = 0.02
+        cfg.workload.bucket = "b"
+        cfg.workload.object_name_prefix = "bench/file_"
+        cfg.workload.object_size = 2_000_000
+        res = run_pod_ingest(cfg)
+        assert res.errors == 0
+        assert res.extra["verified"] is True
+        assert be.injected_errors > 0  # the plan really fired
+
+
 def test_stream_pipeline_multiplexed_http2(h2srv):
     """The streamed pipeline's fetch stage rides the h2 mux too (shared
     fetch_shards_mux helper, http2 branch): multi-object stream over the

@@ -293,7 +293,19 @@ class _Conn:
                     end = meta.size - 1 if not b else min(int(b), meta.size - 1)
                     status = 206
                 length = max(0, end - start + 1)
-                reader = self.backend.open_read(name, start=start, length=length)
+                try:
+                    reader = self.backend.open_read(
+                        name, start=start, length=length
+                    )
+                except StorageError as e:
+                    # Same open-time fault guard as the h2 media branch:
+                    # a classified status, not a dead connection thread.
+                    send(
+                        e.code or 500,
+                        json.dumps({"error": {"code": e.code or 500}}).encode(),
+                        "application/json",
+                    )
+                    continue
                 data = bytearray()
                 mv = memoryview(bytearray(65536))
                 while True:
@@ -398,7 +410,14 @@ class _Conn:
             end = meta.size - 1 if not b else min(int(b), meta.size - 1)
             status = 206
         length = max(0, end - start + 1)
-        reader = self.backend.open_read(name, start=start, length=length)
+        try:
+            reader = self.backend.open_read(name, start=start, length=length)
+        except StorageError as e:
+            # The backend's open-time fault point (distinct from the
+            # error_rate gate above): a dead handler thread here would
+            # leave the stream unanswered and the client waiting out a
+            # socket timeout instead of seeing the classified status.
+            return self._respond_error(stream, e.code or 500, str(e))
         hb = _hp_literal(":status", str(status)) + _hp_literal(
             "content-length", str(length)
         )
