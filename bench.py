@@ -65,7 +65,32 @@ from tpubench import bench_report as br
 # Refill sleeps scale for hermetic testing (TPUBENCH_BENCH_SLEEP_SCALE=0
 # lets a CPU smoke test drive the WHOLE protocol in seconds): the real
 # runs keep the full refill pauses. Empty string counts as unset.
-_SLEEP_SCALE = float(os.environ.get("TPUBENCH_BENCH_SLEEP_SCALE") or 1)
+
+
+def _parse_sleep_scale() -> float:
+    """Validated TPUBENCH_BENCH_SLEEP_SCALE: a clear one-line rejection
+    for non-numeric or negative values instead of an import-time
+    ValueError traceback / a silently disabled sleep (negative values
+    would make every `_sleep` a no-op without saying so)."""
+    raw = os.environ.get("TPUBENCH_BENCH_SLEEP_SCALE", "")
+    if not raw:
+        return 1.0
+    try:
+        v = float(raw)
+    except ValueError:
+        raise SystemExit(
+            f"TPUBENCH_BENCH_SLEEP_SCALE={raw!r}: expected a non-negative "
+            "number (0 disables refill sleeps; 1 keeps them full-length)"
+        ) from None
+    if v < 0 or v != v:  # reject negatives and NaN alike
+        raise SystemExit(
+            f"TPUBENCH_BENCH_SLEEP_SCALE={raw!r}: must be >= 0 "
+            "(0 disables refill sleeps; got a negative/NaN value)"
+        )
+    return v
+
+
+_SLEEP_SCALE = _parse_sleep_scale()
 
 
 def _sleep(seconds: float) -> None:

@@ -32,3 +32,10 @@ def jax_cpu_devices():
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running hygiene/stress tests")
+    # Tier-1 runs `-m 'not slow'` under JAX_PLATFORMS=cpu: flight-recorder
+    # tests are deliberately NOT slow-marked so the observability layer is
+    # exercised on every tier-1 pass; the marker exists for selective runs
+    # (`-m flight`).
+    config.addinivalue_line(
+        "markers", "flight: flight-recorder observability tests"
+    )

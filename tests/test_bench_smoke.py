@@ -76,3 +76,39 @@ def test_bench_end_to_end_cpu():
     assert "host_cores" in d and d["host_cores"] >= 1
     # Pallas ring really ran (its pair samples live under its config).
     assert len(d["samples"]["pallas_s8_w2"]) == 1
+
+
+@pytest.mark.parametrize("value,frag", [
+    ("abc", "non-negative number"),
+    ("-1", "must be >= 0"),
+    ("nan", "must be >= 0"),
+])
+def test_bench_sleep_scale_rejected_loudly(value, frag):
+    """Non-numeric / negative TPUBENCH_BENCH_SLEEP_SCALE must exit with a
+    one-line explanation at import — not a ValueError traceback (non-
+    numeric) or a silently disabled sleep (negative)."""
+    env = dict(os.environ)
+    env["TPUBENCH_BENCH_SLEEP_SCALE"] = value
+    cp = subprocess.run(
+        [sys.executable, "-c", "import bench"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert cp.returncode != 0
+    assert "TPUBENCH_BENCH_SLEEP_SCALE" in cp.stderr
+    assert frag in cp.stderr
+    assert "Traceback" not in cp.stderr
+
+
+def test_bench_sleep_scale_accepts_zero_and_unset():
+    for value in ("0", "", "0.5"):
+        env = dict(os.environ)
+        if value:
+            env["TPUBENCH_BENCH_SLEEP_SCALE"] = value
+        else:
+            env.pop("TPUBENCH_BENCH_SLEEP_SCALE", None)
+        cp = subprocess.run(
+            [sys.executable, "-c",
+             "import bench; print(bench._SLEEP_SCALE)"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert cp.returncode == 0, cp.stderr[-500:]

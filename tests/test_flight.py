@@ -1,0 +1,395 @@
+"""Flight recorder (obs/flight.py): ring semantics, per-path phase
+emission, multihost merge + straggler attribution, report timeline."""
+
+import json
+
+import pytest
+
+pytestmark = pytest.mark.flight  # tier-1 (`not slow`) still runs these
+
+from tpubench.config import MB, BenchConfig
+from tpubench.obs.flight import (
+    PHASES,
+    FlightRecorder,
+    WorkerFlight,
+    load_journals,
+    merge_journal_docs,
+    monotone,
+    phase_segments,
+    render_timeline,
+    straggler_attribution,
+    timeline_summary,
+)
+from tpubench.storage.fake import FakeBackend
+from tpubench.storage.fake_server import FakeGcsServer
+from tpubench.workloads.read import run_read
+
+
+def _read_cfg(endpoint, workers=2, calls=3, staging="none"):
+    cfg = BenchConfig()
+    cfg.transport.protocol = "http"
+    cfg.transport.endpoint = endpoint
+    cfg.workload.workers = workers
+    cfg.workload.read_calls_per_worker = calls
+    cfg.staging.mode = staging
+    return cfg
+
+
+# ------------------------------------------------------------- ring core --
+
+def test_ring_overflow_keeps_newest():
+    wf = WorkerFlight("w0", capacity=4)
+    for i in range(10):
+        op = wf.begin(f"obj{i}")
+        op.mark("body_complete")
+        op.finish(i)
+    recs = wf.records()
+    assert len(recs) == 4
+    assert [r["object"] for r in recs] == ["obj6", "obj7", "obj8", "obj9"]
+    assert wf.total == 10  # 6 dropped, visible via total - capacity
+
+
+def test_recorder_dropped_counter_and_journal_shape(tmp_path):
+    rec = FlightRecorder(capacity_per_worker=2, host=3)
+    wf = rec.worker("w0")
+    for i in range(5):
+        wf.begin(f"o{i}").finish(0)
+    assert rec.dropped == 3
+    path = rec.write_journal(str(tmp_path / "j.json"), extra={"workload": "x"})
+    doc = json.load(open(path))
+    assert doc["format"] == "tpubench-flight-v1"
+    assert doc["host"] == 3
+    assert doc["dropped"] == 3
+    assert doc["workload"] == "x"
+    assert len(doc["records"]) == 2
+    # Round-trips through the loader (format check included).
+    assert load_journals([path])[0]["host"] == 3
+
+
+def test_worker_get_or_create_is_stable():
+    rec = FlightRecorder(capacity_per_worker=8)
+    assert rec.worker("a") is rec.worker("a")
+    assert rec.worker("a") is not rec.worker("b")
+
+
+def test_phase_segments_and_monotone():
+    wf = WorkerFlight("w", capacity=2)
+    op = wf.begin("o", enqueue_ns=1000)
+    op.mark("connect", 1500)
+    op.mark("first_byte", 2500)
+    op.mark("body_complete", 4000)
+    op.finish(10)
+    r = wf.records()[0]
+    seg = phase_segments(r)
+    assert seg == {
+        "connect": 500, "first_byte": 1000, "body_complete": 1500,
+        "total": 3000,
+    }
+    assert monotone(r)
+    r["phases"]["first_byte"] = 99999  # out of order
+    assert not monotone(r)
+
+
+def test_thread_local_channel_noop_without_op():
+    # Backends call these unconditionally; outside an op they must be free
+    # no-ops, not errors.
+    from tpubench.obs.flight import annotate, current_op, note_phase
+
+    assert current_op() is None
+    note_phase("connect")
+    annotate("retry", attempt=1)
+
+
+def test_error_records_and_context_manager():
+    wf = WorkerFlight("w", capacity=4)
+    with pytest.raises(ValueError):
+        with wf.begin("bad"):
+            raise ValueError("boom")
+    r = wf.records()[0]
+    assert "ValueError" in r["error"]
+
+
+# --------------------------------------------------- per-path phase tests --
+
+def test_read_workload_http_records_full_phase_chain(tmp_path):
+    be = FakeBackend.prepopulated("tpubench/file_", count=2, size=1 * MB)
+    with FakeGcsServer(be) as srv:
+        cfg = _read_cfg(srv.endpoint)
+        cfg.obs.flight_journal = str(tmp_path / "j.json")
+        res = run_read(cfg)
+    fl = res.extra["flight"]
+    assert fl["records"] == 6
+    assert fl["errors"] == 0
+    # The HTTP/1.1 path emits connect (pool) + stream_open (response
+    # headers) + first_byte + body_complete.
+    for phase in ("connect", "stream_open", "first_byte", "body_complete"):
+        assert phase in fl["phases"], fl["phases"]
+    docs = load_journals([res.extra["flight_journal"]])
+    recs = merge_journal_docs(docs)
+    assert len(recs) == 6
+    assert all(monotone(r) for r in recs)
+    assert all(r["bytes"] == 1 * MB for r in recs)
+    assert all(r["transport"] == "http" for r in recs)
+
+
+def test_read_workload_fake_backend_staging_emits_hbm_staged():
+    from tpubench.staging.device import make_sink_factory
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 2
+    cfg.workload.read_calls_per_worker = 2
+    cfg.workload.object_size = 4 * MB
+    cfg.staging.mode = "device_put"
+    res = run_read(cfg, sink_factory=make_sink_factory(cfg))
+    fl = res.extra["flight"]
+    assert "hbm_staged" in fl["phases"], fl["phases"]
+    assert "body_complete" in fl["phases"]
+
+
+def test_read_workload_flight_disabled_by_config():
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 1
+    cfg.workload.read_calls_per_worker = 1
+    cfg.workload.object_size = 256 * 1024
+    cfg.staging.mode = "none"
+    cfg.obs.flight_records = 0
+    res = run_read(cfg)
+    assert "flight" not in res.extra
+
+
+def test_retry_annotation_lands_on_record():
+    from tpubench.storage.fake import FaultPlan
+    from tpubench.storage.retrying import RetryingBackend
+
+    be = FakeBackend.prepopulated("tpubench/file_", count=1, size=256 * 1024)
+    be.fault = FaultPlan(error_rate=0.5, seed=7)
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 1
+    cfg.workload.read_calls_per_worker = 8
+    cfg.workload.object_size = 256 * 1024
+    cfg.staging.mode = "none"
+    cfg.transport.retry.initial_backoff_s = 0.001
+    cfg.transport.retry.max_backoff_s = 0.002
+    res = run_read(cfg, backend=RetryingBackend(be, cfg.transport.retry))
+    assert res.extra["flight"]["retries"] > 0
+
+
+def test_native_receive_path_phases(tmp_path):
+    from tpubench.native.engine import get_engine
+
+    if get_engine() is None:
+        pytest.skip("native toolchain unavailable")
+    be = FakeBackend.prepopulated("tpubench/file_", count=1, size=512 * 1024)
+    with FakeGcsServer(be) as srv:
+        cfg = _read_cfg(srv.endpoint, workers=1, calls=2)
+        cfg.transport.native_receive = True
+        cfg.obs.flight_journal = str(tmp_path / "native.json")
+        res = run_read(cfg)
+    fl = res.extra["flight"]
+    assert fl["errors"] == 0
+    for phase in ("connect", "stream_open", "first_byte", "body_complete"):
+        assert phase in fl["phases"], fl["phases"]
+    # Monotonic even though the native begin() stamps first_byte while
+    # parsing headers: stream_open must be noted BEFORE begin, or every
+    # native record would order stream_open after first_byte.
+    recs = merge_journal_docs(load_journals([res.extra["flight_journal"]]))
+    assert recs and all(monotone(r) for r in recs), recs
+    # Native transport counters rode along (tb_stats_* delta).
+    nt = res.extra.get("native_transport", {})
+    assert nt.get("bytes_rx", 0) >= 2 * 512 * 1024
+
+
+def test_h2_path_phases():
+    from tpubench.native.engine import get_engine
+    from tpubench.storage.fake_h2_server import FakeH2Server
+
+    if get_engine() is None:
+        pytest.skip("native toolchain unavailable")
+    be = FakeBackend.prepopulated("tpubench/file_", count=1, size=256 * 1024)
+    with FakeH2Server(be) as srv:
+        cfg = _read_cfg(srv.endpoint, workers=1, calls=2)
+        cfg.transport.http2 = True
+        res = run_read(cfg)
+    fl = res.extra["flight"]
+    assert fl["errors"] == 0
+    for phase in ("connect", "stream_open", "first_byte", "body_complete"):
+        assert phase in fl["phases"], fl["phases"]
+    nt = res.extra.get("native_transport", {})
+    assert nt.get("h2_streams_opened", 0) >= 2
+    assert nt.get("h2_frames_rx", 0) > 0
+
+
+def test_grpc_python_path_phases():
+    pytest.importorskip("google.cloud._storage_v2")
+    from tpubench.storage.fake_grpc_server import FakeGrpcGcsServer
+    from tpubench.storage.gcs_grpc import GcsGrpcBackend
+
+    be = FakeBackend.prepopulated("tpubench/file_", count=1, size=512 * 1024)
+    with FakeGrpcGcsServer(be) as srv:
+        cfg = BenchConfig()
+        cfg.transport.protocol = "grpc"
+        cfg.transport.endpoint = f"insecure://{srv.address}"
+        cfg.transport.directpath = False
+        cfg.workload.workers = 1
+        cfg.workload.read_calls_per_worker = 2
+        cfg.staging.mode = "none"
+        res = run_read(cfg)
+    fl = res.extra["flight"]
+    assert fl["errors"] == 0
+    for phase in ("stream_open", "first_byte", "body_complete"):
+        assert phase in fl["phases"], fl["phases"]
+
+
+# --------------------------------------- merge / stragglers / timeline ----
+
+def _synthetic_host_doc(host: int, base_ms: float, n: int = 10) -> dict:
+    rec = FlightRecorder(capacity_per_worker=64, host=host)
+    wf = rec.worker("w0")
+    t0 = 1_000_000_000
+    for i in range(n):
+        dur = int(base_ms * 1e6) + i * 1000
+        op = wf.begin(f"o{i}", "http", enqueue_ns=t0)
+        op.mark("first_byte", t0 + dur // 2)
+        op.mark("body_complete", t0 + dur)
+        op.finish(100)
+    return rec.journal()
+
+
+def test_multihost_merge_attributes_injected_slow_host():
+    fast = _synthetic_host_doc(0, base_ms=2.0)
+    slow = _synthetic_host_doc(1, base_ms=50.0)
+    recs = merge_journal_docs([fast, slow])
+    assert len(recs) == 20
+    rows = straggler_attribution(recs, by="host")
+    assert rows[0]["host"] == 1
+    assert rows[0]["tail_share"] == 1.0
+    assert rows[-1]["host"] == 0
+    assert rows[-1]["tail_share"] == 0.0
+    summ = timeline_summary(recs)
+    assert summ["hosts"] == [0, 1]
+    assert summ["phases"]["total"]["count"] == 20
+
+
+def test_multihost_read_runs_merge_and_attribute(tmp_path):
+    """Two per-host read runs against one fake server — host 1 with an
+    injected open latency — merge into a pod report whose straggler table
+    names host 1 (the acceptance scenario, single-process twin of the
+    jax.distributed bring-up)."""
+    be = FakeBackend.prepopulated("tpubench/file_", count=2, size=256 * 1024)
+    paths = []
+    with FakeGcsServer(be) as srv:
+        for host in (0, 1):
+            cfg = _read_cfg(srv.endpoint, workers=2, calls=3)
+            cfg.dist.process_id = host
+            cfg.dist.num_processes = 2
+            cfg.obs.flight_journal = str(tmp_path / "pod.json")
+            be.fault.latency_s = 0.05 if host == 1 else 0.0
+            res = run_read(cfg)
+            paths.append(res.extra["flight_journal"])
+        be.fault.latency_s = 0.0
+    # Per-host suffix convention: p0 bare, p1 suffixed.
+    assert paths[0].endswith("pod.json")
+    assert paths[1].endswith("pod.json.p1")
+    docs = load_journals(paths)
+    recs = merge_journal_docs(docs)
+    assert all(monotone(r) for r in recs)
+    rows = straggler_attribution(recs, by="host")
+    assert rows[0]["host"] == 1, rows
+    out = render_timeline(docs)
+    assert "straggler: host=1" in out
+    assert "p99" in out and "p50" in out
+
+
+def test_report_timeline_renders_from_saved_journal(tmp_path):
+    from tpubench.workloads.report_cmd import run_timeline
+
+    p0 = str(tmp_path / "j0.json")
+    p1 = str(tmp_path / "j1.json")
+    json.dump(_synthetic_host_doc(0, 2.0), open(p0, "w"))
+    json.dump(_synthetic_host_doc(1, 80.0), open(p1, "w"))
+    out = run_timeline([p0, p1])
+    assert "flight timeline: 20 records" in out
+    assert "first_byte" in out and "body_complete" in out
+    assert "straggler: host=1" in out
+
+
+def test_report_timeline_cli(tmp_path, capsys):
+    from tpubench.cli import main
+
+    p0 = str(tmp_path / "j0.json")
+    json.dump(_synthetic_host_doc(0, 2.0), open(p0, "w"))
+    assert main(["report", "timeline", p0]) == 0
+    out = capsys.readouterr().out
+    assert "flight timeline" in out
+    assert "phase segments" in out
+
+
+def test_report_timeline_cli_requires_paths():
+    from tpubench.cli import main
+
+    with pytest.raises(SystemExit):
+        main(["report", "timeline"])
+
+
+def test_plain_report_detects_journal_doc(tmp_path):
+    from tpubench.workloads.report_cmd import run_report
+
+    p0 = str(tmp_path / "j0.json")
+    json.dump(_synthetic_host_doc(0, 2.0), open(p0, "w"))
+    out = run_report([p0])
+    assert "flight timeline" in out
+
+
+def test_load_journals_rejects_non_journal(tmp_path):
+    p = str(tmp_path / "x.json")
+    json.dump({"workload": "read"}, open(p, "w"))
+    with pytest.raises(ValueError):
+        load_journals([p])
+
+
+def test_phases_constant_is_ordered_superset():
+    # The canonical order the ISSUE names; analysis depends on it.
+    assert PHASES[0] == "enqueue"
+    assert PHASES[-1] == "gather_complete"
+    assert "hbm_staged" in PHASES
+
+
+# ------------------------------------------------------- pod workloads ----
+
+def test_pod_ingest_stream_journal(tmp_path, jax_cpu_devices):
+    from tpubench.workloads.pod_ingest_stream import run_pod_ingest_stream
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 2
+    cfg.workload.object_size = 2 * MB
+    cfg.obs.flight_journal = str(tmp_path / "stream.json")
+    res = run_pod_ingest_stream(cfg, n_objects=3)
+    fl = res.extra["flight"]
+    # Object-level spans carry the full chain: fetch → HBM → gather.
+    for phase in ("body_complete", "hbm_staged", "gather_complete"):
+        assert phase in fl["phases"], fl["phases"]
+    docs = load_journals([res.extra["flight_journal"]])
+    recs = merge_journal_docs(docs)
+    assert all(monotone(r) for r in recs)
+    kinds = {r.get("kind") for r in recs}
+    assert "object" in kinds and "read" in kinds
+    # Straggler table compares shard reads, not the object spans.
+    rows = straggler_attribution(recs, by="worker")
+    assert all(str(r["worker"]).startswith("shard") for r in rows)
+
+
+def test_pod_ingest_flight_summary(jax_cpu_devices):
+    from tpubench.workloads.pod_ingest import run_pod_ingest
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.object_size = 2 * MB
+    res = run_pod_ingest(cfg)
+    fl = res.extra["flight"]
+    for phase in ("body_complete", "hbm_staged", "gather_complete"):
+        assert phase in fl["phases"], fl["phases"]
+    assert res.errors == 0

@@ -1185,3 +1185,42 @@ def test_stream_pipeline_multiplexed_native_grpc(grpcsrv):
     res = run_pod_ingest_stream(cfg, n_objects=3, verify=True)
     assert res.errors == 0
     assert res.bytes_total == 3 * 3_000_000
+
+
+def test_h2_interim_1xx_with_end_stream_is_protocol_error():
+    """END_STREAM on an interim 1xx HEADERS block is forbidden (RFC 9113
+    §8.1): a server "finishing" a stream on its informational block has no
+    final headers and no content-length, so a client that ran the normal
+    finish there would pass with the truncation check silently disabled.
+    The stream must instead fail TB_EPROTO; the connection survives."""
+    from tpubench.native.engine import TB_EPROTO, get_engine
+
+    eng = get_engine()
+    be = FakeBackend.prepopulated("bench/file_", count=1, size=100_000)
+    with FakeH2Server(be, interim_end_stream=True) as srv:
+        host, port = _hostport(srv)
+        h = eng.connect(host, port)
+        try:
+            buf = eng.alloc(200_000)
+            eng.h2_submit_get(h, f"{host}:{port}", _media("bench/file_0"), buf)
+            c = eng.h2_poll(h)
+            assert c is not None
+            assert c["result"] == TB_EPROTO, c
+            # The malformed interim never counts as "the response":
+            # http_status stays unknown rather than reading 103.
+            assert c["http_status"] == -1, c
+            buf.free()
+        finally:
+            eng.conn_close(h)
+
+
+def test_backend_http2_interim_end_stream_classified_permanent():
+    """Backend level: the malformed-interim stream error surfaces as a
+    permanent (protocol-shape) StorageError — a retry reproduces it."""
+    be = FakeBackend.prepopulated("bench/file_", count=1, size=100_000)
+    with FakeH2Server(be, interim_end_stream=True) as srv:
+        c = _h2_client(srv)
+        with pytest.raises(StorageError) as ei:
+            c.open_read("bench/file_0", length=100_000)
+        assert ei.value.transient is False
+        c.close()

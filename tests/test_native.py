@@ -290,3 +290,124 @@ def test_http_get_close_delimited_exact_fit(engine):
     finally:
         lsock.close()
         t.join(timeout=5)
+
+
+# ------------------------------------------------- tb_stats_* counters ----
+
+def test_stats_api_shape(engine):
+    s = engine.stats()
+    assert s, "tb_stats_* symbols missing from libtpubench.so"
+    for key in (
+        "bytes_tx", "bytes_rx", "recv_wait_ns", "connects",
+        "tls_handshakes", "conn_closes", "h2_frames_rx",
+        "h2_data_bytes_rx", "h2_window_updates_tx", "h2_streams_opened",
+        "h2_rst_rx", "h2_goaway_rx",
+    ):
+        assert key in s and isinstance(s[key], int), (key, s)
+
+
+def test_stats_count_http_get(engine):
+    """One native GET moves the wire counters: a connect, request bytes
+    out, body bytes in, and nonzero recv wait."""
+    from tpubench.native.engine import NativeSourceServer
+
+    body = deterministic_bytes("stats/obj", 64 * 1024).tobytes()
+    with NativeSourceServer(engine, "stats/obj", bytearray(body)) as srv:
+        s0 = engine.stats()
+        buf = engine.alloc(128 * 1024)
+        r = engine.http_get(srv.host, srv.port, "/o/x?alt=media", buf)
+        s1 = engine.stats()
+        assert r["status"] == 200 and r["length"] == len(body)
+        buf.free()
+    assert s1["connects"] - s0["connects"] >= 1
+    assert s1["bytes_rx"] - s0["bytes_rx"] >= len(body)
+    assert s1["bytes_tx"] - s0["bytes_tx"] > 0
+    assert s1["recv_wait_ns"] >= s0["recv_wait_ns"]
+
+
+def test_stats_count_h2_frames(engine):
+    """The h2 client's frame/flow-control activity is visible: frames,
+    DATA bytes, opened streams."""
+    from tpubench.storage.fake_h2_server import FakeH2Server
+
+    be = FakeBackend.prepopulated("bench/file_", count=1, size=128 * 1024)
+    with FakeH2Server(be) as srv:
+        host, port = srv.endpoint.removeprefix("http://").split(":")
+        s0 = engine.stats()
+        h = engine.connect(host, int(port))
+        try:
+            buf = engine.alloc(256 * 1024)
+            engine.h2_submit_get(
+                h, f"{host}:{port}",
+                "/storage/v1/b/b/o/bench%2Ffile_0?alt=media", buf,
+            )
+            c = engine.h2_poll(h)
+            assert c is not None and c["result"] == 128 * 1024
+            buf.free()
+        finally:
+            engine.conn_close(h)
+        s1 = engine.stats()
+    assert s1["h2_streams_opened"] - s0["h2_streams_opened"] == 1
+    assert s1["h2_frames_rx"] - s0["h2_frames_rx"] > 0
+    assert s1["h2_data_bytes_rx"] - s0["h2_data_bytes_rx"] >= 128 * 1024
+    assert s1["conn_closes"] - s0["conn_closes"] == 1
+
+
+# --------------------------------------- loopback server range handling ----
+
+def _srv_get(port, path, headers=None):
+    import http.client
+
+    c = http.client.HTTPConnection("127.0.0.1", port)
+    try:
+        c.request("GET", path, headers=headers or {})
+        r = c.getresponse()
+        return r.status, r.getheader("Content-Range"), r.read()
+    finally:
+        c.close()
+
+
+def test_srv_suffix_range_serves_last_n(engine):
+    """`Range: bytes=-N` (RFC 9110 §14.1.2) must serve the LAST N bytes —
+    the old sscanf path parsed the sign into the start offset and served
+    a 206 of the whole body with a wrong Content-Range."""
+    from tpubench.native.engine import NativeSourceServer
+
+    body = deterministic_bytes("sfx/obj", 4096).tobytes()
+    with NativeSourceServer(engine, "sfx/obj", bytearray(body)) as srv:
+        status, cr, data = _srv_get(
+            srv.port, "/o/x?alt=media", {"Range": "bytes=-100"}
+        )
+        assert status == 206
+        assert cr == "bytes 3996-4095/4096"
+        assert data == body[-100:]
+        # Suffix larger than the body: the whole body (clamped), still 206.
+        status, cr, data = _srv_get(
+            srv.port, "/o/x?alt=media", {"Range": "bytes=-100000"}
+        )
+        assert status == 206 and data == body
+
+
+def test_srv_unsatisfiable_ranges_416(engine):
+    """bytes=-0 and past-EOF starts are unsatisfiable: 416 with a
+    `bytes */len` Content-Range — never a 206 with wrong semantics."""
+    from tpubench.native.engine import NativeSourceServer
+
+    body = deterministic_bytes("sfx/obj2", 1024).tobytes()
+    with NativeSourceServer(engine, "sfx/obj2", bytearray(body)) as srv:
+        status, cr, data = _srv_get(
+            srv.port, "/o/x?alt=media", {"Range": "bytes=-0"}
+        )
+        assert status == 416
+        assert cr == "bytes */1024"
+        assert data == b""
+        status, cr, _ = _srv_get(
+            srv.port, "/o/x?alt=media", {"Range": "bytes=5000-6000"}
+        )
+        assert status == 416
+        # Normal bounded range still exact after the parser change.
+        status, cr, data = _srv_get(
+            srv.port, "/o/x?alt=media", {"Range": "bytes=10-19"}
+        )
+        assert status == 206 and cr == "bytes 10-19/1024"
+        assert data == body[10:20]

@@ -64,6 +64,14 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--trace-exporter", choices=("console", "cloud_trace"),
                    help="span export path (with --enable-tracing)")
     p.add_argument("--profile-dir", help="capture a jax.profiler xplane trace here")
+    p.add_argument("--flight-journal",
+                   help="write the per-host flight-recorder journal JSON "
+                        "here (per-read phase timelines; multi-host "
+                        "processes suffix .p<idx>); render with "
+                        "`tpubench report timeline <paths...>`")
+    p.add_argument("--flight-records", type=int,
+                   help="flight-recorder ring capacity per worker "
+                        "(newest records kept; 0 disables the layer)")
     p.add_argument("--export", choices=("none", "json", "cloud"),
                    help="metric export: cloud = in-run periodic push of full "
                         "latency histograms (metrics_exporter.go:36-58); "
@@ -168,6 +176,15 @@ def build_config(args) -> BenchConfig:
         o.trace_exporter = args.trace_exporter
     if args.profile_dir:
         o.profile_dir = args.profile_dir
+    if getattr(args, "flight_journal", None):
+        o.flight_journal = args.flight_journal
+    if getattr(args, "flight_records", None) is not None:
+        if args.flight_records < 0:
+            raise SystemExit(
+                f"--flight-records {args.flight_records}: must be >= 0 "
+                "(0 disables the flight recorder)"
+            )
+        o.flight_records = args.flight_records
     if args.export:
         o.export = args.export
     if args.metrics_interval is not None:
@@ -479,15 +496,28 @@ def main(argv=None) -> int:
         "report",
         help="summarize/compare result JSONs (percentile blocks, A/B "
              "deltas, sweep tables — replaces the reference's matplotlib "
-             "recipe, README.md:15-36)",
+             "recipe, README.md:15-36); `report timeline <journals...>` "
+             "merges flight journals into the pod-level per-phase "
+             "p50/p99 + straggler report",
     )
-    rep.add_argument("results", nargs="+", help="result/sweep JSON paths")
+    rep.add_argument("results", nargs="+",
+                     help="result/sweep JSON paths — or `timeline` "
+                          "followed by flight-journal paths")
 
     args = top.parse_args(argv)
     if args.cmd == "report":
         # Offline post-processing: no jax, no common config needed.
-        from tpubench.workloads.report_cmd import run_report
+        from tpubench.workloads.report_cmd import run_report, run_timeline
 
+        if args.results and args.results[0] == "timeline":
+            if len(args.results) < 2:
+                raise SystemExit(
+                    "report timeline: at least one flight-journal path "
+                    "required (workload runs write one under "
+                    "--flight-journal)"
+                )
+            print(run_timeline(args.results[1:]))
+            return 0
         print(run_report(args.results))
         return 0
     if args.cmd == "multichip-sweep":

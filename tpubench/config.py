@@ -255,6 +255,17 @@ class ObservabilityConfig:
     # (SURVEY §5.1: the DMA/collective path profiled first-class, replacing
     # the reference's attach-an-external-profiler sleeps).
     profile_dir: str = ""
+    # Flight recorder (obs/flight.py): per-worker ring capacity of
+    # structured per-read phase records (enqueue/connect/first_byte/
+    # body_complete/hbm_staged/gather_complete + retry annotations) — the
+    # always-on, zero-GCP-dependency layer beneath spans/exporters.
+    # 0 disables it entirely.
+    flight_records: int = 1024
+    # Non-empty = write the per-host flight journal JSON here at end of
+    # run (stream: periodically, riding the SnapshotWriter flush path).
+    # Multi-host processes suffix ".p<idx>" (snapshot-file convention);
+    # `tpubench report timeline <paths...>` merges them pod-wide.
+    flight_journal: str = ""
 
 
 @dataclass

@@ -29,8 +29,33 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
+try:  # newer jax exports shard_map at top level …
+    from jax import shard_map as _shard_map
+except ImportError:  # … older releases (this image: 0.4.37) ship it under
+    # experimental, same semantics but the replication-check kwarg is
+    # named check_rep there instead of check_vma.
+    from jax.experimental.shard_map import (  # type: ignore[no-redef]
+        shard_map as _shard_map,
+    )
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import inspect as _inspect
+
+_CHECK_KW = next(
+    (k for k in ("check_vma", "check_rep")
+     if k in _inspect.signature(_shard_map).parameters),
+    None,
+)
+
+
+def shard_map(f, *, check_vma: bool = True, **kw):
+    """jax.shard_map with the replication-check kwarg spelled per the
+    installed jax (check_vma on current releases, check_rep on the
+    experimental module this image ships)."""
+    if _CHECK_KW is not None:
+        kw[_CHECK_KW] = check_vma
+    return _shard_map(f, **kw)
 
 
 def make_mesh(devices: Optional[Sequence] = None, axis: str = "pod") -> Mesh:

@@ -51,6 +51,69 @@ int64_t tb_now_ns() {
   return static_cast<int64_t>(ts.tv_sec) * 1000000000LL + ts.tv_nsec;
 }
 
+// --------------------------------------------------- transport counters --
+// tb_stats_*: engine-wide transport state that was previously invisible
+// from Python — bytes on the wire, h2 frame/flow-control activity, recv
+// wait time, connects/handshakes. Process-cumulative, atomically updated
+// (relaxed: they are monotone counters, not synchronization); callers
+// (the flight recorder) diff two snapshots to scope a run. The counter
+// NAMES are API (Python builds its dict from tb_stats_name); indices are
+// not — always resolve by name.
+enum {
+  TB_STAT_BYTES_TX = 0,       // payload bytes handed to send/SSL_write
+  TB_STAT_BYTES_RX,           // payload bytes returned by recv/SSL_read
+  TB_STAT_RECV_WAIT_NS,       // wall time blocked inside recv/SSL_read —
+                              // the receive-side stall (peer/flow-control
+                              // starvation shows up here)
+  TB_STAT_CONNECTS,           // tb_http_connect successes (TCP connects)
+  TB_STAT_TLS_HANDSHAKES,     // completed TLS handshakes
+  TB_STAT_CONN_CLOSES,        // tb_conn handles closed
+  TB_STAT_H2_FRAMES_RX,       // h2 frames consumed by the poll loop
+  TB_STAT_H2_DATA_BYTES_RX,   // DATA frame payload bytes (incl. padding)
+  TB_STAT_H2_WINDOW_UPDATES_TX,  // flow-control credit frames sent
+  TB_STAT_H2_STREAMS_OPENED,  // streams submitted (gRPC + raw GET)
+  TB_STAT_H2_RST_RX,          // RST_STREAM frames received
+  TB_STAT_H2_GOAWAY_RX,       // GOAWAY frames received
+  TB_STAT_COUNT
+};
+static int64_t tb_stats_v[TB_STAT_COUNT];
+static const char* const tb_stats_names[TB_STAT_COUNT] = {
+    "bytes_tx",
+    "bytes_rx",
+    "recv_wait_ns",
+    "connects",
+    "tls_handshakes",
+    "conn_closes",
+    "h2_frames_rx",
+    "h2_data_bytes_rx",
+    "h2_window_updates_tx",
+    "h2_streams_opened",
+    "h2_rst_rx",
+    "h2_goaway_rx",
+};
+
+static inline void tb_stat_add(int idx, int64_t v) {
+  __atomic_fetch_add(&tb_stats_v[idx], v, __ATOMIC_RELAXED);
+}
+
+int tb_stats_count() { return TB_STAT_COUNT; }
+
+const char* tb_stats_name(int i) {
+  return (i >= 0 && i < TB_STAT_COUNT) ? tb_stats_names[i] : "";
+}
+
+int tb_stats_read(int64_t* out, int cap) {
+  int n = cap < TB_STAT_COUNT ? cap : TB_STAT_COUNT;
+  for (int i = 0; i < n; i++)
+    out[i] = __atomic_load_n(&tb_stats_v[i], __ATOMIC_RELAXED);
+  return n;
+}
+
+void tb_stats_reset() {
+  for (int i = 0; i < TB_STAT_COUNT; i++)
+    __atomic_store_n(&tb_stats_v[i], 0, __ATOMIC_RELAXED);
+}
+
 // --------------------------------------------------------------- buffers --
 // Aligned allocation: O_DIRECT requires buffer, offset and length aligned to
 // the logical block size (typically 512; 4096 is safe for both).
@@ -326,6 +389,7 @@ int tb_http_connect(const char* host, int port) {
   }
   freeaddrinfo(res);
   if (fd < 0) return -ECONNREFUSED;
+  tb_stat_add(TB_STAT_CONNECTS, 1);
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
   // Bounded blocking I/O (the Python pool uses timeout=60 — same here):
@@ -563,7 +627,11 @@ struct tb_conn {
 static const size_t kTlsIoCap = size_t{1} << 30;
 
 static ssize_t conn_send(tb_conn* c, const void* p, size_t n) {
-  if (!c->ssl) return send(c->fd, p, n, 0);
+  if (!c->ssl) {
+    ssize_t k = send(c->fd, p, n, 0);
+    if (k > 0) tb_stat_add(TB_STAT_BYTES_TX, k);
+    return k;
+  }
   if (n > kTlsIoCap) n = kTlsIoCap;
   for (;;) {
     errno = 0;  // stale EINTR from an earlier call must not loop us
@@ -573,21 +641,33 @@ static ssize_t conn_send(tb_conn* c, const void* p, size_t n) {
       errno = ECONNRESET;  // classified transient, like any mid-stream break
       return -1;
     }
+    tb_stat_add(TB_STAT_BYTES_TX, k);
     return k;
   }
 }
 
 static ssize_t conn_recv(tb_conn* c, void* p, size_t n) {
-  if (!c->ssl) return recv(c->fd, p, n, 0);
+  // Receive-side stall accounting: wall time blocked waiting for bytes
+  // (two vDSO clock reads per recv — noise next to a syscall).
+  int64_t t0 = tb_now_ns();
+  if (!c->ssl) {
+    ssize_t k = recv(c->fd, p, n, 0);
+    tb_stat_add(TB_STAT_RECV_WAIT_NS, tb_now_ns() - t0);
+    if (k > 0) tb_stat_add(TB_STAT_BYTES_RX, k);
+    return k;
+  }
   if (n > kTlsIoCap) n = kTlsIoCap;
   for (;;) {
     errno = 0;  // stale EINTR from an earlier call must not loop us
     int k = tls::SSL_read_(c->ssl, p, static_cast<int>(n));
     if (k < 0) {
       if (errno == EINTR) continue;  // interrupted syscall under SSL_read
+      tb_stat_add(TB_STAT_RECV_WAIT_NS, tb_now_ns() - t0);
       errno = ECONNRESET;
       return -1;
     }
+    tb_stat_add(TB_STAT_RECV_WAIT_NS, tb_now_ns() - t0);
+    if (k > 0) tb_stat_add(TB_STAT_BYTES_RX, k);
     return k;  // 0 = close_notify / EOF, same contract as recv
   }
 }
@@ -681,6 +761,7 @@ int64_t tb_conn_tls(int fd, const char* sni, const char* cafile, int insecure,
     tls::SSL_free_(ssl);
     return -ENOMEM;
   }
+  tb_stat_add(TB_STAT_TLS_HANDSHAKES, 1);
   c->fd = fd;
   c->ssl = ssl;
   return reinterpret_cast<int64_t>(c);
@@ -688,6 +769,7 @@ int64_t tb_conn_tls(int fd, const char* sni, const char* cafile, int insecure,
 
 int tb_conn_close(int64_t h) {
   if (h <= 0) return -EINVAL;
+  tb_stat_add(TB_STAT_CONN_CLOSES, 1);
   tb_conn* c = reinterpret_cast<tb_conn*>(h);
   if (c->ssl) {
     tls::SSL_shutdown_(c->ssl);  // best-effort close_notify
@@ -1931,6 +2013,7 @@ static h2_stream* h2_open_stream(tb_conn* c, uint64_t tag, void* buf,
   }
   s->id = c->next_stream;
   c->next_stream += 2;
+  tb_stat_add(TB_STAT_H2_STREAMS_OPENED, 1);
   return s;
 }
 
@@ -2188,6 +2271,8 @@ int64_t tb_grpc_poll(int64_t h, uint64_t* tag_out, int64_t* result_out,
     uint32_t fstream = ((fh[5] & 0x7f) << 24) | (fh[6] << 16) |
                        (fh[7] << 8) | fh[8];
     if (flen > (16u << 20)) return TB_EPROTO;
+    tb_stat_add(TB_STAT_H2_FRAMES_RX, 1);
+    if (ftype == 0) tb_stat_add(TB_STAT_H2_DATA_BYTES_RX, flen);
     switch (ftype) {
       case 0: {  // DATA
         h2_stream* s = h2_find_stream(c, fstream);
@@ -2221,6 +2306,7 @@ int64_t tb_grpc_poll(int64_t h, uint64_t* tag_out, int64_t* result_out,
           uint8_t wu[4];
           h2::put32(wu, static_cast<uint32_t>(conn_unacked));
           h2::send_frame(c, 8, 0, 0, wu, 4);
+          tb_stat_add(TB_STAT_H2_WINDOW_UPDATES_TX, 1);
           conn_unacked = 0;
         }
         if (s) {
@@ -2229,6 +2315,7 @@ int64_t tb_grpc_poll(int64_t h, uint64_t* tag_out, int64_t* result_out,
             uint8_t wu[4];
             h2::put32(wu, static_cast<uint32_t>(s->unacked));
             h2::send_frame(c, 8, 0, fstream, wu, 4);
+            tb_stat_add(TB_STAT_H2_WINDOW_UPDATES_TX, 1);
             s->unacked = 0;
           }
           if (fflags & 0x1) {
@@ -2340,19 +2427,34 @@ int64_t tb_grpc_poll(int64_t h, uint64_t* tag_out, int64_t* result_out,
         if (s) {
           if (s->first_byte_ns == 0) s->first_byte_ns = tb_now_ns();
           if (gs >= 0) s->grpc_status = gs;
-          if (hs >= 0) s->http_status = hs;
           // Only the FINAL response HEADERS' announcement counts: an
           // interim 1xx block (RFC 9113 §8.1) is informational — marking
           // it as "the response" would discard the real block's
           // content-length and silently disable the truncation check —
           // and trailers (got_headers already set) must not
-          // retroactively change it.
+          // retroactively change it. The interim guard covers :status
+          // too: a late 1xx block must not overwrite the response status
+          // (which would flip the 200/206 gate of the truncation check).
           bool interim = hs >= 100 && hs < 200;
           if (!interim) {
+            if (hs >= 0) s->http_status = hs;
             if (cl >= 0 && !s->got_headers) s->content_len = cl;
             s->got_headers = 1;
           }
-          if (fflags & 0x1) h2_stream_finish(s);
+          if (fflags & 0x1) {
+            if (interim) {
+              // END_STREAM on an interim response is a stream protocol
+              // violation (RFC 9113 §8.1: interim responses cannot end a
+              // stream). Finishing normally here would run
+              // h2_stream_finish with the truncation check silently
+              // disabled (no final headers ⇒ no content-length) — fail
+              // the STREAM loudly instead; the connection survives.
+              if (!s->err) s->err = TB_EPROTO;
+              s->done = 1;
+            } else {
+              h2_stream_finish(s);
+            }
+          }
         }
         break;
       }
@@ -2360,6 +2462,7 @@ int64_t tb_grpc_poll(int64_t h, uint64_t* tag_out, int64_t* result_out,
         uint8_t code[4];
         if (flen != 4) return TB_EPROTO;
         if ((rc = h2::recv_all(c, code, 4)) != 0) return rc;
+        tb_stat_add(TB_STAT_H2_RST_RX, 1);
         h2_stream* s = h2_find_stream(c, fstream);
         if (s) {
           s->err = TB_ESHORT;
@@ -2388,6 +2491,7 @@ int64_t tb_grpc_poll(int64_t h, uint64_t* tag_out, int64_t* result_out,
         break;
       }
       case 7: {  // GOAWAY: connection-fatal for our purposes
+        tb_stat_add(TB_STAT_H2_GOAWAY_RX, 1);
         return TB_ESHORT;
       }
       default: {  // WINDOW_UPDATE, PRIORITY, PUSH_PROMISE(never), unknown
@@ -2408,6 +2512,7 @@ int64_t tb_grpc_poll(int64_t h, uint64_t* tag_out, int64_t* result_out,
     uint8_t wu[4];
     h2::put32(wu, static_cast<uint32_t>(conn_unacked));
     h2::send_frame(c, 8, 0, 0, wu, 4);
+    tb_stat_add(TB_STAT_H2_WINDOW_UPDATES_TX, 1);
   }
   if (tag_out) *tag_out = ready->tag;
   if (grpc_status_out) *grpc_status_out = ready->grpc_status;
@@ -2471,6 +2576,7 @@ struct server {
   int conn_fds[256];  // live connection fds, for shutdown on stop
   int n_conns;
   int active;  // live connection-thread count (atomic access only)
+  int64_t rejected;  // connections refused at the tracking cap (under mu)
 };
 
 struct srv_conn_arg {
@@ -2492,10 +2598,27 @@ static int srv_send_all(int fd, const void* p, int64_t n) {
   return 0;
 }
 
-static void srv_track_conn(server* s, int fd, int add) {
+// Track/untrack a live connection fd. Returns 1 on success; 0 when the
+// 256-fd tracking table is full — the caller must then REJECT the
+// connection. An untracked connection would survive tb_srv_stop's
+// shutdown sweep, block the bounded thread-join wait, and force the
+// server struct (and the caller's body buffer) to leak silently with
+// nothing attributing it; rejecting + logging makes the leak condition
+// loud and attributable instead.
+static int srv_track_conn(server* s, int fd, int add) {
+  int ok = 1;
   pthread_mutex_lock(&s->mu);
   if (add) {
-    if (s->n_conns < 256) s->conn_fds[s->n_conns++] = fd;
+    if (s->n_conns < 256) {
+      s->conn_fds[s->n_conns++] = fd;
+    } else {
+      ok = 0;
+      s->rejected++;
+      fprintf(stderr,
+              "tpubench tb_srv: connection-tracking cap (256) reached; "
+              "rejecting new connection (total rejected: %lld)\n",
+              static_cast<long long>(s->rejected));
+    }
   } else {
     for (int i = 0; i < s->n_conns; i++) {
       if (s->conn_fds[i] == fd) {
@@ -2505,6 +2628,7 @@ static void srv_track_conn(server* s, int fd, int add) {
     }
   }
   pthread_mutex_unlock(&s->mu);
+  return ok;
 }
 
 static void* srv_conn_main(void* argp) {
@@ -2530,14 +2654,32 @@ static void* srv_conn_main(void* argp) {
       int is_media = strstr(req, "alt=media") != nullptr;
       int64_t start = 0, last = s->body_len - 1;
       int ranged = 0;
+      int unsatisfiable = 0;
       const char* rg = strstr(req, "\r\nRange: bytes=");
       if (!rg) rg = strstr(req, "\r\nrange: bytes=");
       if (rg) {
-        long long as = 0, bs = -1;
-        if (sscanf(rg + 15, "%lld-%lld", &as, &bs) >= 1) {
+        const char* rv = rg + 15;
+        if (rv[0] == '-' && isdigit(static_cast<unsigned char>(rv[1]))) {
+          // Suffix range "bytes=-N" (RFC 9110 §14.1.2): the LAST N bytes
+          // — sscanf's "%lld" would otherwise swallow the sign and serve
+          // a 206 from offset 0 with a wrong Content-Range. N == 0 and
+          // empty bodies are unsatisfiable → 416, never a bogus 206.
+          long long suf = atoll(rv + 1);
           ranged = 1;
-          start = as;
-          last = bs >= 0 ? bs : s->body_len - 1;
+          if (suf <= 0 || s->body_len == 0) {
+            unsatisfiable = 1;
+          } else {
+            start = suf >= s->body_len ? 0 : s->body_len - suf;
+            last = s->body_len - 1;
+          }
+        } else {
+          long long as = 0, bs = -1;
+          if (sscanf(rv, "%lld-%lld", &as, &bs) >= 1) {
+            ranged = 1;
+            start = as;
+            last = bs >= 0 ? bs : s->body_len - 1;
+            if (as >= s->body_len) unsatisfiable = 1;  // past EOF → 416
+          }
         }
       }
       char hdr[512];
@@ -2550,6 +2692,13 @@ static void* srv_conn_main(void* argp) {
                           mlen);
         if (srv_send_all(fd, hdr, hn) != 0) goto done;
         if (srv_send_all(fd, s->meta_json, mlen) != 0) goto done;
+      } else if (unsatisfiable) {
+        int hn = snprintf(hdr, sizeof(hdr),
+                          "HTTP/1.1 416 Range Not Satisfiable\r\n"
+                          "Content-Range: bytes */%lld\r\n"
+                          "Content-Length: 0\r\n\r\n",
+                          static_cast<long long>(s->body_len));
+        if (srv_send_all(fd, hdr, hn) != 0) goto done;
       } else {
         if (start < 0) start = 0;
         if (last > s->body_len - 1) last = s->body_len - 1;
@@ -2604,7 +2753,13 @@ static void* srv_accept_main(void* argp) {
     }
     a->s = s;
     a->fd = fd;
-    srv_track_conn(s, fd, 1);
+    if (!srv_track_conn(s, fd, 1)) {
+      // Tracking table full: refuse rather than serve an fd that stop()
+      // could never shut down (see srv_track_conn).
+      close(fd);
+      free(a);
+      continue;
+    }
     __sync_fetch_and_add(&s->active, 1);
     pthread_t t;
     pthread_attr_t attr;

@@ -292,6 +292,21 @@ class NativeEngine:
         ]
         lib.tb_srv_stop.restype = c.c_int
         lib.tb_srv_stop.argtypes = [c.c_void_p]
+        # Transport counters (tb_stats_*): bound defensively so a stale
+        # .so predating the API degrades to stats() == {} instead of an
+        # import-time crash.
+        try:
+            lib.tb_stats_count.restype = c.c_int
+            lib.tb_stats_count.argtypes = []
+            lib.tb_stats_name.restype = c.c_char_p
+            lib.tb_stats_name.argtypes = [c.c_int]
+            lib.tb_stats_read.restype = c.c_int
+            lib.tb_stats_read.argtypes = [c.POINTER(c.c_int64), c.c_int]
+            lib.tb_stats_reset.restype = None
+            lib.tb_stats_reset.argtypes = []
+            self._has_stats = True
+        except AttributeError:
+            self._has_stats = False
         self.lib = lib
 
         # DLPack lifetime plumbing. Every managed tensor we produce gets a
@@ -331,6 +346,26 @@ class NativeEngine:
     # ------------------------------------------------------------ helpers --
     def now_ns(self) -> int:
         return self.lib.tb_now_ns()
+
+    def stats(self) -> dict[str, int]:
+        """Engine-wide transport counter snapshot (tb_stats_*): bytes on
+        the wire, h2 frames, flow-control credit returns, recv wait time,
+        connects/handshakes — the native engine's previously-invisible
+        state. Cumulative per process; callers diff two snapshots to
+        scope a run."""
+        if not self._has_stats:
+            return {}
+        n = int(self.lib.tb_stats_count())
+        arr = (ctypes.c_int64 * n)()
+        got = self.lib.tb_stats_read(arr, n)
+        return {
+            self.lib.tb_stats_name(i).decode(): int(arr[i])
+            for i in range(min(n, got))
+        }
+
+    def stats_reset(self) -> None:
+        if self._has_stats:
+            self.lib.tb_stats_reset()
 
     def alloc(self, size: int, align: int = 4096) -> AlignedBuffer:
         return AlignedBuffer(self, size, align)
@@ -986,3 +1021,9 @@ def get_engine() -> Optional[NativeEngine]:
             except BaseException as e:  # noqa: BLE001
                 _engine_error = e
         return _engine
+
+
+def peek_engine() -> Optional[NativeEngine]:
+    """The engine IF this process already built it — never triggers a
+    compile (read-only callers: per-run tb_stats deltas, `info`)."""
+    return _engine

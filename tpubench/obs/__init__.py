@@ -1,5 +1,11 @@
-"""Observability: tracing spans and metric export (SURVEY §5.1, §5.5)."""
+"""Observability: tracing spans, metric export, and the per-read flight
+recorder (SURVEY §5.1, §5.5)."""
 
+from tpubench.obs.flight import (  # noqa: F401
+    FlightRecorder,
+    flight_from_config,
+    render_timeline,
+)
 from tpubench.obs.tracing import (  # noqa: F401
     NoopTracer,
     RecordingTracer,

@@ -1,0 +1,506 @@
+"""Per-read flight recorder: bounded phase timelines with straggler
+attribution (the always-on, zero-GCP-dependency observability layer).
+
+Each read (and each staging slot / pod-ingest object) becomes one
+structured record carrying nanosecond timestamps for the paper's phase
+split — ``enqueue``, ``connect``, ``stream_open``, ``first_byte``,
+``body_complete``, ``hbm_staged``, ``gather_complete`` — plus retry/fault
+annotations. A p99 regression is then attributable: a connection stall
+shows up as a fat ``connect``/``first_byte`` segment, a slow
+``device_put`` as a fat ``hbm_staged`` segment, and a straggling host as
+one row of the straggler table (arXiv:1804.01138 and the Pulsar latency
+study both show percentile tails are only actionable decomposed per
+phase and per endpoint).
+
+Race-freedom is by the same worker-owned-array construction as
+:mod:`tpubench.metrics.recorder`: every worker thread owns a private
+bounded ring of records (:class:`WorkerFlight`); rings are merged only
+after the workers join. The ring keeps the NEWEST records when it
+overflows, so a long run's journal is its recent history, not its
+ancient one.
+
+Backends emit connection-level events (connect, stream-open, stale
+retries) without any signature change through a thread-local channel:
+the workload opens an op (:meth:`WorkerFlight.begin`), which installs
+itself as the thread's current op; :func:`note_phase` / :func:`annotate`
+called anywhere down-stack (connection pools, retry wrappers) attach to
+it, and are free no-ops when no op is active. One worker thread performs
+one read at a time, so the channel is race-free by construction.
+
+Journals are plain JSON docs (``format: tpubench-flight-v1``), one per
+host (multi-host runs suffix ``.p<process_index>``, the same convention
+as the stream snapshot files); :func:`merge_journal_docs` +
+:func:`render_timeline` are the pod-level aggregation pass behind
+``tpubench report timeline``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Iterable, Optional
+
+import numpy as np
+
+from tpubench.metrics.percentiles import summarize_ns
+
+JOURNAL_FORMAT = "tpubench-flight-v1"
+
+# Canonical phase order; segment durations are computed between
+# consecutive phases PRESENT in a record and attributed to the later one
+# ("time spent reaching first_byte from the previous milestone").
+PHASES = (
+    "enqueue",
+    "connect",
+    "stream_open",
+    "first_byte",
+    "body_complete",
+    "hbm_staged",
+    "gather_complete",
+)
+
+_tls = threading.local()
+
+
+def current_op() -> Optional["FlightOp"]:
+    return getattr(_tls, "op", None)
+
+
+def note_phase(phase: str, ns: Optional[int] = None) -> None:
+    """Stamp ``phase`` on the calling thread's current op (no-op when no
+    op is active — the backends call this unconditionally)."""
+    op = getattr(_tls, "op", None)
+    if op is not None:
+        op.mark(phase, ns)
+
+
+def annotate(kind: str, **info) -> None:
+    """Attach a retry/fault annotation to the current op (no-op when no
+    op is active)."""
+    op = getattr(_tls, "op", None)
+    if op is not None:
+        op.note(kind, **info)
+
+
+class FlightOp:
+    """One in-flight read: phase stamps + annotations, appended to the
+    owning ring at :meth:`finish`. Context-manager use finishes with the
+    exception (if any) recorded as the op's error."""
+
+    __slots__ = ("_ring", "worker", "object", "transport", "kind",
+                 "phases", "notes", "bytes", "error", "_done", "_installed")
+
+    def __init__(self, ring: "WorkerFlight", object_name: str,
+                 transport: str, enqueue_ns: Optional[int] = None,
+                 install: bool = True, kind: str = "read"):
+        self._ring = ring
+        self.worker = ring.name
+        self.object = object_name
+        self.transport = transport
+        # "read": one network read (the straggler tables compare these);
+        # "object": a pod-level fetch→stage→gather span; "stage": one
+        # staging-slot transfer.
+        self.kind = kind
+        self.phases: dict[str, int] = {
+            "enqueue": enqueue_ns if enqueue_ns is not None
+            else time.perf_counter_ns()
+        }
+        self.notes: list[dict] = []
+        self.bytes = 0
+        self.error: Optional[str] = None
+        self._done = False
+        # install=False: side-channel records (e.g. staging-slot records
+        # created while a read op is in flight on the same thread) must
+        # not displace the thread's current op.
+        self._installed = install
+        if install:
+            _tls.op = self
+
+    def mark(self, phase: str, ns: Optional[int] = None) -> None:
+        # First stamp wins (e.g. "connect" fires once even when a stale
+        # retry reconnects — the retry itself is an annotation).
+        if phase not in self.phases:
+            self.phases[phase] = int(
+                ns if ns else time.perf_counter_ns()
+            )
+
+    def note(self, kind: str, **info) -> None:
+        self.notes.append({"kind": kind, "t": time.perf_counter_ns(), **info})
+
+    def finish(self, nbytes: int = 0, error: Optional[BaseException] = None
+               ) -> None:
+        if self._done:
+            return
+        self._done = True
+        if self._installed and getattr(_tls, "op", None) is self:
+            _tls.op = None
+        self.bytes = int(nbytes)
+        if error is not None:
+            self.error = f"{type(error).__name__}: {error}"
+        rec = {
+            "worker": self.worker,
+            "object": self.object,
+            "transport": self.transport,
+            "kind": self.kind,
+            "phases": self.phases,
+            "bytes": self.bytes,
+        }
+        if self.notes:
+            rec["notes"] = self.notes
+        if self.error:
+            rec["error"] = self.error
+        self._ring.append(rec)
+
+    def __enter__(self) -> "FlightOp":
+        return self
+
+    def __exit__(self, etype, exc, tb) -> bool:
+        self.finish(self.bytes, error=exc)
+        return False
+
+
+class WorkerFlight:
+    """One worker thread's private bounded record ring (newest kept)."""
+
+    __slots__ = ("name", "capacity", "_buf", "_pos", "total")
+
+    def __init__(self, name: str, capacity: int):
+        self.name = name
+        self.capacity = max(1, capacity)
+        self._buf: list[dict] = []
+        self._pos = 0
+        self.total = 0  # appends ever; total - len(buf) = dropped
+
+    def begin(self, object_name: str, transport: str = "",
+              enqueue_ns: Optional[int] = None,
+              install: bool = True, kind: str = "read") -> FlightOp:
+        return FlightOp(self, object_name, transport, enqueue_ns,
+                        install=install, kind=kind)
+
+    def append(self, rec: dict) -> None:
+        self.total += 1
+        if len(self._buf) < self.capacity:
+            self._buf.append(rec)
+            return
+        # Overwrite the OLDEST slot (ring semantics: newest records win).
+        self._buf[self._pos] = rec
+        self._pos = (self._pos + 1) % self.capacity
+
+    def records(self) -> list[dict]:
+        """Oldest→newest copy (safe post-join; mid-run snapshots may miss
+        or double-see the record being appended — fine for a flush)."""
+        buf = list(self._buf)
+        if self.total <= self.capacity:
+            return buf
+        pos = self._pos % len(buf) if buf else 0
+        return buf[pos:] + buf[:pos]
+
+
+class FlightRecorder:
+    """Per-run registry of worker rings + journal/summary rendering."""
+
+    def __init__(self, capacity_per_worker: int = 1024, host: int = 0):
+        self.capacity = capacity_per_worker
+        self.host = host
+        self._workers: dict[str, WorkerFlight] = {}
+        self._lock = threading.Lock()
+
+    def activate(self) -> "_Activation":
+        """Install as the run's ambient recorder for the scope: layers
+        that the workload cannot hand a ring to directly (the staging
+        slot pipeline) reach it via :func:`active_worker`."""
+        return _Activation(self)
+
+    def worker(self, name: str) -> WorkerFlight:
+        """Get-or-create the ring for ``name`` (creation is locked so
+        worker threads may call this concurrently; each ring still has
+        exactly one appending owner)."""
+        with self._lock:
+            wf = self._workers.get(name)
+            if wf is None:
+                wf = self._workers[name] = WorkerFlight(name, self.capacity)
+            return wf
+
+    def records(self) -> list[dict]:
+        out: list[dict] = []
+        with self._lock:
+            rings = list(self._workers.values())
+        for wf in rings:
+            for r in wf.records():
+                if "host" not in r:
+                    r["host"] = self.host
+                out.append(r)
+        out.sort(key=lambda r: r["phases"].get("enqueue", 0))
+        return out
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            rings = list(self._workers.values())
+        return sum(max(0, wf.total - wf.capacity) for wf in rings)
+
+    def journal(self, extra: Optional[dict] = None) -> dict:
+        doc = {
+            "format": JOURNAL_FORMAT,
+            "host": self.host,
+            "time": time.time(),
+            "dropped": self.dropped,
+            "records": self.records(),
+        }
+        if extra:
+            doc.update(extra)
+        return doc
+
+    def write_journal(self, path: str, extra: Optional[dict] = None) -> str:
+        """Atomic per-host journal write (same torn-JSON-proof discipline
+        as SnapshotWriter)."""
+        doc = self.journal(extra)
+        tmp = f"{path}.tmp"
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+    def summary(self) -> dict:
+        """The RunResult stamp: per-phase p50/p99 + straggler attribution
+        over this host's records."""
+        return timeline_summary(self.records())
+
+
+_active: Optional[FlightRecorder] = None
+
+
+class _Activation:
+    __slots__ = ("_rec", "_prev")
+
+    def __init__(self, rec: FlightRecorder):
+        self._rec = rec
+        self._prev: Optional[FlightRecorder] = None
+
+    def __enter__(self) -> FlightRecorder:
+        global _active
+        self._prev = _active
+        _active = self._rec
+        return self._rec
+
+    def __exit__(self, *exc) -> None:
+        global _active
+        _active = self._prev
+
+
+def active_worker(name: str) -> Optional[WorkerFlight]:
+    """Ring on the run's ambient recorder, or None outside any
+    activation — the staging pipeline's zero-config hookup."""
+    rec = _active
+    return rec.worker(name) if rec is not None else None
+
+
+def host_journal_path(path: str, process_index: int,
+                      process_count: int) -> str:
+    """Per-host journal file: process 0 keeps the bare path (single-host
+    unchanged), others suffix ``.p<idx>`` — the stream-snapshot
+    convention, so one glob collects the pod."""
+    if process_count <= 1 or process_index == 0:
+        return path
+    return f"{path}.p{process_index}"
+
+
+def flight_from_config(cfg) -> Optional[FlightRecorder]:
+    """Recorder per ObservabilityConfig: ``flight_records`` is the
+    per-worker ring capacity (0 disables the layer entirely)."""
+    cap = getattr(cfg.obs, "flight_records", 0)
+    if cap <= 0:
+        return None
+    return FlightRecorder(
+        capacity_per_worker=cap, host=cfg.dist.process_id
+    )
+
+
+def transport_label(cfg) -> str:
+    """One stable per-record transport tag (protocol + receive path)."""
+    t = cfg.transport
+    label = t.protocol
+    if t.http2:
+        label += "+h2"
+    elif t.native_receive:
+        label += "+native"
+    return label
+
+
+# ------------------------------------------------------------ analysis ----
+
+def phase_segments(rec: dict) -> dict[str, int]:
+    """Segment durations (ns) between consecutive present phases,
+    attributed to the later phase, plus ``total`` (last - enqueue)."""
+    ph = rec.get("phases", {})
+    present = [(p, ph[p]) for p in PHASES if p in ph]
+    out: dict[str, int] = {}
+    for (_, t0), (p1, t1) in zip(present, present[1:]):
+        out[p1] = t1 - t0
+    if len(present) >= 2:
+        out["total"] = present[-1][1] - present[0][1]
+    return out
+
+
+def monotone(rec: dict) -> bool:
+    """True when the record's present phases are in non-decreasing
+    timestamp order (the journal invariant the acceptance pins)."""
+    ph = rec.get("phases", {})
+    ts = [ph[p] for p in PHASES if p in ph]
+    return all(a <= b for a, b in zip(ts, ts[1:]))
+
+
+def _phase_stats(records: Iterable[dict]) -> dict[str, dict]:
+    segs: dict[str, list[int]] = {}
+    for rec in records:
+        for name, dur in phase_segments(rec).items():
+            segs.setdefault(name, []).append(dur)
+    order = list(PHASES[1:]) + ["total"]
+    out: dict[str, dict] = {}
+    for name in order:
+        vals = segs.get(name)
+        if not vals:
+            continue
+        s = summarize_ns(np.asarray(vals, dtype=np.int64))
+        out[name] = {
+            "count": s.count,
+            "p50_ms": s.p50_ms,
+            "p99_ms": s.p99_ms,
+        }
+    return out
+
+
+def straggler_attribution(records: list[dict], by: str = "host"
+                          ) -> list[dict]:
+    """Per-``by`` (host/worker/transport) tail ownership over completed
+    records: who owns the slow tail of the total-read latency.
+
+    ``tail_share`` is the fraction of the run's slowest-decile reads
+    owned by the group — an injected per-host delay puts that host's
+    share near 1.0. Rows sort slowest-p99 first, so row 0 IS the
+    straggler. Only "read"-kind records compete (pod-level object spans
+    and staging-slot records measure different quantities and would
+    dominate the tail by construction); when a journal has no read
+    records at all, everything competes."""
+    pool = [r for r in records if r.get("kind", "read") == "read"]
+    if not pool:
+        pool = records
+    totals: list[tuple[object, int]] = []
+    for rec in pool:
+        seg = phase_segments(rec)
+        if "total" in seg and not rec.get("error"):
+            totals.append((rec.get(by, "?"), seg["total"]))
+    if not totals:
+        return []
+    durs = np.asarray([t for _, t in totals], dtype=np.int64)
+    # Slowest decile (at least one read) defines "the tail".
+    k = max(1, len(durs) // 10)
+    tail_cut = np.sort(durs)[-k]
+    tail_total = int((durs >= tail_cut).sum())
+    rows = []
+    for key in sorted({g for g, _ in totals}, key=str):
+        mine = np.asarray([t for g, t in totals if g == key], dtype=np.int64)
+        s = summarize_ns(mine)
+        rows.append({
+            by: key,
+            "count": int(mine.size),
+            "p50_ms": s.p50_ms,
+            "p99_ms": s.p99_ms,
+            "tail_share": float((mine >= tail_cut).sum() / tail_total),
+        })
+    rows.sort(key=lambda r: (-r["p99_ms"], str(r[by])))
+    return rows
+
+
+def timeline_summary(records: list[dict]) -> dict:
+    """Journal → {phases: per-segment p50/p99, stragglers, counts}."""
+    errors = sum(1 for r in records if r.get("error"))
+    retries = sum(
+        1 for r in records for n in r.get("notes", ())
+        if n.get("kind") == "retry"
+    )
+    return {
+        "records": len(records),
+        "errors": errors,
+        "retries": retries,
+        "hosts": sorted({r.get("host", 0) for r in records}),
+        "phases": _phase_stats(records),
+        "stragglers": {
+            "by_host": straggler_attribution(records, by="host"),
+            "by_worker": straggler_attribution(records, by="worker"),
+        },
+    }
+
+
+def merge_journal_docs(docs: Iterable[dict]) -> list[dict]:
+    """Pod-level merge: per-host journal docs → one record list, each
+    record carrying its host (doc-level host stamped onto records that
+    predate the per-record stamp)."""
+    out: list[dict] = []
+    for doc in docs:
+        host = doc.get("host", 0)
+        for rec in doc.get("records", ()):
+            if "host" not in rec:
+                rec = {**rec, "host": host}
+            out.append(rec)
+    out.sort(key=lambda r: r["phases"].get("enqueue", 0))
+    return out
+
+
+def render_timeline(docs: list[dict]) -> str:
+    """The ``tpubench report timeline`` body: per-phase p50/p99 block +
+    straggler tables over the merged journals."""
+    records = merge_journal_docs(docs)
+    summ = timeline_summary(records)
+    dropped = sum(int(d.get("dropped", 0)) for d in docs)
+    lines = [
+        f"== flight timeline: {summ['records']} records, "
+        f"{len(docs)} journal(s), hosts={summ['hosts']} "
+        f"errors={summ['errors']} retries={summ['retries']}"
+        + (f" dropped={dropped}" if dropped else "")
+        + " ==",
+    ]
+    if not records:
+        lines.append("  (no records)")
+        return "\n".join(lines)
+    lines.append("phase segments (ms):")
+    for name, s in summ["phases"].items():
+        lines.append(
+            f"  {name:<16} n={s['count']:<6} p50={s['p50_ms']:9.3f}  "
+            f"p99={s['p99_ms']:9.3f}"
+        )
+    for by in ("host", "worker"):
+        rows = summ["stragglers"][f"by_{by}"]
+        if len(rows) < 2:
+            continue
+        lines.append(f"stragglers by {by} (slowest p99 first):")
+        for r in rows:
+            lines.append(
+                f"  {by}={r[by]!s:<12} n={r['count']:<6} "
+                f"p50={r['p50_ms']:9.3f}  p99={r['p99_ms']:9.3f}  "
+                f"tail_share={r['tail_share']:.2f}"
+            )
+        top = rows[0]
+        lines.append(
+            f"  -> straggler: {by}={top[by]} "
+            f"(p99 {top['p99_ms']:.3f} ms, owns "
+            f"{top['tail_share']:.0%} of the slowest decile)"
+        )
+    return "\n".join(lines)
+
+
+def load_journals(paths: Iterable[str]) -> list[dict]:
+    docs = []
+    for p in paths:
+        with open(p) as f:
+            doc = json.load(f)
+        if doc.get("format") != JOURNAL_FORMAT:
+            raise ValueError(
+                f"{p}: not a flight journal (format="
+                f"{doc.get('format')!r}; expected {JOURNAL_FORMAT!r})"
+            )
+        docs.append(doc)
+    return docs

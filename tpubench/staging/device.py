@@ -43,6 +43,7 @@ import jax.numpy as jnp
 
 from tpubench.config import BenchConfig, StagingConfig
 from tpubench.metrics.recorder import LatencyRecorder
+from tpubench.obs import flight as _flight
 
 
 @jax.jit
@@ -191,6 +192,13 @@ class DevicePutStager(GranuleAggregator):
         self.transfer_wait_ns = 0
         self.put_submit_ns = 0
         self.stage_recorder = LatencyRecorder(f"w{worker_id}/stage")
+        # Flight recorder: one record per SLOT transfer (enqueue →
+        # hbm_staged) on the run's ambient recorder. Slot records are the
+        # honest per-phase hbm_staged source — slots aggregate granules
+        # across reads, so a per-READ hbm_staged stamp would be fiction.
+        # Ring ownership: inline drains run on the fetch thread, threaded
+        # drains on the drainer — exactly one appender either way.
+        self._flight = _flight.active_worker(f"w{worker_id}/stage")
         self._validate = cfg.validate_checksum
         self._host_sum = np.uint64(0)
         self._dev_sum = None
@@ -245,9 +253,15 @@ class DevicePutStager(GranuleAggregator):
                 # Stage latency from ENQUEUE, not dequeue: with overlap
                 # the queueing behind earlier slots is part of the
                 # quantity that sizes the pipeline (module docstring).
-                self.stage_recorder.record_ns(
-                    time.perf_counter_ns() - enqueue_ns
-                )
+                done_ns = time.perf_counter_ns()
+                self.stage_recorder.record_ns(done_ns - enqueue_ns)
+                if self._flight is not None:
+                    op = self._flight.begin(
+                        "slot", "device_put", enqueue_ns=enqueue_ns,
+                        install=False, kind="stage",
+                    )
+                    op.mark("hbm_staged", done_ns)
+                    op.finish(nbytes)
                 self.staged_bytes += nbytes
             except BaseException as e:  # re-raised at the next acquire
                 if self._drain_err is None:
@@ -261,8 +275,16 @@ class DevicePutStager(GranuleAggregator):
             return
         t0 = time.perf_counter_ns()
         fut.block_until_ready()
-        self.transfer_wait_ns += time.perf_counter_ns() - t0
-        self.stage_recorder.record_ns(time.perf_counter_ns() - self._submit_ns[k])
+        done_ns = time.perf_counter_ns()
+        self.transfer_wait_ns += done_ns - t0
+        self.stage_recorder.record_ns(done_ns - self._submit_ns[k])
+        if self._flight is not None:
+            op = self._flight.begin(
+                "slot", "device_put", enqueue_ns=self._submit_ns[k],
+                install=False, kind="stage",
+            )
+            op.mark("hbm_staged", done_ns)
+            op.finish(self._true_bytes[k])
         self.staged_bytes += self._true_bytes[k]
         if self._validate:
             self._dev_sum = _accum_checksum(self._dev_sum, fut)

@@ -134,6 +134,7 @@ class _Conn:
         backend: FakeBackend,
         truncate_body_bytes: Optional[int] = None,
         send_interim_1xx: bool = False,
+        interim_end_stream: bool = False,
     ):
         self.sock = sock
         self.backend = backend
@@ -147,6 +148,11 @@ class _Conn:
         # the response discards the real block's content-length and its
         # truncation check goes blind.
         self.send_interim_1xx = send_interim_1xx
+        # Knob: MALFORMED interim — the 103 block carries END_STREAM
+        # (forbidden by RFC 9113 §8.1). A correct client fails the stream
+        # as a protocol error; a sloppy one "finishes" it with the
+        # truncation check never armed.
+        self.interim_end_stream = interim_end_stream
         self.wlock = threading.Lock()
 
     # ---------------------------------------------------------- frame io --
@@ -336,6 +342,12 @@ class _Conn:
             "content-length", str(len(body))
         )
         try:
+            if self.interim_end_stream:
+                # Malformed: informational block ends the stream.
+                self.send_frame(
+                    1, 0x4 | 0x1, stream, _hp_literal(":status", "103")
+                )
+                return
             if self.send_interim_1xx:
                 self.send_frame(1, 0x4, stream, _hp_literal(":status", "103"))
             self.send_frame(1, 0x4, stream, hb)
@@ -422,6 +434,12 @@ class _Conn:
             "content-length", str(length)
         )
         try:
+            if self.interim_end_stream:
+                # Malformed interim (see __init__): END_STREAM on the 103.
+                self.send_frame(
+                    1, 0x4 | 0x1, stream, _hp_literal(":status", "103")
+                )
+                return
             if self.send_interim_1xx:
                 # Informational block first: END_HEADERS, no END_STREAM,
                 # no content-length — the response block follows.
@@ -476,10 +494,12 @@ class FakeH2Server:
         tls: bool = False,
         truncate_body_bytes: Optional[int] = None,
         send_interim_1xx: bool = False,
+        interim_end_stream: bool = False,
     ):
         self.backend = backend or FakeBackend()
         self.truncate_body_bytes = truncate_body_bytes
         self.send_interim_1xx = send_interim_1xx
+        self.interim_end_stream = interim_end_stream
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("127.0.0.1", port))
@@ -520,6 +540,7 @@ class FakeH2Server:
                     conn, self.backend,
                     truncate_body_bytes=self.truncate_body_bytes,
                     send_interim_1xx=self.send_interim_1xx,
+                    interim_end_stream=self.interim_end_stream,
                 ).serve,
                 daemon=True,
             ).start()

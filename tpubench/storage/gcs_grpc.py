@@ -32,6 +32,7 @@ from typing import Optional
 import grpc
 
 from tpubench.config import TransportConfig
+from tpubench.obs.flight import note_phase as flight_note
 from tpubench.obs.tracing import NoopTracer, SpanCarrier
 from tpubench.storage.base import ObjectMeta, StorageError
 
@@ -385,6 +386,7 @@ class GcsGrpcBackend:
             with self._tracer.span(
                 "gcs_grpc.read_native", object=name, bucket=self.bucket
             ) as sp:
+                flight_note("stream_open")
                 r = engine.grpc_read(
                     conn, f"{host}:{port}", self._bucket_path, name, buf,
                     read_offset=start, read_limit=length or 0,
@@ -600,6 +602,7 @@ class GcsGrpcBackend:
         )
         try:
             stream = self._stub()["read"](req)
+            flight_note("stream_open")
             return _GrpcReader(stream, carrier=carrier)
         except BaseException as e:
             carrier.close(e)

@@ -39,6 +39,7 @@ from typing import Optional
 import time
 
 from tpubench.config import TransportConfig
+from tpubench.obs.flight import note_phase as flight_note
 from tpubench.obs.tracing import NoopTracer, SpanCarrier
 from tpubench.storage.auth import TokenSource, make_token_source
 from tpubench.storage.base import ObjectMeta, StorageError
@@ -75,6 +76,7 @@ class _ConnectionPool:
     def _new_conn(self) -> http.client.HTTPConnection:
         with self._lock:
             self.stats["connects"] += 1
+        flight_note("connect")  # flight-recorder phase (no-op off-op)
         if self._scheme == "https":
             return http.client.HTTPSConnection(
                 self._host, self._port, context=self._ctx, timeout=60
@@ -561,6 +563,7 @@ class GcsHttpBackend:
                 engine.h2_submit_get(
                     conn, authority, req_path, buf, headers=headers
                 )
+                flight_note("stream_open")
                 c = engine.h2_poll(conn)
                 if c is None:
                     raise NativeError("h2 stream vanished", code=-1001)
@@ -768,6 +771,7 @@ class GcsHttpBackend:
             conn, resp = self._checked(
                 "GET", self._opath(name) + "?alt=media", headers=headers
             )
+            flight_note("stream_open")
             carrier.event("response_headers", status=resp.status)
             clen = int(resp.headers.get("Content-Length", "0"))
             return _HttpReader(self._pool, conn, resp, clen, carrier=carrier)
@@ -807,6 +811,12 @@ class GcsHttpBackend:
         carrier = SpanCarrier(
             self._tracer, "gcs_http.get_native", object=name, bucket=self.bucket
         )
+        # Flight stream_open BEFORE begin(): begin() reads the response
+        # headers and stamps the native first_byte — noting afterwards
+        # would order stream_open after first_byte and break the
+        # journal's monotonicity invariant (first-stamp-wins makes this
+        # safe across the stale retransmit below).
+        flight_note("stream_open")
         while True:
             try:
                 r = engine.conn_get_begin(
@@ -817,7 +827,7 @@ class GcsHttpBackend:
                 pool.discard(conn)
                 if reused and e.code not in PERMANENT_CODES:
                     reused = False
-                    pool.note_stale_retry()
+                    pool.note_stale_retry()  # also flight-annotates
                     carrier.event("stale_retry")
                     try:
                         conn = pool.fresh()

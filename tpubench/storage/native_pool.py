@@ -23,6 +23,8 @@ import threading
 from typing import Callable
 
 from tpubench.native.engine import PERMANENT_CODES, NativeError
+from tpubench.obs.flight import annotate as flight_annotate
+from tpubench.obs.flight import note_phase as flight_note
 from tpubench.storage.base import StorageError
 
 
@@ -138,6 +140,7 @@ class NativeConnPool:
         h = self._connect()
         with self._lock:
             self.stats["connects"] += 1
+        flight_note("connect")  # flight-recorder phase (no-op off-op)
         return h
 
     def fresh(self) -> int:
@@ -174,6 +177,7 @@ class NativeConnPool:
     def note_stale_retry(self) -> None:
         with self._lock:
             self.stats["stale_retries"] += 1
+        flight_annotate("retry", reason="stale")
 
     def run(
         self,
@@ -203,8 +207,7 @@ class NativeConnPool:
                 self.engine.conn_close(conn)
                 if reused and retry_stale(e):
                     reused = False
-                    with self._lock:
-                        self.stats["stale_retries"] += 1
+                    self.note_stale_retry()
                     conn = self._new()
                     continue
                 raise

@@ -76,6 +76,12 @@ class _ResumingReader:
                     time.monotonic() - start
                 ) + pause > self._retry.deadline_s:
                     raise
+                from tpubench.obs.flight import annotate as _flight_annotate
+
+                _flight_annotate(
+                    "retry", attempt=attempts, reason="resume",
+                    error=type(exc).__name__,
+                )
                 time.sleep(pause)
                 self._reopen()
                 continue

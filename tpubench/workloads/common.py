@@ -100,6 +100,14 @@ def fetch_shard(backend, name: str, table, shard_index: int, buffer) -> None:
                 break
             got += r
     finally:
+        # Flight-recorder phase: the reader's own stamp (native CLOCK_
+        # MONOTONIC or Python perf_counter — same clock on Linux) lands
+        # on the calling worker's current op; no-op when none is active.
+        fb = getattr(reader, "first_byte_ns", None)
+        if fb:
+            from tpubench.obs.flight import note_phase
+
+            note_phase("first_byte", fb)
         reader.close()
     if got != sh.length:
         raise IOError(f"{name} shard {shard_index}: short fetch {got}/{sh.length}")
@@ -156,13 +164,21 @@ def fetch_shards_mux(backend, cfg, name, table, local_idx, buffers):
     """
     import time as _time
 
-    from tpubench.storage.gcs_grpc import GcsGrpcBackend
+    try:
+        # The gRPC backend needs the generated storage-v2 stubs; their
+        # absence must not break the THREADED fetch path for every other
+        # backend (this import is reachable from all pod workloads).
+        from tpubench.storage.gcs_grpc import GcsGrpcBackend
+    except ImportError:
+        GcsGrpcBackend = None  # type: ignore[assignment,misc]
     from tpubench.storage.gcs_http import GcsHttpBackend
     from tpubench.storage.retry import Backoff, _is_retryable
 
     inner = getattr(backend, "inner", backend)
     supported = (
-        isinstance(inner, GcsGrpcBackend) and inner.transport.native_receive
+        GcsGrpcBackend is not None
+        and isinstance(inner, GcsGrpcBackend)
+        and inner.transport.native_receive
     ) or (isinstance(inner, GcsHttpBackend) and inner.transport.http2)
     if not (supported and len(local_idx) > 0):
         return None

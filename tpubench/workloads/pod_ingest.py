@@ -34,6 +34,11 @@ from tpubench.dist.reassemble import (
 )
 from tpubench.dist.shard import ShardTable
 from tpubench.metrics.report import RunResult
+from tpubench.obs.flight import (
+    flight_from_config,
+    host_journal_path,
+    transport_label,
+)
 from tpubench.storage import open_backend
 from tpubench.storage.base import StorageBackend
 from tpubench.workloads.common import (
@@ -76,9 +81,31 @@ class PodIngestWorkload:
         local_idx = [i for i, d in enumerate(all_devices) if d.process_index == pid]
         buffers = [np.zeros(table.shard_bytes, dtype=np.uint8) for _ in local_idx]
 
-        def fetch(k: int, cancel) -> None:
-            fetch_shard(self.backend, name, table, local_idx[k], buffers[k])
+        # Flight recorder: one record per shard fetch (connect/stream_open/
+        # first_byte emitted down-stack via the thread-local channel) plus
+        # one pod-level record spanning fetch→stage→gather.
+        flight = flight_from_config(self.cfg)
+        tlabel = transport_label(self.cfg)
 
+        def fetch(k: int, cancel) -> None:
+            op = (
+                flight.worker(f"shard{local_idx[k]}").begin(name, tlabel)
+                if flight is not None else None
+            )
+            try:
+                fetch_shard(self.backend, name, table, local_idx[k], buffers[k])
+            except BaseException as e:
+                if op is not None:
+                    op.finish(error=e)
+                raise
+            if op is not None:
+                op.mark("body_complete")
+                op.finish(table.shard(local_idx[k]).length)
+
+        pod_op = (
+            flight.worker("pod").begin(name, tlabel, kind="object")
+            if flight is not None else None
+        )
         t0 = time.perf_counter()
         gres = fetch_shards_mux(
             self.backend, self.cfg, name, table, local_idx, buffers
@@ -88,6 +115,8 @@ class PodIngestWorkload:
                 len(local_idx), fetch, name="fetch"
             )
         t_fetch = time.perf_counter() - t0
+        if pod_op is not None:
+            pod_op.mark("body_complete")
 
         # Failure domains (SURVEY §5.3): with abort_on_error=False a failed
         # shard does not abort the pod — its buffer is zeroed so the gather
@@ -100,6 +129,8 @@ class PodIngestWorkload:
         global_arr = shard_to_device_array(buffers, mesh, self.cfg.dist.mesh_axis, lane)
         jax.block_until_ready(global_arr)
         t_stage = time.perf_counter() - t0
+        if pod_op is not None:
+            pod_op.mark("hbm_staged")
 
         # ---- gather: ICI all-gather (compile excluded via warmup) --------
         fn = (make_ring_reassemble if self.ring else make_reassemble)(
@@ -112,6 +143,8 @@ class PodIngestWorkload:
         gathered, csum = fn(global_arr)
         jax.block_until_ready(gathered)
         t_gather = time.perf_counter() - t0
+        if pod_op is not None:
+            pod_op.mark("gather_complete")
 
         # ---- verify ------------------------------------------------------
         ok = True
@@ -172,6 +205,17 @@ class PodIngestWorkload:
                 "shard_bytes": table.shard_bytes,
             }
         )
+        if pod_op is not None:
+            pod_op.finish(delivered)
+        if flight is not None:
+            res.extra["flight"] = flight.summary()
+            if self.cfg.obs.flight_journal:
+                res.extra["flight_journal"] = flight.write_journal(
+                    host_journal_path(
+                        self.cfg.obs.flight_journal, pid, jax.process_count()
+                    ),
+                    extra={"workload": "pod_ingest"},
+                )
         # One-burst workload: cloud export is a single final flush of the
         # stage-separated numbers (the periodic loop belongs to the long
         # runners — read and stream).

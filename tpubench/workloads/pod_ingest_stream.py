@@ -42,6 +42,11 @@ from tpubench.obs.exporters import (
     SnapshotWriter,
     cloud_exporter_from_config,
 )
+from tpubench.obs.flight import (
+    flight_from_config,
+    host_journal_path,
+    transport_label,
+)
 from tpubench.obs.profiling import annotate
 from tpubench.storage import open_backend
 from tpubench.storage.base import StorageBackend
@@ -83,15 +88,35 @@ class StreamedPodIngest:
         # = N" identifies exactly the first N stream positions.
         self.resume_from = resume_from
         self._progress: dict = {"objects_done": 0, "bytes": 0}
+        # Flight recorder: per-shard fetch records + one record per
+        # streamed object (fetch→stage→gather), journaled per host.
+        self._flight = flight_from_config(cfg)
+        self._tlabel = transport_label(cfg)
 
     def _fetch_local(self, plan: _ObjectPlan, buffers: list[np.ndarray], local_idx):
         w = self.cfg.workload
+        flight = self._flight
 
         def fetch(k: int, cancel) -> None:
             # fetch_shard zeroes the pad tail — essential here because the
             # double-buffer sets are REUSED across objects of differing
             # sizes; stale bytes would otherwise be gathered as padding.
-            fetch_shard(self.backend, plan.name, plan.table, local_idx[k], buffers[k])
+            op = (
+                flight.worker(f"shard{local_idx[k]}").begin(
+                    plan.name, self._tlabel
+                )
+                if flight is not None else None
+            )
+            try:
+                fetch_shard(self.backend, plan.name, plan.table,
+                            local_idx[k], buffers[k])
+            except BaseException as e:
+                if op is not None:
+                    op.finish(error=e)
+                raise
+            if op is not None:
+                op.mark("body_complete")
+                op.finish(plan.table.shard(local_idx[k]).length)
 
         gres = fetch_shards_mux(
             self.backend, self.cfg, plan.name, plan.table, local_idx, buffers
@@ -235,6 +260,26 @@ class StreamedPodIngest:
             else None
         )
 
+        # Flight journal rides the same periodic flush machinery as the
+        # progress snapshots (atomic per-host files; final flush
+        # guaranteed), so a crashed stream still leaves a journal behind.
+        flight = self._flight
+        flight_path = (
+            host_journal_path(
+                self.cfg.obs.flight_journal, pid, jax.process_count()
+            )
+            if flight is not None and self.cfg.obs.flight_journal
+            else None
+        )
+        flight_ctx = (
+            SnapshotWriter(
+                flight.journal, flight_path, interval_s=5.0,
+                process_index=pid,
+            )
+            if flight_path
+            else None
+        )
+
         # In-run cloud export (metrics_exporter.go:36-58): stream progress
         # gauges every metrics_interval_s during the run + final flush — a
         # 30-minute stream emits series long before it finishes.
@@ -256,16 +301,39 @@ class StreamedPodIngest:
         try:
             if snap_ctx:
                 snap_ctx.__enter__()
+            if flight_ctx:
+                flight_ctx.__enter__()
             if cloud_exp is not None:
                 cloud_periodic = PeriodicExporter(
                     flush_progress, self.cfg.obs.metrics_interval_s
                 ).start()
 
             def timed_fetch(k: int):
+                # Object-level flight op opened HERE (the fetch thread):
+                # the mux fetch path's connect/retry notes attach to it
+                # via the thread-local channel; the main loop stamps the
+                # stage/gather phases after the future resolves.
+                op = (
+                    flight.worker("stream").begin(
+                        plans[k].name, self._tlabel, kind="object"
+                    )
+                    if flight is not None else None
+                )
                 t0 = time.perf_counter()
                 with annotate(f"fetch/obj{k}"):
-                    holes = self._fetch_local(plans[k], buffer_sets[k % 2], local_idx)
-                return time.perf_counter() - t0, holes
+                    # On failure the op is deliberately NOT finished here:
+                    # the "stream" ring's one appending owner is the main
+                    # loop (finish after gather), and a pool-thread append
+                    # could race it while it finishes the previous object.
+                    # The exception aborts the run via the future; the
+                    # in-flight record is dropped, never corrupted (the
+                    # per-shard error records from _fetch_local survive).
+                    holes = self._fetch_local(
+                        plans[k], buffer_sets[k % 2], local_idx
+                    )
+                if op is not None:
+                    op.mark("body_complete")
+                return time.perf_counter() - t0, holes, op
 
             pending = (
                 pool.submit(timed_fetch, start_k)
@@ -273,7 +341,8 @@ class StreamedPodIngest:
                 else None
             )
             for k in range(start_k, self.n_objects):
-                dt, holes = pending.result()  # object k's shards are on host
+                # Object k's shards are on host.
+                dt, holes, obj_op = pending.result()
                 fetch_s += dt
                 # Pod-wide totals (collective over DCN when multi-host —
                 # called unconditionally so every process participates).
@@ -294,6 +363,8 @@ class StreamedPodIngest:
                     jax.block_until_ready(arr)
                 t1 = time.perf_counter()
                 stage_s += t1 - t0
+                if obj_op is not None:
+                    obj_op.mark("hbm_staged")
                 shape_key = arr.shape
                 if shape_key not in compiled_shapes:
                     jax.block_until_ready(reassemble(arr))  # compile, uncounted
@@ -303,6 +374,9 @@ class StreamedPodIngest:
                     gathered, csum = reassemble(arr)
                     jax.block_until_ready(gathered)
                 gather_s += time.perf_counter() - t1
+                if obj_op is not None:
+                    obj_op.mark("gather_complete")
+                    obj_op.finish(plan.size - ghole["bytes"])
                 # Delivered bytes only: holes moved nothing (see pod_ingest);
                 # pod-wide totals so another host's failure counts here too.
                 total_bytes += plan.size - ghole["bytes"]
@@ -332,6 +406,8 @@ class StreamedPodIngest:
             pool.shutdown(wait=False, cancel_futures=True)
             if snap_ctx:
                 snap_ctx.__exit__(None, None, None)
+            if flight_ctx:
+                flight_ctx.__exit__(None, None, None)  # final journal flush
             if cloud_periodic is not None:
                 cloud_periodic.close()  # guaranteed final flush
                 cloud_exp.close()
@@ -374,6 +450,10 @@ class StreamedPodIngest:
         )
         if cloud_exp is not None:
             res.extra["metrics_export"] = cloud_exp.summary(cloud_periodic)
+        if flight is not None:
+            res.extra["flight"] = flight.summary()
+            if flight_path:
+                res.extra["flight_journal"] = flight_path
         return res
 
 

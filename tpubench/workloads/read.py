@@ -22,6 +22,7 @@ Deltas over the reference (the north star):
 
 from __future__ import annotations
 
+import contextlib
 import time
 from dataclasses import dataclass
 from typing import Callable, Optional, Protocol
@@ -29,6 +30,11 @@ from typing import Callable, Optional, Protocol
 from tpubench.config import BenchConfig
 from tpubench.metrics import MetricSet
 from tpubench.metrics.report import RunResult
+from tpubench.obs.flight import (
+    flight_from_config,
+    host_journal_path,
+    transport_label,
+)
 from tpubench.obs.tracing import NoopTracer, Tracer
 from tpubench.storage import open_backend
 from tpubench.storage.base import (
@@ -81,9 +87,25 @@ class ReadWorkload:
         worker_bytes = [0] * n
         sink_stats: list[dict] = [{} for _ in range(n)]
         zero_copy_used = [False] * n
+        # Flight recorder (obs/flight.py): per-worker record rings, one
+        # structured phase record per read — same worker-owned-array
+        # race-freedom as the latency recorders above.
+        flight = flight_from_config(self.cfg)
+        tlabel = transport_label(self.cfg)
+        flights = [
+            flight.worker(f"w{i}") if flight is not None else None
+            for i in range(n)
+        ]
+        # Native transport counters (tb_stats_*): delta across the run is
+        # folded into the result/journal when the engine is live.
+        from tpubench.native.engine import peek_engine
+
+        eng0 = peek_engine()
+        native_stats0 = eng0.stats() if eng0 is not None else {}
 
         def worker(i: int, cancel) -> None:
             read_rec, fb_rec = recorders[i]
+            wf = flights[i]
             name = f"{w.object_name_prefix}{i}"  # main.go:121
             sink = self.sink_factory(i) if self.sink_factory else None
             # Zero-copy route: fetch lands bytes directly in the staging
@@ -110,20 +132,34 @@ class ReadWorkload:
                         "ReadObject", bucket=w.bucket, object=name
                     ) as span:
                         t0 = time.perf_counter_ns()
-                        reader = self.backend.open_read(name)
-                        if zero_copy:
-                            nbytes, fb_ns = read_object_into_sink(
-                                reader, sink, w.granule_bytes
-                            )
-                        else:
-                            nbytes, fb_ns = read_object_through(
-                                reader, granule, submit
-                            )
-                        t1 = time.perf_counter_ns()
+                        op = (
+                            wf.begin(name, tlabel, enqueue_ns=t0)
+                            if wf is not None else None
+                        )
+                        try:
+                            reader = self.backend.open_read(name)
+                            if zero_copy:
+                                nbytes, fb_ns = read_object_into_sink(
+                                    reader, sink, w.granule_bytes
+                                )
+                            else:
+                                nbytes, fb_ns = read_object_through(
+                                    reader, granule, submit
+                                )
+                            t1 = time.perf_counter_ns()
+                        except BaseException as e:
+                            if op is not None:
+                                op.finish(error=e)
+                            raise
                         read_rec.record_ns(t1 - t0)
                         if fb_ns is not None:
                             fb_rec.record_ns(fb_ns - t0)
                             span.event("first_byte")
+                        if op is not None:
+                            if fb_ns is not None:
+                                op.mark("first_byte", fb_ns)
+                            op.mark("body_complete", t1)
+                            op.finish(nbytes)
                         total_local += nbytes
                         # Single-writer slot: the periodic exporter reads a
                         # live pod-progress sum without shared hot-loop state.
@@ -145,7 +181,12 @@ class ReadWorkload:
             if session is not None:
                 session.__enter__()
             try:
-                gres = group.run(n, worker, name="read")
+                # Ambient flight recorder: the staging slot pipeline
+                # (constructed inside the workers) attaches its per-slot
+                # hbm_staged records to the same journal.
+                with (flight.activate() if flight is not None
+                      else contextlib.nullcontext()):
+                    gres = group.run(n, worker, name="read")
                 result_errors = gres.error_count
             finally:
                 metrics.ingest.stop()
@@ -218,6 +259,30 @@ class ReadWorkload:
         checks = [st["checksum_ok"] for st in sink_stats if "checksum_ok" in st]
         if checks:
             res.extra["checksum_ok"] = all(checks)
+        # Flight recorder: phase-breakdown summary stamped into the result
+        # (so BENCH trajectories carry per-phase p50/p99), native transport
+        # counter deltas folded in, per-host journal written when asked.
+        eng1 = peek_engine()
+        native_delta = None
+        if eng1 is not None:
+            stats1 = eng1.stats()
+            native_delta = {
+                k: v - native_stats0.get(k, 0) for k, v in stats1.items()
+            }
+            if any(native_delta.values()):
+                res.extra["native_transport"] = native_delta
+        if flight is not None:
+            res.extra["flight"] = flight.summary()
+            jpath = self.cfg.obs.flight_journal
+            if jpath:
+                d = self.cfg.dist
+                extra = {"workload": "read"}
+                if native_delta:
+                    extra["native_transport"] = native_delta
+                res.extra["flight_journal"] = flight.write_journal(
+                    host_journal_path(jpath, d.process_id, d.num_processes),
+                    extra=extra,
+                )
         return res
 
 

@@ -194,6 +194,16 @@ def multichip_block(d: dict, label: str = "") -> str:
     return "\n".join(lines)
 
 
+def run_timeline(paths: list[str]) -> str:
+    """``tpubench report timeline <journal...>`` — merge per-host flight
+    journals (obs/flight.py) into the pod-level per-phase p50/p99 report
+    with straggler attribution. One file = single-host timeline; many =
+    the cross-host aggregation pass."""
+    from tpubench.obs.flight import load_journals, render_timeline
+
+    return render_timeline(load_journals(paths))
+
+
 def run_report(paths: list[str]) -> str:
     """Load result/sweep/bench JSONs and render the full report."""
     runs: list[dict] = []
@@ -203,6 +213,13 @@ def run_report(paths: list[str]) -> str:
             doc = json.load(f)
         if isinstance(doc, list):  # a sweep cells file
             chunks.append(sweep_table(doc))
+            continue
+        if doc.get("format") == "tpubench-flight-v1":
+            # A flight journal handed to the plain report renders as a
+            # single-host timeline (same body as `report timeline`).
+            from tpubench.obs.flight import render_timeline
+
+            chunks.append(render_timeline([doc]))
             continue
         if "metric" in doc:  # a bench.py output line saved to a file
             chunks.append(bench_block(doc, label=f"({p})"))
