@@ -68,26 +68,14 @@ from tpubench import bench_report as br
 
 
 def _parse_sleep_scale() -> float:
-    """Validated TPUBENCH_BENCH_SLEEP_SCALE: a clear one-line rejection
-    for non-numeric or negative values instead of an import-time
-    ValueError traceback / a silently disabled sleep (negative values
-    would make every `_sleep` a no-op without saying so)."""
-    raw = os.environ.get("TPUBENCH_BENCH_SLEEP_SCALE", "")
-    if not raw:
-        return 1.0
-    try:
-        v = float(raw)
-    except ValueError:
-        raise SystemExit(
-            f"TPUBENCH_BENCH_SLEEP_SCALE={raw!r}: expected a non-negative "
-            "number (0 disables refill sleeps; 1 keeps them full-length)"
-        ) from None
-    if v < 0 or v != v:  # reject negatives and NaN alike
-        raise SystemExit(
-            f"TPUBENCH_BENCH_SLEEP_SCALE={raw!r}: must be >= 0 "
-            "(0 disables refill sleeps; got a negative/NaN value)"
-        )
-    return v
+    """Validated TPUBENCH_BENCH_SLEEP_SCALE (shared definition in
+    tpubench.config so the chaos workload's timeline scaling accepts
+    exactly the same values): a clear one-line rejection for non-numeric
+    or negative values instead of an import-time ValueError traceback /
+    a silently disabled sleep."""
+    from tpubench.config import parse_sleep_scale
+
+    return parse_sleep_scale("refill sleeps")
 
 
 _SLEEP_SCALE = _parse_sleep_scale()

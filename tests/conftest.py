@@ -39,3 +39,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "flight: flight-recorder observability tests"
     )
+    # Chaos tests (tail tolerance + scheduled fault timelines) stay in
+    # tier-1 — same policy as `flight`: not slow-marked, so the
+    # resilience layer is exercised on every pass; the marker exists for
+    # selective runs (`-m chaos`).
+    config.addinivalue_line(
+        "markers", "chaos: tail-tolerance / fault-timeline tests"
+    )

@@ -342,3 +342,101 @@ def test_cli_sweep_http1_vs_http2(tmp_path, capsys):
     rows = json.loads(capsys.readouterr().out)
     assert [r["protocol"] for r in rows] == ["http", "http2"]
     assert all(r["gbps"] > 0 for r in rows)
+
+
+def test_cli_rejects_out_of_range_fault_rates(tmp_path):
+    with pytest.raises(SystemExit) as ei:
+        main([
+            "read", "--protocol", "fake", "--fault-error-rate", "1.5",
+        ])
+    assert "error_rate" in str(ei.value) and "[0, 1]" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        main([
+            "read", "--protocol", "fake", "--fault-stall-s", "-2",
+        ])
+    assert "stall_s" in str(ei.value)
+
+
+def test_cli_tail_flags_build_config(tmp_path):
+    from tpubench.cli import build_config, main as _main
+    cfg_path = tmp_path / "cfg.json"
+    rc = main([
+        "read", "--protocol", "fake", "--hedge", "--hedge-delay", "0.02",
+        "--hedge-from-p99", "--watchdog", "--stall-window", "0.5",
+        "--stall-floor-bps", "2048", "--breaker", "--breaker-failures", "3",
+        "--breaker-reset", "1.5", "--fault-stall-s", "0.1",
+        "--fault-stall-rate", "0.25",
+        "--save-config", str(cfg_path),
+    ])
+    assert rc == 0
+    from tpubench.config import BenchConfig
+    cfg = BenchConfig.from_json(cfg_path.read_text())
+    t = cfg.transport.tail
+    assert t.hedge and t.hedge_from_p99 and t.watchdog and t.breaker
+    assert t.hedge_delay_s == 0.02
+    assert t.stall_window_s == 0.5
+    assert t.stall_floor_bps == 2048
+    assert t.breaker_failures == 3 and t.breaker_reset_s == 1.5
+    assert cfg.transport.fault.stall_s == 0.1
+    assert cfg.transport.fault.stall_rate == 0.25
+
+
+def test_cli_chaos_timeline_builders(tmp_path):
+    import argparse
+
+    from tpubench.cli import chaos_timeline_from_args
+
+    ns = argparse.Namespace(
+        chaos_timeline=None, chaos_fault="stall", chaos_start=1.0,
+        chaos_duration=2.0, fault_stall_s=0.25, fault_stall_rate=0.5,
+        fault_stall_after_bytes=None,
+    )
+    tl = chaos_timeline_from_args(ns)
+    assert tl == [[1.0, 3.0, {
+        "stall_s": 0.25, "stall_rate": 0.5, "stall_after_bytes": 0,
+    }]]
+    ns.chaos_fault = "blackhole"
+    assert chaos_timeline_from_args(ns)[0][2]["stall_s"] == 3600.0
+    # Explicit JSON wins over the shorthand; @file form loads from disk.
+    ns.chaos_timeline = '[[0.5, 1.0, {"drip_bps": 100}]]'
+    assert chaos_timeline_from_args(ns) == [[0.5, 1.0, {"drip_bps": 100}]]
+    p = tmp_path / "tl.json"
+    p.write_text('[[0.1, 0.2, {"error_rate": 1.0}]]')
+    ns.chaos_timeline = f"@{p}"
+    assert chaos_timeline_from_args(ns) == [[0.1, 0.2, {"error_rate": 1.0}]]
+    ns.chaos_timeline = "{not json"
+    with pytest.raises(SystemExit, match="invalid JSON"):
+        chaos_timeline_from_args(ns)
+
+
+def test_cli_chaos_end_to_end(tmp_path, capsys):
+    """`tpubench chaos` against the fake backend: hedged run under a
+    scheduled stall window, scorecard printed and stamped in the result."""
+    # Sizing: 80 reads x ≥10 ms injected pacing ≈ 0.8 s per worker even
+    # on an unloaded machine — comfortably outlasting the [0.1, 0.4] s
+    # fault window (timeline_covered must hold un-flakily).
+    rc = main([
+        "chaos", "--protocol", "fake", "--workers", "2",
+        "--read-call-per-worker", "80", "--object-size", "65536",
+        "--staging", "none", "--export", "none",
+        "--fault-per-read-latency", "0.01",
+        "--hedge", "--hedge-delay", "0.02", "--watchdog",
+        "--stall-window", "0.6",
+        "--chaos-fault", "stall", "--fault-stall-s", "0.05",
+        "--fault-stall-rate", "0.6",
+        "--chaos-start", "0.1", "--chaos-duration", "0.3",
+        "--results-dir", str(tmp_path),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "resilience scorecard" in out
+    assert "goodput retention" in out
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+    assert len(files) == 1
+    with open(tmp_path / files[0]) as f:
+        data = json.load(f)
+    assert data["workload"] == "chaos"
+    assert data["errors"] == 0
+    sc = data["extra"]["chaos"]["scorecard"]
+    assert sc["failed_reads"] == 0
+    assert sc["timeline_covered"]

@@ -44,3 +44,58 @@ def test_smoke_preset_is_hermetic():
     cfg = preset("smoke")
     assert cfg.transport.protocol == "fake"
     assert cfg.workload.object_size <= 8 * MB
+
+
+def test_fault_and_tail_roundtrip():
+    cfg = BenchConfig()
+    fc = cfg.transport.fault
+    fc.stall_s = 0.5
+    fc.stall_rate = 0.3
+    fc.drip_bps = 1024.0
+    fc.phases = [[1.0, 2.0, {"error_rate": 1.0}]]
+    cfg.transport.tail.hedge = True
+    cfg.transport.tail.hedge_delay_s = 0.02
+    cfg.transport.tail.breaker = True
+    cfg2 = BenchConfig.from_json(cfg.to_json())
+    assert cfg2.transport.fault.stall_s == 0.5
+    assert cfg2.transport.fault.phases == [[1.0, 2.0, {"error_rate": 1.0}]]
+    assert cfg2.transport.tail.hedge and cfg2.transport.tail.breaker
+    assert cfg2.transport.tail.hedge_delay_s == 0.02
+    assert cfg2.to_dict() == cfg.to_dict()
+
+
+def test_fault_config_active_includes_chaos_fields():
+    from tpubench.config import FaultConfig
+
+    assert not FaultConfig().active
+    assert FaultConfig(stall_s=1.0).active
+    assert FaultConfig(drip_bps=10.0).active
+    assert FaultConfig(truncate_after_bytes=1).active
+    assert FaultConfig(reset_after_bytes=1).active
+    assert FaultConfig(phases=[[0, 1, {"error_rate": 1.0}]]).active
+
+
+def test_validate_fault_config_rejects_bad_values():
+    import pytest
+
+    from tpubench.config import FaultConfig, validate_fault_config
+
+    validate_fault_config(FaultConfig())  # defaults are fine
+    for kwargs, needle in (
+        ({"error_rate": 1.5}, "error_rate"),
+        ({"read_error_rate": -0.1}, "read_error_rate"),
+        ({"stall_rate": 2.0}, "stall_rate"),
+        ({"latency_s": -1.0}, "latency_s"),
+        ({"stall_s": -0.5}, "stall_s"),
+        ({"drip_bps": -1.0}, "drip_bps"),
+        ({"phases": [[-1.0, 2.0, {}]]}, "phases[0]"),
+        ({"phases": [[2.0, 1.0, {}]]}, "phases[0]"),
+        ({"phases": [[0.0, 1.0, {"nope": 1}]]}, "nope"),
+        ({"phases": [[0.0, 1.0, {"error_rate": 7}]]}, "error_rate"),
+        ({"phases": [["x", 1.0, {}]]}, "numeric"),
+        ({"phases": [[0.0, 1.0]]}, "expected"),
+        ({"phases": [[0.0, 1.0, {"phases": []}]]}, "phases"),
+    ):
+        with pytest.raises(SystemExit) as ei:
+            validate_fault_config(FaultConfig(**kwargs), "fault")
+        assert needle in str(ei.value)

@@ -92,3 +92,145 @@ def test_metadata_ops_retried():
     assert len(rb.list("f/")) == 2
     rb.write("g", b"x")
     rb.delete("g")
+
+
+# ------------------------------------------- consecutive-failure budget --
+
+
+class _ScriptedFaultBackend:
+    """Every opened reader delivers one 1 KB granule per readinto and
+    raises a transient fault on scripted per-open call numbers —
+    deterministic interleaving of progress and faults."""
+
+    def __init__(self, size: int, fail_calls=()):
+        self.inner = FakeBackend.prepopulated("f/", count=1, size=size)
+        self.fail_calls = set(fail_calls)
+        self.opens = 0
+
+    def open_read(self, name, start=0, length=None):
+        self.opens += 1
+        r = self.inner.open_read(name, start, length)
+        calls = [0]
+        orig = r.readinto
+
+        def scripted(buf):
+            calls[0] += 1
+            if calls[0] in self.fail_calls:
+                raise StorageError("scripted transient", transient=True)
+            return orig(buf[:1024])
+
+        r.readinto = scripted
+        return r
+
+    def close(self):
+        self.inner.close()
+
+
+def test_attempts_reset_once_bytes_flow():
+    """A long stream with ONE recovering fault per reopen never exhausts
+    max_attempts: the consecutive-failure budget resets as soon as bytes
+    flow again (the chaos plane's sporadic-fault shape)."""
+    size = 32 * 1024  # 32 granules; every reader faults after 4 granules
+    sb = _ScriptedFaultBackend(size, fail_calls={5})
+    rb = RetryingBackend(
+        sb, RetryConfig(jitter=False, initial_backoff_s=0.0,
+                        max_backoff_s=0.0, max_attempts=2),
+        sleep=lambda s: None,
+    )
+    # Each reader streams 4 granules then faults; the resumed reader
+    # streams 4 more then faults again — 7 faults over the stream, every
+    # one at consecutive-count 1 < 2 because flowing bytes reset the
+    # budget. (A cumulative counter would exhaust max_attempts=2 at the
+    # second fault despite every fault having recovered.)
+    got = bytearray()
+    total, _ = read_object_through(
+        rb.open_read("f/0"), memoryview(bytearray(1024)),
+        sink=lambda mv: got.extend(mv),
+    )
+    assert total == size
+    assert bytes(got) == deterministic_bytes("f/0", size).tobytes()
+    assert sb.opens >= 7  # the fault really fired on every resume
+
+
+def test_consecutive_failures_still_exhaust_budget():
+    """Zero-progress fault loops are still bounded: two consecutive
+    failures with max_attempts=2 raise."""
+    sb = _ScriptedFaultBackend(8 * 1024, fail_calls=set(range(1, 100)))
+    rb = RetryingBackend(
+        sb, RetryConfig(jitter=False, initial_backoff_s=0.0,
+                        max_backoff_s=0.0, max_attempts=2),
+        sleep=lambda s: None,
+    )
+    r = rb.open_read("f/0")
+    with pytest.raises(StorageError):
+        r.readinto(memoryview(bytearray(1024)))
+
+
+def test_resume_uses_injected_sleep_clock_rng():
+    """The resume path is fully deterministic under injected primitives:
+    no real sleeping, pauses drawn from the seeded rng, deadline measured
+    on the fake clock."""
+    import random
+
+    sleeps = []
+    clock_t = [0.0]
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        clock_t[0] += s
+
+    be = FakeBackend.prepopulated(
+        "f/", count=1, size=100_000,
+        fault=FaultPlan(read_error_rate=0.3, seed=5),
+    )
+    rb = RetryingBackend(
+        be, RetryConfig(jitter=True, initial_backoff_s=1.0, max_attempts=100),
+        rng=random.Random(42), sleep=fake_sleep, clock=lambda: clock_t[0],
+    )
+    import time as _time
+
+    t0 = _time.perf_counter()
+    total, _ = read_object_through(
+        rb.open_read("f/0"), memoryview(bytearray(8 * 1024))
+    )
+    wall = _time.perf_counter() - t0
+    assert total == 100_000
+    assert sleeps, "faults must have routed through the injected sleep"
+    assert wall < 1.0  # seconds of nominal backoff, zero real sleeping
+    # Seeded rng ⇒ the exact pause sequence reproduces.
+    sleeps2 = []
+    rb2 = RetryingBackend(
+        FakeBackend.prepopulated(
+            "f/", count=1, size=100_000,
+            fault=FaultPlan(read_error_rate=0.3, seed=5),
+        ),
+        RetryConfig(jitter=True, initial_backoff_s=1.0, max_attempts=100),
+        rng=random.Random(42),
+        sleep=lambda s: sleeps2.append(s), clock=lambda: 0.0,
+    )
+    read_object_through(rb2.open_read("f/0"), memoryview(bytearray(8 * 1024)))
+    assert sleeps2 == sleeps
+
+
+def test_resume_deadline_on_injected_clock():
+    """deadline_s is enforced on the injected clock across a zero-progress
+    fault loop (no real time passes)."""
+    clock_t = [0.0]
+
+    def fake_sleep(s):
+        clock_t[0] += s
+
+    be = FakeBackend.prepopulated(
+        "f/", count=1, size=10_000,
+        fault=FaultPlan(read_error_rate=1.0, seed=3),
+    )
+    rb = RetryingBackend(
+        be, RetryConfig(jitter=False, initial_backoff_s=1.0,
+                        multiplier=1.0, max_backoff_s=1.0, deadline_s=5.0),
+        sleep=fake_sleep, clock=lambda: clock_t[0],
+    )
+    r = rb.open_read("f/0")
+    with pytest.raises(StorageError):
+        while r.readinto(memoryview(bytearray(1024))) > 0:
+            pass
+    assert clock_t[0] <= 5.0

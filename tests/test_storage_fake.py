@@ -86,3 +86,100 @@ def test_read_object_through_granules():
 def test_iter_ranges():
     assert list(iter_ranges(10, 4)) == [(0, 4), (4, 4), (8, 2)]
     assert list(iter_ranges(0, 4)) == []
+
+
+# ---------------------------------------------------- chaos-plane faults --
+
+
+def _drain(reader, chunk=4096):
+    buf = bytearray(chunk)
+    got = bytearray()
+    while True:
+        n = reader.readinto(memoryview(buf))
+        if n <= 0:
+            return bytes(got)
+        got.extend(buf[:n])
+
+
+def test_truncate_fault_clean_eof_short_of_length():
+    be = FakeBackend.prepopulated(
+        "f/", count=1, size=50_000,
+        fault=FaultPlan(truncate_after_bytes=12_288),
+    )
+    got = _drain(be.open_read("f/0"))
+    # Clean EOF once the threshold is crossed (whole granules deliver, so
+    # the cut lands on the first boundary at/after the threshold).
+    assert 12_288 <= len(got) < 50_000
+    assert got == deterministic_bytes("f/0", 50_000).tobytes()[: len(got)]
+
+
+def test_reset_fault_transient_after_threshold():
+    import pytest
+
+    be = FakeBackend.prepopulated(
+        "f/", count=1, size=50_000, fault=FaultPlan(reset_after_bytes=8_192),
+    )
+    r = be.open_read("f/0")
+    buf = bytearray(8_192)
+    assert r.readinto(memoryview(buf)) == 8_192
+    with pytest.raises(StorageError) as ei:
+        r.readinto(memoryview(buf))
+    assert ei.value.transient and ei.value.code == 104
+
+
+def test_stall_fault_pauses_once_per_reader():
+    import time
+
+    be = FakeBackend.prepopulated(
+        "f/", count=1, size=20_000,
+        fault=FaultPlan(stall_after_bytes=4_096, stall_s=0.08),
+    )
+    t0 = time.perf_counter()
+    got = _drain(be.open_read("f/0"))
+    elapsed = time.perf_counter() - t0
+    assert got == deterministic_bytes("f/0", 20_000).tobytes()
+    assert elapsed >= 0.08  # exactly one stall, after the byte threshold
+    assert elapsed < 0.3
+
+
+def test_stall_rate_zero_never_stalls():
+    import time
+
+    be = FakeBackend.prepopulated(
+        "f/", count=1, size=20_000,
+        fault=FaultPlan(stall_s=5.0, stall_rate=0.0),
+    )
+    t0 = time.perf_counter()
+    _drain(be.open_read("f/0"))
+    assert time.perf_counter() - t0 < 1.0
+
+
+def test_drip_fault_caps_throughput():
+    import time
+
+    size = 16_384
+    be = FakeBackend.prepopulated(
+        "f/", count=1, size=size, fault=FaultPlan(drip_bps=128 * 1024),
+    )
+    t0 = time.perf_counter()
+    got = _drain(be.open_read("f/0"))
+    elapsed = time.perf_counter() - t0
+    assert got == deterministic_bytes("f/0", size).tobytes()
+    assert elapsed >= size / (128 * 1024) * 0.8  # paced to ~the cap
+
+
+def test_phase_schedule_shapes_midstream_reads():
+    """A stall phase switching on MID-STREAM shapes a reader that was
+    opened before the phase began (the root plan is resolved per
+    readinto, not snapshotted at open)."""
+    t = [0.0]
+    plan = FaultPlan(phases=[(1.0, 2.0, {"truncate_after_bytes": 1})])
+    plan.arm(clock=lambda: t[0])
+    be = FakeBackend.prepopulated("f/", count=1, size=40_000, fault=plan)
+    r = be.open_read("f/0")
+    buf = bytearray(4096)
+    assert r.readinto(memoryview(buf)) == 4096  # base plan: clean
+    t[0] = 1.5  # phase on: truncation threshold already crossed
+    assert r.readinto(memoryview(buf)) == 0
+    t[0] = 2.5  # phase off again: the stream resumes delivering
+    assert r.readinto(memoryview(buf)) == 4096

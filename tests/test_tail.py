@@ -1,0 +1,542 @@
+"""Tail-tolerance layer (storage/tail.py): stall watchdog with a
+deterministic clock, hedge win/lose/cancel paths, circuit breaker state
+machine, and composition with the resume path in RetryingBackend."""
+
+import threading
+import time
+
+import pytest
+
+from tpubench.config import RetryConfig, TailConfig
+from tpubench.storage import FakeBackend, FaultPlan, RetryingBackend, StorageError
+from tpubench.storage.base import deterministic_bytes, read_object_through
+from tpubench.storage.retry import _is_retryable
+from tpubench.storage.tail import (
+    BreakerBackend,
+    CircuitBreaker,
+    CircuitOpenError,
+    HedgedBackend,
+    StallError,
+    WatchdogBackend,
+    WatchdogReader,
+    collect_tail_stats,
+    wrap_tail,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class ScriptedReader:
+    """Returns scripted chunk sizes; advances an optional clock per call."""
+
+    def __init__(self, chunks, clock=None, dt=0.0):
+        self.chunks = list(chunks)
+        self.clock = clock
+        self.dt = dt
+        self.first_byte_ns = None
+        self.closed = False
+
+    def readinto(self, buf):
+        if self.clock is not None:
+            self.clock.advance(self.dt)
+        if not self.chunks:
+            return 0
+        item = self.chunks.pop(0)
+        if isinstance(item, BaseException):
+            raise item
+        n = min(len(buf), item)
+        buf[:n] = b"x" * n
+        if self.first_byte_ns is None:
+            self.first_byte_ns = time.perf_counter_ns()
+        return n
+
+    def close(self):
+        self.closed = True
+
+
+# ------------------------------------------------------------ StallError --
+
+
+def test_stall_error_is_transient_and_retryable():
+    e = StallError("slow")
+    assert isinstance(e, StorageError)
+    assert e.transient
+    assert _is_retryable(e, "always")
+    assert _is_retryable(e, "idempotent")
+    assert not _is_retryable(e, "never")
+
+
+# -------------------------------------------------------------- watchdog --
+
+
+def test_watchdog_raises_stall_on_slow_reader():
+    clock = FakeClock()
+    inner = ScriptedReader([100] * 50, clock=clock, dt=1.0)
+    r = WatchdogReader(inner, window_s=3.0, floor_bps=1000.0, clock=clock)
+    buf = memoryview(bytearray(4096))
+    with pytest.raises(StallError):
+        for _ in range(50):
+            r.readinto(buf)
+    assert inner.closed  # the stalled stream was cancelled
+
+
+def test_watchdog_leaves_healthy_stream_alone():
+    clock = FakeClock()
+    inner = ScriptedReader([4096] * 20, clock=clock, dt=1.0)  # 4 KB/s > floor
+    r = WatchdogReader(inner, window_s=3.0, floor_bps=1000.0, clock=clock)
+    buf = memoryview(bytearray(4096))
+    total = 0
+    while True:
+        n = r.readinto(buf)
+        if n == 0:
+            break
+        total += n
+    assert total == 20 * 4096
+
+
+def test_watchdog_eof_is_not_a_stall():
+    clock = FakeClock()
+    inner = ScriptedReader([10], clock=clock, dt=10.0)  # slow, then EOF
+    r = WatchdogReader(inner, window_s=1.0, floor_bps=1e6, clock=clock)
+    buf = memoryview(bytearray(64))
+    with pytest.raises(StallError):
+        r.readinto(buf)  # first chunk: below floor over a full window
+    # A reader that EOFs immediately never stalls.
+    r2 = WatchdogReader(
+        ScriptedReader([], clock=clock, dt=10.0),
+        window_s=1.0, floor_bps=1e6, clock=clock,
+    )
+    assert r2.readinto(buf) == 0
+
+
+def test_watchdog_stall_resumes_under_retrying_backend():
+    """StallError is transient: the resume path reopens at offset and the
+    stream completes with exact bytes."""
+    clock = FakeClock()
+    size = 200_000
+
+    class SlowThenFineBackend:
+        def __init__(self):
+            self.inner = FakeBackend.prepopulated("f/", count=1, size=size)
+            self.opens = 0
+
+        def open_read(self, name, start=0, length=None):
+            self.opens += 1
+            r = self.inner.open_read(name, start, length)
+            if self.opens == 1:
+                # First stream crawls: 10 B per call, 1 s per call.
+                orig = r.readinto
+
+                def slow_readinto(buf):
+                    clock.advance(1.0)
+                    return orig(buf[:10])
+
+                r.readinto = slow_readinto
+            return r
+
+        def close(self):
+            self.inner.close()
+
+    sb = SlowThenFineBackend()
+    wd = WatchdogBackend(sb, TailConfig(
+        watchdog=True, stall_window_s=2.0, stall_floor_bps=1000.0,
+    ), clock=clock)
+    rb = RetryingBackend(
+        wd, RetryConfig(jitter=False, initial_backoff_s=0.0,
+                        max_backoff_s=0.0, max_attempts=10),
+        sleep=lambda s: None, clock=clock,
+    )
+    got = bytearray()
+    total, _ = read_object_through(
+        rb.open_read("f/0"), memoryview(bytearray(32 * 1024)),
+        sink=lambda mv: got.extend(mv),
+    )
+    assert total == size
+    assert bytes(got) == deterministic_bytes("f/0", size).tobytes()
+    assert sb.opens >= 2  # the stall really forced a reopen
+    assert wd.stalls >= 1
+
+
+# --------------------------------------------------------------- breaker --
+
+
+def test_breaker_state_machine_deterministic():
+    clock = FakeClock()
+    br = CircuitBreaker(failures=2, reset_s=5.0, probes=1, clock=clock)
+    assert br.allow()
+    br.record_failure()
+    assert br.state == "closed"
+    br.record_failure()
+    assert br.state == "open"
+    assert not br.allow()  # shedding
+    clock.advance(4.9)
+    assert not br.allow()
+    clock.advance(0.2)  # past reset_s: half-open probe admitted
+    adm = br.allow()
+    assert adm and adm.probe
+    assert br.state == "half_open"
+    assert not br.allow()  # only one probe in flight
+    br.record_success(probe=True)
+    assert br.state == "closed"
+    snap = br.snapshot()
+    assert snap["opens"] == 1
+    assert snap["open_s"] == pytest.approx(5.1, abs=0.01)
+    assert snap["shed"] >= 2
+
+
+def test_breaker_failed_probe_reopens():
+    clock = FakeClock()
+    br = CircuitBreaker(failures=1, reset_s=1.0, probes=1, clock=clock)
+    br.record_failure()
+    assert br.state == "open"
+    clock.advance(1.5)
+    assert br.allow().probe
+    br.record_failure(probe=True)  # probe fails → straight back to open
+    assert br.state == "open"
+    assert br.opens == 2
+
+
+def test_breaker_abandoned_probe_releases_slot():
+    """A probe stream closed without a verdict (cancelled hedge loser,
+    caller closed early and byteless) must release its slot — a leaked
+    slot would shed every subsequent open forever."""
+    clock = FakeClock()
+    br = CircuitBreaker(failures=1, reset_s=1.0, probes=1, clock=clock)
+    br.record_failure()
+    clock.advance(1.5)
+    assert br.allow().probe  # slot taken
+    assert not br.allow()    # and exhausted
+    br.abandon_probe()       # probe closed undecided: slot frees
+    adm = br.allow()
+    assert adm and adm.probe
+    br.record_success(probe=True)
+    assert br.state == "closed"
+
+
+def test_breaker_reader_early_close_settles():
+    """BreakerBackend readers closed before EOF still settle: delivered
+    bytes = success (exactly-length ranged reads never see the 0-byte
+    EOF read); a byteless probe close releases the probe slot."""
+    clock = FakeClock()
+    be = FakeBackend.prepopulated("f/", count=1, size=10_000)
+    bb = BreakerBackend(be, TailConfig(
+        breaker=True, breaker_failures=1, breaker_reset_s=1.0,
+    ), clock=clock)
+    bb.breaker.record_failure()  # force open
+    clock.advance(1.5)
+    r = bb.open_read("f/0")      # the half-open probe
+    buf = memoryview(bytearray(10_000))
+    assert r.readinto(buf) == 10_000
+    r.close()  # exactly-length: closed without ever reading EOF
+    assert bb.breaker.state == "closed"  # delivered bytes = probe success
+    # Byteless close of a probe: slot released, breaker stays half-open.
+    bb.breaker.record_failure()
+    clock.advance(1.5)
+    r2 = bb.open_read("f/0")
+    r2.close()
+    assert bb.breaker.state == "half_open"
+    r3 = bb.open_read("f/0")  # slot was freed, probe admitted again
+    while r3.readinto(buf) > 0:
+        pass
+    r3.close()
+    assert bb.breaker.state == "closed"
+
+
+def test_breaker_backend_sheds_and_recovers():
+    clock = FakeClock()
+
+    class FlakyBackend:
+        def __init__(self):
+            self.broken = True
+            self.inner = FakeBackend.prepopulated("f/", count=1, size=100)
+
+        def open_read(self, name, start=0, length=None):
+            if self.broken:
+                raise StorageError("boom", transient=True, code=503)
+            return self.inner.open_read(name, start, length)
+
+        def close(self):
+            pass
+
+    fb = FlakyBackend()
+    bb = BreakerBackend(fb, TailConfig(
+        breaker=True, breaker_failures=2, breaker_reset_s=3.0,
+    ), clock=clock)
+    for _ in range(2):
+        with pytest.raises(StorageError):
+            bb.open_read("f/0")
+    assert bb.breaker.state == "open"
+    with pytest.raises(CircuitOpenError):
+        bb.open_read("f/0")  # shed without touching the inner backend
+    fb.broken = False
+    clock.advance(3.5)
+    r = bb.open_read("f/0")  # half-open probe goes through
+    buf = memoryview(bytearray(256))
+    while r.readinto(buf) > 0:
+        pass
+    r.close()
+    assert bb.breaker.state == "closed"
+    assert collect_tail_stats(bb)["breaker"]["opens"] == 1
+
+
+def test_breaker_read_errors_count_as_failures():
+    clock = FakeClock()
+    be = FakeBackend.prepopulated(
+        "f/", count=1, size=50_000,
+        fault=FaultPlan(read_error_rate=1.0, seed=1),
+    )
+    bb = BreakerBackend(be, TailConfig(
+        breaker=True, breaker_failures=1, breaker_reset_s=100.0,
+    ), clock=clock)
+    r = bb.open_read("f/0")
+    with pytest.raises(StorageError):
+        r.readinto(memoryview(bytearray(1024)))
+    assert bb.breaker.state == "open"
+
+
+# ---------------------------------------------------------------- hedging --
+
+
+def hedged(be, **kw) -> HedgedBackend:
+    t = TailConfig(hedge=True, **kw)
+    return HedgedBackend(be, t, chunk_bytes=16 * 1024)
+
+
+class GatedBackend:
+    """First open blocks on an event (the straggler); later opens stream
+    immediately — the deterministic hedge-win scenario."""
+
+    def __init__(self, size=100_000, block_first=1):
+        self.inner = FakeBackend.prepopulated("f/", count=1, size=size)
+        self.gate = threading.Event()
+        self.opens = 0
+        self.block_first = block_first
+
+    def open_read(self, name, start=0, length=None):
+        self.opens += 1
+        r = self.inner.open_read(name, start, length)
+        if self.opens <= self.block_first:
+            orig = r.readinto
+
+            def gated_readinto(buf):
+                self.gate.wait(timeout=10.0)
+                return orig(buf)
+
+            r.readinto = gated_readinto
+        return r
+
+    def close(self):
+        self.inner.close()
+
+
+def test_hedge_win_rescues_straggler():
+    gb = GatedBackend()
+    hb = hedged(gb, hedge_delay_s=0.02)
+    got = bytearray()
+    total, fb_ns = read_object_through(
+        hb.open_read("f/0"), memoryview(bytearray(16 * 1024)),
+        sink=lambda mv: got.extend(mv),
+    )
+    gb.gate.set()  # release the loser so its thread exits
+    assert total == 100_000
+    assert bytes(got) == deterministic_bytes("f/0", 100_000).tobytes()
+    assert fb_ns is not None
+    assert gb.opens == 2
+    assert hb.stats["hedges"] == 1
+    assert hb.stats["hedge_wins"] == 1
+    assert hb.stats["hedge_losses"] == 0
+
+
+def test_hedge_lose_counts_waste():
+    """Primary delivers first (slow hedge): the hedge is cancelled as the
+    loser and any bytes it produced are waste, not duplicates."""
+    class SlowHedgeBackend(GatedBackend):
+        def __init__(self):
+            super().__init__(block_first=0)
+            self.delay_opens = {2}  # the hedge (second open) is slow
+
+        def open_read(self, name, start=0, length=None):
+            self.opens += 1
+            r = self.inner.open_read(name, start, length)
+            if self.opens in self.delay_opens:
+                orig = r.readinto
+
+                def slow_readinto(buf):
+                    time.sleep(0.2)
+                    return orig(buf)
+
+                r.readinto = slow_readinto
+            else:
+                orig2 = r.readinto
+
+                def paced_readinto(buf):
+                    time.sleep(0.03)
+                    return orig2(buf)
+
+                r.readinto = paced_readinto
+            return r
+
+    sb = SlowHedgeBackend()
+    hb = hedged(sb, hedge_delay_s=0.005)  # hedge launches before 1st byte
+    got = bytearray()
+    total, _ = read_object_through(
+        hb.open_read("f/0"), memoryview(bytearray(16 * 1024)),
+        sink=lambda mv: got.extend(mv),
+    )
+    assert total == 100_000
+    assert bytes(got) == deterministic_bytes("f/0", 100_000).tobytes()
+    assert sb.opens == 2
+    assert hb.stats["hedges"] == 1
+    assert hb.stats["hedge_losses"] == 1
+    assert hb.stats["hedge_wins"] == 0
+
+
+def test_no_hedge_when_first_byte_fast():
+    be = FakeBackend.prepopulated("f/", count=1, size=50_000)
+    hb = hedged(be, hedge_delay_s=5.0)
+    total, _ = read_object_through(
+        hb.open_read("f/0"), memoryview(bytearray(16 * 1024))
+    )
+    assert total == 50_000
+    assert hb.stats["hedges"] == 0
+
+
+def test_hedged_zero_byte_object():
+    be = FakeBackend.prepopulated("f/", count=1, size=0)
+    hb = hedged(be, hedge_delay_s=5.0)
+    r = hb.open_read("f/0")
+    assert r.readinto(memoryview(bytearray(64))) == 0
+    r.close()
+
+
+def test_hedged_error_propagates_when_all_attempts_die():
+    be = FakeBackend.prepopulated("f/", count=1, size=100)
+    hb = hedged(be, hedge_delay_s=5.0)
+    r = hb.open_read("nope")  # 404 from the only attempt
+    with pytest.raises(StorageError) as ei:
+        r.readinto(memoryview(bytearray(64)))
+    assert ei.value.code == 404
+
+
+def test_hedged_async_watchdog_fires_while_producer_blocked():
+    """The hedged reader's consumer-side watchdog detects a blackhole even
+    though both producers are blocked inside readinto — the shape the
+    boundary-based watchdog can never see."""
+    gb = GatedBackend(block_first=2)  # primary AND hedge both blackhole
+    t = TailConfig(hedge=True, hedge_delay_s=0.02, watchdog=True,
+                   stall_window_s=0.15, stall_floor_bps=1.0)
+    hb = HedgedBackend(gb, t, chunk_bytes=16 * 1024)
+    r = hb.open_read("f/0")
+    with pytest.raises(StallError):
+        r.readinto(memoryview(bytearray(16 * 1024)))
+    gb.gate.set()
+    assert hb.stats["stalls"] == 1
+
+
+def test_hedge_resume_composes_with_retrying_backend():
+    """Blackholed primary+hedge → StallError → RetryingBackend reopens →
+    unblocked backend delivers exact bytes."""
+    gb = GatedBackend(block_first=2)
+    t = TailConfig(hedge=True, hedge_delay_s=0.02, watchdog=True,
+                   stall_window_s=0.15, stall_floor_bps=1.0)
+    hb = HedgedBackend(gb, t, chunk_bytes=16 * 1024)
+    rb = RetryingBackend(hb, RetryConfig(
+        jitter=False, initial_backoff_s=0.0, max_backoff_s=0.0,
+        max_attempts=10,
+    ))
+    got = bytearray()
+    total, _ = read_object_through(
+        rb.open_read("f/0"), memoryview(bytearray(16 * 1024)),
+        sink=lambda mv: got.extend(mv),
+    )
+    gb.gate.set()
+    assert total == 100_000
+    assert bytes(got) == deterministic_bytes("f/0", 100_000).tobytes()
+
+
+def test_hedge_delay_from_rolling_p99():
+    be = FakeBackend.prepopulated("f/", count=1, size=10)
+    hb = HedgedBackend(
+        be,
+        TailConfig(hedge=True, hedge_delay_s=0.01, hedge_from_p99=True,
+                   hedge_p99_scale=2.0),
+    )
+    assert hb.hedge_delay() == 0.01  # too few samples: fixed floor
+    # 24 samples crosses the cache's refresh cadence, so the delay
+    # reflects the full window: p99 of 1..24 ms = 24 ms, x2 scale.
+    for ms in range(1, 25):
+        hb.note_first_byte(ms / 1000.0)
+    assert hb.hedge_delay() == pytest.approx(0.048, rel=0.15)
+
+
+# ------------------------------------------------------------ composition --
+
+
+def test_wrap_tail_composes_all_layers():
+    be = FakeBackend.prepopulated("f/", count=1, size=40_000)
+    t = TailConfig(hedge=True, watchdog=True, breaker=True,
+                   hedge_delay_s=5.0, stall_window_s=5.0)
+    b = wrap_tail(be, t, chunk_bytes=8 * 1024)
+    total, _ = read_object_through(
+        b.open_read("f/0"), memoryview(bytearray(8 * 1024))
+    )
+    assert total == 40_000
+    stats = collect_tail_stats(b)
+    assert stats["hedge"]["reads"] == 1
+    assert stats["breaker"]["state"] == "closed"
+    assert "watchdog" in stats
+
+
+def test_wrap_tail_inactive_is_identity():
+    be = FakeBackend.prepopulated("f/", count=1, size=10)
+    assert wrap_tail(be, TailConfig()) is be
+    assert wrap_tail(be, None) is be
+    assert collect_tail_stats(be) == {}
+
+
+def test_hedge_producer_threads_adopt_flight_op():
+    """Backend-level flight events (connect phases, annotations) emitted
+    on hedge producer threads still attribute to the read's record — the
+    producers adopt the consumer thread's op."""
+    from tpubench.obs.flight import WorkerFlight, note_phase, annotate
+
+    class AnnotatingBackend:
+        def __init__(self):
+            self.inner = FakeBackend.prepopulated("f/", count=1, size=30_000)
+
+        def open_read(self, name, start=0, length=None):
+            note_phase("connect")       # what gcs_http/native pools do
+            annotate("conn", reused=False)
+            return self.inner.open_read(name, start, length)
+
+        def close(self):
+            self.inner.close()
+
+    hb = hedged(AnnotatingBackend(), hedge_delay_s=5.0)
+    wf = WorkerFlight("w0", 8)
+    op = wf.begin("f/0", "fake")
+    total, _ = read_object_through(
+        hb.open_read("f/0"), memoryview(bytearray(16 * 1024))
+    )
+    op.finish(total)
+    rec = wf.records()[0]
+    assert total == 30_000
+    assert "connect" in rec["phases"]
+    assert any(n["kind"] == "conn" for n in rec.get("notes", ()))
+    # And a straggler thread touching the op after finish() is a no-op:
+    # the stored record stays immutable (journal monotonicity).
+    op.mark("stream_open")
+    op.note("late", x=1)
+    assert "stream_open" not in rec["phases"]
+    assert all(n["kind"] != "late" for n in rec.get("notes", ()))

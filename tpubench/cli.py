@@ -94,6 +94,59 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="fake backend: P(granule read fails mid-stream)")
     p.add_argument("--fault-latency", type=float,
                    help="fake backend: added first-byte latency (s)")
+    p.add_argument("--fault-per-read-latency", type=float,
+                   help="fake backend: added latency per granule read (s)")
+    p.add_argument("--fault-stall-s", type=float,
+                   help="chaos plane: one mid-body pause of this many "
+                        "seconds per reader (very large = blackhole)")
+    p.add_argument("--fault-stall-after-bytes", type=int,
+                   help="chaos plane: the stall triggers after this many "
+                        "delivered bytes (default 0 = at first byte)")
+    p.add_argument("--fault-stall-rate", type=float,
+                   help="chaos plane: P(a given reader stalls at all) — "
+                        "<1 makes the stall a straggler, the shape "
+                        "hedged reads race against")
+    p.add_argument("--fault-drip-bps", type=float,
+                   help="chaos plane: per-reader throughput cap "
+                        "(bytes/s; the slow-drip the stall watchdog "
+                        "detects)")
+    p.add_argument("--fault-truncate-after-bytes", type=int,
+                   help="chaos plane: clean EOF after N bytes, short of "
+                        "the announced length")
+    p.add_argument("--fault-reset-after-bytes", type=int,
+                   help="chaos plane: kill the stream abruptly after N "
+                        "bytes (reset/RST shape)")
+    p.add_argument("--hedge", action="store_true",
+                   help="tail tolerance: race a second ranged read when "
+                        "the first byte is late; first winner streams, "
+                        "loser cancelled (wins/losses/waste recorded)")
+    p.add_argument("--hedge-delay", type=float,
+                   help="seconds before the hedge launches (default 0.05)")
+    p.add_argument("--hedge-from-p99", action="store_true",
+                   help="derive the hedge delay from the run's rolling "
+                        "p99 first-byte latency instead of the fixed "
+                        "--hedge-delay (which becomes the floor)")
+    p.add_argument("--watchdog", action="store_true",
+                   help="tail tolerance: cancel+resume a stream whose "
+                        "throughput stays below --stall-floor-bps for "
+                        "--stall-window seconds")
+    p.add_argument("--stall-window", type=float,
+                   help="watchdog stall window seconds (default 1.0)")
+    p.add_argument("--stall-floor-bps", type=float,
+                   help="watchdog throughput floor bytes/s (default 1024)")
+    p.add_argument("--breaker", action="store_true",
+                   help="tail tolerance: per-backend circuit breaker "
+                        "(closed→open→half-open) shedding a failing "
+                        "endpoint instead of hammering it")
+    p.add_argument("--breaker-failures", type=int,
+                   help="consecutive failures that open the breaker "
+                        "(default 5)")
+    p.add_argument("--breaker-reset", type=float,
+                   help="seconds the breaker stays open before probing "
+                        "(default 5.0)")
+    p.add_argument("--breaker-probes", type=int,
+                   help="half-open probe successes required to close "
+                        "(default 1)")
     p.add_argument("--retry-deadline", type=float,
                    help="per-op retry deadline (s); bounds the reference's "
                         "retry-forever default — set this with --fault-* "
@@ -208,6 +261,52 @@ def build_config(args) -> BenchConfig:
         t.fault.read_error_rate = args.fault_read_error_rate
     if args.fault_latency is not None:
         t.fault.latency_s = args.fault_latency
+    for attr, dest in (
+        ("fault_per_read_latency", "per_read_latency_s"),
+        ("fault_stall_s", "stall_s"),
+        ("fault_stall_after_bytes", "stall_after_bytes"),
+        ("fault_stall_rate", "stall_rate"),
+        ("fault_drip_bps", "drip_bps"),
+        ("fault_truncate_after_bytes", "truncate_after_bytes"),
+        ("fault_reset_after_bytes", "reset_after_bytes"),
+    ):
+        v = getattr(args, attr, None)
+        if v is not None:
+            setattr(t.fault, dest, v)
+    tail = t.tail
+    if getattr(args, "hedge", False):
+        tail.hedge = True
+    if getattr(args, "hedge_delay", None) is not None:
+        tail.hedge_delay_s = args.hedge_delay
+    if getattr(args, "hedge_from_p99", False):
+        tail.hedge = True  # the adaptive delay implies hedging
+        tail.hedge_from_p99 = True
+    if getattr(args, "watchdog", False):
+        tail.watchdog = True
+    if getattr(args, "stall_window", None) is not None:
+        tail.stall_window_s = args.stall_window
+    if getattr(args, "stall_floor_bps", None) is not None:
+        tail.stall_floor_bps = args.stall_floor_bps
+    if getattr(args, "breaker", False):
+        tail.breaker = True
+    if getattr(args, "breaker_failures", None) is not None:
+        tail.breaker_failures = args.breaker_failures
+    if getattr(args, "breaker_reset", None) is not None:
+        tail.breaker_reset_s = args.breaker_reset
+    if getattr(args, "breaker_probes", None) is not None:
+        tail.breaker_probes = args.breaker_probes
+    if tail.hedge_delay_s < 0:
+        raise SystemExit(
+            f"--hedge-delay {tail.hedge_delay_s}: must be >= 0"
+        )
+    if tail.stall_window_s <= 0:
+        raise SystemExit(
+            f"--stall-window {tail.stall_window_s}: must be > 0"
+        )
+    if tail.stall_floor_bps < 0:
+        raise SystemExit(
+            f"--stall-floor-bps {tail.stall_floor_bps}: must be >= 0"
+        )
     if args.retry_deadline is not None:
         t.retry.deadline_s = args.retry_deadline
     if args.retry_max_attempts is not None:
@@ -253,6 +352,11 @@ def build_config(args) -> BenchConfig:
             "--process-id/--coordinator set but --num-processes is 1: "
             "pass the pod's total process count on every host"
         )
+    # Fault-config sanity (rates in [0,1], non-negative durations, sane
+    # phase windows) fails HERE, at parse time — not an hour into a run.
+    from tpubench.config import validate_fault_config
+
+    validate_fault_config(t.fault, "transport.fault")
     if o.results_bucket and t.protocol not in ("http", "grpc"):
         # Fail at parse time, not after an hour-long run: upload_result
         # needs an object-store protocol ('local' roots at workload.dir,
@@ -327,6 +431,62 @@ def cmd_pod_ingest(cfg: BenchConfig, args) -> RunResult:
     from tpubench.workloads.pod_ingest import run_pod_ingest
 
     return run_pod_ingest(cfg, ring=args.ring)
+
+
+def chaos_timeline_from_args(args) -> list:
+    """The ``tpubench chaos`` fault timeline: explicit JSON
+    (``--chaos-timeline``, inline or ``@file``), or the single-phase
+    shorthand built from ``--chaos-fault``/``--chaos-start``/
+    ``--chaos-duration`` with fault parameters from the ``--fault-*``
+    flags (sensible defaults per kind)."""
+    if args.chaos_timeline:
+        raw = args.chaos_timeline
+        if raw.startswith("@"):
+            with open(raw[1:]) as f:
+                raw = f.read()
+        try:
+            timeline = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise SystemExit(f"--chaos-timeline: invalid JSON: {e}") from None
+        if not isinstance(timeline, list):
+            raise SystemExit(
+                "--chaos-timeline: expected a JSON list of "
+                "[t0, t1, {fault fields}] entries"
+            )
+        return timeline
+    t0 = args.chaos_start
+    t1 = t0 + args.chaos_duration
+
+    def pick(attr, default):
+        v = getattr(args, attr, None)
+        return default if v is None else v
+
+    kind = args.chaos_fault
+    if kind == "stall":
+        plan = {
+            "stall_s": pick("fault_stall_s", 0.4),
+            "stall_rate": pick("fault_stall_rate", 1.0),
+            "stall_after_bytes": pick("fault_stall_after_bytes", 0),
+        }
+    elif kind == "blackhole":
+        # Bytes stop and never resume within any sane window; hedges and
+        # the watchdog are the only way out.
+        plan = {
+            "stall_s": 3600.0,
+            "stall_rate": pick("fault_stall_rate", 1.0),
+            "stall_after_bytes": pick("fault_stall_after_bytes", 0),
+        }
+    elif kind == "drip":
+        plan = {"drip_bps": pick("fault_drip_bps", 64 * KB)}
+    elif kind == "truncate":
+        plan = {"truncate_after_bytes": pick("fault_truncate_after_bytes", 64 * KB)}
+    elif kind == "reset":
+        plan = {"reset_after_bytes": pick("fault_reset_after_bytes", 64 * KB)}
+    elif kind == "error":
+        plan = {"error_rate": pick("fault_error_rate", 0.5)}
+    else:  # latency
+        plan = {"latency_s": pick("fault_latency", 0.2)}
+    return [[t0, t1, plan]]
 
 
 def cmd_prepare(cfg: BenchConfig, args) -> None:
@@ -463,6 +623,26 @@ def main(argv=None) -> int:
     mcs.add_argument("--shard-mb")
     mcs.add_argument("--reps")
     mcs.add_argument("--out")
+    chaos = add("chaos", "scripted fault timeline + resilience scorecard "
+                         "(hermetic: fake backend or in-process fake "
+                         "server; see --chaos-*)")
+    chaos.add_argument("--chaos-workload", choices=("read", "pod-ingest"),
+                       default="read",
+                       help="workload the fault timeline runs against")
+    chaos.add_argument("--chaos-timeline",
+                       help="JSON [[t0,t1,{fault fields}],...] (seconds "
+                            "from run start), or @path to a JSON file; "
+                            "overrides the --chaos-fault trio")
+    chaos.add_argument("--chaos-fault",
+                       choices=("stall", "blackhole", "drip", "truncate",
+                                "reset", "error", "latency"),
+                       default="stall",
+                       help="single-phase shorthand: which fault the "
+                            "window injects (parameters from --fault-*)")
+    chaos.add_argument("--chaos-start", type=float, default=2.0,
+                       help="fault window start, seconds from run start")
+    chaos.add_argument("--chaos-duration", type=float, default=2.0,
+                       help="fault window length in seconds")
     probe = add("probe", "host→HBM transfer-physics probe (fixed cost, "
                          "size sweep, burst/floor shaping, slow start)")
     probe.add_argument("--cycles", type=int, default=8,
@@ -650,6 +830,26 @@ def main(argv=None) -> int:
                 cfg, shard_mb=args.shard_mb, reps=args.reps, ring=args.ring,
                 collective=args.collective,
             )
+        elif args.cmd == "chaos":
+            from tpubench.config import FaultConfig
+            from tpubench.workloads.chaos import format_scorecard, run_chaos
+
+            timeline = chaos_timeline_from_args(args)
+            if not args.chaos_timeline:
+                # Shorthand mode: the --fault-* values parameterized the
+                # PHASE — reset them on the base plan, or the "fault"
+                # would run every second of the timeline and the
+                # baseline/recovery segments would measure nothing.
+                defaults = FaultConfig()
+                for fname in timeline[0][2]:
+                    setattr(cfg.transport.fault, fname,
+                            getattr(defaults, fname))
+            res = run_chaos(
+                cfg,
+                timeline=timeline,
+                chaos_workload=args.chaos_workload,
+            )
+            print(format_scorecard(res.extra["chaos"]))
         elif args.cmd == "probe":
             from tpubench.workloads.probe import run_probe
 

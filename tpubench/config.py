@@ -63,15 +63,41 @@ class RetryConfig:
 
 @dataclass
 class FaultConfig:
-    """Fault injection for the fake backend (SURVEY §5.3 prescription:
-    error %, latency injection — the resilience-testing mode the reference
-    lacked). Ignored by real backends."""
+    """Fault injection for the fake backend and fake servers (SURVEY §5.3
+    prescription: error %, latency injection — the resilience-testing mode
+    the reference lacked). Ignored by real backends.
+
+    Beyond the rate/latency knobs, the chaos plane adds *shaped* faults
+    (stall, slow-drip, truncation, connection reset) and **time-phased
+    schedules**: ``phases`` is a list of ``[t0, t1, {fault fields}]``
+    windows (seconds relative to the run start) during which the phase's
+    plan replaces the base one — the scripted fault timeline behind
+    ``tpubench chaos``."""
 
     error_rate: float = 0.0  # P(read-open raises transient 503)
     read_error_rate: float = 0.0  # P(granule read raises mid-stream)
     latency_s: float = 0.0  # added first-byte latency per open
     per_read_latency_s: float = 0.0  # added latency per granule read
     seed: int = 0
+    # --- shaped faults (the chaos plane) ---
+    # Stall: one mid-body pause of stall_s once a reader has delivered
+    # stall_after_bytes; stall_rate is P(a given reader stalls at all) —
+    # <1.0 makes the fault a straggler (some streams stall, some don't),
+    # the shape hedged reads exist for. A very large stall_s is the
+    # blackhole: bytes stop flowing but the stream never errors.
+    stall_after_bytes: int = 0
+    stall_s: float = 0.0
+    stall_rate: float = 1.0
+    # Slow-drip: per-reader throughput cap (bytes/second); 0 = off.
+    drip_bps: float = 0.0
+    # Truncation: clean EOF after this many bytes, SHORT of the announced
+    # length (the proxy-died shape a correct client must detect); 0 = off.
+    truncate_after_bytes: int = 0
+    # Connection reset: the stream dies abruptly after this many bytes
+    # (transient error / RST / closed socket depending on the surface).
+    reset_after_bytes: int = 0
+    # Time-phased schedule: [[t0, t1, {fault fields}], ...] — see class doc.
+    phases: list = field(default_factory=list)
 
     @property
     def active(self) -> bool:
@@ -80,7 +106,147 @@ class FaultConfig:
             or self.read_error_rate
             or self.latency_s
             or self.per_read_latency_s
+            or self.stall_s
+            or self.drip_bps
+            or self.truncate_after_bytes
+            or self.reset_after_bytes
+            or self.phases
         )
+
+
+# Fields a fault phase dict may set (everything but the schedule itself:
+# nested phases would have no defined epoch).
+_FAULT_PHASE_FIELDS = (
+    "error_rate", "read_error_rate", "latency_s", "per_read_latency_s",
+    "seed", "stall_after_bytes", "stall_s", "stall_rate", "drip_bps",
+    "truncate_after_bytes", "reset_after_bytes",
+)
+
+
+def validate_fault_config(fc: "FaultConfig", where: str = "fault") -> None:
+    """Reject malformed fault configs with a clear one-line ``SystemExit``
+    (the TPUBENCH_BENCH_SLEEP_SCALE validation style): probabilities
+    outside [0, 1], negative latencies/durations/byte counts, and
+    malformed or negative phase windows all fail at config-load time, not
+    an hour into a run."""
+
+    def _num(label: str, name: str, v):
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            raise SystemExit(
+                f"{label}.{name}={v!r}: must be a number"
+            ) from None
+
+    def _check_fields(d: dict, label: str) -> None:
+        for name in ("error_rate", "read_error_rate", "stall_rate"):
+            v = d.get(name)
+            if v is not None and not (0.0 <= _num(label, name, v) <= 1.0):
+                raise SystemExit(
+                    f"{label}.{name}={v!r}: must be a probability in [0, 1]"
+                )
+        for name in (
+            "latency_s", "per_read_latency_s", "stall_s", "drip_bps",
+            "stall_after_bytes", "truncate_after_bytes", "reset_after_bytes",
+        ):
+            v = d.get(name)
+            if v is not None and _num(label, name, v) < 0:
+                raise SystemExit(f"{label}.{name}={v!r}: must be >= 0")
+
+    base = {f: getattr(fc, f) for f in _FAULT_PHASE_FIELDS}
+    _check_fields(base, where)
+    for i, ph in enumerate(fc.phases or ()):
+        label = f"{where}.phases[{i}]"
+        if not isinstance(ph, (list, tuple)) or len(ph) != 3:
+            raise SystemExit(
+                f"{label}: expected [t0, t1, {{fault fields}}], got {ph!r}"
+            )
+        t0, t1, plan = ph
+        try:
+            t0, t1 = float(t0), float(t1)
+        except (TypeError, ValueError):
+            raise SystemExit(
+                f"{label}: phase window [{ph[0]!r}, {ph[1]!r}] must be numeric"
+            ) from None
+        if t0 < 0 or t1 < t0:
+            raise SystemExit(
+                f"{label}: phase window [{t0}, {t1}] must satisfy "
+                "0 <= t0 <= t1"
+            )
+        if not isinstance(plan, dict):
+            raise SystemExit(
+                f"{label}: third element must be a fault-field dict, "
+                f"got {plan!r}"
+            )
+        unknown = sorted(set(plan) - set(_FAULT_PHASE_FIELDS))
+        if unknown:
+            raise SystemExit(
+                f"{label}: unknown fault field(s) {unknown}; "
+                f"valid: {sorted(_FAULT_PHASE_FIELDS)}"
+            )
+        _check_fields(plan, label)
+
+
+def parse_sleep_scale(purpose: str = "refill sleeps") -> float:
+    """Validated ``TPUBENCH_BENCH_SLEEP_SCALE``: one definition shared by
+    bench.py (refill sleeps) and the chaos workload (timeline durations),
+    so the two surfaces can never drift on what the env var accepts. A
+    clear one-line rejection for non-numeric/negative/NaN values instead
+    of a ValueError traceback; empty/unset = 1.0."""
+    raw = os.environ.get("TPUBENCH_BENCH_SLEEP_SCALE", "")
+    if not raw:
+        return 1.0
+    try:
+        v = float(raw)
+    except ValueError:
+        raise SystemExit(
+            f"TPUBENCH_BENCH_SLEEP_SCALE={raw!r}: expected a non-negative "
+            f"number (0 disables {purpose}; 1 keeps them full-length)"
+        ) from None
+    if v < 0 or v != v:  # reject negatives and NaN alike
+        raise SystemExit(
+            f"TPUBENCH_BENCH_SLEEP_SCALE={raw!r}: must be >= 0 "
+            f"(0 disables {purpose}; got a negative/NaN value)"
+        )
+    return v
+
+
+@dataclass
+class TailConfig:
+    """Tail-tolerance (storage/tail.py): hedged reads, the stall watchdog
+    and the per-backend circuit breaker. All off by default — the
+    reference has none of this (it retries-after-failure only); turning
+    them on is the resilience A/B the chaos workload measures."""
+
+    # Hedged reads: if the first byte hasn't arrived hedge_delay_s after
+    # open, race a second ranged read for the same bytes and take the
+    # winner (loser cancelled; wins/losses/wasted bytes recorded).
+    hedge: bool = False
+    hedge_delay_s: float = 0.05
+    # Derive the hedge delay from the run's rolling p99 first-byte latency
+    # (x hedge_p99_scale, floored at hedge_delay_s) instead of the fixed
+    # delay — self-tuning to the endpoint's actual tail.
+    hedge_from_p99: bool = False
+    hedge_p99_scale: float = 1.5
+    # Stall watchdog: a stream whose throughput stays below
+    # stall_floor_bps for at least stall_window_s is cancelled with a
+    # transient StallError — the resume path reopens it at offset.
+    watchdog: bool = False
+    stall_window_s: float = 1.0
+    stall_floor_bps: float = 1024.0
+    # Circuit breaker (closed → open → half-open): breaker_failures
+    # consecutive failures open it; after breaker_reset_s one probe
+    # (breaker_probes successes) closes it again. While open, opens are
+    # shed with a transient CircuitOpenError instead of hammering the
+    # endpoint.
+    breaker: bool = False
+    breaker_failures: int = 5
+    breaker_reset_s: float = 5.0
+    breaker_probes: int = 1
+
+    @property
+    def active(self) -> bool:
+        return self.hedge or self.watchdog or self.breaker
 
 
 @dataclass
@@ -111,6 +277,7 @@ class TransportConfig:
     endpoint: str = ""  # empty = https://storage.googleapis.com
     retry: RetryConfig = field(default_factory=RetryConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
+    tail: TailConfig = field(default_factory=TailConfig)
 
 
 @dataclass
@@ -313,6 +480,7 @@ _SUBTYPES = {
     "obs": ObservabilityConfig,
     "retry": RetryConfig,
     "fault": FaultConfig,
+    "tail": TailConfig,
 }
 
 

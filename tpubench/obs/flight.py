@@ -68,6 +68,18 @@ def current_op() -> Optional["FlightOp"]:
     return getattr(_tls, "op", None)
 
 
+def adopt_op(op: Optional["FlightOp"]) -> None:
+    """Install ``op`` as THIS thread's current op (None clears it).
+
+    For helper threads doing work on behalf of a read that began on a
+    workload thread — the hedged reader's producer threads adopt the
+    consumer's op so backend-level phases/annotations (connect,
+    first_byte, breaker events) still land on the read's record.
+    Appends from two threads interleave but never tear (GIL-atomic
+    list/dict ops; first-stamp-wins already governs phase marks)."""
+    _tls.op = op
+
+
 def note_phase(phase: str, ns: Optional[int] = None) -> None:
     """Stamp ``phase`` on the calling thread's current op (no-op when no
     op is active — the backends call this unconditionally)."""
@@ -120,13 +132,18 @@ class FlightOp:
 
     def mark(self, phase: str, ns: Optional[int] = None) -> None:
         # First stamp wins (e.g. "connect" fires once even when a stale
-        # retry reconnects — the retry itself is an annotation).
-        if phase not in self.phases:
-            self.phases[phase] = int(
-                ns if ns else time.perf_counter_ns()
-            )
+        # retry reconnects — the retry itself is an annotation). A
+        # finished op is immutable: its record is already in the ring,
+        # and a straggling helper thread (cancelled hedge loser) must
+        # not add out-of-order stamps that would break the journal's
+        # monotonicity invariant.
+        if self._done or phase in self.phases:
+            return
+        self.phases[phase] = int(ns if ns else time.perf_counter_ns())
 
     def note(self, kind: str, **info) -> None:
+        if self._done:
+            return
         self.notes.append({"kind": kind, "t": time.perf_counter_ns(), **info})
 
     def finish(self, nbytes: int = 0, error: Optional[BaseException] = None
@@ -418,14 +435,29 @@ def straggler_attribution(records: list[dict], by: str = "host"
 def timeline_summary(records: list[dict]) -> dict:
     """Journal → {phases: per-segment p50/p99, stragglers, counts}."""
     errors = sum(1 for r in records if r.get("error"))
-    retries = sum(
-        1 for r in records for n in r.get("notes", ())
-        if n.get("kind") == "retry"
-    )
+    notes = [n for r in records for n in r.get("notes", ())]
+    retries = sum(1 for n in notes if n.get("kind") == "retry")
+    # Tail-tolerance attribution (storage/tail.py): every hedge launch/
+    # win/loss, watchdog stall and breaker transition is a note on the
+    # read it happened to, so the timeline can say WHICH reads the
+    # resilience machinery touched.
+    tail = {
+        "hedges": sum(
+            1 for n in notes
+            if n.get("kind") == "hedge" and n.get("event") == "launch"
+        ),
+        "hedge_wins": sum(
+            1 for n in notes
+            if n.get("kind") == "hedge" and n.get("event") == "win"
+        ),
+        "stalls": sum(1 for n in notes if n.get("kind") == "stall"),
+        "breaker_events": sum(1 for n in notes if n.get("kind") == "breaker"),
+    }
     return {
         "records": len(records),
         "errors": errors,
         "retries": retries,
+        "tail": tail,
         "hosts": sorted({r.get("host", 0) for r in records}),
         "phases": _phase_stats(records),
         "stragglers": {
@@ -466,6 +498,13 @@ def render_timeline(docs: list[dict]) -> str:
     if not records:
         lines.append("  (no records)")
         return "\n".join(lines)
+    tail = summ.get("tail", {})
+    if any(tail.values()):
+        lines.append(
+            f"tail events: hedges={tail['hedges']} "
+            f"(wins={tail['hedge_wins']}) stalls={tail['stalls']} "
+            f"breaker={tail['breaker_events']}"
+        )
     lines.append("phase segments (ms):")
     for name, s in summ["phases"].items():
         lines.append(

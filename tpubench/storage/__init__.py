@@ -21,6 +21,12 @@ from tpubench.storage.base import (  # noqa: F401
 from tpubench.storage.fake import FakeBackend, FaultPlan  # noqa: F401
 from tpubench.storage.retry import Backoff, retry_call  # noqa: F401
 from tpubench.storage.retrying import RetryingBackend  # noqa: F401
+from tpubench.storage.tail import (  # noqa: F401
+    CircuitOpenError,
+    StallError,
+    collect_tail_stats,
+    wrap_tail,
+)
 
 
 def open_backend(cfg, fault=None, tracer=None) -> StorageBackend:
@@ -66,6 +72,13 @@ def open_backend(cfg, fault=None, tracer=None) -> StorageBackend:
         inner = LocalFsBackend(root=cfg.workload.dir)
     else:
         raise ValueError(f"unknown protocol {proto!r} (http|grpc|local|fake)")
+    # Tail-tolerance layer (storage/tail.py): hedging/watchdog/breaker
+    # wrap INSIDE the retry decorator, so a StallError or CircuitOpenError
+    # rides the same resume/backoff machinery as a server 503.
+    inner = wrap_tail(
+        inner, getattr(cfg.transport, "tail", None),
+        chunk_bytes=cfg.workload.granule_bytes,
+    )
     if cfg.transport.retry.policy == "never":
         return inner
     return RetryingBackend(inner, cfg.transport.retry)

@@ -24,20 +24,82 @@ from tpubench.storage.base import (
 
 @dataclass
 class FaultPlan:
-    """Deterministic fault injection for tests and resilience benchmarks."""
+    """Deterministic fault injection for tests and resilience benchmarks.
+
+    Field-compatible with :class:`tpubench.config.FaultConfig` by contract
+    (``open_backend`` builds one from the other by name). Beyond the
+    rate/latency knobs it carries the chaos plane: shaped faults (stall /
+    slow-drip / truncation / connection reset, all triggered after a byte
+    threshold) and a time-phased schedule — ``phases`` is a sequence of
+    ``(t0, t1, plan)`` windows relative to :meth:`arm`'s epoch during
+    which ``plan`` replaces the base fields. Consumers (the fake backend
+    AND both fake servers) resolve the moment's effective plan via
+    :meth:`at` per operation, so a phase turning on mid-run hits streams
+    already in flight — exactly the shape a tail-tolerance layer must
+    survive."""
 
     error_rate: float = 0.0  # probability a read-open raises transient 503
     read_error_rate: float = 0.0  # probability a granule read raises mid-stream
     latency_s: float = 0.0  # fixed added latency per open (first byte)
     per_read_latency_s: float = 0.0  # added latency per granule read
     seed: int = 0
+    # Shaped faults (see FaultConfig for semantics): stall once after N
+    # delivered bytes (stall_rate = P(this reader stalls); big stall_s =
+    # blackhole), cap per-reader throughput, end the body early, or kill
+    # the stream after N bytes.
+    stall_after_bytes: int = 0
+    stall_s: float = 0.0
+    stall_rate: float = 1.0
+    drip_bps: float = 0.0
+    truncate_after_bytes: int = 0
+    reset_after_bytes: int = 0
+    phases: tuple = ()  # ((t0, t1, FaultPlan | field-dict), ...)
+
+    def __post_init__(self):
+        self._epoch: Optional[float] = None
+        self._clock = time.monotonic
+        norm = []
+        for t0, t1, plan in self.phases or ():
+            if isinstance(plan, dict):
+                # Phase dicts inherit the base seed unless they set one,
+                # so a seeded timeline stays deterministic end to end.
+                plan = FaultPlan(**{"seed": self.seed, **plan})
+            norm.append((float(t0), float(t1), plan))
+        self.phases = tuple(norm)
 
     def rng(self) -> random.Random:
         return random.Random(self.seed)
 
+    def arm(self, clock=None) -> "FaultPlan":
+        """Pin the schedule's epoch to *now* (chaos calls this right
+        before the workload starts so phase windows line up with the
+        scorecard's timeline). ``clock`` is injectable for tests."""
+        if clock is not None:
+            self._clock = clock
+        self._epoch = self._clock()
+        return self
+
+    def at(self, now: Optional[float] = None) -> "FaultPlan":
+        """The effective plan at ``now`` (default: the armed clock's
+        current reading; auto-arms on first use). Plans without phases
+        return themselves — the common case costs one tuple check."""
+        if not self.phases:
+            return self
+        if self._epoch is None:
+            self.arm()
+        t = (self._clock() if now is None else now) - self._epoch
+        for t0, t1, plan in self.phases:
+            if t0 <= t < t1:
+                return plan
+        return self
+
 
 class _FakeReader:
-    """Streams a (possibly range-limited) view of an in-memory object."""
+    """Streams a (possibly range-limited) view of an in-memory object.
+
+    Holds the ROOT fault plan (not a phase snapshot) and resolves the
+    effective plan per ``readinto``, so a scheduled fault phase switching
+    on mid-stream shapes a read that is already in flight."""
 
     def __init__(self, data: memoryview, fault: FaultPlan, rng: random.Random):
         self._data = data
@@ -46,21 +108,48 @@ class _FakeReader:
         self._rng = rng
         self.first_byte_ns: Optional[int] = None
         self._closed = False
+        self._delivered = 0
+        self._stall_rolled = False
 
     def readinto(self, buf: memoryview) -> int:
         if self._closed:
             raise StorageError("reader closed", transient=False)
         if self._pos >= len(self._data):
             return 0
-        if self._fault.per_read_latency_s:
-            time.sleep(self._fault.per_read_latency_s)
-        if self._fault.read_error_rate and self._rng.random() < self._fault.read_error_rate:
+        plan = self._fault.at()
+        if plan.per_read_latency_s:
+            time.sleep(plan.per_read_latency_s)
+        if plan.read_error_rate and self._rng.random() < plan.read_error_rate:
             raise StorageError("injected mid-stream failure", transient=True, code=503)
+        if plan.reset_after_bytes and self._delivered >= plan.reset_after_bytes:
+            # Abrupt stream death: the servers translate this into a
+            # closed socket / RST_STREAM; direct users see the transient.
+            raise StorageError(
+                "injected connection reset", transient=True, code=104
+            )
+        if plan.truncate_after_bytes and self._delivered >= plan.truncate_after_bytes:
+            return 0  # clean EOF short of the announced length
+        if plan.stall_s > 0 and not self._stall_rolled and (
+            self._delivered >= plan.stall_after_bytes
+        ):
+            # One roll per reader: either this stream is a straggler
+            # (pause once for stall_s) or it never stalls — the
+            # probabilistic-straggler shape hedged reads race against.
+            self._stall_rolled = True
+            if plan.stall_rate >= 1.0 or self._rng.random() < plan.stall_rate:
+                time.sleep(plan.stall_s)
         n = min(len(buf), len(self._data) - self._pos)
+        if plan.drip_bps > 0:
+            # Slow-drip: cap the chunk so the pacing sleep stays fine-
+            # grained (a whole-granule sleep would look like a stall).
+            n = max(1, min(n, int(plan.drip_bps * 0.05)))
         buf[:n] = self._data[self._pos : self._pos + n]
         self._pos += n
+        self._delivered += n
         if self.first_byte_ns is None:
             self.first_byte_ns = time.perf_counter_ns()
+        if plan.drip_bps > 0:
+            time.sleep(n / plan.drip_bps)
         return n
 
     def close(self) -> None:
@@ -105,9 +194,10 @@ class FakeBackend:
         with self._rng_lock:
             r = self._rng.random()
             reader_rng = random.Random(self._rng.getrandbits(64))
-        if self.fault.latency_s:
-            time.sleep(self.fault.latency_s)
-        if self.fault.error_rate and r < self.fault.error_rate:
+        plan = self.fault.at()
+        if plan.latency_s:
+            time.sleep(plan.latency_s)
+        if plan.error_rate and r < plan.error_rate:
             self.injected_errors += 1
             raise StorageError("injected open failure", transient=True, code=503)
         with self._lock:

@@ -359,7 +359,10 @@ class _Conn:
         self._respond_body(stream, status, msg.encode())
 
     def _handle(self, stream: int, h: dict) -> None:
-        fault = self.backend.fault
+        # Effective plan for this moment (time-phased schedules switch
+        # the open-time faults on/off mid-run; the shaped mid-stream
+        # faults ride the backend reader below).
+        fault = self.backend.fault.at()
         if fault.latency_s:
             import time
 

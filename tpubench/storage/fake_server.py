@@ -20,6 +20,7 @@ client-side retry policy something real to chew on.
 from __future__ import annotations
 
 import json
+import socket
 import threading
 import time
 import urllib.parse
@@ -58,7 +59,9 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(code, {"error": {"code": code, "message": message}})
 
     def _maybe_inject_fault(self) -> bool:
-        fault = self.backend.fault
+        # The effective plan for THIS moment: time-phased schedules make
+        # the open-time faults turn on and off mid-run.
+        fault = self.backend.fault.at()
         if fault.latency_s:
             time.sleep(fault.latency_s)
         if fault.error_rate:
@@ -142,12 +145,28 @@ class _Handler(BaseHTTPRequestHandler):
         # path either way).
         buf = bytearray(getattr(self.server, "chunk_bytes", 256 * 1024))
         mv = memoryview(buf)
-        while True:
-            n = reader.readinto(mv)
-            if n <= 0:
-                break
-            self.wfile.write(mv[:n])
-        reader.close()
+        try:
+            while True:
+                try:
+                    n = reader.readinto(mv)
+                except StorageError:
+                    # Mid-body fault (injected reset / read error): the
+                    # headers are already on the wire, so a JSON error here
+                    # would be consumed as BODY bytes (content-length
+                    # framing) and silently corrupt the stream. Kill the
+                    # connection abruptly instead — the reset shape the
+                    # chaos plane wants, and what a dying proxy produces.
+                    self.close_connection = True
+                    try:
+                        self.connection.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    return
+                if n <= 0:
+                    break
+                self.wfile.write(mv[:n])
+        finally:
+            reader.close()
 
     def do_POST(self):  # noqa: N802
         path, parts, query = self._parse()

@@ -9,14 +9,23 @@ uniformly for every backend via this wrapper:
 * a reader hit by a transient mid-stream error is re-opened at
   ``start + bytes_already_delivered`` (ranged read) and continues, so the
   caller sees one uninterrupted stream.
+
+The failure budget is *consecutive*: the attempt counter and the
+backoff/deadline window reset as soon as bytes flow again, so a long
+stream with sporadic-but-recovering transient faults (the chaos plane's
+bread and butter) never exhausts ``max_attempts`` — only a fault the
+resume path cannot make progress past does. ``rng``/``sleep``/``clock``
+are injectable so chaos tests run deterministically without real sleeps.
 """
 
 from __future__ import annotations
 
+import random
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from tpubench.config import RetryConfig
+from tpubench.obs.flight import annotate as _flight_annotate
 from tpubench.storage.base import ObjectMeta, StorageBackend
 from tpubench.storage.retry import Backoff, _is_retryable, retry_call
 
@@ -29,15 +38,30 @@ class _ResumingReader:
         start: int,
         length: Optional[int],
         retry: RetryConfig,
+        *,
+        rng: Optional[random.Random] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
     ):
         self._backend = backend
         self._name = name
         self._start = start
         self._length = length
         self._retry = retry
+        self._rng = rng
+        self._sleep = sleep
+        self._clock = clock
         self._delivered = 0
         self.first_byte_ns: Optional[int] = None
-        self._inner = retry_call(lambda: backend.open_read(name, start, length), retry)
+        # Consecutive-failure state: persists across readinto calls while
+        # no bytes flow, resets on progress (see module docstring).
+        self._attempts = 0
+        self._backoff: Optional[Backoff] = None
+        self._window_start: Optional[float] = None
+        self._inner = retry_call(
+            lambda: backend.open_read(name, start, length), retry,
+            sleep=sleep, clock=clock, rng=rng,
+        )
         self.reopen_count = 0
 
     def _reopen(self) -> None:
@@ -50,45 +74,54 @@ class _ResumingReader:
         self._inner = retry_call(
             lambda: self._backend.open_read(self._name, new_start, new_length),
             self._retry,
+            sleep=self._sleep, clock=self._clock, rng=self._rng,
         )
         self.reopen_count += 1
 
     def readinto(self, buf: memoryview) -> int:
-        attempts = 0
-        backoff = start = None  # lazily created: the happy path pays nothing
         while True:
             try:
                 n = self._inner.readinto(buf)
             except BaseException as exc:  # noqa: BLE001 — classified below
-                attempts += 1
+                self._attempts += 1
                 if not _is_retryable(exc, self._retry.policy):
                     raise
-                if self._retry.max_attempts and attempts >= self._retry.max_attempts:
+                if self._retry.max_attempts and (
+                    self._attempts >= self._retry.max_attempts
+                ):
                     raise
                 # Same bounding as retry_call: gax backoff pause between
                 # resume attempts, and deadline_s terminates an otherwise
-                # endless resume loop (e.g. 100% injected read faults).
-                if backoff is None:
-                    backoff = Backoff(self._retry)
-                    start = time.monotonic()
-                pause = backoff.pause()
+                # endless zero-progress resume loop (e.g. 100% injected
+                # read faults). Lazily created: the happy path pays
+                # nothing; discarded again once bytes flow.
+                if self._backoff is None:
+                    self._backoff = Backoff(self._retry, rng=self._rng)
+                    self._window_start = self._clock()
+                pause = self._backoff.pause()
                 if self._retry.deadline_s and (
-                    time.monotonic() - start
+                    self._clock() - self._window_start
                 ) + pause > self._retry.deadline_s:
                     raise
-                from tpubench.obs.flight import annotate as _flight_annotate
-
                 _flight_annotate(
-                    "retry", attempt=attempts, reason="resume",
+                    "retry", attempt=self._attempts, reason="resume",
                     error=type(exc).__name__,
                 )
-                time.sleep(pause)
+                self._sleep(pause)
                 self._reopen()
                 continue
             if n > 0 and self.first_byte_ns is None:
                 self.first_byte_ns = self._inner.first_byte_ns
             if n > 0:
                 self._delivered += n
+                if self._attempts:
+                    # Bytes flow again: every fault so far recovered, so
+                    # the NEXT fault gets the full gax allowance (fresh
+                    # counter, fresh backoff progression, fresh deadline
+                    # window) instead of the leftovers.
+                    self._attempts = 0
+                    self._backoff = None
+                    self._window_start = None
             return n
 
     def close(self) -> None:
@@ -96,26 +129,48 @@ class _ResumingReader:
 
 
 class RetryingBackend:
-    """Wraps any StorageBackend with the reference's client-level retry."""
+    """Wraps any StorageBackend with the reference's client-level retry.
 
-    def __init__(self, inner: StorageBackend, retry: Optional[RetryConfig] = None):
+    ``rng``/``sleep``/``clock`` flow through to every retry loop (open
+    retries AND mid-stream resumes) for deterministic chaos tests."""
+
+    def __init__(
+        self,
+        inner: StorageBackend,
+        retry: Optional[RetryConfig] = None,
+        *,
+        rng: Optional[random.Random] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self.inner = inner
         self.retry = retry or RetryConfig()
+        self._rng = rng
+        self._sleep = sleep
+        self._clock = clock
+
+    def _call(self, fn):
+        return retry_call(
+            fn, self.retry, sleep=self._sleep, clock=self._clock, rng=self._rng
+        )
 
     def open_read(self, name: str, start: int = 0, length: Optional[int] = None):
-        return _ResumingReader(self.inner, name, start, length, self.retry)
+        return _ResumingReader(
+            self.inner, name, start, length, self.retry,
+            rng=self._rng, sleep=self._sleep, clock=self._clock,
+        )
 
     def write(self, name: str, data: bytes) -> ObjectMeta:
-        return retry_call(lambda: self.inner.write(name, data), self.retry)
+        return self._call(lambda: self.inner.write(name, data))
 
     def list(self, prefix: str = "") -> list[ObjectMeta]:
-        return retry_call(lambda: self.inner.list(prefix), self.retry)
+        return self._call(lambda: self.inner.list(prefix))
 
     def stat(self, name: str) -> ObjectMeta:
-        return retry_call(lambda: self.inner.stat(name), self.retry)
+        return self._call(lambda: self.inner.stat(name))
 
     def delete(self, name: str) -> None:
-        return retry_call(lambda: self.inner.delete(name), self.retry)
+        return self._call(lambda: self.inner.delete(name))
 
     def close(self) -> None:
         self.inner.close()

@@ -1,0 +1,422 @@
+"""``tpubench chaos`` — scripted fault timelines + the resilience scorecard.
+
+Runs a workload (read or pod-ingest) against a hermetic target while a
+time-phased :class:`~tpubench.config.FaultConfig` schedule turns faults
+on and off mid-run, then scores how ingest *degraded and recovered*:
+
+* **goodput retention** — goodput during the fault window as a fraction
+  of the pre-fault baseline;
+* **p99 inflation** — read p99 during the fault vs the baseline;
+* **hedge win rate / wasted bytes, stall count, breaker open time** —
+  what the tail-tolerance layer (storage/tail.py) actually did;
+* **time-to-recover** — how long after the fault clears until windowed
+  goodput is back to ≥90 % of baseline.
+
+The per-read raw material is the PR-1 flight recorder: every read is a
+phase-stamped record (with hedge/stall/breaker events as notes), so the
+scorecard is computed offline from the run's own flight journal — and
+``tpubench report timeline`` attributes the same events per read.
+
+Hermetic by construction: the fault plane only exists in the fake
+backend and the fake servers, so chaos supports ``--protocol fake``
+(in-process store), ``http`` (in-process HTTP/1.1 server) and ``http``
++ ``--http2`` (in-process h2 server, native client). Wall-clock is
+bounded: every phase window and time-shaped fault duration scales by
+``TPUBENCH_BENCH_SLEEP_SCALE`` so CI can run a miniature timeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+import time
+from typing import Optional
+
+from tpubench.config import BenchConfig, parse_sleep_scale, validate_fault_config
+
+# Fault fields that are durations (seconds): these scale with the
+# timeline so a scaled-down run keeps the same *shape*.
+_TIME_FIELDS = ("latency_s", "per_read_latency_s", "stall_s")
+
+
+def _sleep_scale() -> float:
+    """Validated ``TPUBENCH_BENCH_SLEEP_SCALE`` — the SAME parser bench.py
+    uses (tpubench.config), applied here to every phase window and
+    time-shaped fault duration; unset = 1."""
+    return parse_sleep_scale("chaos timeline durations")
+
+
+def _scaled_phases(fc, scale: float) -> list:
+    out = []
+    for t0, t1, plan in fc.phases:
+        p = dict(plan)
+        for f in _TIME_FIELDS:
+            if p.get(f):
+                p[f] = p[f] * scale
+        out.append([t0 * scale, t1 * scale, p])
+    return out
+
+
+# ------------------------------------------------------------ scorecard ---
+
+
+def _segment_stats(reads: list, lo: float, hi: float,
+                   duration: float) -> dict:
+    """One timeline segment over ``reads`` = [(t_start, t_end, dur_ms,
+    bytes), ...], bucketed by COMPLETION time: goodput is bytes that
+    actually arrived during the segment's wall window, and a read that
+    began just before the fault and crawled through it carries its
+    latency into the segment where it finally landed."""
+    durs = sorted(r[2] for r in reads if lo <= r[1] < hi)
+    total = sum(r[3] for r in reads if lo <= r[1] < hi)
+
+    def pct(p: float) -> float:
+        if not durs:
+            return 0.0
+        return durs[min(len(durs) - 1, int(p * len(durs)))]
+
+    return {
+        "reads": len(durs),
+        "bytes": total,
+        "seconds": round(duration, 6),
+        "goodput_gbps": (total / 1e9 / duration) if duration > 0 else 0.0,
+        "p50_ms": pct(0.50),
+        "p99_ms": pct(0.99),
+    }
+
+
+def resilience_scorecard(
+    records: list[dict],
+    phases: list,
+    epoch_ns: int,
+    tail_stats: Optional[dict] = None,
+    recover_frac: float = 0.9,
+) -> dict:
+    """Score a run's flight records against its fault timeline.
+
+    ``phases`` are the (scaled) ``[t0, t1, plan]`` windows; the fault
+    window scored is their bounding box. ``epoch_ns`` is the
+    ``perf_counter_ns`` stamp taken when the schedule was armed, mapping
+    record timestamps onto timeline seconds."""
+    fault_t0 = min(p[0] for p in phases)
+    fault_t1 = max(p[1] for p in phases)
+    reads = []  # (t_start_s, t_end_s, dur_ms, bytes), timeline-relative
+    failed = 0
+    for r in records:
+        if r.get("kind", "read") != "read":
+            continue
+        if r.get("error"):
+            failed += 1
+            continue
+        ph = r.get("phases", {})
+        end_ns = ph.get("body_complete") or max(ph.values())
+        start_ns = ph.get("enqueue", end_ns)
+        reads.append((
+            (start_ns - epoch_ns) / 1e9,
+            (end_ns - epoch_ns) / 1e9,
+            (end_ns - start_ns) / 1e6,
+            int(r.get("bytes", 0)),
+        ))
+    run_end = max((r[1] for r in reads), default=fault_t1)
+
+    inf = float("inf")
+    base_s = _segment_stats(reads, -inf, fault_t0, fault_t0)
+    fault_s = _segment_stats(reads, fault_t0, fault_t1, fault_t1 - fault_t0)
+    rec_s = _segment_stats(reads, fault_t1, inf,
+                           max(0.0, run_end - fault_t1))
+    recovery = [r for r in reads if r[1] >= fault_t1]  # by completion
+
+    retention = None
+    if base_s["goodput_gbps"] > 0:
+        retention = fault_s["goodput_gbps"] / base_s["goodput_gbps"]
+    p99_inflation = None
+    if base_s["p99_ms"] > 0:
+        p99_inflation = fault_s["p99_ms"] / base_s["p99_ms"]
+
+    # Time-to-recover: the first sliding window after the fault clears
+    # whose goodput is back to >= recover_frac of baseline. A run that
+    # bounces back instantly scores 0.0; None = not recovered (or no
+    # baseline to recover to) within the run.
+    ttr = None
+    base_rate = base_s["goodput_gbps"] * 1e9  # B/s
+    if base_rate > 0 and recovery:
+        tail_len = max(1e-9, run_end - fault_t1)
+        w = min(max(0.05, tail_len / 4), max(0.05, fault_t0))
+        step = w / 4
+        s = fault_t1
+        while s + w <= run_end + step:
+            got = sum(r[3] for r in recovery if s <= r[1] < s + w)
+            if got / w >= recover_frac * base_rate:
+                ttr = s - fault_t1
+                break
+            s += step
+
+    card: dict = {
+        "fault_window_s": [fault_t0, fault_t1],
+        "baseline": base_s,
+        "fault": fault_s,
+        "recovery": rec_s,
+        "goodput_retention": retention,
+        "p99_inflation": p99_inflation,
+        "time_to_recover_s": ttr,
+        "recover_frac": recover_frac,
+        "failed_reads": failed,
+        "run_end_s": run_end,
+        # A timeline the run never reached is a mis-sized experiment —
+        # flag it rather than report a vacuous recovery. Zero successful
+        # reads is the degenerate case of exactly that.
+        "timeline_covered": bool(reads) and run_end >= fault_t1,
+    }
+    tail_stats = tail_stats or {}
+    hedge = dict(tail_stats.get("hedge", {}))
+    if hedge:
+        launched = hedge.get("hedges", 0)
+        hedge["win_rate"] = (
+            hedge.get("hedge_wins", 0) / launched if launched else None
+        )
+    card["hedge"] = hedge
+    card["stalls"] = tail_stats.get("watchdog", {}).get("stalls", 0)
+    breaker = tail_stats.get("breaker")
+    if breaker:
+        card["breaker"] = {
+            "opens": breaker.get("opens", 0),
+            "open_s": breaker.get("open_s", 0.0),
+            "state": breaker.get("state"),
+        }
+    return card
+
+
+def format_scorecard(chaos: dict) -> str:
+    """Human rendering of ``extra["chaos"]`` (also used by ``tpubench
+    report`` on chaos result files)."""
+    sc = chaos.get("scorecard", {})
+    t0, t1 = sc.get("fault_window_s", (0, 0))
+    lines = [
+        f"== resilience scorecard ({chaos.get('workload', 'read')}; "
+        f"fault window {t0:.2f}s-{t1:.2f}s) ==",
+    ]
+    for seg in ("baseline", "fault", "recovery"):
+        s = sc.get(seg, {})
+        lines.append(
+            f"  {seg:<9} reads={s.get('reads', 0):<5} "
+            f"goodput={s.get('goodput_gbps', 0.0):.4f} GB/s  "
+            f"p50={s.get('p50_ms', 0.0):.2f} ms  "
+            f"p99={s.get('p99_ms', 0.0):.2f} ms"
+        )
+    ret = sc.get("goodput_retention")
+    infl = sc.get("p99_inflation")
+    ttr = sc.get("time_to_recover_s")
+    lines.append(
+        "  goodput retention: "
+        + (f"{ret:.1%}" if ret is not None else "n/a (no baseline)")
+    )
+    lines.append(
+        "  p99 inflation:     "
+        + (f"{infl:.2f}x" if infl is not None else "n/a")
+    )
+    lines.append(
+        "  time-to-recover:   "
+        + (f"{ttr:.3f}s" if ttr is not None else
+           "not recovered within run"
+           if sc.get("timeline_covered") else "n/a (run ended mid-fault)")
+    )
+    hedge = sc.get("hedge") or {}
+    if hedge:
+        wr = hedge.get("win_rate")
+        wr_cell = f"{wr:.1%}" if wr is not None else "n/a"
+        lines.append(
+            f"  hedges: launched={hedge.get('hedges', 0)} "
+            f"wins={hedge.get('hedge_wins', 0)} "
+            f"losses={hedge.get('hedge_losses', 0)} "
+            f"win_rate={wr_cell} "
+            f"wasted_bytes={hedge.get('wasted_bytes', 0)}"
+        )
+    lines.append(f"  stalls detected:   {sc.get('stalls', 0)}")
+    br = sc.get("breaker")
+    if br:
+        lines.append(
+            f"  breaker: opens={br['opens']} open_s={br['open_s']:.3f} "
+            f"state={br['state']}"
+        )
+    lines.append(f"  failed reads:      {sc.get('failed_reads', 0)}")
+    return "\n".join(lines)
+
+
+# -------------------------------------------------------------- workload --
+
+
+def run_chaos(
+    cfg: BenchConfig,
+    timeline: Optional[list] = None,
+    chaos_workload: str = "read",
+):
+    """Run ``chaos_workload`` under the scheduled fault timeline and
+    return its RunResult with ``extra["chaos"]`` (the scorecard) stamped.
+
+    ``timeline`` (``[[t0, t1, {fault fields}], ...]``) overrides
+    ``cfg.transport.fault.phases``. The target is hermetic: the fake
+    backend for ``--protocol fake``, an in-process fake GCS server for
+    ``http`` (h1.1, or the h2 server with ``--http2``)."""
+    fc = cfg.transport.fault
+    if timeline is not None:
+        fc.phases = timeline
+    validate_fault_config(fc, "transport.fault")
+    if not fc.phases:
+        raise SystemExit(
+            "chaos: no fault timeline — pass --chaos-timeline or the "
+            "--chaos-fault/--chaos-start/--chaos-duration trio "
+            "(fault.phases in a config file also works)"
+        )
+    proto = cfg.transport.protocol
+    if proto not in ("fake", "http") or (
+        proto == "http" and cfg.transport.endpoint
+    ):
+        raise SystemExit(
+            "chaos: hermetic protocols only (fake, or http[--http2] "
+            f"against the in-process fake server), not {proto!r} with "
+            f"endpoint {cfg.transport.endpoint!r} — the fault plane "
+            "lives in the fake backend/servers"
+        )
+
+    # Scale into a LOCAL fault dict — never back into cfg, which the
+    # caller may reuse (a second run must not double-scale its timeline).
+    scale = _sleep_scale()
+    phases = _scaled_phases(fc, scale)
+    fdict = dataclasses.asdict(fc)
+    fdict["phases"] = phases
+    for f in _TIME_FIELDS:
+        if fdict.get(f):
+            fdict[f] = fdict[f] * scale
+
+    # Flight recorder is the scorecard's raw material: force it on, sized
+    # to hold every read, journaled to disk (a temp path unless the run
+    # already asked for one). Every cfg field touched here is restored on
+    # exit — the caller's config must survive a second run unchanged
+    # (the hedged-vs-plain A/B reuses one config).
+    w = cfg.workload
+    cfg_restore = {
+        "endpoint": cfg.transport.endpoint,
+        "flight_records": cfg.obs.flight_records,
+        "flight_journal": cfg.obs.flight_journal,
+    }
+    cfg.obs.flight_records = max(
+        cfg.obs.flight_records, w.read_calls_per_worker * 2 + 64
+    )
+    tmp_journal = None
+    if not cfg.obs.flight_journal:
+        fd, tmp_journal = tempfile.mkstemp(prefix="tpubench-chaos-", suffix=".json")
+        os.close(fd)
+        cfg.obs.flight_journal = tmp_journal
+
+    from tpubench.storage.fake import FakeBackend, FaultPlan
+
+    server = None
+    backend = None
+    plan = FaultPlan(**fdict)
+    try:
+        if proto == "http":
+            # In-process server speaking the real wire protocol, backed by
+            # a fake store carrying the fault plan (server-side injection:
+            # stalls/resets/truncation happen ON THE WIRE).
+            store = FakeBackend.prepopulated(
+                prefix=w.object_name_prefix,
+                count=max(w.workers, w.threads),
+                size=w.object_size,
+                fault=plan,
+            )
+            if cfg.transport.http2:
+                from tpubench.storage.fake_h2_server import FakeH2Server
+
+                server = FakeH2Server(backend=store).start()
+            else:
+                from tpubench.storage.fake_server import FakeGcsServer
+
+                server = FakeGcsServer(backend=store).start()
+            cfg.transport.endpoint = server.endpoint
+            if cfg.transport.http2 or cfg.transport.native_receive:
+                # Load the C++ engine BEFORE arming: its first-use cost
+                # (dlopen, possibly a compile) must not eat the
+                # timeline's baseline window.
+                from tpubench.native.engine import get_engine
+
+                get_engine()
+
+        # Pre-build everything expensive (workload import, client
+        # backend), then arm: timeline second 0 ≈ the first read, so the
+        # baseline window actually measures reads, not bring-up. Both
+        # workloads get the SAME armed plan (via the explicit backend),
+        # so phase windows and scorecard segments share one epoch.
+        if chaos_workload == "read":
+            from tpubench.workloads.read import run_read as _runner
+        elif chaos_workload == "pod-ingest":
+            from tpubench.workloads.pod_ingest import run_pod_ingest
+
+            def _runner(cfg, backend):
+                return run_pod_ingest(cfg, backend=backend)
+        else:
+            raise SystemExit(
+                f"chaos: unknown workload {chaos_workload!r} "
+                "(read|pod-ingest)"
+            )
+        from tpubench.storage import open_backend
+
+        backend = open_backend(cfg, fault=plan if proto == "fake" else None)
+        # One best-effort warm-up read before arming: connection setup,
+        # TLS, stat caches and thread machinery must not be billed to
+        # the timeline's baseline window.
+        try:
+            from tpubench.storage.base import read_object_through
+
+            read_object_through(
+                backend.open_read(f"{w.object_name_prefix}0"),
+                memoryview(bytearray(w.granule_bytes)),
+            )
+        except Exception:  # noqa: BLE001 — the run will surface it
+            pass
+        epoch_ns = time.perf_counter_ns()
+        plan.arm()
+        res = _runner(cfg, backend=backend)
+
+        jpath = res.extra.get("flight_journal") or cfg.obs.flight_journal
+        with open(jpath) as f:
+            records = json.load(f).get("records", [])
+        if tmp_journal is not None:
+            # The journal was only the scorecard's scratch input — don't
+            # advertise a path that is about to be deleted.
+            res.extra.pop("flight_journal", None)
+        # Tail-tolerance counters: the read workload stamps them itself;
+        # pod-ingest doesn't, but run_chaos holds the wrapped backend —
+        # collect here so the scorecard never under-reports what the
+        # hedging/watchdog/breaker machinery actually did.
+        if "tail" not in res.extra:
+            from tpubench.storage.tail import collect_tail_stats
+
+            ts = collect_tail_stats(backend)
+            if ts:
+                res.extra["tail"] = ts
+        res.workload = "chaos"
+        res.extra["chaos"] = {
+            "workload": chaos_workload,
+            "timeline": phases,
+            "sleep_scale": scale,
+            "scorecard": resilience_scorecard(
+                records, phases, epoch_ns,
+                tail_stats=res.extra.get("tail"),
+            ),
+        }
+        return res
+    finally:
+        if backend is not None:
+            backend.close()
+        if server is not None:
+            server.stop()
+        if tmp_journal is not None:
+            try:
+                os.unlink(tmp_journal)
+            except OSError:
+                pass
+        cfg.transport.endpoint = cfg_restore["endpoint"]
+        cfg.obs.flight_records = cfg_restore["flight_records"]
+        cfg.obs.flight_journal = cfg_restore["flight_journal"]

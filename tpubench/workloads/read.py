@@ -223,10 +223,23 @@ class ReadWorkload:
         # Native-receive connection accounting (connects/reuses/
         # stale_retries) — read from the pool only if one was actually
         # built, so this never constructs a pool as a side effect.
-        inner = getattr(self.backend, "inner", self.backend)
+        inner = self.backend
+        for _ in range(8):  # unwrap retry/tail decorators to the base client
+            nxt = getattr(inner, "inner", None)
+            if nxt is None:
+                break
+            inner = nxt
         native_pool = getattr(inner, "_native_pool_obj", None)
         if native_pool is not None:
             res.extra["native_conn_stats"] = dict(native_pool.stats)
+        # Tail-tolerance counters (hedge wins/losses/wasted bytes, stalls,
+        # breaker state/open-time) from whatever tail wrappers are in the
+        # backend chain — the resilience scorecard's raw material.
+        from tpubench.storage.tail import collect_tail_stats
+
+        tail_stats = collect_tail_stats(self.backend)
+        if tail_stats:
+            res.extra["tail"] = tail_stats
         if staged:
             res.extra["staging_zero_copy"] = all(zero_copy_used)
             res.extra["staged_bytes"] = staged

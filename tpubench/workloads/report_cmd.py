@@ -71,6 +71,13 @@ def summarize_run(run: dict, label: str = "") -> str:
         lines.append(f"  staged GB/s/chip={staged:.4f}")
     if "checksum_ok" in extra:
         lines.append(f"  checksum_ok={extra['checksum_ok']}")
+    chaos = extra.get("chaos")
+    if chaos:
+        # The resilience scorecard travels in the result file; render it
+        # with the same body `tpubench chaos` printed live.
+        from tpubench.workloads.chaos import format_scorecard
+
+        lines.append(format_scorecard(chaos))
     return "\n".join(lines)
 
 
@@ -100,6 +107,26 @@ def compare_runs(runs: list[dict]) -> str:
                 f"    {name}: p50 {s.get('p50_ms', 0.0):.3f} ms "
                 f"({d50:+.3f}), p99 {s.get('p99_ms', 0.0):.3f} ms "
                 f"({d99:+.3f})"
+            )
+        # Scorecard diff: two chaos runs (e.g. hedged vs unhedged over the
+        # same timeline) compare on resilience, not just throughput.
+        osc = (other.get("extra", {}).get("chaos") or {}).get("scorecard")
+        bsc = (base.get("extra", {}).get("chaos") or {}).get("scorecard")
+        if osc and bsc:
+            def cell(sc, key, fmt):
+                v = sc.get(key)
+                return fmt.format(v) if v is not None else "n/a"
+
+            lines.append(
+                "    scorecard: retention "
+                f"{cell(osc, 'goodput_retention', '{:.1%}')} vs "
+                f"{cell(bsc, 'goodput_retention', '{:.1%}')}, "
+                "p99 inflation "
+                f"{cell(osc, 'p99_inflation', '{:.2f}x')} vs "
+                f"{cell(bsc, 'p99_inflation', '{:.2f}x')}, "
+                "time-to-recover "
+                f"{cell(osc, 'time_to_recover_s', '{:.3f}s')} vs "
+                f"{cell(bsc, 'time_to_recover_s', '{:.3f}s')}"
             )
     return "\n".join(lines)
 
