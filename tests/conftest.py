@@ -46,3 +46,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: tail-tolerance / fault-timeline tests"
     )
+    # Pipeline tests (chunk cache / prefetcher / train-ingest) stay in
+    # tier-1 — same policy as `flight`/`chaos`: not slow-marked, so the
+    # ingest pipeline is exercised on every pass; the marker exists for
+    # selective runs (`-m pipeline`).
+    config.addinivalue_line(
+        "markers", "pipeline: ingest pipeline (cache/prefetch/train-ingest)"
+    )

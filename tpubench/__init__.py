@@ -23,6 +23,7 @@ from tpubench.config import (  # noqa: F401
     BenchConfig,
     DistConfig,
     ObservabilityConfig,
+    PipelineConfig,
     RetryConfig,
     StagingConfig,
     TransportConfig,

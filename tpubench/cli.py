@@ -147,6 +147,39 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--breaker-probes", type=int,
                    help="half-open probe successes required to close "
                         "(default 1)")
+    p.add_argument("--cache-bytes", type=int,
+                   help="ingest pipeline: host chunk-cache budget in bytes "
+                        "(LRU, single-flight dedup; 0 disables caching)")
+    p.add_argument("--readahead", type=int,
+                   help="ingest pipeline: readahead depth in chunks the "
+                        "prefetcher keeps scheduled ahead of the consumer "
+                        "(0 = cold demand reads, the A/B baseline)")
+    p.add_argument("--readahead-bytes", type=int,
+                   help="ingest pipeline: prefetch byte budget (in-flight "
+                        "+ unconsumed prefetched bytes; 0 = depth-bounded)")
+    p.add_argument("--prefetch-workers", type=int,
+                   help="ingest pipeline: prefetch worker threads")
+    p.add_argument("--steps", type=int,
+                   help="train-ingest: training steps per epoch")
+    p.add_argument("--epochs", type=int,
+                   help="train-ingest: epochs (the plan repeats; epoch 2+ "
+                        "measures the warm-cache path)")
+    p.add_argument("--batch-shards", type=int,
+                   help="train-ingest: chunks consumed per step")
+    p.add_argument("--chunk-bytes", type=int,
+                   help="ingest pipeline: chunk size in bytes "
+                        "(default: workload.granule_bytes)")
+    p.add_argument("--step-compute-ms", type=float,
+                   help="train-ingest: synthetic per-step compute window "
+                        "(ms) the prefetcher hides fetch latency behind")
+    p.add_argument("--stall-threshold-ms", type=float,
+                   help="train-ingest: a step whose data wait exceeds this "
+                        "counts as a stalled step")
+    p.add_argument("--pipeline-pod", action="store_true",
+                   help="train-ingest: stage each step's batch as "
+                        "byte-range shards across the mesh and reassemble "
+                        "over ICI (dist.shard/reassemble) instead of the "
+                        "slot-ring device_put path")
     p.add_argument("--retry-deadline", type=float,
                    help="per-op retry deadline (s); bounds the reference's "
                         "retry-forever default — set this with --fault-* "
@@ -307,6 +340,20 @@ def build_config(args) -> BenchConfig:
         raise SystemExit(
             f"--stall-floor-bps {tail.stall_floor_bps}: must be >= 0"
         )
+    pl = cfg.pipeline
+    for attr in (
+        "cache_bytes", "readahead", "readahead_bytes", "prefetch_workers",
+        "steps", "epochs", "batch_shards", "chunk_bytes",
+        "step_compute_ms", "stall_threshold_ms",
+    ):
+        v = getattr(args, attr, None)
+        if v is not None:
+            setattr(pl, attr, v)
+    if getattr(args, "pipeline_pod", False):
+        pl.pod = True
+    from tpubench.config import validate_pipeline_config
+
+    validate_pipeline_config(pl)
     if args.retry_deadline is not None:
         t.retry.deadline_s = args.retry_deadline
     if args.retry_max_attempts is not None:
@@ -597,6 +644,10 @@ def main(argv=None) -> int:
         return p
 
     add("read", "root GCS read bench (reference main.go)")
+    add("train-ingest", "step-paced training-loop ingest: chunk cache + "
+                        "readahead prefetch + data-stall accounting "
+                        "(see --cache-bytes/--readahead/--steps/"
+                        "--step-compute-ms)")
     add("pod-ingest", "sharded object → pod HBM with ICI all-gather")
     stream = add("stream", "pipelined multi-object pod ingest (fetch ∥ stage+gather)")
     stream.add_argument("--objects", type=int, default=8)
@@ -626,9 +677,13 @@ def main(argv=None) -> int:
     chaos = add("chaos", "scripted fault timeline + resilience scorecard "
                          "(hermetic: fake backend or in-process fake "
                          "server; see --chaos-*)")
-    chaos.add_argument("--chaos-workload", choices=("read", "pod-ingest"),
+    chaos.add_argument("--chaos-workload",
+                       choices=("read", "pod-ingest", "train-ingest"),
                        default="read",
-                       help="workload the fault timeline runs against")
+                       help="workload the fault timeline runs against "
+                            "(train-ingest: the fault schedule exercises "
+                            "the prefetcher — a blackhole shows up as "
+                            "data-stall time, never a hang)")
     chaos.add_argument("--chaos-timeline",
                        help="JSON [[t0,t1,{fault fields}],...] (seconds "
                             "from run start), or @path to a JSON file; "
@@ -799,6 +854,14 @@ def main(argv=None) -> int:
     with maybe_profile(cfg.obs.profile_dir):
         if args.cmd == "read":
             res = cmd_read(cfg, args)
+        elif args.cmd == "train-ingest":
+            from tpubench.workloads.train_ingest import (
+                format_pipeline_scorecard,
+                run_train_ingest,
+            )
+
+            res = run_train_ingest(cfg)
+            print(format_pipeline_scorecard(res.extra["pipeline"]))
         elif args.cmd == "pod-ingest":
             res = cmd_pod_ingest(cfg, args)
         elif args.cmd == "stream":

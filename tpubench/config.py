@@ -250,6 +250,74 @@ class TailConfig:
 
 
 @dataclass
+class PipelineConfig:
+    """Ingest pipeline (tpubench/pipeline/): host chunk cache + readahead
+    prefetcher + the step-paced ``train-ingest`` workload.
+
+    The reference (and every other tpubench workload) issues cold,
+    demand-driven reads — no overlap between fetch and consumption. This
+    subsystem is the input pipeline that hides storage latency behind
+    compute and *measures how well it does so*: per-step data-stall time,
+    cache hit ratio, prefetch efficiency (used vs wasted bytes).
+    """
+
+    # Host-RAM chunk cache budget (bytes). Entries are keyed by
+    # (bucket, object, generation, range); byte-budgeted LRU eviction with
+    # single-flight dedup of concurrent misses. 0 disables caching (every
+    # chunk access is a backend read — the cold baseline).
+    cache_bytes: int = 256 * MB
+    # Readahead depth in CHUNKS: how far ahead of the consumer the
+    # prefetcher walks the access plan. 0 disables prefetch entirely
+    # (the cold A/B arm).
+    readahead: int = 8
+    # Prefetch byte budget: in-flight + cached-but-unconsumed prefetched
+    # bytes never exceed this (0 = bounded by readahead depth alone).
+    readahead_bytes: int = 0
+    # Worker threads issuing prefetch reads through the backend stack
+    # (hedging/watchdog/breaker/retry compose underneath, like any read).
+    prefetch_workers: int = 2
+    # --- train-ingest step loop ---
+    steps: int = 8  # training steps per epoch
+    epochs: int = 1  # plan repeats; epoch 2+ re-reads (cache hit path)
+    batch_shards: int = 4  # chunks consumed per step
+    # Chunk size (bytes); 0 = workload.granule_bytes.
+    chunk_bytes: int = 0
+    # Synthetic per-step compute time (ms): the window prefetch has to
+    # hide the next batch's fetch latency behind.
+    step_compute_ms: float = 0.0
+    # A step whose data-wait exceeds this is a *stalled step* (the
+    # stalled-step fraction the scorecard reports).
+    stall_threshold_ms: float = 1.0
+    # Pod path: stage each step's batch as byte-range shards across the
+    # mesh and reassemble over ICI (dist.shard / dist.reassemble), instead
+    # of the per-host slot-ring device_put path.
+    pod: bool = False
+
+
+def validate_pipeline_config(pc: "PipelineConfig",
+                             where: str = "pipeline") -> None:
+    """Parse-time sanity for the pipeline knobs (same one-line SystemExit
+    style as validate_fault_config)."""
+    for name, lo in (
+        ("cache_bytes", 0), ("readahead", 0), ("readahead_bytes", 0),
+        ("prefetch_workers", 1), ("steps", 1), ("epochs", 1),
+        ("batch_shards", 1), ("chunk_bytes", 0),
+    ):
+        v = getattr(pc, name)
+        if v < lo:
+            raise SystemExit(f"{where}.{name}={v!r}: must be >= {lo}")
+    for name in ("step_compute_ms", "stall_threshold_ms"):
+        v = getattr(pc, name)
+        if not (v >= 0):  # also rejects NaN
+            raise SystemExit(f"{where}.{name}={v!r}: must be >= 0")
+    # The cross-field readahead/cache/chunk checks live in
+    # run_train_ingest, where the effective chunk size is known AND only
+    # the workload that actually constructs the pipeline pays them —
+    # `tpubench read --cache-bytes 0` must not fail on the pipeline's
+    # default readahead.
+
+
+@dataclass
 class TransportConfig:
     """L1 client construction knobs (reference ``main.go:30-42,62-117``)."""
 
@@ -444,6 +512,7 @@ class BenchConfig:
     staging: StagingConfig = field(default_factory=StagingConfig)
     dist: DistConfig = field(default_factory=DistConfig)
     obs: ObservabilityConfig = field(default_factory=ObservabilityConfig)
+    pipeline: PipelineConfig = field(default_factory=PipelineConfig)
 
     # ------------------------------------------------------------------ io --
     def to_dict(self) -> dict[str, Any]:
@@ -478,6 +547,7 @@ _SUBTYPES = {
     "staging": StagingConfig,
     "dist": DistConfig,
     "obs": ObservabilityConfig,
+    "pipeline": PipelineConfig,
     "retry": RetryConfig,
     "fault": FaultConfig,
     "tail": TailConfig,

@@ -51,12 +51,22 @@ JOURNAL_FORMAT = "tpubench-flight-v1"
 # Canonical phase order; segment durations are computed between
 # consecutive phases PRESENT in a record and attributed to the later one
 # ("time spent reaching first_byte from the previous milestone").
+# Pipeline phases (PR 3): cache_hit/cache_miss stamp a chunk access's
+# resolution, prefetch_issue marks a readahead fetch leaving the queue,
+# and stall_begin/stall_end bracket a train-ingest step's data wait — so
+# `report timeline` attributes stalls (the stall_end segment IS the
+# stall duration) the same way it attributes connect/first_byte time.
 PHASES = (
     "enqueue",
+    "cache_hit",
+    "cache_miss",
+    "prefetch_issue",
     "connect",
     "stream_open",
     "first_byte",
     "body_complete",
+    "stall_begin",
+    "stall_end",
     "hbm_staged",
     "gather_complete",
 )
@@ -145,6 +155,18 @@ class FlightOp:
         if self._done:
             return
         self.notes.append({"kind": kind, "t": time.perf_counter_ns(), **info})
+
+    def abandon(self) -> None:
+        """Discard the op WITHOUT appending a record: the work it was
+        opened for turned out to be a no-op (e.g. a prefetch pop whose
+        chunk a demand read claimed first). A zero-byte ~0 ms record
+        would dilute every downstream percentile, so none is written;
+        the thread's channel is still released."""
+        if self._done:
+            return
+        self._done = True
+        if self._installed and getattr(_tls, "op", None) is self:
+            _tls.op = None
 
     def finish(self, nbytes: int = 0, error: Optional[BaseException] = None
                ) -> None:
@@ -453,11 +475,38 @@ def timeline_summary(records: list[dict]) -> dict:
         "stalls": sum(1 for n in notes if n.get("kind") == "stall"),
         "breaker_events": sum(1 for n in notes if n.get("kind") == "breaker"),
     }
+    # Ingest-pipeline attribution (PR 3): step records carry
+    # stall_begin/stall_end only when the step actually waited for data,
+    # so the stalled-step count and the stall_end segment stats below ARE
+    # the timeline's data-stall story; chunk records carry their cache
+    # resolution (hit/miss/prefetch) as phases.
+    steps = [r for r in records if r.get("kind") == "step"]
+    pipeline = {
+        "steps": len(steps),
+        # Any step that waited on data at all (has the stall phases).
+        # Deliberately NOT named "stalled_steps": the scorecard's
+        # stalled-step count applies stall_threshold_ms, which the
+        # journal doesn't carry — two different quantities must not
+        # share a name.
+        "steps_with_data_wait": sum(
+            1 for r in steps if "stall_end" in r.get("phases", {})
+        ),
+        "cache_hits": sum(
+            1 for r in records if "cache_hit" in r.get("phases", {})
+        ),
+        "cache_misses": sum(
+            1 for r in records if "cache_miss" in r.get("phases", {})
+        ),
+        "prefetch_issues": sum(
+            1 for r in records if "prefetch_issue" in r.get("phases", {})
+        ),
+    }
     return {
         "records": len(records),
         "errors": errors,
         "retries": retries,
         "tail": tail,
+        "pipeline": pipeline,
         "hosts": sorted({r.get("host", 0) for r in records}),
         "phases": _phase_stats(records),
         "stragglers": {
@@ -505,6 +554,15 @@ def render_timeline(docs: list[dict]) -> str:
             f"(wins={tail['hedge_wins']}) stalls={tail['stalls']} "
             f"breaker={tail['breaker_events']}"
         )
+    pipe = summ.get("pipeline", {})
+    if any(pipe.values()):
+        lines.append(
+            f"pipeline: steps={pipe['steps']} "
+            f"(with_data_wait={pipe['steps_with_data_wait']}) "
+            f"cache_hits={pipe['cache_hits']} "
+            f"cache_misses={pipe['cache_misses']} "
+            f"prefetch_issues={pipe['prefetch_issues']}"
+        )
     lines.append("phase segments (ms):")
     for name, s in summ["phases"].items():
         lines.append(
@@ -532,10 +590,32 @@ def render_timeline(docs: list[dict]) -> str:
 
 
 def load_journals(paths: Iterable[str]) -> list[dict]:
+    """Load journal docs, degrading gracefully on partial files: an empty
+    or truncated journal (a run died mid-flush, or the stream writer was
+    killed between SnapshotWriter flushes) is SKIPPED with a one-line
+    warning instead of a traceback — one dead host must not make the
+    pod's other journals unreadable. A well-formed JSON doc that is not
+    a flight journal is still a hard error (wrong file, not a partial
+    one)."""
+    import sys
+
     docs = []
     for p in paths:
         with open(p) as f:
-            doc = json.load(f)
+            raw = f.read()
+        if not raw.strip():
+            print(f"warning: {p}: empty flight journal, skipped",
+                  file=sys.stderr)
+            continue
+        try:
+            doc = json.loads(raw)
+        except json.JSONDecodeError as e:
+            print(
+                f"warning: {p}: truncated/partial flight journal "
+                f"({e.msg} at char {e.pos}), skipped",
+                file=sys.stderr,
+            )
+            continue
         if doc.get("format") != JOURNAL_FORMAT:
             raise ValueError(
                 f"{p}: not a flight journal (format="

@@ -1,0 +1,10 @@
+"""Ingest pipeline subsystem (the fetch∥consume overlap the reference
+lacks): a bounded host-RAM chunk cache (:mod:`cache`), a plan-walking
+readahead prefetcher (:mod:`prefetch`), and the step-paced
+``train-ingest`` workload (:mod:`tpubench.workloads.train_ingest`) that
+measures how well they hide storage latency behind compute —
+per-step data-stall time, cache hit ratio, prefetch efficiency.
+"""
+
+from tpubench.pipeline.cache import ChunkCache, ChunkKey  # noqa: F401
+from tpubench.pipeline.prefetch import Prefetcher  # noqa: F401

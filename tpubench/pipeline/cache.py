@@ -1,0 +1,328 @@
+"""Bounded host-RAM chunk cache with single-flight miss dedup.
+
+The pipeline's working set is chunks — ``(bucket, object, generation,
+range)``-keyed byte slices of storage objects. The cache is byte-budgeted
+(not entry-counted: a 100 MB chunk and a 256 KB chunk are not the same
+cost) with LRU eviction, and **single-flight**: N concurrent misses for
+one chunk issue ONE backend read, the rest wait on it (the coalesce
+counter records how many reads the dedup saved — the thundering-herd
+shape a prefetcher racing demand reads produces constantly).
+
+Generation is part of the key, so an overwritten object can never serve
+stale bytes; entries of superseded generations are dropped eagerly the
+moment a newer generation is seen (counted, so invalidation is
+observable in the ``extra["pipeline"]["cache"]`` stamp).
+
+Prefetch-efficiency accounting lives here because only the cache sees
+both sides: entries carry their origin (``prefetch`` vs ``demand``) and
+a used bit; a prefetched entry's bytes count as *used* on its first hit
+and as *wasted* when it is evicted — or still sitting unused at the end
+of the run — without ever being consumed.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, NamedTuple, Optional
+
+
+class ChunkKey(NamedTuple):
+    bucket: str
+    object: str
+    generation: int
+    start: int
+    length: int
+
+
+class _Entry:
+    __slots__ = ("data", "origin", "used")
+
+    def __init__(self, data: bytes, origin: str):
+        self.data = data
+        self.origin = origin
+        self.used = False
+
+
+class _Flight:
+    """One in-flight fetch; losers of the single-flight race wait on it."""
+
+    __slots__ = ("event", "data", "error", "consumer_waiters")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.data: Optional[bytes] = None
+        self.error: Optional[BaseException] = None
+        # Consumers blocked on this fetch (lock-guarded): the owner
+        # marks the landed entry used at INSERT time when any exist, so
+        # an eviction racing the waiter's wakeup can never count bytes
+        # that were consumed as prefetch waste.
+        self.consumer_waiters = 0
+
+
+class ChunkCache:
+    """Thread-safe byte-budgeted LRU chunk cache (see module docstring).
+
+    ``capacity_bytes <= 0`` disables storage entirely — every access is a
+    recorded miss that fetches through (the cold baseline arm of the
+    pipeline A/B), and single-flight dedup still applies.
+    """
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity = max(0, int(capacity_bytes))
+        self.bytes = 0
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[ChunkKey, _Entry]" = OrderedDict()
+        self._inflight: dict[ChunkKey, _Flight] = {}
+        self._obj_gen: dict[tuple[str, str], int] = {}
+        # Counters (the extra["pipeline"]["cache"] stamp).
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.coalesced = 0  # misses served by an already-in-flight fetch
+        self.inserted_bytes = 0
+        self.evicted_bytes = 0
+        self.oversize_skips = 0  # chunks larger than the whole budget
+        self.generation_invalidations = 0
+        self.stale_rejects = 0  # superseded-generation inserts refused
+        self.prefetch_inserted_bytes = 0
+        self.prefetch_used_bytes = 0
+        # Two flavors of prefetch waste, kept separate on purpose: the
+        # prefetcher's byte-budget estimate relies on the identity
+        # resident_unused = inserted - used - wasted, so `wasted` may
+        # only count bytes that WERE resident (evictions). Bytes that
+        # never entered the cache (oversize skip, stale-generation
+        # reject) go in `dropped` — folding them into `wasted` would
+        # deflate the identity and let prefetch exceed its budget.
+        self.prefetch_wasted_bytes = 0  # evicted before any use
+        self.prefetch_dropped_bytes = 0  # never cached at all
+        self.prefetch_invalidated_bytes = 0  # dropped by a newer generation
+        # Directly-maintained count of resident prefetched-but-unused
+        # bytes: the prefetcher's byte-budget source of truth (O(1),
+        # no derived identity to keep consistent across drop reasons).
+        self.prefetch_resident_unused = 0
+
+    # ------------------------------------------------------------ internal --
+    def _note_generation_locked(self, key: ChunkKey) -> None:
+        """Eager invalidation: the first sighting of a newer generation
+        drops every entry of the object's older generations."""
+        ok = (key.bucket, key.object)
+        g = self._obj_gen.get(ok)
+        if g is None or key.generation > g:
+            if g is not None:
+                stale = [
+                    k for k in self._entries
+                    if (k.bucket, k.object) == ok and k.generation < key.generation
+                ]
+                for k in stale:
+                    self._drop_locked(k, reason="invalidate")
+                    self.generation_invalidations += 1
+            self._obj_gen[ok] = key.generation
+
+    def _mark_used_locked(self, e: _Entry) -> None:
+        if e.origin == "prefetch" and not e.used:
+            self.prefetch_used_bytes += len(e.data)
+            self.prefetch_resident_unused -= len(e.data)
+        e.used = True
+
+    def _drop_locked(self, key: ChunkKey, reason: str = "evict") -> None:
+        e = self._entries.pop(key)
+        self.bytes -= len(e.data)
+        self.evicted_bytes += len(e.data)
+        if e.origin == "prefetch" and not e.used:
+            self.prefetch_resident_unused -= len(e.data)
+            if reason == "invalidate":
+                # Kept out of `wasted`: the prefetcher's cancel-on-
+                # eviction thrash guard watches wasted bytes, and a
+                # generation invalidation is data churn, not a sign the
+                # readahead window outran the cache budget.
+                self.prefetch_invalidated_bytes += len(e.data)
+            else:
+                self.prefetch_wasted_bytes += len(e.data)
+
+    def _insert_locked(self, key: ChunkKey, data: bytes, origin: str) -> None:
+        n = len(data)
+        g = self._obj_gen.get((key.bucket, key.object))
+        if g is not None and key.generation < g:
+            # An in-flight fetch of a superseded generation completed
+            # AFTER the invalidation pass — never resurrect stale bytes
+            # (a later gen-g sighting would not drop them: invalidation
+            # fires only on strictly newer generations).
+            self.stale_rejects += 1
+            if origin == "prefetch":
+                self.prefetch_dropped_bytes += n
+            return
+        if n > self.capacity:
+            # A chunk that cannot fit even an empty cache would evict the
+            # whole working set for nothing — serve it uncached.
+            self.oversize_skips += 1
+            if origin == "prefetch":
+                self.prefetch_dropped_bytes += n
+            return
+        if key in self._entries:
+            return  # racer already inserted the same (immutable) bytes
+        while self.bytes + n > self.capacity:
+            old_key = next(iter(self._entries))
+            self._drop_locked(old_key)
+            self.evictions += 1
+        self._entries[key] = _Entry(data, origin)
+        self.bytes += n
+        self.inserted_bytes += n
+        if origin == "prefetch":
+            self.prefetch_inserted_bytes += n
+            self.prefetch_resident_unused += n
+
+    def _hit_locked(self, key: ChunkKey, e: _Entry) -> bytes:
+        self._entries.move_to_end(key)
+        self.hits += 1
+        self._mark_used_locked(e)
+        return e.data
+
+    # ------------------------------------------------------------- surface --
+    def get(self, key: ChunkKey) -> Optional[bytes]:
+        """Consumer hit-or-None lookup (no fetch, no miss accounting).
+        The prefetcher's membership probe is :meth:`contains` — this one
+        counts a hit and marks the entry used."""
+        with self._lock:
+            e = self._entries.get(key)
+            return self._hit_locked(key, e) if e is not None else None
+
+    def contains(self, key: ChunkKey) -> bool:
+        with self._lock:
+            return key in self._entries or key in self._inflight
+
+    def get_or_fetch(
+        self, key: ChunkKey, fetch: Callable[[], bytes],
+        origin: str = "demand", consumer: bool = True,
+    ) -> bytes:
+        """The consumer path: hit → cached bytes; miss → ``fetch()`` once
+        per key no matter how many threads ask concurrently (losers wait
+        and share the winner's bytes — or its exception).
+
+        ``consumer=False`` is the prefetcher's variant: a hit neither
+        counts nor marks the entry used (the prefetcher finding its work
+        already done is not a consumption), and joining an in-flight
+        fetch is not a coalesce save."""
+        return self.get_or_fetch_info(key, fetch, origin, consumer)[0]
+
+    def get_or_fetch_info(
+        self, key: ChunkKey, fetch: Callable[[], bytes],
+        origin: str = "demand", consumer: bool = True,
+    ) -> tuple[bytes, str]:
+        """:meth:`get_or_fetch` plus HOW the bytes arrived — ``"hit"``
+        (already cached), ``"fetched"`` (this caller issued the backend
+        read) or ``"coalesced"`` (joined another caller's in-flight
+        read). Callers that account delivered-from-storage bytes (the
+        flight records the chaos scorecard sums) credit them only to the
+        ``"fetched"`` owner, so one backend read is never counted
+        twice."""
+        # One consumer access contributes exactly ONE count — hit, miss
+        # or coalesce — decided by its FINAL outcome: a consumer that
+        # joins a failed fetch and loops back to fetch itself is one
+        # miss, not a coalesce plus a miss (hit_ratio's denominator
+        # would otherwise inflate precisely in fault runs).
+        while True:
+            with self._lock:
+                self._note_generation_locked(key)
+                e = self._entries.get(key)
+                if e is not None:
+                    if not consumer:
+                        return e.data, "hit"
+                    return self._hit_locked(key, e), "hit"
+                fl = self._inflight.get(key)
+                if fl is None:
+                    fl = self._inflight[key] = _Flight()
+                    if consumer:
+                        self.misses += 1
+                    break  # owner: fetch below
+                if consumer:
+                    # Register on EVERY flight joined (a consumer whose
+                    # first joined fetch failed loops back and may join
+                    # a re-scheduled attempt — that flight too must
+                    # mark-at-insert). The coalesce COUNT, by contrast,
+                    # is only taken on a successful join below.
+                    fl.consumer_waiters += 1
+            fl.event.wait()
+            if fl.error is None:
+                assert fl.data is not None
+                if consumer:
+                    # A demand read joining an in-flight PREFETCH
+                    # consumed those bytes: mark the landed entry used,
+                    # or the very overlap the pipeline exists to produce
+                    # would be counted as prefetch waste (and a
+                    # readahead byte budget would slowly choke on
+                    # phantom outstanding bytes).
+                    with self._lock:
+                        self.coalesced += 1
+                        e = self._entries.get(key)
+                        if (e is not None and e.origin == "prefetch"
+                                and not e.used):
+                            self._mark_used_locked(e)
+                return fl.data, "coalesced"
+            if not consumer:
+                # A prefetch worker joining a failed fetch stays
+                # advisory: surface the error, the worker records it.
+                raise fl.error
+            # The joined fetch failed — but prefetch (the usual owner)
+            # is advisory, and its retry window may have opened long
+            # before this consumer arrived. A demand read is entitled
+            # to its OWN attempt with a fresh retry stack: loop back
+            # and (most likely) become the owner. Readahead must never
+            # make a run strictly LESS fault-tolerant than cold reads.
+        try:
+            data = bytes(fetch())
+        except BaseException as exc:
+            with self._lock:
+                fl.error = exc
+                del self._inflight[key]
+            fl.event.set()
+            raise
+        with self._lock:
+            fl.data = data
+            del self._inflight[key]
+            self._insert_locked(key, data, origin)
+            if fl.consumer_waiters:
+                # A consumer is already waiting on these bytes: they ARE
+                # consumed. Mark at insert, not at the waiter's wakeup —
+                # an eviction in between must not count them as waste
+                # (and spuriously clamp the readahead depth).
+                e = self._entries.get(key)
+                if e is not None:
+                    self._mark_used_locked(e)
+        fl.event.set()
+        return data, "fetched"
+
+    def insert(self, key: ChunkKey, data: bytes, origin: str = "demand") -> None:
+        with self._lock:
+            self._note_generation_locked(key)
+            self._insert_locked(key, bytes(data), origin)
+
+    def unused_prefetched_bytes(self) -> int:
+        """Prefetched entries still waiting for their first use — at end
+        of run these are waste (the prefetcher folds them in)."""
+        with self._lock:
+            return self.prefetch_resident_unused
+
+    def stats(self) -> dict:
+        with self._lock:
+            lookups = self.hits + self.misses + self.coalesced
+            return {
+                "capacity_bytes": self.capacity,
+                "resident_bytes": self.bytes,
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "coalesced": self.coalesced,
+                "hit_ratio": (self.hits / lookups) if lookups else None,
+                "evictions": self.evictions,
+                "inserted_bytes": self.inserted_bytes,
+                "evicted_bytes": self.evicted_bytes,
+                "oversize_skips": self.oversize_skips,
+                "generation_invalidations": self.generation_invalidations,
+                "stale_rejects": self.stale_rejects,
+                "prefetch_inserted_bytes": self.prefetch_inserted_bytes,
+                "prefetch_used_bytes": self.prefetch_used_bytes,
+                "prefetch_wasted_bytes": self.prefetch_wasted_bytes,
+                "prefetch_dropped_bytes": self.prefetch_dropped_bytes,
+                "prefetch_invalidated_bytes": self.prefetch_invalidated_bytes,
+            }

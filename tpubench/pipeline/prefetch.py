@@ -1,0 +1,294 @@
+"""Readahead prefetcher: walk the workload's future access plan and warm
+the chunk cache ahead of the consumer.
+
+The access plan is the ordered list of :class:`~tpubench.pipeline.cache.
+ChunkKey`\\ s the workload will consume (train-ingest knows its epoch
+schedule up front — the property real input pipelines exploit). The
+prefetcher keeps a bounded readahead window ``[cursor, cursor+depth)``
+scheduled on a small worker pool; reads go through the ordinary
+``open_backend`` stack, so hedging, the stall watchdog, the circuit
+breaker and retry all compose underneath readahead exactly as they do
+under demand reads.
+
+Priority is plan order (a min-heap on plan index): the next-needed chunk
+is always fetched before deeper readahead, so a slow backend degrades to
+"barely ahead of the consumer", never to "busy fetching step N+8 while
+step N+1 starves". Two safety valves bound memory:
+
+* ``readahead_bytes`` — scheduled + cached-but-unconsumed prefetched
+  bytes never exceed it;
+* cancel-on-eviction — when the cache reports prefetched-unused bytes
+  being evicted (budget thrash: readahead outran the cache), the
+  effective depth halves, creeping back up one chunk per thrash-free
+  advance. Queued entries behind the consumer's cursor are cancelled on
+  every advance.
+
+Demand misses are NOT queued here — the consumer fetches them on its own
+thread through the cache's single-flight path, which coalesces with any
+in-flight prefetch of the same chunk (so a demand read never waits behind
+pool scheduling, and a half-done prefetch is joined, not duplicated).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import Optional, Sequence
+
+from tpubench.obs import flight as _flight
+from tpubench.pipeline.cache import ChunkCache, ChunkKey
+from tpubench.storage.base import StorageError
+
+
+def read_chunk(backend, key: ChunkKey) -> bytes:
+    """One ranged read of ``key``'s bytes through the backend stack,
+    streamed to completion (shared by the prefetch workers and the
+    consumer's demand path so both arms measure the same read shape).
+
+    When the transport surfaces the served object's generation
+    (``reader.generation`` — the fake backend and the h1.1 JSON-API
+    HTTP client do, from ``x-goog-generation``; forwarded through every
+    wrapper reader), a mismatch with the plan's keyed generation is a
+    hard error: the object was overwritten after the plan was built,
+    and caching these bytes under the stale key would poison the cache
+    with content that doesn't match its key. The caller's remedy is to
+    rebuild the plan (re-stat), not to retry. Transports that don't
+    surface response headers (the native h2/receive engine paths) read
+    ``generation=None`` = *unknown*: enforcement degrades to plan-build
+    keying there — a documented scope line, not a silent guarantee."""
+    buf = bytearray(key.length)
+    mv = memoryview(buf)
+    reader = backend.open_read(key.object, start=key.start, length=key.length)
+    got = 0
+    try:
+        while got < key.length:
+            n = reader.readinto(mv[got:])
+            if n <= 0:
+                break
+            got += n
+    finally:
+        fb = getattr(reader, "first_byte_ns", None)
+        if fb:
+            _flight.note_phase("first_byte", fb)
+        reader.close()
+    gen = getattr(reader, "generation", None)
+    if gen and key.generation and gen != key.generation:
+        raise StorageError(
+            f"{key.object}: generation changed under the plan "
+            f"({key.generation} -> {gen}); rebuild the access plan",
+            transient=False,
+        )
+    if got != key.length:
+        raise IOError(
+            f"{key.object} [{key.start}:+{key.length}]: short chunk read "
+            f"{got}/{key.length}"
+        )
+    return bytes(buf)
+
+
+class Prefetcher:
+    """Plan-walking readahead over a :class:`ChunkCache` (module doc)."""
+
+    def __init__(
+        self,
+        backend,
+        cache: ChunkCache,
+        plan: Sequence[ChunkKey],
+        *,
+        workers: int = 2,
+        depth: int = 8,
+        byte_budget: int = 0,
+        transport: str = "",
+    ):
+        self._backend = backend
+        self._cache = cache
+        self._plan = list(plan)
+        self._depth = max(0, depth)
+        self._depth_effective = self._depth
+        self._budget = max(0, byte_budget)
+        self._transport = transport
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._heap: list[tuple[int, ChunkKey]] = []
+        self._scheduled: set[int] = set()  # queued or fetching
+        self._cursor = 0
+        self._inflight_bytes = 0
+        self._stop = False
+        self._wasted_seen = 0
+        # Counters (the extra["pipeline"]["prefetch"] stamp).
+        self.issued = 0
+        self.completed = 0
+        self.cancelled = 0
+        self.skipped = 0  # already cached/in-flight at pop time
+        self.errors = 0
+        self.last_error: Optional[str] = None
+        self.depth_clamps = 0  # cancel-on-eviction engagements
+        # Flight rings are bound HERE, on the constructing thread, while
+        # the run's recorder activation is known-live — a worker thread
+        # resolving the ambient recorder at its own start time could race
+        # the activation scope and silently record nothing.
+        n_workers = max(1, workers) if self._depth else 0
+        self._threads = [
+            threading.Thread(
+                target=self._worker,
+                args=(_flight.active_worker(f"prefetch-{i}"),),
+                name=f"prefetch-{i}", daemon=True,
+            )
+            for i in range(n_workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    # ------------------------------------------------------------- control --
+    def advance(self, pos: int) -> None:
+        """The consumer reached plan position ``pos``: drop stale queue
+        entries, apply the eviction thrash-guard, and top the readahead
+        window back up (within the byte budget)."""
+        if not self._depth:
+            return
+        with self._cond:
+            self._cursor = max(self._cursor, pos)
+            # Cancel-on-eviction: prefetched-unused bytes being evicted
+            # means readahead outran the cache budget — halve the window.
+            wasted = self._cache.prefetch_wasted_bytes
+            if wasted > self._wasted_seen:
+                self._wasted_seen = wasted
+                if self._depth_effective > 1:
+                    self._depth_effective = max(1, self._depth_effective // 2)
+                    self.depth_clamps += 1
+            elif self._depth_effective < self._depth:
+                self._depth_effective += 1
+            hi = min(len(self._plan), self._cursor + self._depth_effective)
+            for i in range(self._cursor, hi):
+                if i in self._scheduled:
+                    continue
+                key = self._plan[i]
+                if self._budget and (
+                    self._outstanding_locked() + key.length > self._budget
+                ):
+                    break
+                if self._cache.contains(key):
+                    continue
+                self._scheduled.add(i)
+                heapq.heappush(self._heap, (i, key))
+            self._cond.notify_all()
+
+    def _outstanding_locked(self) -> int:
+        # prefetch_resident_unused is the cache's directly-maintained
+        # count (not a derived identity over insert/use/waste counters,
+        # which drop paths like stale-rejects would silently skew).
+        queued = sum(k.length for _, k in self._heap)
+        return (
+            max(0, self._cache.prefetch_resident_unused)
+            + self._inflight_bytes + queued
+        )
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join()
+
+    # -------------------------------------------------------------- worker --
+    def _worker(self, wf) -> None:
+        while True:
+            with self._cond:
+                while not self._heap and not self._stop:
+                    self._cond.wait()
+                if self._stop:
+                    # Shutdown cancels queued readahead — close() must
+                    # not sit through deep-window fetches nobody will
+                    # ever consume.
+                    while self._heap:
+                        i, _ = heapq.heappop(self._heap)
+                        self._scheduled.discard(i)
+                        self.cancelled += 1
+                    return
+                idx, key = heapq.heappop(self._heap)
+                if idx < self._cursor:
+                    self._scheduled.discard(idx)
+                    self.cancelled += 1
+                    continue
+                self._inflight_bytes += key.length
+                self.issued += 1
+            op = None
+            try:
+                if self._cache.contains(key):
+                    # Already cached or in flight: nothing to do, and no
+                    # flight record either — a zero-byte ~0 ms "read"
+                    # would dilute every percentile downstream (the
+                    # chaos scorecard sums kind="read" records).
+                    with self._lock:
+                        self.skipped += 1
+                    continue
+                op = (
+                    wf.begin(key.object, self._transport)
+                    if wf is not None else None
+                )
+                if op is not None:
+                    op.mark("prefetch_issue")
+                data, source = self._cache.get_or_fetch_info(
+                    key, lambda: read_chunk(self._backend, key),
+                    origin="prefetch", consumer=False,
+                )
+                if source == "fetched":
+                    with self._lock:
+                        self.completed += 1
+                    if op is not None:
+                        op.mark("body_complete")
+                        op.finish(len(data))
+                else:
+                    # A demand read claimed the chunk between the
+                    # contains() probe and the fetch (hit or joined
+                    # in-flight): that read's record carries the bytes
+                    # and the wait — appending one here would both
+                    # double-count and dilute percentiles. Drop the op.
+                    with self._lock:
+                        self.skipped += 1
+                    if op is not None:
+                        op.abandon()
+            except BaseException as exc:  # noqa: BLE001 — best-effort layer
+                # Prefetch is advisory: the error is recorded, the chunk
+                # stays uncached, and the demand path (with its own retry
+                # stack) surfaces any real failure to the workload.
+                with self._lock:
+                    self.errors += 1
+                    self.last_error = f"{type(exc).__name__}: {exc}"
+                if op is not None:
+                    op.finish(error=exc)
+            finally:
+                with self._cond:
+                    self._inflight_bytes -= key.length
+                    self._scheduled.discard(idx)
+
+    # --------------------------------------------------------------- stats --
+    def stats(self) -> dict:
+        used = self._cache.prefetch_used_bytes
+        # Everything prefetched that never served a consumer is waste,
+        # whatever dropped it: LRU eviction, never-cached (oversize/
+        # stale-reject), generation invalidation, or still sitting
+        # unused at end of run.
+        wasted = (
+            self._cache.prefetch_wasted_bytes
+            + self._cache.prefetch_dropped_bytes
+            + self._cache.prefetch_invalidated_bytes
+            + self._cache.unused_prefetched_bytes()
+        )
+        denom = used + wasted
+        return {
+            "depth": self._depth,
+            "depth_effective": self._depth_effective,
+            "workers": len(self._threads),
+            "issued": self.issued,
+            "completed": self.completed,
+            "cancelled": self.cancelled,
+            "skipped": self.skipped,
+            "errors": self.errors,
+            "last_error": self.last_error,
+            "depth_clamps": self.depth_clamps,
+            "prefetched_bytes": self._cache.prefetch_inserted_bytes,
+            "used_bytes": used,
+            "wasted_bytes": wasted,
+            "efficiency": (used / denom) if denom else None,
+        }

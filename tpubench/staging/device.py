@@ -410,6 +410,46 @@ class DevicePutStager(GranuleAggregator):
         return stats
 
 
+class LockedSink:
+    """Serialization wrapper for a slot ring shared by CONCURRENT
+    producers.
+
+    A :class:`GranuleAggregator` is single-producer by construction:
+    ``acquire``/``commit`` mutate the fill mark and ring cursor
+    non-atomically, so two unsynchronized producers could be handed the
+    SAME slot region (double-assign) and silently corrupt each other's
+    bytes. This wrapper makes each ``submit`` — the whole
+    acquire→fill→commit transaction — atomic under one lock.
+
+    No production path shares a ring today — train-ingest's step loop is
+    the stager's only producer (the prefetcher fills the HOST cache, not
+    the ring), and every other workload keeps one stager per worker.
+    This is the designated wrapper for a pipeline that does fan multiple
+    producers into one ring (e.g. staging prefetched chunks from the
+    prefetch workers directly); the double-assign test in
+    ``test_staging.py`` pins the invariant it must then provide.
+
+    Deliberately does NOT forward the zero-copy ``acquire``/``commit``
+    pair: a lock released between acquire and commit would re-open the
+    double-assign window, and holding it across the producer's socket
+    read would serialize the fetches the ring exists to overlap. Shared
+    rings use the copying ``submit`` path; the workload's
+    ``hasattr(sink, "acquire")`` probe then routes correctly on its own.
+    """
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._lock = threading.Lock()
+
+    def submit(self, mv: memoryview) -> None:
+        with self._lock:
+            self._inner.submit(mv)
+
+    def finish(self) -> dict:
+        with self._lock:
+            return self._inner.finish()
+
+
 def budgeted_slot_bytes(cfg: BenchConfig) -> int:
     """slot_bytes scaled so ALL workers' slots fit the host budget (never
     below one granule): 48 reference-default workers must not pin gigabytes

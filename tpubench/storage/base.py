@@ -54,6 +54,12 @@ class ObjectReader(Protocol):
     ``time.perf_counter_ns`` stamp when the first payload byte arrives — the
     observability the reference lacks (its ``NewReader``+``CopyBuffer`` hides
     time-to-first-byte inside full-read latency, ``main.go:135-140``).
+
+    Readers MAY additionally carry ``generation``: the served object's
+    generation (GCS ``x-goog-generation``), when the transport surfaces
+    it — the fake backend and the JSON-API HTTP client do. Consumers
+    (the pipeline chunk cache's invalidation tests) must treat a missing
+    attribute or ``None`` as *unknown*, never as *unchanged*.
     """
 
     first_byte_ns: Optional[int]

@@ -101,12 +101,17 @@ class _FakeReader:
     effective plan per ``readinto``, so a scheduled fault phase switching
     on mid-stream shapes a read that is already in flight."""
 
-    def __init__(self, data: memoryview, fault: FaultPlan, rng: random.Random):
+    def __init__(self, data: memoryview, fault: FaultPlan, rng: random.Random,
+                 generation: int = 0):
         self._data = data
         self._pos = 0
         self._fault = fault
         self._rng = rng
         self.first_byte_ns: Optional[int] = None
+        # Generation of the object this stream serves (the GCS
+        # `x-goog-generation` surface) — what the pipeline cache keys on,
+        # so generation-change invalidation is testable hermetically.
+        self.generation = generation
         self._closed = False
         self._delivered = 0
         self._stall_rolled = False
@@ -202,6 +207,7 @@ class FakeBackend:
             raise StorageError("injected open failure", transient=True, code=503)
         with self._lock:
             obj = self._objects.get(name)
+            gen = self._generation.get(name, 1)
             self.open_count += 1
         if obj is None:
             raise StorageError(f"object not found: {name}", transient=False, code=404)
@@ -210,7 +216,10 @@ class FakeBackend:
             raise StorageError(
                 f"range start {start} > size {len(obj)}", transient=False, code=416
             )
-        return _FakeReader(memoryview(obj.data)[start:end], self.fault, reader_rng)
+        return _FakeReader(
+            memoryview(obj.data)[start:end], self.fault, reader_rng,
+            generation=gen,
+        )
 
     def write(self, name: str, data: bytes) -> ObjectMeta:
         arr = np.frombuffer(bytes(data), dtype=np.uint8).copy()

@@ -325,6 +325,9 @@ class _Conn:
                     if status == 206
                     else ""
                 )
+                # Generation on every download (x-goog-generation): the
+                # h1.1 side mirrors the h2 media branch and fake_server.
+                cr += f"x-goog-generation: {meta.generation}\r\n"
                 send(status, bytes(data), "application/octet-stream", cr)
             else:
                 from tpubench.storage.base import object_meta_dict
@@ -433,8 +436,12 @@ class _Conn:
             # leave the stream unanswered and the client waiting out a
             # socket timeout instead of seeing the classified status.
             return self._respond_error(stream, e.code or 500, str(e))
-        hb = _hp_literal(":status", str(status)) + _hp_literal(
-            "content-length", str(length)
+        hb = (
+            _hp_literal(":status", str(status))
+            + _hp_literal("content-length", str(length))
+            # Generation on every media response (x-goog-generation),
+            # matching the h1.1 fake server's download surface.
+            + _hp_literal("x-goog-generation", str(meta.generation))
         )
         try:
             if self.interim_end_stream:

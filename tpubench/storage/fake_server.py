@@ -100,21 +100,18 @@ class _Handler(BaseHTTPRequestHandler):
         path, parts, query = self._parse()
         if self._maybe_inject_fault():
             return
+        from tpubench.storage.base import object_meta_dict
+
         try:
             name = self._object_name(parts)
             if name:  # object media or metadata
                 if query.get("alt", [""])[0] == "media":
                     return self._get_media(name)
                 meta = self.backend.stat(name)
-                from tpubench.storage.base import object_meta_dict
-
                 return self._send_json(200, object_meta_dict(meta))
             if len(parts) >= 6 and parts[3] == "b" and parts[5] == "o":  # list
                 prefix = query.get("prefix", [""])[0]
-                items = [
-                    {"kind": "storage#object", "name": m.name, "size": str(m.size)}
-                    for m in self.backend.list(prefix)
-                ]
+                items = [object_meta_dict(m) for m in self.backend.list(prefix)]
                 return self._send_json(200, {"kind": "storage#objects", "items": items})
             self._send_error_json(404, f"no route: {path}")
         except StorageError as e:
@@ -134,6 +131,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/octet-stream")
         self.send_header("Content-Length", str(length))
+        # The real media surface stamps the served object's generation on
+        # every download — what clients (and the pipeline chunk cache)
+        # use to detect an overwrite without a second stat round-trip.
+        self.send_header("x-goog-generation", str(meta.generation))
         if code == 206:
             self.send_header("Content-Range", f"bytes {start}-{end}/{meta.size}")
         self.end_headers()

@@ -127,12 +127,16 @@ class _HttpReader:
     """
 
     def __init__(self, pool: _ConnectionPool, conn, resp, length: int,
-                 carrier=None):
+                 carrier=None, generation: Optional[int] = None):
         self._pool = pool
         self._conn = conn
         self._resp = resp
         self._remaining = length
         self.first_byte_ns: Optional[int] = None
+        # Served object's generation (x-goog-generation header), None when
+        # the server didn't stamp one — cache-invalidation consumers treat
+        # None as "unknown", never as "unchanged".
+        self.generation = generation
         self._done = False
         self._carrier = carrier
 
@@ -774,7 +778,11 @@ class GcsHttpBackend:
             flight_note("stream_open")
             carrier.event("response_headers", status=resp.status)
             clen = int(resp.headers.get("Content-Length", "0"))
-            return _HttpReader(self._pool, conn, resp, clen, carrier=carrier)
+            gen_hdr = resp.headers.get("x-goog-generation")
+            return _HttpReader(
+                self._pool, conn, resp, clen, carrier=carrier,
+                generation=int(gen_hdr) if gen_hdr else None,
+            )
         except BaseException as e:
             carrier.close(e)
             raise
@@ -947,7 +955,10 @@ class GcsHttpBackend:
             finally:
                 self._pool.release(conn, reusable=True)
         return [
-            ObjectMeta(it["name"], int(it["size"])) for it in payload.get("items", [])
+            ObjectMeta(
+                it["name"], int(it["size"]), int(it.get("generation", 0))
+            )
+            for it in payload.get("items", [])
         ]
 
     def stat(self, name: str) -> ObjectMeta:

@@ -64,6 +64,14 @@ class _ResumingReader:
         )
         self.reopen_count = 0
 
+    @property
+    def generation(self):
+        """Served object's generation when the transport surfaces it
+        (see ObjectReader protocol) — forwarded from the CURRENT inner
+        reader, so a resume that lands on a different generation is
+        visible to cache-invalidation consumers."""
+        return getattr(self._inner, "generation", None)
+
     def _reopen(self) -> None:
         try:
             self._inner.close()

@@ -257,6 +257,10 @@ class _BreakerReader:
     def first_byte_ns(self):
         return self._inner.first_byte_ns
 
+    @property
+    def generation(self):
+        return getattr(self._inner, "generation", None)
+
     def readinto(self, buf: memoryview) -> int:
         try:
             n = self._inner.readinto(buf)
@@ -346,6 +350,10 @@ class WatchdogReader:
     def first_byte_ns(self):
         return self._inner.first_byte_ns
 
+    @property
+    def generation(self):
+        return getattr(self._inner, "generation", None)
+
     def readinto(self, buf: memoryview) -> int:
         t0 = self._clock()
         n = self._inner.readinto(buf)
@@ -421,7 +429,7 @@ class _Attempt:
 
     __slots__ = (
         "idx", "open_fn", "out_q", "chunk_bytes", "cancelled", "credits",
-        "bytes", "first_byte_ns", "op", "thread",
+        "bytes", "first_byte_ns", "generation", "op", "thread",
     )
 
     def __init__(self, idx: int, open_fn, out_q: "queue.Queue",
@@ -434,6 +442,9 @@ class _Attempt:
         self.credits = threading.Semaphore(_ATTEMPT_DEPTH)
         self.bytes = 0
         self.first_byte_ns: Optional[int] = None
+        # Producer-written once post-open, consumer-read post-race
+        # (GIL-atomic attribute, same discipline as first_byte_ns).
+        self.generation = None
         # The consumer thread's flight op (captured at launch): the
         # producer adopts it so backend-level phases/annotations
         # (connect, first_byte, breaker/retry events) still attribute to
@@ -455,6 +466,7 @@ class _Attempt:
         except BaseException as e:  # noqa: BLE001 — surfaced to the consumer
             self.out_q.put((self.idx, "err", e))
             return
+        self.generation = getattr(reader, "generation", None)
         try:
             while not self.cancelled.is_set():
                 while not self.credits.acquire(timeout=_CANCEL_POLL_S):
@@ -594,6 +606,11 @@ class HedgedReader:
         if self.first_byte_ns is None:
             self.first_byte_ns = time.perf_counter_ns()
         self._hb.note_first_byte(self._hb._clock() - self._opened_t)
+
+    @property
+    def generation(self):
+        att = self._winner or (self._attempts[0] if self._attempts else None)
+        return att.generation if att is not None else None
 
     # ------------------------------------------------------ ObjectReader --
     def readinto(self, buf: memoryview) -> int:

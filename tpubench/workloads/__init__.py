@@ -1,6 +1,9 @@
 """Workload drivers (L4 of SURVEY §1): one module per reference binary.
 
 * ``read``        — root GCS read bench (``main.go``), the flagship.
+* ``train_ingest``— step-paced training-loop ingest over the pipeline
+                    subsystem (chunk cache + readahead prefetch) with
+                    data-stall accounting; no reference analog.
 * ``read_fs``     — sequential FS read (``benchmark-script/read_operation``).
 * ``write``       — durable write (``benchmark-script/write_operations``).
 * ``listing``     — list bench (``benchmark-script/list_operation``).

@@ -301,8 +301,12 @@ def run_chaos(
         "flight_records": cfg.obs.flight_records,
         "flight_journal": cfg.obs.flight_journal,
     }
+    reads_expected = w.read_calls_per_worker
+    if chaos_workload == "train-ingest":
+        pl = cfg.pipeline
+        reads_expected = pl.steps * pl.epochs * pl.batch_shards
     cfg.obs.flight_records = max(
-        cfg.obs.flight_records, w.read_calls_per_worker * 2 + 64
+        cfg.obs.flight_records, reads_expected * 2 + 64
     )
     tmp_journal = None
     if not cfg.obs.flight_journal:
@@ -350,6 +354,16 @@ def run_chaos(
         # so phase windows and scorecard segments share one epoch.
         if chaos_workload == "read":
             from tpubench.workloads.read import run_read as _runner
+        elif chaos_workload == "train-ingest":
+            # The pipeline smoke path: fault schedules exercise the
+            # prefetcher + cache; a blackhole window surfaces as
+            # data-stall time in extra["pipeline"]["stall"] (and as
+            # stall_begin/stall_end step phases in the journal), never
+            # as a hang — demand reads ride the same tail-tolerance +
+            # retry stack as every other workload.
+            from tpubench.workloads.train_ingest import (
+                run_train_ingest as _runner,
+            )
         elif chaos_workload == "pod-ingest":
             from tpubench.workloads.pod_ingest import run_pod_ingest
 
@@ -358,7 +372,7 @@ def run_chaos(
         else:
             raise SystemExit(
                 f"chaos: unknown workload {chaos_workload!r} "
-                "(read|pod-ingest)"
+                "(read|pod-ingest|train-ingest)"
             )
         from tpubench.storage import open_backend
 

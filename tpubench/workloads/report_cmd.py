@@ -20,6 +20,14 @@ import json
 from tpubench.metrics.percentiles import PCT_FIELDS
 
 
+def _cell(d, fmt, *path):
+    """Dig ``path`` out of nested dict ``d``; format or n/a — the ONE
+    cell formatter for every A/B diff line in :func:`compare_runs`."""
+    for k in path:
+        d = (d or {}).get(k)
+    return fmt.format(d) if d is not None else "n/a"
+
+
 def _axis(run: dict) -> str:
     """The config axis label an A/B varies: protocol(+http2/native), the
     staging mode, and the fetch executor."""
@@ -40,6 +48,9 @@ def _axis(run: dict) -> str:
     sweep = run.get("extra", {}).get("sweep")
     if sweep:
         bits.append(f"size={sweep.get('size')}")
+    if run.get("workload") == "train_ingest":
+        ra = (cfg.get("pipeline") or {}).get("readahead", 0)
+        bits.append(f"readahead={ra}" if ra else "cold")
     return " ".join(bits)
 
 
@@ -78,6 +89,14 @@ def summarize_run(run: dict, label: str = "") -> str:
         from tpubench.workloads.chaos import format_scorecard
 
         lines.append(format_scorecard(chaos))
+    pipe = extra.get("pipeline")
+    if pipe:
+        # Ingest-pipeline scorecard (train-ingest): same body the CLI
+        # printed live — stall accounting, cache hit ratio, prefetch
+        # efficiency.
+        from tpubench.workloads.train_ingest import format_pipeline_scorecard
+
+        lines.append(format_pipeline_scorecard(pipe))
     return "\n".join(lines)
 
 
@@ -108,25 +127,39 @@ def compare_runs(runs: list[dict]) -> str:
                 f"({d50:+.3f}), p99 {s.get('p99_ms', 0.0):.3f} ms "
                 f"({d99:+.3f})"
             )
+        cell = _cell
+        # Pipeline diff: two train-ingest runs (readahead on vs cold)
+        # compare on what the pipeline exists for — stall time, stalled
+        # fraction, hit ratio — not just throughput.
+        op_ = other.get("extra", {}).get("pipeline")
+        bp = base.get("extra", {}).get("pipeline")
+        if op_ and bp:
+            lines.append(
+                "    pipeline: stalled "
+                f"{cell(op_, '{:.1%}', 'stall', 'stalled_fraction')} vs "
+                f"{cell(bp, '{:.1%}', 'stall', 'stalled_fraction')}, "
+                "stall p99 "
+                f"{cell(op_, '{:.2f}ms', 'stall', 'p99_ms')} vs "
+                f"{cell(bp, '{:.2f}ms', 'stall', 'p99_ms')}, "
+                "hit ratio "
+                f"{cell(op_, '{:.1%}', 'cache', 'hit_ratio')} vs "
+                f"{cell(bp, '{:.1%}', 'cache', 'hit_ratio')}"
+            )
         # Scorecard diff: two chaos runs (e.g. hedged vs unhedged over the
         # same timeline) compare on resilience, not just throughput.
         osc = (other.get("extra", {}).get("chaos") or {}).get("scorecard")
         bsc = (base.get("extra", {}).get("chaos") or {}).get("scorecard")
         if osc and bsc:
-            def cell(sc, key, fmt):
-                v = sc.get(key)
-                return fmt.format(v) if v is not None else "n/a"
-
             lines.append(
                 "    scorecard: retention "
-                f"{cell(osc, 'goodput_retention', '{:.1%}')} vs "
-                f"{cell(bsc, 'goodput_retention', '{:.1%}')}, "
+                f"{cell(osc, '{:.1%}', 'goodput_retention')} vs "
+                f"{cell(bsc, '{:.1%}', 'goodput_retention')}, "
                 "p99 inflation "
-                f"{cell(osc, 'p99_inflation', '{:.2f}x')} vs "
-                f"{cell(bsc, 'p99_inflation', '{:.2f}x')}, "
+                f"{cell(osc, '{:.2f}x', 'p99_inflation')} vs "
+                f"{cell(bsc, '{:.2f}x', 'p99_inflation')}, "
                 "time-to-recover "
-                f"{cell(osc, 'time_to_recover_s', '{:.3f}s')} vs "
-                f"{cell(bsc, 'time_to_recover_s', '{:.3f}s')}"
+                f"{cell(osc, '{:.3f}s', 'time_to_recover_s')} vs "
+                f"{cell(bsc, '{:.3f}s', 'time_to_recover_s')}"
             )
     return "\n".join(lines)
 

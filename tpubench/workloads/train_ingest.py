@@ -1,0 +1,491 @@
+"""``tpubench train-ingest`` — step-paced training-loop ingest with
+data-stall accounting.
+
+Every other tpubench workload issues cold, demand-driven reads: fetch
+and consumption never overlap, which is exactly the effect that
+dominates real input pipelines (MLPerf TPU-pod scaling attributes step
+time cliffs to input stalls). This workload emulates the consumer side
+of a training job — a step loop that each step consumes a *batch* of
+chunks, stages them to HBM, then "computes" for a configurable synthetic
+window — on top of the pipeline subsystem (host chunk cache + readahead
+prefetcher), and measures what an input pipeline is actually for:
+
+* **data-stall time per step** — the time the step loop spent blocked
+  waiting for bytes that were not ready (p50/p99 per-step stall ms, and
+  the stalled-step fraction over ``pipeline.stall_threshold_ms``);
+* **cache hit ratio** — including the re-epoch pass, where a warm cache
+  should serve repeats without touching storage;
+* **prefetch efficiency** — prefetched-and-used vs wasted bytes.
+
+The A/B that matters: the same run with ``pipeline.readahead=0`` (cold,
+demand-only — the behavior of every pre-PR-3 workload) against
+readahead on. Both arms go through the identical cache/fetch code path,
+so the delta is the overlap, not incidental code differences.
+
+Step records land in the flight journal as ``kind="step"`` with
+``stall_begin``/``stall_end`` bracketing the step's data wait; chunk
+accesses carry ``cache_hit``/``cache_miss``/``prefetch_issue`` phases —
+``tpubench report timeline`` attributes stalls from the same journal.
+
+Pod path (``pipeline.pod``): each step's batch is treated as one
+sharded logical object — byte-range shards staged across the mesh and
+reassembled over ICI (``dist.shard`` / ``dist.reassemble``) — instead
+of the per-host slot-ring ``device_put`` path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+import numpy as np
+
+from tpubench.config import BenchConfig, validate_pipeline_config
+from tpubench.metrics.percentiles import summarize_ns
+from tpubench.metrics.recorder import LatencyRecorder
+from tpubench.metrics.report import RunResult
+from tpubench.obs.flight import (
+    flight_from_config,
+    host_journal_path,
+    transport_label,
+)
+from tpubench.pipeline.cache import ChunkCache, ChunkKey
+from tpubench.pipeline.prefetch import Prefetcher, read_chunk
+from tpubench.storage import open_backend
+from tpubench.storage.base import StorageBackend, iter_ranges
+
+
+def build_plan(cfg: BenchConfig, backend: StorageBackend) -> list[ChunkKey]:
+    """One epoch's ordered chunk access plan: ``steps × batch_shards``
+    chunk keys walked object-by-object (wrapping when the dataset is
+    smaller than an epoch), each keyed by the object's CURRENT
+    generation — an overwritten object yields new keys, and the cache
+    invalidates the stale generation's chunks on first sight."""
+    w, p = cfg.workload, cfg.pipeline
+    chunk = p.chunk_bytes or w.granule_bytes
+    n_objects = max(w.workers, w.threads, 1)
+    needed = p.steps * p.batch_shards
+    plan: list[ChunkKey] = []
+    obj_chunks: list[list[ChunkKey]] = []
+    for i in range(n_objects):
+        name = f"{w.object_name_prefix}{i}"
+        meta = backend.stat(name)
+        obj_chunks.append([
+            ChunkKey(w.bucket, name, meta.generation, start, length)
+            for start, length in iter_ranges(meta.size, chunk)
+        ])
+        if sum(len(c) for c in obj_chunks) >= needed:
+            break
+    flat = [k for chunks in obj_chunks for k in chunks]
+    if not flat:
+        raise ValueError("train-ingest: dataset is empty (object_size=0?)")
+    while len(plan) < needed:
+        plan.extend(flat[: needed - len(plan)])
+    return plan
+
+
+def run_train_ingest(
+    cfg: BenchConfig, backend: Optional[StorageBackend] = None
+) -> RunResult:
+    validate_pipeline_config(cfg.pipeline)
+    p = cfg.pipeline
+    chunk = p.chunk_bytes or cfg.workload.granule_bytes
+    if p.readahead > 0 and p.cache_bytes < chunk:
+        # Covers cache_bytes=0 through cache_bytes<chunk alike (this is
+        # the ONLY guard — validate_pipeline_config runs for every
+        # subcommand and must not reject non-pipeline workloads): every
+        # prefetched chunk would hit the cache's oversize-skip path, be
+        # counted as waste, and re-fetch on demand — ~2x the cold arm's
+        # backend reads, silently. The effective chunk size is only
+        # known here (chunk_bytes=0 defers to granule_bytes).
+        raise SystemExit(
+            f"pipeline.cache_bytes={p.cache_bytes} is smaller than one "
+            f"chunk ({chunk} B) with readahead={p.readahead}: no "
+            "prefetched chunk can ever be cached — raise --cache-bytes "
+            "or set --readahead 0 (the cold arm)"
+        )
+    if p.readahead > 0 and 0 < p.readahead_bytes < chunk:
+        # Sibling misconfiguration: a prefetch byte budget below one
+        # chunk means advance() can never schedule anything — the
+        # "readahead=N" arm would silently run cold and the A/B would
+        # compare cold vs cold under different labels.
+        raise SystemExit(
+            f"pipeline.readahead_bytes={p.readahead_bytes} is smaller "
+            f"than one chunk ({chunk} B): the prefetcher can never "
+            "schedule a fetch — raise --readahead-bytes or drop it "
+            "(0 = depth-bounded)"
+        )
+    owns_backend = backend is None
+    backend = backend or open_backend(cfg)
+    try:
+        return _TrainIngest(cfg, backend).run()
+    finally:
+        if owns_backend:
+            backend.close()
+
+
+class _TrainIngest:
+    def __init__(self, cfg: BenchConfig, backend: StorageBackend):
+        self.cfg = cfg
+        self.backend = backend
+
+    # ------------------------------------------------------------ staging --
+    def _make_stager(self):
+        """Per-run staging sink (slot ring → device_put), or None for
+        staging mode "none" / the pod path (which stages per step via
+        dist.shard/reassemble)."""
+        if self.cfg.staging.mode == "none" or self.cfg.pipeline.pod:
+            return None
+        from tpubench.staging.device import make_sink_factory
+
+        factory = make_sink_factory(self.cfg)
+        return factory(0) if factory is not None else None
+
+    def _pod_setup(self):
+        from tpubench.dist.reassemble import make_mesh, make_reassemble
+
+        mesh = make_mesh(axis=self.cfg.dist.mesh_axis)
+        return mesh, make_reassemble(mesh, self.cfg.dist.mesh_axis)
+
+    def _pod_stage_gather(self, mesh, reassemble, datas: list[bytes]):
+        """Pod path for one step: the batch's bytes as byte-range shards
+        across the mesh, reassembled over ICI. Returns gather-complete
+        perf_counter_ns."""
+        import jax
+
+        from tpubench.dist.reassemble import shard_to_device_array
+        from tpubench.dist.shard import ShardTable
+
+        lane = self.cfg.staging.lane
+        blob = b"".join(datas)
+        n = int(mesh.devices.size)
+        table = ShardTable.build(len(blob), n, align=lane)
+        buffers = []
+        for sh in table.shards():
+            buf = np.zeros(table.shard_bytes, dtype=np.uint8)
+            if sh.length:
+                buf[: sh.length] = np.frombuffer(
+                    blob[sh.start : sh.start + sh.length], dtype=np.uint8
+                )
+            buffers.append(buf)
+        global_arr = shard_to_device_array(
+            buffers, mesh, self.cfg.dist.mesh_axis, lane
+        )
+        jax.block_until_ready(global_arr)
+        staged_ns = time.perf_counter_ns()
+        gathered, _ = reassemble(global_arr)
+        jax.block_until_ready(gathered)
+        return staged_ns, time.perf_counter_ns()
+
+    # ---------------------------------------------------------------- run --
+    def run(self) -> RunResult:
+        cfg, w, p = self.cfg, self.cfg.workload, self.cfg.pipeline
+        plan_epoch = build_plan(cfg, self.backend)
+        plan = plan_epoch * p.epochs
+        batch = p.batch_shards
+        total_steps = p.steps * p.epochs
+        cache = ChunkCache(p.cache_bytes)
+        tlabel = transport_label(cfg)
+        flight = flight_from_config(cfg)
+        consumer_wf = flight.worker("consumer") if flight is not None else None
+        step_wf = flight.worker("steps") if flight is not None else None
+
+        step_rec = LatencyRecorder("step")
+        stall_rec = LatencyRecorder("stall")
+        fetch_rec = LatencyRecorder("read")
+        stalled_steps = 0
+        consumed_bytes = 0
+        compute_s = p.step_compute_ms / 1e3
+
+        stager = self._make_stager()
+        mesh = reassemble = None
+        if p.pod:
+            mesh, reassemble = self._pod_setup()
+            # Warmup: the first reassemble pays compile; a step must not.
+            # jit compiles PER SHAPE, so the warmup blob must be the size
+            # of a real full batch (batch × chunk) — a token-sized blob
+            # would shift the compile onto step 0 and skew its stall/step
+            # percentiles (a short final batch may still recompile once).
+            chunk = p.chunk_bytes or w.granule_bytes
+            self._pod_stage_gather(mesh, reassemble, [b"\0" * (batch * chunk)])
+
+        pf: Optional[Prefetcher] = None
+        activation = (
+            flight.activate() if flight is not None
+            else contextlib.nullcontext()
+        )
+        t_run0 = time.perf_counter_ns()
+        sink_stats: dict = {}
+        try:
+            with activation:
+                if p.readahead > 0:
+                    pf = Prefetcher(
+                        self.backend, cache, plan,
+                        workers=p.prefetch_workers,
+                        depth=p.readahead,
+                        byte_budget=p.readahead_bytes,
+                        transport=tlabel,
+                    )
+                    pf.advance(0)
+                step_t0 = time.perf_counter_ns()
+                for step in range(total_steps):
+                    lo = step * batch
+                    keys = plan[lo : lo + batch]
+                    op = (
+                        step_wf.begin(f"step{step}", tlabel,
+                                      install=False, kind="step")
+                        if step_wf is not None else None
+                    )
+                    stall_ns = 0
+                    first_block_ns = last_block_ns = None
+                    datas: list[bytes] = []
+                    for key in keys:
+                        data = cache.get(key)
+                        if data is not None:
+                            if consumer_wf is not None:
+                                cop = consumer_wf.begin(
+                                    key.object, tlabel, kind="cache"
+                                )
+                                cop.mark("cache_hit")
+                                cop.finish(len(data))
+                        else:
+                            cop = (
+                                consumer_wf.begin(key.object, tlabel)
+                                if consumer_wf is not None else None
+                            )
+                            t0 = time.perf_counter_ns()
+                            if cop is not None:
+                                cop.mark("cache_miss", t0)
+                            try:
+                                data, source = cache.get_or_fetch_info(
+                                    key,
+                                    lambda k=key: read_chunk(self.backend, k),
+                                )
+                            except BaseException as e:
+                                # errgroup semantics (read.py parity): a
+                                # demand fetch that still fails after the
+                                # whole retry/tail stack aborts the run —
+                                # the exception IS the error report.
+                                if cop is not None:
+                                    cop.finish(error=e)
+                                if op is not None:
+                                    op.finish(error=e)
+                                raise
+                            t1 = time.perf_counter_ns()
+                            if source == "hit":
+                                # Raced hit: a prefetch landed the chunk
+                                # between the get() probe and this call.
+                                # No wait happened — no stall marks, no
+                                # ~0 ms sample in the read histogram,
+                                # and the would-be miss record becomes a
+                                # cache-hit record (abandon drops it
+                                # without appending).
+                                if cop is not None:
+                                    cop.abandon()
+                                    # enqueue_ns=t0: the record spans the
+                                    # access from probe to hit — begin()'s
+                                    # default "now" stamp would postdate
+                                    # t1 and break phase monotonicity.
+                                    hop = consumer_wf.begin(
+                                        key.object, tlabel, kind="cache",
+                                        enqueue_ns=t0,
+                                    )
+                                    hop.mark("cache_hit", t1)
+                                    hop.finish(len(data))
+                            else:
+                                stall_ns += t1 - t0
+                                if first_block_ns is None:
+                                    first_block_ns = t0
+                                last_block_ns = t1
+                                fetch_rec.record_ns(t1 - t0)
+                                if cop is not None:
+                                    cop.mark("body_complete", t1)
+                                    # Bytes credit the fetch OWNER only:
+                                    # a coalesced wait consumed bytes
+                                    # some other record (the in-flight
+                                    # prefetch) already carries — the
+                                    # chaos scorecard sums read records,
+                                    # and one backend read must count
+                                    # once.
+                                    cop.finish(
+                                        len(data)
+                                        if source == "fetched" else 0
+                                    )
+                        datas.append(data)
+                    if op is not None and first_block_ns is not None:
+                        op.mark("stall_begin", first_block_ns)
+                        op.mark("stall_end", last_block_ns)
+                    # ---- stage the batch -------------------------------
+                    if p.pod:
+                        staged_ns, gathered_ns = self._pod_stage_gather(
+                            mesh, reassemble, datas
+                        )
+                        if op is not None:
+                            op.mark("hbm_staged", staged_ns)
+                            op.mark("gather_complete", gathered_ns)
+                    elif stager is not None:
+                        for data in datas:
+                            stager.submit(memoryview(data))
+                        if op is not None:
+                            op.mark("hbm_staged")
+                    step_bytes = sum(len(d) for d in datas)
+                    consumed_bytes += step_bytes
+                    stall_rec.record_ns(stall_ns)
+                    if stall_ns > p.stall_threshold_ms * 1e6:
+                        stalled_steps += 1
+                    # Top the readahead window up BEFORE the compute
+                    # window: the prefetcher works while the step
+                    # "trains" — that overlap is the whole point.
+                    if pf is not None:
+                        pf.advance(lo + batch)
+                    if compute_s:
+                        time.sleep(compute_s)
+                    if op is not None:
+                        op.finish(step_bytes)
+                    now = time.perf_counter_ns()
+                    step_rec.record_ns(now - step_t0)
+                    step_t0 = now
+        finally:
+            if pf is not None:
+                pf.close()
+            if stager is not None:
+                sink_stats = stager.finish() or {}
+        wall = (time.perf_counter_ns() - t_run0) / 1e9
+
+        # ------------------------------------------------------- result ----
+        stall_arr = stall_rec.as_ns_array()
+        pipe_extra = {
+            "cache": cache.stats(),
+            "prefetch": pf.stats() if pf is not None else None,
+            "stall": {
+                "steps": total_steps,
+                "stalled_steps": stalled_steps,
+                "stalled_fraction": (
+                    stalled_steps / total_steps if total_steps else 0.0
+                ),
+                "threshold_ms": p.stall_threshold_ms,
+                "total_stall_ms": float(stall_arr.sum() / 1e6),
+                "p50_ms": float(np.percentile(stall_arr, 50) / 1e6)
+                if stall_arr.size else 0.0,
+                "p99_ms": float(np.percentile(stall_arr, 99) / 1e6)
+                if stall_arr.size else 0.0,
+            },
+            "plan": {
+                "epochs": p.epochs,
+                "steps_per_epoch": p.steps,
+                "batch_shards": batch,
+                "chunks": len(plan),
+                "unique_chunks": len(set(plan)),
+                "chunk_bytes": p.chunk_bytes or w.granule_bytes,
+            },
+        }
+        summaries = {}
+        for name, rec in (
+            ("step", step_rec), ("stall", stall_rec), ("read", fetch_rec),
+        ):
+            if len(rec):
+                summaries[name] = summarize_ns(rec.as_ns_array())
+        stage_rec = sink_stats.get("stage_recorder")
+        if stage_rec is not None and len(stage_rec):
+            summaries["stage"] = stage_rec.summarize()
+        if p.pod and mesh is not None:
+            # Pod path has no stager stats: the batch was staged across
+            # the whole mesh (pod_ingest parity — per-chip bandwidth
+            # must divide by the mesh size, not default to 1).
+            n_chips = max(1, int(mesh.devices.size))
+        else:
+            n_chips = max(1, int(sink_stats.get("n_chips", 1)))
+        gbps = (consumed_bytes / 1e9) / wall if wall > 0 else 0.0
+        # Demand-path failures abort the run (errgroup semantics), so a
+        # RunResult only exists for runs whose consumption succeeded;
+        # prefetch errors are advisory (the demand path re-fetched) but
+        # still degradation — surface them as the run's error count, the
+        # same way read.py reports recovered worker failure domains.
+        errors = pipe_extra["prefetch"]["errors"] if pf is not None else 0
+        res = RunResult(
+            workload="train_ingest",
+            config=cfg.to_dict(),
+            bytes_total=consumed_bytes,
+            wall_seconds=wall,
+            gbps=gbps,
+            gbps_per_chip=gbps / n_chips,
+            n_chips=n_chips,
+            summaries=summaries,
+            errors=errors,
+        )
+        res.extra["pipeline"] = pipe_extra
+        if sink_stats.get("staged_bytes"):
+            res.extra["staged_bytes"] = sink_stats["staged_bytes"]
+        from tpubench.storage.tail import collect_tail_stats
+
+        tail_stats = collect_tail_stats(self.backend)
+        if tail_stats:
+            res.extra["tail"] = tail_stats
+        if flight is not None:
+            res.extra["flight"] = flight.summary()
+            jpath = cfg.obs.flight_journal
+            if jpath:
+                d = cfg.dist
+                res.extra["flight_journal"] = flight.write_journal(
+                    host_journal_path(jpath, d.process_id, d.num_processes),
+                    extra={"workload": "train_ingest"},
+                )
+        return res
+
+
+# -------------------------------------------------------------- rendering --
+
+
+def format_pipeline_scorecard(pipe: dict) -> str:
+    """Human rendering of ``extra["pipeline"]`` (printed by the CLI and
+    by ``tpubench report`` on train-ingest result files)."""
+    stall = pipe.get("stall", {})
+    cache = pipe.get("cache", {})
+    pf = pipe.get("prefetch")
+    plan = pipe.get("plan", {})
+    lines = [
+        "== ingest-pipeline scorecard ==",
+        (
+            f"  steps={stall.get('steps', 0)} "
+            f"(epochs={plan.get('epochs', '?')}"
+            f"×{plan.get('steps_per_epoch', '?')}, "
+            f"batch={plan.get('batch_shards', '?')} chunks)"
+        ),
+        (
+            f"  data stalls: stalled_steps={stall.get('stalled_steps', 0)} "
+            f"({stall.get('stalled_fraction', 0.0):.1%} of steps over "
+            f"{stall.get('threshold_ms', 0)} ms)  "
+            f"p50={stall.get('p50_ms', 0.0):.2f} ms  "
+            f"p99={stall.get('p99_ms', 0.0):.2f} ms  "
+            f"total={stall.get('total_stall_ms', 0.0):.1f} ms"
+        ),
+    ]
+    hr = cache.get("hit_ratio")
+    lines.append(
+        f"  cache: hits={cache.get('hits', 0)} "
+        f"misses={cache.get('misses', 0)} "
+        f"coalesced={cache.get('coalesced', 0)} "
+        f"hit_ratio={f'{hr:.1%}' if hr is not None else 'n/a'} "
+        f"evictions={cache.get('evictions', 0)} "
+        f"resident={cache.get('resident_bytes', 0)}B"
+        + (
+            f" gen_invalidations={cache['generation_invalidations']}"
+            if cache.get("generation_invalidations") else ""
+        )
+    )
+    if pf:
+        eff = pf.get("efficiency")
+        lines.append(
+            f"  prefetch: issued={pf.get('issued', 0)} "
+            f"completed={pf.get('completed', 0)} "
+            f"skipped={pf.get('skipped', 0)} "
+            f"cancelled={pf.get('cancelled', 0)} "
+            f"errors={pf.get('errors', 0)}  "
+            f"used={pf.get('used_bytes', 0)}B "
+            f"wasted={pf.get('wasted_bytes', 0)}B "
+            f"efficiency={f'{eff:.1%}' if eff is not None else 'n/a'}"
+        )
+    else:
+        lines.append("  prefetch: off (cold demand reads)")
+    return "\n".join(lines)
