@@ -53,3 +53,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "pipeline: ingest pipeline (cache/prefetch/train-ingest)"
     )
+    # Slab tests (zero-copy datapath: refcounted pinned-buffer pool,
+    # copies-per-byte accounting, lease-lifecycle-under-faults) stay in
+    # tier-1 — same policy as `pipeline`: not slow-marked, so the
+    # copy-regression guard runs on every pass; the marker exists for
+    # selective runs (`-m slab`).
+    config.addinivalue_line(
+        "markers", "slab: zero-copy slab datapath (mem/ pool + copy guard)"
+    )

@@ -45,6 +45,34 @@ def test_empty_raises():
         summarize([])
 
 
+def test_partition_percentiles_match_sorted_reference_bit_for_bit():
+    """summarize() now selects order statistics via np.partition (O(n),
+    not O(n log n)); the ssd_test index convention must survive exactly:
+    every field equals the full-sort reference sorted[p*n//100] for
+    adversarial shapes — duplicates, tiny n, colliding indices, negative
+    and denormal-ish values."""
+    rng = np.random.default_rng(7)
+    cases = [
+        rng.normal(5.0, 2.0, size=100_003),
+        rng.integers(0, 5, size=997).astype(np.float64),  # heavy ties
+        np.array([3.0, 1.0, 2.0]),
+        np.array([2.0, 2.0]),  # p20..p99 all collide on one index
+        np.array([-1.5, 0.0, 1e-300, 7.0, 7.0]),
+        rng.exponential(1.0, size=10_000),
+    ]
+    for arr in cases:
+        s = summarize(arr)
+        ref = np.sort(arr)
+        n = len(ref)
+        for p, got in ((20, s.p20_ms), (50, s.p50_ms),
+                       (90, s.p90_ms), (99, s.p99_ms)):
+            idx = min((p * n) // 100, n - 1)
+            assert got == float(ref[idx]), (p, n)
+        assert s.min_ms == float(ref[0])
+        assert s.max_ms == float(ref[-1])
+        assert s.count == n
+
+
 def test_summarize_ns_converts_to_ms():
     s = summarize_ns([2_000_000, 4_000_000])
     assert s.min_ms == 2.0 and s.max_ms == 4.0

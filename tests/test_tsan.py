@@ -22,7 +22,8 @@ def test_engine_clean_under_tsan(tmp_path):
         gxx, "-O1", "-g", "-fsanitize=thread", "-std=c++17",
         os.path.join(NATIVE, "engine.cc"),
         os.path.join(NATIVE, "stress.cc"),
-        "-o", binary, "-lpthread",
+        # -ldl matches build.py: engine.cc dlopens OpenSSL at first use.
+        "-o", binary, "-lpthread", "-ldl",
     ]
     cp = subprocess.run(compile_cmd, capture_output=True, text=True)
     if cp.returncode != 0:

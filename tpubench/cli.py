@@ -180,6 +180,19 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "byte-range shards across the mesh and reassemble "
                         "over ICI (dist.shard/reassemble) instead of the "
                         "slot-ring device_put path")
+    p.add_argument("--slab-bytes", type=int,
+                   help="zero-copy datapath: slab size in bytes for the "
+                        "pinned chunk-buffer pool (0 = one chunk per "
+                        "slab; must hold at least one chunk)")
+    p.add_argument("--pool-slabs", type=int,
+                   help="zero-copy datapath: slab pool capacity (0 = "
+                        "auto-sized from cache budget + readahead + "
+                        "batch; exhaustion spills to counted overflow "
+                        "leases, never blocks)")
+    p.add_argument("--no-slab-pool", action="store_true",
+                   help="disable the zero-copy slab datapath: chunks "
+                        "materialize as bytes (2+ host-RAM copies per "
+                        "chunk — the copies-per-byte A/B baseline arm)")
     p.add_argument("--retry-deadline", type=float,
                    help="per-op retry deadline (s); bounds the reference's "
                         "retry-forever default — set this with --fault-* "
@@ -345,12 +358,15 @@ def build_config(args) -> BenchConfig:
         "cache_bytes", "readahead", "readahead_bytes", "prefetch_workers",
         "steps", "epochs", "batch_shards", "chunk_bytes",
         "step_compute_ms", "stall_threshold_ms",
+        "slab_bytes", "pool_slabs",
     ):
         v = getattr(args, attr, None)
         if v is not None:
             setattr(pl, attr, v)
     if getattr(args, "pipeline_pod", False):
         pl.pod = True
+    if getattr(args, "no_slab_pool", False):
+        pl.slab_pool = False
     from tpubench.config import validate_pipeline_config
 
     validate_pipeline_config(pl)

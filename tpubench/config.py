@@ -292,6 +292,19 @@ class PipelineConfig:
     # mesh and reassemble over ICI (dist.shard / dist.reassemble), instead
     # of the per-host slot-ring device_put path.
     pod: bool = False
+    # --- zero-copy slab datapath (tpubench/mem/) ---
+    # Lease chunks from a refcounted pinned-slab pool: the transport
+    # readinto()s wire bytes straight into a leased slab, the cache
+    # stores the lease, and the consumer stages the slab view in place —
+    # one host-RAM write per chunk byte. False = the legacy bytes path
+    # (the copies-per-byte A/B baseline arm).
+    slab_pool: bool = True
+    # Slab size in bytes; 0 = the effective chunk size (chunk_bytes or
+    # workload.granule_bytes). Must be >= one chunk.
+    slab_bytes: int = 0
+    # Pool capacity in slabs; 0 = auto-sized so the cache budget plus the
+    # readahead window plus one step's batch fit without overflow.
+    pool_slabs: int = 0
 
 
 def validate_pipeline_config(pc: "PipelineConfig",
@@ -302,6 +315,7 @@ def validate_pipeline_config(pc: "PipelineConfig",
         ("cache_bytes", 0), ("readahead", 0), ("readahead_bytes", 0),
         ("prefetch_workers", 1), ("steps", 1), ("epochs", 1),
         ("batch_shards", 1), ("chunk_bytes", 0),
+        ("slab_bytes", 0), ("pool_slabs", 0),
     ):
         v = getattr(pc, name)
         if v < lo:

@@ -1,0 +1,34 @@
+"""Pinned host-memory management for the zero-copy ingest datapath.
+
+The ingest pipeline's hot path (PR 3) paid 2-3 full host-RAM copies per
+chunk: the prefetcher filled a ``bytearray`` and materialized ``bytes``,
+the cache re-copied on insert, and the consumer copied again into the
+staging slot.  This package is the fix: a refcounted pool of fixed-size
+lane-aligned slabs (:class:`~tpubench.mem.slab.SlabPool`) that the whole
+pipeline leases end-to-end — the transport ``readinto``\\ s the wire bytes
+straight into a leased slab, the cache stores the lease, and the consumer
+stages the slab view in place, so a chunk is written once off the wire
+and never copied again.
+
+:class:`~tpubench.mem.slab.CopyMeter` is the proof: it counts every
+host-RAM write of chunk payload bytes (the wire landing plus any
+subsequent copy), and ``copies_per_byte`` is stamped into
+``extra["pipeline"]["copies"]`` so a regression test can pin the slab
+path at exactly 1.0 writes per delivered byte.
+"""
+
+from tpubench.mem.slab import (
+    CopyMeter,
+    SlabLease,
+    SlabPool,
+    payload_view,
+    release_payload,
+)
+
+__all__ = [
+    "CopyMeter",
+    "SlabLease",
+    "SlabPool",
+    "payload_view",
+    "release_payload",
+]

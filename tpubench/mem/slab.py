@@ -1,0 +1,294 @@
+"""Refcounted slab buffer pool: the allocation substrate of the zero-copy
+ingest datapath.
+
+A :class:`SlabPool` owns ``n_slabs`` fixed-size slabs. When the native
+engine is available each slab is its own ``posix_memalign``'d
+:class:`~tpubench.native.engine.AlignedBuffer` (4096-aligned, so every
+slab is lane-aligned for the TPU staging layout and O_DIRECT-safe);
+otherwise slabs degrade to plain ``bytearray``\\ s with identical
+semantics — the pool is a performance substrate, never a capability gate.
+
+Lifecycle is **lease → share → release**:
+
+* :meth:`SlabPool.lease` hands out a :class:`SlabLease` with refcount 1
+  (the leaser's reference). The transport ``readinto``\\ s wire bytes
+  straight into ``lease.view()``.
+* Every party that needs the bytes to outlive the current lock scope
+  takes its own reference (:meth:`SlabLease.incref`): the chunk cache
+  takes one at insert, and hands one to each consumer it serves.
+* :meth:`SlabLease.release` drops a reference; the LAST release retires
+  the slab to the pool's free list. A cache eviction racing a consumer
+  mid-read therefore can never free memory under the reader — the
+  consumer's reference keeps the slab alive until it releases.
+
+Exhaustion never deadlocks: a lease requested from an empty pool is
+served from a transient **overflow** allocation (counted in
+``stats()['overflow_leases']`` — sustained overflow means the pool is
+undersized) that is freed, not pooled, on retirement.
+
+Leak detection: the pool tracks outstanding leases; :meth:`SlabPool.close`
+reports (and keeps alive, so no dangling views) anything still leased —
+``stats()['leaked_slabs']`` must be 0 after a clean run, which the slab
+test suite pins under chaos-injected mid-chunk failures.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class SlabLease:
+    """One leased slab: a bounded writable view plus a refcount.
+
+    ``len(lease)`` is the payload size it was leased for (not the slab
+    capacity), so cache byte accounting treats leases and ``bytes``
+    uniformly. The underlying memory is valid until the LAST reference
+    releases."""
+
+    __slots__ = ("_pool", "_slab", "nbytes", "_refs", "overflow")
+
+    def __init__(self, pool: "SlabPool", slab, nbytes: int, overflow: bool):
+        self._pool = pool
+        self._slab = slab  # AlignedBuffer | bytearray
+        self.nbytes = nbytes
+        self._refs = 1
+        self.overflow = overflow
+
+    def __len__(self) -> int:
+        return self.nbytes
+
+    def view(self, n: Optional[int] = None) -> memoryview:
+        """Writable memoryview of the first ``n`` (default: leased) bytes."""
+        slab = self._slab
+        if slab is None:
+            raise ValueError("slab lease already fully released")
+        want = self.nbytes if n is None else n
+        if isinstance(slab, bytearray):
+            return memoryview(slab)[:want]
+        return slab.view(want)  # AlignedBuffer
+
+    def tobytes(self) -> bytes:
+        """Copying escape hatch (NOT the hot path — callers that need an
+        immutable snapshot, e.g. integrity checks)."""
+        return bytes(self.view())
+
+    def incref(self) -> "SlabLease":
+        with self._pool._lock:
+            if self._refs <= 0:
+                raise ValueError("incref on a fully released slab lease")
+            self._refs += 1
+        return self
+
+    def release(self) -> None:
+        """Drop one reference; the last one retires the slab to the pool."""
+        self._pool._release(self)
+
+
+class SlabPool:
+    """Fixed-size slab pool (module docstring). Thread-safe."""
+
+    def __init__(
+        self,
+        slab_bytes: int,
+        n_slabs: int,
+        *,
+        use_native: bool = True,
+        engine=None,
+    ):
+        if slab_bytes <= 0:
+            raise ValueError(f"slab_bytes={slab_bytes}: must be > 0")
+        if n_slabs <= 0:
+            raise ValueError(f"n_slabs={n_slabs}: must be > 0")
+        self.slab_bytes = int(slab_bytes)
+        self.n_slabs = int(n_slabs)
+        self._lock = threading.Lock()
+        self._closed = False
+        if engine is None and use_native:
+            # get_engine (not peek): with a cached .so this is a dlopen,
+            # not a compile, and a missing toolchain degrades to bytearray
+            # slabs instead of failing the run.
+            from tpubench.native.engine import get_engine
+
+            engine = get_engine()
+        self._engine = engine if use_native else None
+        self._free: list = []
+        alloc_failed = False
+        for _ in range(self.n_slabs):
+            slab = None
+            if self._engine is not None and not alloc_failed:
+                try:
+                    slab = self._engine.alloc(self.slab_bytes)
+                except MemoryError:
+                    alloc_failed = True  # fall through to bytearray
+            if slab is None:
+                slab = bytearray(self.slab_bytes)
+            self._free.append(slab)
+        self.native = self._engine is not None and not alloc_failed
+        # Counters (the extra["pipeline"]["copies"]["pool"] stamp).
+        self.leases = 0
+        self.retires = 0
+        self.overflow_leases = 0
+        self.peak_leased = 0
+        self._leased = 0
+        self.leaked_slabs = 0
+
+    # ------------------------------------------------------------ surface --
+    def lease(self, nbytes: int) -> SlabLease:
+        """A slab sized to hold ``nbytes`` (refcount 1, caller-owned).
+        Raises ValueError when ``nbytes`` exceeds the slab size — the
+        caller's chunking is wrong, not the pool's."""
+        if nbytes > self.slab_bytes:
+            raise ValueError(
+                f"lease of {nbytes} B exceeds slab_bytes={self.slab_bytes}"
+            )
+        with self._lock:
+            if self._closed:
+                raise ValueError("pool closed")
+            slab = self._free.pop() if self._free else None
+            overflow = slab is None
+            self.leases += 1
+            if overflow:
+                self.overflow_leases += 1
+            self._leased += 1
+            self.peak_leased = max(self.peak_leased, self._leased)
+        if overflow:
+            # Transient allocation outside the pool memory: never pooled
+            # on retirement, so pool footprint stays bounded at
+            # n_slabs × slab_bytes + whatever is CURRENTLY overflowed.
+            if self._engine is not None and self.native:
+                try:
+                    slab = self._engine.alloc(self.slab_bytes)
+                except MemoryError:
+                    slab = bytearray(self.slab_bytes)
+            else:
+                slab = bytearray(self.slab_bytes)
+        return SlabLease(self, slab, int(nbytes), overflow)
+
+    def _release(self, lease: SlabLease) -> None:
+        free_native = None
+        with self._lock:
+            if lease._refs <= 0:
+                raise ValueError("release of a fully released slab lease")
+            lease._refs -= 1
+            if lease._refs > 0:
+                return
+            slab, lease._slab = lease._slab, None
+            self._leased -= 1
+            self.retires += 1
+            if lease.overflow or self._closed:
+                if not isinstance(slab, bytearray):
+                    free_native = slab
+            else:
+                self._free.append(slab)
+        if free_native is not None:
+            free_native.free()
+
+    def close(self) -> dict:
+        """Free pooled slabs; anything still leased is counted as leaked
+        and (deliberately) kept alive — a dangling view would be worse
+        than the leak it reports. Returns final :meth:`stats`."""
+        with self._lock:
+            if self._closed:
+                return self.stats_locked()
+            self._closed = True
+            free, self._free = self._free, []
+            self.leaked_slabs = self._leased
+        for slab in free:
+            if not isinstance(slab, bytearray):
+                slab.free()
+        return self.stats()
+
+    # -------------------------------------------------------------- stats --
+    def stats_locked(self) -> dict:
+        return {
+            "slab_bytes": self.slab_bytes,
+            "slabs": self.n_slabs,
+            "native": self.native,
+            "leased": self._leased,
+            "peak_leased": self.peak_leased,
+            "leases": self.leases,
+            "retires": self.retires,
+            "overflow_leases": self.overflow_leases,
+            "leaked_slabs": self.leaked_slabs,
+        }
+
+    def stats(self) -> dict:
+        with self._lock:
+            return self.stats_locked()
+
+    @property
+    def leased(self) -> int:
+        with self._lock:
+            return self._leased
+
+
+# ------------------------------------------------------- payload helpers --
+# The pipeline's chunk payload is EITHER immutable ``bytes`` (the legacy /
+# A-B baseline arm) or a SlabLease (the zero-copy arm). These two helpers
+# are the only polymorphism consumers need.
+
+
+def payload_view(data) -> memoryview:
+    """Read view of a chunk payload (bytes or SlabLease), no copy."""
+    if isinstance(data, SlabLease):
+        return data.view()
+    return memoryview(data)
+
+
+def release_payload(data) -> None:
+    """Drop the caller's reference on a payload (no-op for bytes)."""
+    if isinstance(data, SlabLease):
+        data.release()
+
+
+class CopyMeter:
+    """Counts host-RAM writes of chunk payload bytes on the ingest path.
+
+    ``landed_bytes`` is the unavoidable write: wire → first host buffer
+    (slab or bytearray). ``copied_bytes`` is every write AFTER that —
+    ``bytes()`` materialization, cache insert copies, coalesce copies.
+    ``copies_per_byte`` = (landed + copied) / landed: exactly 1.0 means
+    a chunk is written once off the wire and never copied again (the
+    slab path's contract); the legacy bytes path pays >= 2.0.
+
+    Staging writes (host cache → slot ring / HBM) are deliberately OUT of
+    scope: both A/B arms pay them identically, and the DMA feed is the
+    staging subsystem's own accounting (``staged_bytes``). So is
+    transport-INTERNAL buffering: a hedged read's racing producer
+    streams cannot share one destination, so hedging inherently buffers
+    once more inside ``storage/tail.py`` — on both arms equally; the
+    meter measures the pipeline datapath, wire-landing onward.
+    """
+
+    __slots__ = ("_lock", "landed_bytes", "copied_bytes")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.landed_bytes = 0
+        self.copied_bytes = 0
+
+    def landed(self, n: int) -> None:
+        with self._lock:
+            self.landed_bytes += int(n)
+
+    def copied(self, n: int) -> None:
+        with self._lock:
+            self.copied_bytes += int(n)
+
+    def copies_per_byte(self) -> Optional[float]:
+        with self._lock:
+            if not self.landed_bytes:
+                return None
+            return (self.landed_bytes + self.copied_bytes) / self.landed_bytes
+
+    def stats(self) -> dict:
+        with self._lock:
+            landed, copied = self.landed_bytes, self.copied_bytes
+        return {
+            "landed_bytes": landed,
+            "copied_bytes": copied,
+            "copies_per_byte": (
+                (landed + copied) / landed if landed else None
+            ),
+        }

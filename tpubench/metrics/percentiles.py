@@ -37,26 +37,42 @@ class LatencySummary:
 
 def _index_percentile(sorted_ms: np.ndarray, p: int) -> float:
     # ssd_test/main.go:157-163 convention: sorted[p*n/100], clamped to n-1 so
-    # p=100-ish indices on tiny samples stay in range.
+    # p=100-ish indices on tiny samples stay in range. The array must be
+    # sorted — or np.partition'ed at this index, which places the same
+    # order statistic there.
     n = len(sorted_ms)
     idx = min((p * n) // 100, n - 1)
     return float(sorted_ms[idx])
+
+
+# The ssd_test percentile points summarize() extracts.
+_PCT_POINTS = (20, 50, 90, 99)
 
 
 def summarize(latencies_ms: Sequence[float] | np.ndarray) -> LatencySummary:
     arr = np.asarray(latencies_ms, dtype=np.float64)
     if arr.size == 0:
         raise ValueError("summarize() needs at least one sample")
-    s = np.sort(arr)
+    n = arr.size
+    # Index-based selection via ONE np.partition over all four order
+    # statistics — O(n) where the previous full np.sort paid O(n log n)
+    # on every multi-million-sample journal summary. A partitioned array
+    # holds the exact order statistic at every partition index, so
+    # _index_percentile (the ONE home of the ssd_test index convention)
+    # reads the same sorted[p*n//100] value bit-for-bit — regression-
+    # pinned against a sorted reference in test_metrics.py.
+    idxs = sorted({min((p * n) // 100, n - 1) for p in _PCT_POINTS})
+    part = np.partition(arr, idxs)
+    pcts = {p: _index_percentile(part, p) for p in _PCT_POINTS}
     return LatencySummary(
-        count=int(s.size),
-        avg_ms=float(s.mean()),
-        p20_ms=_index_percentile(s, 20),
-        p50_ms=_index_percentile(s, 50),
-        p90_ms=_index_percentile(s, 90),
-        p99_ms=_index_percentile(s, 99),
-        min_ms=float(s[0]),
-        max_ms=float(s[-1]),
+        count=int(n),
+        avg_ms=float(arr.mean()),
+        p20_ms=pcts[20],
+        p50_ms=pcts[50],
+        p90_ms=pcts[90],
+        p99_ms=pcts[99],
+        min_ms=float(arr.min()),
+        max_ms=float(arr.max()),
     )
 
 

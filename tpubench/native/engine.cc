@@ -74,6 +74,13 @@ enum {
   TB_STAT_H2_STREAMS_OPENED,  // streams submitted (gRPC + raw GET)
   TB_STAT_H2_RST_RX,          // RST_STREAM frames received
   TB_STAT_H2_GOAWAY_RX,       // GOAWAY frames received
+  // Fetch-executor completion-queue handoff (BENCH_r05 attributed the
+  // native executor's deficit to per-completion queue crossings):
+  TB_STAT_POOL_WAKES,          // consumer wakes that returned >=1 completion
+  TB_STAT_POOL_COMPLETIONS,    // completions delivered across all wakes —
+                               // completions/wakes is the batching ratio
+  TB_STAT_POOL_BATCHED_WAKES,  // wakes that drained >1 completion in one
+                               // lock crossing (tb_pool_next_batch)
   TB_STAT_COUNT
 };
 static int64_t tb_stats_v[TB_STAT_COUNT];
@@ -90,6 +97,9 @@ static const char* const tb_stats_names[TB_STAT_COUNT] = {
     "h2_streams_opened",
     "h2_rst_rx",
     "h2_goaway_rx",
+    "pool_wakes",
+    "pool_completions",
+    "pool_batched_wakes",
 };
 
 static inline void tb_stat_add(int idx, int64_t v) {
@@ -1871,7 +1881,76 @@ int tb_pool_next(int64_t h, int timeout_ms, uint64_t* tag_out,
   if (total_ns_out) *total_ns_out = t->total_ns;
   if (start_ns_out) *start_ns_out = t->start_ns;
   free(t);
+  tb_stat_add(TB_STAT_POOL_WAKES, 1);
+  tb_stat_add(TB_STAT_POOL_COMPLETIONS, 1);
   return 1;
+}
+
+// Batched completion handoff: wait like tb_pool_next, then drain up to
+// `max_n` ready completions in the SAME lock crossing — under fan-out,
+// completions pile up while the consumer processes the previous one, so
+// one wake amortizes the mutex/condvar cost across the whole backlog
+// (the per-completion handoff tax BENCH_r05 measured). Fills the
+// parallel out arrays; returns the count drained (0 on timeout),
+// -EINVAL on a bad handle or max_n. max_n is clamped to 256.
+int tb_pool_next_batch(int64_t h, int timeout_ms, int max_n,
+                       uint64_t* tags_out, int64_t* results_out,
+                       int* statuses_out, int64_t* first_byte_ns_out,
+                       int64_t* total_ns_out, int64_t* start_ns_out) {
+  if (h == 0 || max_n <= 0) return -EINVAL;
+  fp::Pool* p = reinterpret_cast<fp::Pool*>(h);
+  fp::Task* batch[256];
+  if (max_n > 256) max_n = 256;
+  pthread_mutex_lock(&p->mu);
+  if (p->done_len == 0) {
+    if (timeout_ms == 0) {
+      pthread_mutex_unlock(&p->mu);
+      return 0;
+    }
+    if (timeout_ms < 0) {
+      while (p->done_len == 0 && !(p->shutdown && p->inflight == 0))
+        pthread_cond_wait(&p->done_cv, &p->mu);
+    } else {
+      struct timespec ts;
+      clock_gettime(CLOCK_REALTIME, &ts);
+      ts.tv_sec += timeout_ms / 1000;
+      ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+      if (ts.tv_nsec >= 1000000000L) {
+        ts.tv_sec++;
+        ts.tv_nsec -= 1000000000L;
+      }
+      while (p->done_len == 0 && !(p->shutdown && p->inflight == 0)) {
+        if (pthread_cond_timedwait(&p->done_cv, &p->mu, &ts) != 0) break;
+      }
+    }
+    if (p->done_len == 0) {
+      pthread_mutex_unlock(&p->mu);
+      return 0;
+    }
+  }
+  int n = 0;
+  while (p->done_len > 0 && n < max_n) {
+    fp::Task* t = p->doneq[p->done_head];
+    p->done_head = (p->done_head + 1) % p->cap;
+    p->done_len--;
+    p->inflight--;
+    batch[n++] = t;
+  }
+  pthread_mutex_unlock(&p->mu);
+  for (int i = 0; i < n; i++) {
+    fp::Task* t = batch[i];
+    if (tags_out) tags_out[i] = t->tag;
+    if (results_out) results_out[i] = t->result;
+    if (statuses_out) statuses_out[i] = t->status;
+    if (first_byte_ns_out) first_byte_ns_out[i] = t->first_byte_ns;
+    if (total_ns_out) total_ns_out[i] = t->total_ns;
+    if (start_ns_out) start_ns_out[i] = t->start_ns;
+    free(t);
+  }
+  tb_stat_add(TB_STAT_POOL_WAKES, 1);
+  tb_stat_add(TB_STAT_POOL_COMPLETIONS, n);
+  if (n > 1) tb_stat_add(TB_STAT_POOL_BATCHED_WAKES, 1);
+  return n;
 }
 
 // Shut down: workers finish queued tasks, then exit; joins all threads.

@@ -45,6 +45,9 @@ int64_t tb_pool_create(int threads, int cap, int tls,
 int tb_pool_submit(int64_t h, const char* host, int port, const char* path,
                    const char* headers, void* buf, int64_t buf_len,
                    uint64_t tag);
+int tb_pool_next_batch(int64_t h, int timeout_ms, int max_n, uint64_t* tags,
+                       int64_t* results, int* statuses, int64_t* fbs,
+                       int64_t* totals, int64_t* starts);
 int tb_pool_next(int64_t h, int timeout_ms, uint64_t* tag, int64_t* result,
                  int* status, int64_t* fb, int64_t* total, int64_t* start);
 int tb_pool_destroy(int64_t h);
@@ -171,22 +174,41 @@ static int stress_fetch_pool() {
   // when everything submitted has drained and both submitters finished —
   // a hard submit error just shrinks the total instead of turning into
   // 30s-per-missing-task timeouts.
+  // Alternate the single and BATCHED drain paths so TSAN sees both
+  // completion handoffs racing the submitters.
   int drained = 0;
   int bad = 0;
+  bool use_batch = false;
   for (;;) {
     if (drained == kTasks) break;
     if (done_submitters.load() == 2 && drained >= submitted.load()) break;
-    uint64_t tag;
-    int64_t result, fb, total, start;
-    int status;
-    int rc = tb_pool_next(pool, 30000, &tag, &result, &status, &fb, &total,
-                          &start);
-    if (rc != 1) {  // stall: bail with a failure instead of hanging
-      bad++;
-      break;
+    if (use_batch) {
+      uint64_t tags[8];
+      int64_t results[8], fbs[8], totals[8], starts[8];
+      int statuses[8];
+      int n = tb_pool_next_batch(pool, 30000, 8, tags, results, statuses,
+                                 fbs, totals, starts);
+      if (n <= 0) {  // stall: bail with a failure instead of hanging
+        bad++;
+        break;
+      }
+      for (int i = 0; i < n; i++)
+        if (results[i] != 16 || statuses[i] != 200) bad++;
+      drained += n;
+    } else {
+      uint64_t tag;
+      int64_t result, fb, total, start;
+      int status;
+      int rc = tb_pool_next(pool, 30000, &tag, &result, &status, &fb,
+                            &total, &start);
+      if (rc != 1) {  // stall: bail with a failure instead of hanging
+        bad++;
+        break;
+      }
+      if (result != 16 || status != 200) bad++;
+      drained++;
     }
-    if (result != 16 || status != 200) bad++;
-    drained++;
+    use_batch = !use_batch;
   }
   for (auto& t : submitters) t.join();
   if (submit_failed.load()) bad++;

@@ -500,6 +500,13 @@ def timeline_summary(records: list[dict]) -> dict:
         "prefetch_issues": sum(
             1 for r in records if "prefetch_issue" in r.get("phases", {})
         ),
+        # Slab-pool pressure (tpubench/mem/): a read that had to lease an
+        # overflow slab notes it — sustained overflow here means the pool
+        # is undersized for the working set (raise --pool-slabs).
+        "slab_overflows": sum(
+            1 for n in notes
+            if n.get("kind") == "slab" and n.get("event") == "overflow"
+        ),
     }
     return {
         "records": len(records),
@@ -562,6 +569,10 @@ def render_timeline(docs: list[dict]) -> str:
             f"cache_hits={pipe['cache_hits']} "
             f"cache_misses={pipe['cache_misses']} "
             f"prefetch_issues={pipe['prefetch_issues']}"
+            + (
+                f" slab_overflows={pipe['slab_overflows']}"
+                if pipe.get("slab_overflows") else ""
+            )
         )
     lines.append("phase segments (ms):")
     for name, s in summ["phases"].items():

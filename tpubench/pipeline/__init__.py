@@ -4,7 +4,15 @@ readahead prefetcher (:mod:`prefetch`), and the step-paced
 ``train-ingest`` workload (:mod:`tpubench.workloads.train_ingest`) that
 measures how well they hide storage latency behind compute —
 per-step data-stall time, cache hit ratio, prefetch efficiency.
+
+Chunk payloads ride the zero-copy slab datapath (:mod:`tpubench.mem`):
+leased pinned slabs filled once off the wire, cached and staged as
+views — ``copies_per_byte == 1.0``, regression-pinned.
 """
 
 from tpubench.pipeline.cache import ChunkCache, ChunkKey  # noqa: F401
-from tpubench.pipeline.prefetch import Prefetcher  # noqa: F401
+from tpubench.pipeline.prefetch import (  # noqa: F401
+    Prefetcher,
+    fetch_chunk,
+    read_chunk,
+)

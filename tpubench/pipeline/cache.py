@@ -18,6 +18,16 @@ both sides: entries carry their origin (``prefetch`` vs ``demand``) and
 a used bit; a prefetched entry's bytes count as *used* on its first hit
 and as *wasted* when it is evicted — or still sitting unused at the end
 of the run — without ever being consumed.
+
+**Payloads** are either immutable ``bytes`` (the legacy / A-B baseline
+arm) or refcounted :class:`~tpubench.mem.slab.SlabLease`\\ s (the
+zero-copy arm). The cache stores the payload object as-is — never a
+copy — and manages lease references: it takes one reference when an
+entry lands, drops it on eviction (retiring the slab once no consumer
+still reads it), and hands every *consumer* access its OWN reference,
+which the consumer releases when done. Non-consumer accesses (the
+prefetcher probing its own work) get the payload without a reference
+and must not release.
 """
 
 from __future__ import annotations
@@ -25,6 +35,18 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 from typing import Callable, NamedTuple, Optional
+
+from tpubench.mem.slab import SlabLease
+
+
+def _freeze(data):
+    """Storable payload: ``bytes`` and slab leases pass through untouched;
+    only mutable buffers (bytearray/memoryview) are copied — and at most
+    ONCE (the PR-3 path copied every miss twice: ``bytes(fetch())`` then
+    ``bytes(data)`` again inside insert)."""
+    if isinstance(data, (bytes, SlabLease)):
+        return data
+    return bytes(data)
 
 
 class ChunkKey(NamedTuple):
@@ -51,7 +73,7 @@ class _Flight:
 
     def __init__(self):
         self.event = threading.Event()
-        self.data: Optional[bytes] = None
+        self.data = None  # bytes | SlabLease once the fetch lands
         self.error: Optional[BaseException] = None
         # Consumers blocked on this fetch (lock-guarded): the owner
         # marks the landed entry used at INSERT time when any exist, so
@@ -139,8 +161,12 @@ class ChunkCache:
                 self.prefetch_invalidated_bytes += len(e.data)
             else:
                 self.prefetch_wasted_bytes += len(e.data)
+        if isinstance(e.data, SlabLease):
+            # Drop the CACHE's reference only: a consumer still reading
+            # the slab holds its own, so the memory outlives the entry.
+            e.data.release()
 
-    def _insert_locked(self, key: ChunkKey, data: bytes, origin: str) -> None:
+    def _insert_locked(self, key: ChunkKey, data, origin: str) -> None:
         n = len(data)
         g = self._obj_gen.get((key.bucket, key.object))
         if g is not None and key.generation < g:
@@ -165,6 +191,10 @@ class ChunkCache:
             old_key = next(iter(self._entries))
             self._drop_locked(old_key)
             self.evictions += 1
+        if isinstance(data, SlabLease):
+            # The cache's own reference (dropped by _drop_locked). Lock
+            # order is cache lock -> pool lock, everywhere.
+            data.incref()
         self._entries[key] = _Entry(data, origin)
         self.bytes += n
         self.inserted_bytes += n
@@ -172,17 +202,22 @@ class ChunkCache:
             self.prefetch_inserted_bytes += n
             self.prefetch_resident_unused += n
 
-    def _hit_locked(self, key: ChunkKey, e: _Entry) -> bytes:
+    def _hit_locked(self, key: ChunkKey, e: _Entry):
         self._entries.move_to_end(key)
         self.hits += 1
         self._mark_used_locked(e)
+        if isinstance(e.data, SlabLease):
+            # Every consumer access owns a reference: an eviction between
+            # this return and the consumer's read must not retire the slab.
+            e.data.incref()
         return e.data
 
     # ------------------------------------------------------------- surface --
-    def get(self, key: ChunkKey) -> Optional[bytes]:
+    def get(self, key: ChunkKey):
         """Consumer hit-or-None lookup (no fetch, no miss accounting).
         The prefetcher's membership probe is :meth:`contains` — this one
-        counts a hit and marks the entry used."""
+        counts a hit, marks the entry used, and (lease payloads) hands the
+        caller its own reference to release."""
         with self._lock:
             e = self._entries.get(key)
             return self._hit_locked(key, e) if e is not None else None
@@ -192,9 +227,9 @@ class ChunkCache:
             return key in self._entries or key in self._inflight
 
     def get_or_fetch(
-        self, key: ChunkKey, fetch: Callable[[], bytes],
+        self, key: ChunkKey, fetch: Callable[[], object],
         origin: str = "demand", consumer: bool = True,
-    ) -> bytes:
+    ):
         """The consumer path: hit → cached bytes; miss → ``fetch()`` once
         per key no matter how many threads ask concurrently (losers wait
         and share the winner's bytes — or its exception).
@@ -206,9 +241,9 @@ class ChunkCache:
         return self.get_or_fetch_info(key, fetch, origin, consumer)[0]
 
     def get_or_fetch_info(
-        self, key: ChunkKey, fetch: Callable[[], bytes],
+        self, key: ChunkKey, fetch: Callable[[], object],
         origin: str = "demand", consumer: bool = True,
-    ) -> tuple[bytes, str]:
+    ) -> tuple:
         """:meth:`get_or_fetch` plus HOW the bytes arrived — ``"hit"``
         (already cached), ``"fetched"`` (this caller issued the backend
         read) or ``"coalesced"`` (joined another caller's in-flight
@@ -245,19 +280,25 @@ class ChunkCache:
             fl.event.wait()
             if fl.error is None:
                 assert fl.data is not None
-                if consumer:
-                    # A demand read joining an in-flight PREFETCH
-                    # consumed those bytes: mark the landed entry used,
-                    # or the very overlap the pipeline exists to produce
-                    # would be counted as prefetch waste (and a
-                    # readahead byte budget would slowly choke on
-                    # phantom outstanding bytes).
-                    with self._lock:
-                        self.coalesced += 1
-                        e = self._entries.get(key)
-                        if (e is not None and e.origin == "prefetch"
-                                and not e.used):
-                            self._mark_used_locked(e)
+                if not consumer:
+                    # A prefetch worker that raced another fetch for the
+                    # same chunk: the chunk landed, its job is done. No
+                    # payload reference is taken (only consumers own
+                    # references), so the caller must not release.
+                    return fl.data, "coalesced"
+                # A demand read joining an in-flight PREFETCH consumed
+                # those bytes: mark the landed entry used, or the very
+                # overlap the pipeline exists to produce would be counted
+                # as prefetch waste (and a readahead byte budget would
+                # slowly choke on phantom outstanding bytes). The
+                # consumer's payload reference was taken by the owner at
+                # insert time (one per registered waiter).
+                with self._lock:
+                    self.coalesced += 1
+                    e = self._entries.get(key)
+                    if (e is not None and e.origin == "prefetch"
+                            and not e.used):
+                        self._mark_used_locked(e)
                 return fl.data, "coalesced"
             if not consumer:
                 # A prefetch worker joining a failed fetch stays
@@ -270,7 +311,11 @@ class ChunkCache:
             # and (most likely) become the owner. Readahead must never
             # make a run strictly LESS fault-tolerant than cold reads.
         try:
-            data = bytes(fetch())
+            # At most ONE copy, and only for mutable fetch results: bytes
+            # and slab leases store as-is (the PR-3 path paid bytes(fetch())
+            # here AND bytes(data) again inside insert — two full copies
+            # per miss even when the result was already immutable).
+            data = _freeze(fetch())
         except BaseException as exc:
             with self._lock:
                 fl.error = exc
@@ -289,13 +334,33 @@ class ChunkCache:
                 e = self._entries.get(key)
                 if e is not None:
                     self._mark_used_locked(e)
+                if isinstance(data, SlabLease):
+                    # One payload reference per registered consumer waiter
+                    # (they wake after the event and each release when
+                    # done); taken under the cache lock, BEFORE the event,
+                    # so no waiter can observe an unreferenced payload.
+                    for _ in range(fl.consumer_waiters):
+                        data.incref()
         fl.event.set()
         return data, "fetched"
 
-    def insert(self, key: ChunkKey, data: bytes, origin: str = "demand") -> None:
+    def insert(self, key: ChunkKey, data, origin: str = "demand") -> None:
         with self._lock:
             self._note_generation_locked(key)
-            self._insert_locked(key, bytes(data), origin)
+            self._insert_locked(key, _freeze(data), origin)
+
+    def close(self) -> None:
+        """Run teardown: drop every resident entry, releasing the cache's
+        lease references so the slab pool's leak detector sees only REAL
+        leaks. Deliberately touches no counters — end-of-run stats were
+        already snapshotted, and resident-but-unused prefetched bytes are
+        ALREADY counted as waste by ``unused_prefetched_bytes``."""
+        with self._lock:
+            entries, self._entries = self._entries, OrderedDict()
+            self.bytes = 0
+        for e in entries.values():
+            if isinstance(e.data, SlabLease):
+                e.data.release()
 
     def unused_prefetched_bytes(self) -> int:
         """Prefetched entries still waiting for their first use — at end

@@ -35,15 +35,16 @@ import heapq
 import threading
 from typing import Optional, Sequence
 
+from tpubench.mem.slab import CopyMeter, SlabPool, release_payload
 from tpubench.obs import flight as _flight
 from tpubench.pipeline.cache import ChunkCache, ChunkKey
 from tpubench.storage.base import StorageError
 
 
-def read_chunk(backend, key: ChunkKey) -> bytes:
-    """One ranged read of ``key``'s bytes through the backend stack,
-    streamed to completion (shared by the prefetch workers and the
-    consumer's demand path so both arms measure the same read shape).
+def _stream_into(backend, key: ChunkKey, mv: memoryview) -> None:
+    """Stream ``key``'s exact byte range through the backend stack into
+    caller memory (slab or bytearray — the ONE read shape both A/B arms
+    and both the demand and prefetch paths measure).
 
     When the transport surfaces the served object's generation
     (``reader.generation`` — the fake backend and the h1.1 JSON-API
@@ -56,8 +57,6 @@ def read_chunk(backend, key: ChunkKey) -> bytes:
     surface response headers (the native h2/receive engine paths) read
     ``generation=None`` = *unknown*: enforcement degrades to plan-build
     keying there — a documented scope line, not a silent guarantee."""
-    buf = bytearray(key.length)
-    mv = memoryview(buf)
     reader = backend.open_read(key.object, start=key.start, length=key.length)
     got = 0
     try:
@@ -83,7 +82,46 @@ def read_chunk(backend, key: ChunkKey) -> bytes:
             f"{key.object} [{key.start}:+{key.length}]: short chunk read "
             f"{got}/{key.length}"
         )
+
+
+def read_chunk(backend, key: ChunkKey,
+               meter: Optional[CopyMeter] = None) -> bytes:
+    """The legacy ``bytes`` chunk read (the A/B baseline arm): wire →
+    scratch bytearray (one write), then a full ``bytes`` materialization
+    (a second write of every byte) — exactly the copy tax the slab path
+    (:func:`fetch_chunk`) exists to delete."""
+    buf = bytearray(key.length)
+    _stream_into(backend, key, memoryview(buf))
+    if meter is not None:
+        meter.landed(key.length)
+        meter.copied(key.length)  # the bytes() below re-writes every byte
     return bytes(buf)
+
+
+def fetch_chunk(backend, key: ChunkKey, pool: Optional[SlabPool] = None,
+                meter: Optional[CopyMeter] = None):
+    """One chunk fetch, zero-copy when a slab pool is given: the backend
+    stack ``readinto``\\ s the wire bytes straight into a leased slab and
+    the LEASE is the payload — the cache stores it, the consumer stages
+    its view in place, and nothing re-copies. Returns the caller-owned
+    payload (``SlabLease`` with refcount 1, or ``bytes`` without a
+    pool); any failure mid-chunk releases the lease back to the pool
+    before propagating — chaos faults must never leak slabs."""
+    if pool is None:
+        return read_chunk(backend, key, meter=meter)
+    lease = pool.lease(key.length)
+    if lease.overflow:
+        # Pool-pressure breadcrumb on the read's flight record: sustained
+        # overflow means --pool-slabs is undersized for the working set.
+        _flight.annotate("slab", event="overflow")
+    try:
+        _stream_into(backend, key, lease.view())
+    except BaseException:
+        lease.release()
+        raise
+    if meter is not None:
+        meter.landed(key.length)  # wire → slab: the one and only write
+    return lease
 
 
 class Prefetcher:
@@ -99,9 +137,13 @@ class Prefetcher:
         depth: int = 8,
         byte_budget: int = 0,
         transport: str = "",
+        pool: Optional[SlabPool] = None,
+        meter: Optional[CopyMeter] = None,
     ):
         self._backend = backend
         self._cache = cache
+        self._pool = pool
+        self._meter = meter
         self._plan = list(plan)
         self._depth = max(0, depth)
         self._depth_effective = self._depth
@@ -229,15 +271,24 @@ class Prefetcher:
                 if op is not None:
                     op.mark("prefetch_issue")
                 data, source = self._cache.get_or_fetch_info(
-                    key, lambda: read_chunk(self._backend, key),
+                    key,
+                    lambda: fetch_chunk(self._backend, key,
+                                        pool=self._pool, meter=self._meter),
                     origin="prefetch", consumer=False,
                 )
                 if source == "fetched":
+                    nbytes = len(data)
+                    # The worker's own (leaser) reference: the cache took
+                    # its reference at insert — the prefetcher does not
+                    # consume, so it lets go here. A refused insert
+                    # (stale generation / oversize) retires the slab
+                    # right now instead of leaking it.
+                    release_payload(data)
                     with self._lock:
                         self.completed += 1
                     if op is not None:
                         op.mark("body_complete")
-                        op.finish(len(data))
+                        op.finish(nbytes)
                 else:
                     # A demand read claimed the chunk between the
                     # contains() probe and the fetch (hit or joined

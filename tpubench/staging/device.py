@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from tpubench.config import BenchConfig, StagingConfig
+from tpubench.mem.slab import SlabLease
 from tpubench.metrics.recorder import LatencyRecorder
 from tpubench.obs import flight as _flight
 
@@ -91,9 +92,17 @@ class GranuleAggregator:
         if self._fill >= self._slot_bytes:
             self._launch()
 
-    def submit(self, mv: memoryview) -> None:
-        """Copying path (granule was filled elsewhere): copy into slot free
-        space, launching transfers as slots fill."""
+    def submit(self, mv) -> None:
+        """Slot-fill path (granule was filled elsewhere): read the source
+        into slot free space, launching transfers as slots fill. Accepts
+        any bytes-like source or a :class:`~tpubench.mem.slab.SlabLease`
+        — the pipeline's pinned chunk slabs feed the ring directly, with
+        no ``bytes`` materialization in between (the caller keeps its
+        lease reference until submit returns; the fill is synchronous)."""
+        if isinstance(mv, SlabLease):
+            mv = mv.view()
+        elif not isinstance(mv, memoryview):
+            mv = memoryview(mv)
         off = 0
         n = len(mv)
         while off < n:

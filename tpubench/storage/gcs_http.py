@@ -50,6 +50,21 @@ DEFAULT_ENDPOINT = "https://storage.googleapis.com"
 # semantics: 408, 429, 5xx).
 _TRANSIENT = {408, 429, 500, 502, 503, 504}
 
+_drain_tls = threading.local()
+
+
+def _drain_scratch() -> bytearray:
+    """Per-thread 64 KiB drain sink, allocated once. Response closes that
+    drain small remainders (to keep the connection reusable) used to
+    allocate a fresh bytearray per close — a guaranteed allocation on
+    every partially-consumed response, paid on the pipeline's hot path.
+    One worker thread drains one response at a time, so a thread-local
+    scratch is race-free by construction."""
+    buf = getattr(_drain_tls, "buf", None)
+    if buf is None:
+        buf = _drain_tls.buf = bytearray(65536)
+    return buf
+
 
 class _ConnectionPool:
     """Keep-alive pool with the reference's two caps (main.go:31-32)."""
@@ -174,10 +189,12 @@ class _HttpReader:
             return
         complete = self._remaining == 0
         if not complete:
-            # Drain small remainders so the connection stays reusable.
+            # Drain small remainders so the connection stays reusable
+            # (reused per-thread scratch: no allocation per close).
             if 0 < self._remaining <= 1 << 20:
+                sink = memoryview(_drain_scratch())
                 try:
-                    while self._resp.read(65536):
+                    while self._resp.readinto(sink):
                         pass
                     complete = True
                 except Exception:
@@ -256,10 +273,11 @@ class _NativeStreamReader:
         try:
             if not self._done and self._content_len >= 0:
                 # Drain small remainders so the connection stays reusable
-                # (same policy as the Python reader above).
+                # (same policy as the Python reader above; reused
+                # per-thread scratch, not a fresh 64 KiB per close).
                 left = self._content_len - self._consumed
                 if 0 < left <= self._DRAIN_CAP:
-                    sink = bytearray(65536)
+                    sink = _drain_scratch()
                     while engine.conn_body_read(conn, sink, len(sink)) > 0:
                         pass
             reusable = engine.conn_get_end(conn)
@@ -902,7 +920,7 @@ class GcsHttpBackend:
                 n = engine.conn_body_read(conn, msg, len(msg))
                 clen = r["content_len"]
                 if 0 <= clen <= _NativeStreamReader._DRAIN_CAP:
-                    sink = bytearray(65536)
+                    sink = _drain_scratch()
                     while engine.conn_body_read(conn, sink, len(sink)) > 0:
                         pass
                     pool.release(conn, engine.conn_get_end(conn))

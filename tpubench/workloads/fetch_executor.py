@@ -184,6 +184,15 @@ def _make_pool(engine, inner, threads: int, cap: int):
     )
 
 
+def _stamp_native_delta(res: RunResult, engine, stats0: dict) -> None:
+    """tb_stats delta across the run (read.py parity): makes the wire
+    counters AND the completion-batching ratio (pool_completions /
+    pool_wakes > 1 = batching engaged) visible in the result JSON."""
+    delta = {k: v - stats0.get(k, 0) for k, v in engine.stats().items()}
+    if any(delta.values()):
+        res.extra["native_transport"] = delta
+
+
 def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunResult:
     """Fetch fan-out on the executor, bytes discarded in host RAM
     (reference parity: ``io.Discard``, main.go:140). Client retry policy
@@ -215,6 +224,7 @@ def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunRe
         res.extra["fetch_executor"] = "native"
         return res
     pool = _make_pool(engine, inner, w.workers, max(4, 2 * w.workers))
+    native_stats0 = engine.stats()
     retry = RetryScheduler(cfg.transport.retry)
     bytes_total = 0
     errors = 0
@@ -260,18 +270,9 @@ def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunRe
             submit(wid, 0)
         completed = 0
         idle_waits = 0
-        while completed < total_reads:
-            for tag in retry.pop_due():
-                resubmit(tag)
-            c = pool.next(timeout_ms=retry.next_due_in_ms(30_000))
-            if c is None:
-                if retry.waiting:
-                    continue  # timeout was just a backoff pause elapsing
-                idle_waits += 1
-                if idle_waits >= 4:  # 4 x 30 s with zero completions
-                    raise RuntimeError("native fetch executor stalled (120s)")
-                continue
-            idle_waits = 0
+
+        def handle(c: dict) -> None:
+            nonlocal completed, errors, first_error, bytes_total
             tag = c["tag"]
             wid = tag // reads_per
             read_rec, fb_rec = recorders[wid]
@@ -285,7 +286,7 @@ def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunRe
                 pause = retry.offer(tag, verdict)
                 if pause is not None:
                     retry.push(tag, tag, pause)
-                    continue  # slot for this read stays inflight
+                    return  # slot for this read stays inflight
                 retry.done(tag)
                 errors += 1
                 if not first_error:
@@ -309,6 +310,26 @@ def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunRe
             if per_worker_next[wid] < reads_per:
                 submit(wid, per_worker_next[wid])
                 per_worker_next[wid] += 1
+
+        while completed < total_reads:
+            for tag in retry.pop_due():
+                resubmit(tag)
+            # Batched drain (tb_pool_next_batch): under fan-out the
+            # workers land completions faster than Python processes them
+            # — one wake takes the whole backlog in a single native lock
+            # crossing instead of paying the handoff per completion (the
+            # BENCH_r05 deficit attribution).
+            cs = pool.next_batch(timeout_ms=retry.next_due_in_ms(30_000))
+            if not cs:
+                if retry.waiting:
+                    continue  # timeout was just a backoff pause elapsing
+                idle_waits += 1
+                if idle_waits >= 4:  # 4 x 30 s with zero completions
+                    raise RuntimeError("native fetch executor stalled (120s)")
+                continue
+            idle_waits = 0
+            for c in cs:
+                handle(c)
     finally:
         # Stop the clock BEFORE teardown (thread joins + multi-MB munmaps
         # must not bias the measured window vs the Python path).
@@ -332,6 +353,7 @@ def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunRe
     )
     res.extra["fetch_executor"] = "native"
     res.extra["executor_threads"] = w.workers
+    _stamp_native_delta(res, engine, native_stats0)
     res.extra["client_retry"] = (
         f"gax policy over completions (policy={cfg.transport.retry.policy}, "
         f"retries={retry.retries})"
@@ -502,6 +524,7 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
         ws.append(st)
 
     pool = _make_pool(engine, inner, w.workers, max(8, 2 * w.workers * depth))
+    native_stats0 = engine.stats()
     retry = RetryScheduler(cfg.transport.retry)
     inflight: dict[int, tuple] = {}  # tag -> (wid, slot, start, length)
     # PER-WORKER transfer FIFOs: completion order is FIFO per device, not
@@ -590,6 +613,66 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
         # this one's fetches all settled (serial reads per worker).
         return False
 
+    def _handle_staged_completion(c: dict) -> None:
+        nonlocal bytes_total, errors, first_error, completed_reads, transfers_n
+        tag = c["tag"]
+        wid, slot, start, length = inflight[tag][:4]
+        st = ws[wid]
+        pipe = pipes[wid]
+        verdict = _classify(c["result"], c["status"], PERMANENT_CODES)
+        if verdict == "ok" and c["result"] != length:
+            # Range honored means exactly `length` bytes; anything else
+            # is a protocol-shape failure (server ignored the range).
+            verdict = "permanent"
+        if verdict != "ok":
+            pause = retry.offer(tag, verdict)
+            if pause is not None:
+                retry.push(tag, tag, pause)
+                return  # slot stays owned by the retrying task
+            if not st.failed:
+                # One error per failed READ (not per failed range) —
+                # RunResult.errors parity with the other paths.
+                errors += 1
+            if not first_error:
+                first_error = (
+                    f"worker {wid} range {start}+{length}: "
+                    f"result {c['result']} status {c['status']}"
+                )
+            del inflight[tag]
+            retry.done(tag)
+            pipe.free.append(slot)
+            # Abandon this call: stop submitting its ranges; it
+            # completes (as a failed read) when in-flight ones settle.
+            st.next_off = sizes[wid]
+            st.failed = True
+            st.ranges_out -= 1
+            if w.abort_on_error:
+                raise RuntimeError(
+                    f"staged executor: read failed ({first_error})"
+                )
+        else:
+            retry.done(tag)
+            del inflight[tag]
+            if not st.first_fb and c["first_byte_ns"]:
+                recorders[wid][1].record_ns(
+                    c["first_byte_ns"] - c["start_ns"]
+                )
+                st.first_fb = True
+            bytes_total += length
+            st.ranges_out -= 1
+            transfers[wid].append((slot,) + pipe.launch(slot, length))
+            transfers_n += 1
+        # Call complete when fully submitted and nothing outstanding.
+        if st.next_off >= sizes[wid] and st.ranges_out == 0:
+            if not st.failed:
+                # Failed reads are counted in `errors`, not in the
+                # latency histogram (Python-path parity).
+                recorders[wid][0].record_ns(time.perf_counter_ns() - st.t0)
+            completed_reads += 1
+            st.call += 1
+            st.next_off = 0 if st.call < reads_per else sizes[wid]
+            st.failed = False
+
     from tpubench.obs.exporters import metrics_session_from_config
 
     session = metrics_session_from_config(
@@ -624,67 +707,15 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
             # the loop: keep the wait short while any are pending so the
             # device-side pipeline is never starved behind a slow fetch.
             cap_ms = 5 if transfers_n else 100
-            c = pool.next(timeout_ms=retry.next_due_in_ms(cap_ms))
-            if c is None:
+            # Batched drain: one native lock crossing takes the whole
+            # completion backlog (per-worker slot launches then happen
+            # back-to-back without re-paying the handoff per range).
+            cs = pool.next_batch(timeout_ms=retry.next_due_in_ms(cap_ms))
+            if not cs:
                 continue
             last_progress = time.monotonic()
-            tag = c["tag"]
-            wid, slot, start, length = inflight[tag][:4]
-            st = ws[wid]
-            pipe = pipes[wid]
-            verdict = _classify(c["result"], c["status"], PERMANENT_CODES)
-            if verdict == "ok" and c["result"] != length:
-                # Range honored means exactly `length` bytes; anything else
-                # is a protocol-shape failure (server ignored the range).
-                verdict = "permanent"
-            if verdict != "ok":
-                pause = retry.offer(tag, verdict)
-                if pause is not None:
-                    retry.push(tag, tag, pause)
-                    continue  # slot stays owned by the retrying task
-                if not st.failed:
-                    # One error per failed READ (not per failed range) —
-                    # RunResult.errors parity with the other paths.
-                    errors += 1
-                if not first_error:
-                    first_error = (
-                        f"worker {wid} range {start}+{length}: "
-                        f"result {c['result']} status {c['status']}"
-                    )
-                del inflight[tag]
-                retry.done(tag)
-                pipe.free.append(slot)
-                # Abandon this call: stop submitting its ranges; it
-                # completes (as a failed read) when in-flight ones settle.
-                st.next_off = sizes[wid]
-                st.failed = True
-                st.ranges_out -= 1
-                if w.abort_on_error:
-                    raise RuntimeError(
-                        f"staged executor: read failed ({first_error})"
-                    )
-            else:
-                retry.done(tag)
-                del inflight[tag]
-                if not st.first_fb and c["first_byte_ns"]:
-                    recorders[wid][1].record_ns(
-                        c["first_byte_ns"] - c["start_ns"]
-                    )
-                    st.first_fb = True
-                bytes_total += length
-                st.ranges_out -= 1
-                transfers[wid].append((slot,) + pipe.launch(slot, length))
-                transfers_n += 1
-            # Call complete when fully submitted and nothing outstanding.
-            if st.next_off >= sizes[wid] and st.ranges_out == 0:
-                if not st.failed:
-                    # Failed reads are counted in `errors`, not in the
-                    # latency histogram (Python-path parity).
-                    recorders[wid][0].record_ns(time.perf_counter_ns() - st.t0)
-                completed_reads += 1
-                st.call += 1
-                st.next_off = 0 if st.call < reads_per else sizes[wid]
-                st.failed = False
+            for c in cs:
+                _handle_staged_completion(c)
         # All fetches done; drain remaining transfers into the timed window
         # (staged bandwidth counts transfer completion, same as the Python
         # staged path's finish()).
@@ -730,6 +761,7 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
     )
     res.extra["fetch_executor"] = "native"
     res.extra["executor_threads"] = w.workers
+    _stamp_native_delta(res, engine, native_stats0)
     res.extra["staging_zero_copy"] = True
     res.extra["staged_bytes"] = staged
     res.extra["staged_gbps"] = (staged / 1e9) / wall if wall > 0 else 0.0

@@ -51,6 +51,11 @@ def _axis(run: dict) -> str:
     if run.get("workload") == "train_ingest":
         ra = (cfg.get("pipeline") or {}).get("readahead", 0)
         bits.append(f"readahead={ra}" if ra else "cold")
+        # Slab-vs-bytes is the copies A/B's axis: label it so the diff
+        # table reads "slab vs bytes", not two identical rows.
+        copies = (run.get("extra", {}).get("pipeline") or {}).get("copies")
+        if copies and copies.get("mode"):
+            bits.append(copies["mode"])
     return " ".join(bits)
 
 
@@ -145,6 +150,16 @@ def compare_runs(runs: list[dict]) -> str:
                 f"{cell(op_, '{:.1%}', 'cache', 'hit_ratio')} vs "
                 f"{cell(bp, '{:.1%}', 'cache', 'hit_ratio')}"
             )
+            if op_.get("copies") or bp.get("copies"):
+                # The zero-copy A/B's headline: host-RAM writes per
+                # delivered chunk byte (slab = 1.00, legacy bytes >= 2).
+                lines.append(
+                    "    copies/byte "
+                    f"{cell(op_, '{:.2f}', 'copies', 'copies_per_byte')} "
+                    f"({cell(op_, '{}', 'copies', 'mode')}) vs "
+                    f"{cell(bp, '{:.2f}', 'copies', 'copies_per_byte')} "
+                    f"({cell(bp, '{}', 'copies', 'mode')})"
+                )
         # Scorecard diff: two chaos runs (e.g. hedged vs unhedged over the
         # same timeline) compare on resilience, not just throughput.
         osc = (other.get("extra", {}).get("chaos") or {}).get("scorecard")

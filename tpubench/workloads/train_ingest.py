@@ -42,6 +42,12 @@ from typing import Optional
 import numpy as np
 
 from tpubench.config import BenchConfig, validate_pipeline_config
+from tpubench.mem.slab import (
+    CopyMeter,
+    SlabPool,
+    payload_view,
+    release_payload,
+)
 from tpubench.metrics.percentiles import summarize_ns
 from tpubench.metrics.recorder import LatencyRecorder
 from tpubench.metrics.report import RunResult
@@ -51,7 +57,7 @@ from tpubench.obs.flight import (
     transport_label,
 )
 from tpubench.pipeline.cache import ChunkCache, ChunkKey
-from tpubench.pipeline.prefetch import Prefetcher, read_chunk
+from tpubench.pipeline.prefetch import Prefetcher, fetch_chunk
 from tpubench.storage import open_backend
 from tpubench.storage.base import StorageBackend, iter_ranges
 
@@ -116,6 +122,15 @@ def run_train_ingest(
             "schedule a fetch — raise --readahead-bytes or drop it "
             "(0 = depth-bounded)"
         )
+    if p.slab_pool and 0 < p.slab_bytes < chunk:
+        # A slab that cannot hold one chunk makes every lease fail: the
+        # run would degrade to errors, not to the bytes path. Rejected
+        # here because the effective chunk size is only known now.
+        raise SystemExit(
+            f"pipeline.slab_bytes={p.slab_bytes} is smaller than one "
+            f"chunk ({chunk} B): no chunk can be leased — raise "
+            "--slab-bytes (or 0 = auto: one chunk per slab)"
+        )
     owns_backend = backend is None
     backend = backend or open_backend(cfg)
     try:
@@ -148,17 +163,18 @@ class _TrainIngest:
         mesh = make_mesh(axis=self.cfg.dist.mesh_axis)
         return mesh, make_reassemble(mesh, self.cfg.dist.mesh_axis)
 
-    def _pod_stage_gather(self, mesh, reassemble, datas: list[bytes]):
+    def _pod_stage_gather(self, mesh, reassemble, datas: list):
         """Pod path for one step: the batch's bytes as byte-range shards
         across the mesh, reassembled over ICI. Returns gather-complete
-        perf_counter_ns."""
+        perf_counter_ns. ``datas`` holds payloads (bytes or slab leases);
+        the shard build reads their views directly."""
         import jax
 
         from tpubench.dist.reassemble import shard_to_device_array
         from tpubench.dist.shard import ShardTable
 
         lane = self.cfg.staging.lane
-        blob = b"".join(datas)
+        blob = b"".join(payload_view(d) for d in datas)
         n = int(mesh.devices.size)
         table = ShardTable.build(len(blob), n, align=lane)
         buffers = []
@@ -186,6 +202,30 @@ class _TrainIngest:
         batch = p.batch_shards
         total_steps = p.steps * p.epochs
         cache = ChunkCache(p.cache_bytes)
+        # Zero-copy slab datapath (tpubench/mem/): chunks are leased from
+        # a pinned-slab pool, readinto'd once off the wire, cached and
+        # staged as views — the CopyMeter proves it (copies stamp below).
+        meter = CopyMeter()
+        pool: Optional[SlabPool] = None
+        if p.slab_pool:
+            chunk_eff = p.chunk_bytes or w.granule_bytes
+            slab_bytes = p.slab_bytes or chunk_eff
+            n_slabs = p.pool_slabs
+            if not n_slabs:
+                # Auto-size: the resident working set (cache budget in
+                # CHUNKS — the cache accounts payload length, not slab
+                # size — but never more than the plan's unique chunks) +
+                # the readahead window + one step's batch + in-flight
+                # fetch headroom. Overflow leases cover estimation error.
+                resident = min(
+                    p.cache_bytes // max(1, chunk_eff), len(set(plan))
+                )
+                n_slabs = min(
+                    8192,
+                    max(1, resident + p.readahead + batch
+                        + p.prefetch_workers + 2),
+                )
+            pool = SlabPool(slab_bytes, n_slabs)
         tlabel = transport_label(cfg)
         flight = flight_from_config(cfg)
         consumer_wf = flight.worker("consumer") if flight is not None else None
@@ -226,6 +266,7 @@ class _TrainIngest:
                         depth=p.readahead,
                         byte_budget=p.readahead_bytes,
                         transport=tlabel,
+                        pool=pool, meter=meter,
                     )
                     pf.advance(0)
                 step_t0 = time.perf_counter_ns()
@@ -239,7 +280,10 @@ class _TrainIngest:
                     )
                     stall_ns = 0
                     first_block_ns = last_block_ns = None
-                    datas: list[bytes] = []
+                    # Chunk payloads: bytes (legacy arm) or SlabLease
+                    # (zero-copy arm). Every entry carries this step's
+                    # consumer reference, released after staging.
+                    datas: list = []
                     for key in keys:
                         data = cache.get(key)
                         if data is not None:
@@ -260,7 +304,10 @@ class _TrainIngest:
                             try:
                                 data, source = cache.get_or_fetch_info(
                                     key,
-                                    lambda k=key: read_chunk(self.backend, k),
+                                    lambda k=key: fetch_chunk(
+                                        self.backend, k,
+                                        pool=pool, meter=meter,
+                                    ),
                                 )
                             except BaseException as e:
                                 # errgroup semantics (read.py parity): a
@@ -326,11 +373,18 @@ class _TrainIngest:
                             op.mark("gather_complete", gathered_ns)
                     elif stager is not None:
                         for data in datas:
-                            stager.submit(memoryview(data))
+                            # The slab view stages IN PLACE: the sink's
+                            # slot fill reads straight out of the pinned
+                            # slab (no bytes() materialization between).
+                            stager.submit(payload_view(data))
                         if op is not None:
                             op.mark("hbm_staged")
                     step_bytes = sum(len(d) for d in datas)
                     consumed_bytes += step_bytes
+                    # Staging consumed the views synchronously: drop this
+                    # step's consumer references so evicted slabs retire.
+                    for data in datas:
+                        release_payload(data)
                     stall_rec.record_ns(stall_ns)
                     if stall_ns > p.stall_threshold_ms * 1e6:
                         stalled_steps += 1
@@ -380,6 +434,19 @@ class _TrainIngest:
                 "chunk_bytes": p.chunk_bytes or w.granule_bytes,
             },
         }
+        # Copies-per-byte: the zero-copy datapath's proof (and the A/B's
+        # headline axis) — host-RAM writes of chunk payload per delivered
+        # byte; 1.0 = written once off the wire, never copied again.
+        copies = meter.stats()
+        copies["mode"] = "slab" if pool is not None else "bytes"
+        if pool is not None:
+            # Teardown order is load-bearing: releasing the cache's lease
+            # references BEFORE closing the pool makes leaked_slabs a
+            # true leak signal (a resident cache entry is not a leak).
+            cache.close()
+            pool.close()
+            copies["pool"] = pool.stats()
+        pipe_extra["copies"] = copies
         summaries = {}
         for name, rec in (
             ("step", step_rec), ("stall", stall_rec), ("read", fetch_rec),
@@ -429,7 +496,10 @@ class _TrainIngest:
                 d = cfg.dist
                 res.extra["flight_journal"] = flight.write_journal(
                     host_journal_path(jpath, d.process_id, d.num_processes),
-                    extra={"workload": "train_ingest"},
+                    extra={
+                        "workload": "train_ingest",
+                        "pipeline_copies": pipe_extra["copies"],
+                    },
                 )
         return res
 
@@ -488,4 +558,24 @@ def format_pipeline_scorecard(pipe: dict) -> str:
         )
     else:
         lines.append("  prefetch: off (cold demand reads)")
+    cp = pipe.get("copies")
+    if cp:
+        cpb = cp.get("copies_per_byte")
+        line = (
+            f"  copies: mode={cp.get('mode', '?')} "
+            f"{f'{cpb:.2f}/byte' if cpb is not None else 'n/a'} "
+            f"(landed={cp.get('landed_bytes', 0)}B "
+            f"copied={cp.get('copied_bytes', 0)}B)"
+        )
+        pl = cp.get("pool")
+        if pl:
+            line += (
+                f"  pool: slabs={pl.get('slabs', 0)}"
+                f"×{pl.get('slab_bytes', 0)}B "
+                f"{'pinned' if pl.get('native') else 'bytearray'} "
+                f"peak={pl.get('peak_leased', 0)} "
+                f"overflow={pl.get('overflow_leases', 0)} "
+                f"leaked={pl.get('leaked_slabs', 0)}"
+            )
+        lines.append(line)
     return "\n".join(lines)
