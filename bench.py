@@ -178,6 +178,63 @@ def _fetch_only_run(endpoint: str, total_mb: int, executor: str) -> float:
     return res.gbps
 
 
+def _tune_ab_cell() -> dict:
+    """Static-vs-adaptive A/B on the hermetic train-ingest pipeline:
+    the SAME shaped-straggler target (fixed fault seed), once at the
+    static default operating point (readahead=1) and once with the
+    online tune controller driving readahead/prefetch-workers live —
+    so the trajectory records the controller's gain (BENCH_r06+).
+    Sleep-scale honored: fault/compute/window durations all scale, with
+    floors so the scale=0 smoke still exercises the whole loop."""
+    from tpubench.config import BenchConfig
+    from tpubench.workloads.train_ingest import run_train_ingest
+
+    def cfg_for() -> "BenchConfig":
+        cfg = BenchConfig()
+        cfg.transport.protocol = "fake"
+        cfg.workload.workers = 2
+        cfg.workload.threads = 2
+        cfg.workload.object_size = 512 * 1024
+        cfg.workload.granule_bytes = 64 * 1024
+        cfg.staging.mode = "none"
+        cfg.obs.export = "none"
+        # Shaped straggler plan (the chaos plane): 30% of reads stall —
+        # exactly the tail readahead exists to hide behind compute.
+        cfg.transport.fault.per_read_latency_s = 0.002 * _SLEEP_SCALE
+        cfg.transport.fault.stall_s = 0.05 * _SLEEP_SCALE
+        cfg.transport.fault.stall_rate = 0.3
+        cfg.transport.fault.seed = 7
+        cfg.pipeline.readahead = 1  # deliberately conservative default
+        cfg.pipeline.prefetch_workers = 2
+        cfg.pipeline.steps = 40
+        cfg.pipeline.batch_shards = 2
+        cfg.pipeline.step_compute_ms = 20.0 * _SLEEP_SCALE
+        cfg.tune.seed = 7
+        cfg.tune.window_s = max(0.05, 0.25 * _SLEEP_SCALE)
+        cfg.tune.warmup_windows = 1
+        cfg.tune.epsilon = 0.02
+        cfg.tune.knobs = ["readahead", "prefetch_workers"]
+        return cfg
+
+    static = run_train_ingest(cfg_for())
+    adaptive_cfg = cfg_for()
+    adaptive_cfg.tune.enabled = True
+    adaptive = run_train_ingest(adaptive_cfg)
+    tn = adaptive.extra.get("tune") or {}
+    return {
+        "static_gbps": round(static.gbps, 4),
+        "adaptive_gbps": round(adaptive.gbps, 4),
+        "adaptive_vs_static": (
+            round(adaptive.gbps / static.gbps, 4) if static.gbps > 0 else None
+        ),
+        "converged": tn.get("converged"),
+        "windows_to_converge": tn.get("windows_to_converge"),
+        "initial": tn.get("initial"),
+        "final": tn.get("final"),
+        "sleep_scale": _SLEEP_SCALE,
+    }
+
+
 def _host_ram_run(total_mb: int, workers: int) -> float:
     """Reference-parity run: fetch loop, bytes discarded in host RAM."""
     from tpubench.workloads.read import run_read
@@ -259,6 +316,14 @@ def main() -> int:
             }
         except Exception as e:
             print(f"# fetch-only A/B failed: {e}", file=sys.stderr)
+
+    # Static-vs-adaptive tune A/B: hermetic, CPU-only (no staging, no
+    # jax), so it rides the quiet-CPU segment with the fetch A/B.
+    tune_ab: dict = {}
+    try:
+        tune_ab = _tune_ab_cell()
+    except Exception as e:  # noqa: BLE001 — the bench must not die here
+        print(f"# tune A/B failed: {e}", file=sys.stderr)
 
     dev = jax.local_devices()[0]  # first jax touch: AFTER the quiet-CPU A/B
 
@@ -508,6 +573,7 @@ def main() -> int:
                 "efficiency_pairs": eff_pairs,
                 "gap_breakdown": gap,
                 "fetch_only_ab": fetch_ab,
+                "tune_ab": tune_ab,
                 "shaped_verdict": shaped,
                 "probe_divergence_factor": pdf,
                 "host_cores": _usable_cores(),

@@ -61,3 +61,17 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slab: zero-copy slab datapath (mem/ pool + copy guard)"
     )
+    # Tune tests (adaptive autotuner: controller convergence, live
+    # actuation, knob-drift guard) stay in tier-1 — same policy as
+    # `pipeline`/`slab`: not slow-marked, so the controller is exercised
+    # on every pass; the marker exists for selective runs (`-m tune`).
+    config.addinivalue_line(
+        "markers", "tune: adaptive autotuner (controller/sweep/actuation)"
+    )
+    # Multihost tests are marker-gated (see tests/test_multihost.py):
+    # they need working multi-process jax.distributed, which this
+    # container lacks — tier-1 collects clean skips, not failures.
+    config.addinivalue_line(
+        "markers", "multihost: multi-process jax.distributed tests "
+                   "(TPUBENCH_MULTIHOST_TESTS=1 to enable)"
+    )

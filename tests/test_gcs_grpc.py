@@ -3,11 +3,25 @@ server — the gRPC twin of test_gcs_http."""
 
 import pytest
 
-from tpubench.config import BenchConfig, RetryConfig, TransportConfig
-from tpubench.storage import FakeBackend, FaultPlan, RetryingBackend, StorageError
-from tpubench.storage.base import deterministic_bytes, read_object_through
-from tpubench.storage.fake_grpc_server import FakeGcsGrpcServer
-from tpubench.storage.gcs_grpc import GcsGrpcBackend
+# Optional dependency: the gRPC path needs the generated storage-v2
+# stubs (and grpcio). Collect as a clean module skip where they are
+# absent — not a collection error.
+pytest.importorskip("grpc")
+pytest.importorskip("google.cloud._storage_v2")
+
+from tpubench.config import BenchConfig, RetryConfig, TransportConfig  # noqa: E402
+from tpubench.storage import (  # noqa: E402
+    FakeBackend,
+    FaultPlan,
+    RetryingBackend,
+    StorageError,
+)
+from tpubench.storage.base import (  # noqa: E402
+    deterministic_bytes,
+    read_object_through,
+)
+from tpubench.storage.fake_grpc_server import FakeGcsGrpcServer  # noqa: E402
+from tpubench.storage.gcs_grpc import GcsGrpcBackend  # noqa: E402
 
 
 @pytest.fixture(scope="module")

@@ -491,6 +491,7 @@ def test_pod_ingest_multiplexed_http2(h2srv):
 @pytest.fixture(scope="module")
 def grpcsrv():
     grpc = pytest.importorskip("grpc")  # noqa: F841
+    pytest.importorskip("google.cloud._storage_v2")
     from tpubench.storage.fake_grpc_server import FakeGcsGrpcServer
 
     be = FakeBackend.prepopulated("bench/file_", count=4, size=3_000_000)
@@ -956,6 +957,8 @@ def test_mux_retry_chains_are_per_range():
     failing for the first time in a later round still gets max_attempts
     tries of its own (ADVICE r3: one shared round counter starved
     late-failing ranges)."""
+    pytest.importorskip("grpc")
+    pytest.importorskip("google.cloud._storage_v2")
     import numpy as np
 
     from tpubench.config import BenchConfig
@@ -1025,6 +1028,8 @@ def test_mux_retry_deadline_never_oversleeps():
     With a deadline smaller than the first backoff pause, the failing
     range must be abandoned immediately: exactly one read_ranges round,
     no backoff sleep."""
+    pytest.importorskip("grpc")
+    pytest.importorskip("google.cloud._storage_v2")
     import time as _t
 
     import numpy as np
@@ -1101,6 +1106,7 @@ def test_pod_ingest_mux_retries_injected_faults():
     parity with the RetryingBackend-wrapped threaded path): injected
     UNAVAILABLEs heal and the pod verifies."""
     grpc = pytest.importorskip("grpc")  # noqa: F841
+    pytest.importorskip("google.cloud._storage_v2")
     from tpubench.storage.fake import FaultPlan
     from tpubench.storage.fake_grpc_server import FakeGcsGrpcServer
     from tpubench.workloads.pod_ingest import run_pod_ingest

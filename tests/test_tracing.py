@@ -156,6 +156,8 @@ def test_http_request_span_ends_on_error():
 
 
 def test_grpc_backend_emits_request_spans():
+    pytest.importorskip("grpc")
+    pytest.importorskip("google.cloud._storage_v2")
     from tpubench.config import TransportConfig
     from tpubench.storage import FakeBackend
     from tpubench.storage.base import read_object_through
@@ -221,6 +223,8 @@ def test_make_tracer_falls_back_when_otel_broken(monkeypatch):
 def test_failed_grpc_stream_closes_span_with_error():
     """Mid-stream failure must export a FAILED request span (closed with
     the error), not an OK one."""
+    pytest.importorskip("grpc")
+    pytest.importorskip("google.cloud._storage_v2")
     from tpubench.config import TransportConfig
     from tpubench.storage import FakeBackend, FaultPlan, StorageError
     from tpubench.storage.fake_grpc_server import FakeGcsGrpcServer
@@ -235,8 +239,6 @@ def test_failed_grpc_stream_closes_span_with_error():
         t = TransportConfig(protocol="grpc", endpoint=srv.endpoint,
                             directpath=False)
         c = GcsGrpcBackend(bucket="testbucket", transport=t, tracer=tracer)
-        import pytest
-
         r = c.open_read("tr/file_0")
         buf = memoryview(bytearray(2 * 1024 * 1024))
         with pytest.raises(StorageError):

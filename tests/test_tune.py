@@ -1,0 +1,811 @@
+"""Adaptive ingest autotuner: deterministic controller convergence,
+live actuation (prefetcher reclamp / elastic gates / hedge delay),
+config+CLI wiring, the knob-drift guard, and the hermetic
+static-vs-adaptive acceptance A/B against the fake h2 server under a
+shaped straggler fault plan."""
+
+import argparse
+import dataclasses
+import json
+import threading
+import time
+
+import pytest
+
+from tpubench.config import (
+    TUNE_KNOBS,
+    BenchConfig,
+    TuneConfig,
+    validate_tune_config,
+)
+from tpubench.tune.controller import (
+    ACTUATED,
+    Knob,
+    RecorderSampler,
+    TuneController,
+)
+
+pytestmark = pytest.mark.tune
+
+
+# ---------------------------------------------------- deterministic core --
+
+
+class FakeSampler:
+    """Deterministic window source: ``fn()`` -> (goodput_bps, p99_ms)."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.calls = 0
+
+    def sample(self):
+        self.calls += 1
+        g, p = self.fn()
+        return {"seconds": 0.1, "goodput_bps": g, "p99_ms": p, "reads": 10}
+
+
+def _tc(**kw) -> TuneConfig:
+    base = dict(window_s=0.1, warmup_windows=1, epsilon=0.05,
+                freeze_after_reverts=2, seed=1)
+    base.update(kw)
+    return TuneConfig(**base)
+
+
+def _store_knob(name="readahead", value=1, lo=1, hi=16, mode="mul", **kw):
+    state = {"v": value}
+    k = Knob(name, value, lambda v: state.__setitem__("v", v),
+             lo=lo, hi=hi, mode=mode, **kw)
+    return k, state
+
+
+def drive(ctrl, max_windows=40, settle=3):
+    for _ in range(max_windows):
+        ctrl.step()
+        if ctrl.converged_at is not None:
+            break
+    for _ in range(settle if ctrl.converged_at is not None else 0):
+        ctrl.step()  # post-convergence hold windows (the settled tail)
+    return ctrl.stats()
+
+
+def test_monotone_workload_converges_to_the_knee():
+    """Goodput rises with the knob up to a saturation knee: the
+    controller must climb to it (doubling), bounce off the flat top,
+    and converge within a handful of windows."""
+    knob, state = _store_knob(value=1, lo=1, hi=16)
+    sampler = FakeSampler(lambda: (100.0 * min(state["v"], 8), 1.0))
+    ctrl = TuneController(_tc(), [knob], sampler)
+    stats = drive(ctrl, max_windows=20)
+    assert stats["converged"]
+    assert stats["windows_to_converge"] is not None
+    assert stats["windows_to_converge"] <= 15
+    assert stats["final"]["readahead"] == 8  # the knee, not the bound
+    assert stats["accepts"] >= 3  # 1 -> 2 -> 4 -> 8
+    assert stats["converged_goodput_bps"] == pytest.approx(800.0)
+
+
+def test_guardrail_reverts_shaped_workload_and_post_convergence_is_clean():
+    """Goodput keeps rising with the knob but the tail explodes past
+    value 4: every over-guard probe must revert (verdict recorded), the
+    session settles at the largest guard-clean value, and no
+    post-convergence window violates the guardrail."""
+    knob, state = _store_knob(value=1, lo=1, hi=64)
+    sampler = FakeSampler(
+        lambda: (100.0 * state["v"], 1.0 if state["v"] <= 4 else 50.0)
+    )
+    ctrl = TuneController(_tc(p99_guard=2.0), [knob], sampler)
+    stats = drive(ctrl, max_windows=30)
+    assert stats["converged"]
+    assert stats["final"]["readahead"] == 4
+    assert stats["guard_violations"] >= 1
+    assert any(w["verdict"] == "revert_guard" for w in stats["windows"])
+    base = stats["guard"]["baseline_p99_ms"]
+    for w in stats["windows"][stats["windows_to_converge"]:]:
+        if w["p99_ms"] is not None:
+            assert w["p99_ms"] <= 2.0 * base
+
+
+def test_noisy_flat_workload_damps_oscillation():
+    """A knob with no real goodput response must not thrash: probes
+    revert, the knob freezes after freeze_after_reverts, the session
+    converges back AT the initial operating point with zero accepts."""
+    knob, state = _store_knob(value=4, lo=1, hi=16)
+    seq = [100.0, 103.0, 97.0, 101.0, 99.0, 102.0, 98.0]
+    i = [0]
+
+    def fn():
+        i[0] += 1
+        return seq[i[0] % len(seq)], 1.0
+
+    ctrl = TuneController(_tc(), [knob], sampler=FakeSampler(fn))
+    stats = drive(ctrl, max_windows=20)
+    assert stats["converged"]
+    assert stats["accepts"] == 0
+    assert stats["final"]["readahead"] == 4  # every probe reverted
+    assert state["v"] == 4  # the actuator really is back at the start
+    # Damping: once converged, values never move again.
+    pre = len(ctrl.windows)
+    for _ in range(5):
+        ctrl.step()
+    for w in ctrl.windows[pre:]:
+        assert w["verdict"] == "hold"
+        assert w["values"]["readahead"] == 4
+
+
+def test_controller_round_robins_multiple_knobs_and_converges():
+    ka, sa = _store_knob("readahead", value=1, lo=1, hi=8)
+    kb, sb = _store_knob("prefetch_workers", value=1, lo=1, hi=4,
+                         mode="add")
+    sampler = FakeSampler(
+        lambda: (50.0 * min(sa["v"], 4) + 25.0 * sb["v"], 1.0)
+    )
+    ctrl = TuneController(_tc(), [ka, kb], sampler)
+    stats = drive(ctrl, max_windows=40)
+    assert stats["converged"]
+    assert stats["final"]["readahead"] == 4
+    assert stats["final"]["prefetch_workers"] == 4
+
+
+def test_zero_goodput_windows_never_accept():
+    """Windows shorter than one unit of progress sample 0 bytes: the
+    accept bar must not degenerate to 0 >= 0 and bless every probe —
+    zero-goodput probe windows revert, the knob freezes, and the
+    session converges back at the initial operating point."""
+    knob, state = _store_knob(value=4, lo=1, hi=16)
+    ctrl = TuneController(_tc(), [knob], FakeSampler(lambda: (0.0, None)))
+    stats = drive(ctrl, max_windows=20)
+    assert stats["converged"]
+    assert stats["accepts"] == 0
+    assert stats["final"]["readahead"] == 4
+    assert all(w["verdict"] != "accept" for w in stats["windows"])
+
+
+def test_knob_bounds_expand_to_configured_start():
+    """A configured operating point outside the derived bounds must NOT
+    be clamped: the controller's view has to match the live value, or
+    the first revert would 'restore' a value the run never had."""
+    k = Knob("readahead", 100, lambda v: None, lo=1, hi=64)
+    assert k.value == 100 and k.initial == 100
+    assert k.candidate(-1) == 50
+    assert k.candidate(+1) is None  # 100 IS the expanded hi
+
+
+def test_immovable_knob_retires_instead_of_blocking_convergence():
+    """A mul knob whose start is 0 can never move (0*2 == 0/2 == 0):
+    it must be retired so the session still converges."""
+    stuck = Knob("hedge_delay_s", 0.0, lambda v: None, lo=0.001, hi=0.4,
+                 mode="mul", integer=False)
+    live, state = _store_knob(value=1, lo=1, hi=8)
+    sampler = FakeSampler(lambda: (100.0 * min(state["v"], 4), 1.0))
+    ctrl = TuneController(_tc(), [live, stuck], sampler)
+    stats = drive(ctrl, max_windows=30)
+    assert stats["converged"]
+    assert stats["final"]["readahead"] == 4
+    assert stats["final"]["hedge_delay_s"] == 0.0  # never actuated
+
+
+def test_guard_violation_flips_probe_direction():
+    """After a p99-guard revert the knob must try the OTHER side next,
+    not re-inject the identical over-guard probe into the live run."""
+    knob, state = _store_knob(value=4, lo=1, hi=64)
+    # Any value above 4 violates the guard; goodput is flat.
+    ctrl = TuneController(
+        _tc(p99_guard=2.0),
+        [knob],
+        FakeSampler(lambda: (100.0, 1.0 if state["v"] <= 4 else 50.0)),
+    )
+    for _ in range(30):
+        ctrl.step()
+        if ctrl.converged_at is not None:
+            break
+    probes = [w["probe"]["to"] for w in ctrl.windows if "probe" in w]
+    over = [p for p in probes if p > 4]
+    assert len(over) == 1  # the violating probe is never repeated
+
+
+def test_cooldown_of_one_window_still_converges():
+    """cooldown_windows=1 (the validated minimum) must actually freeze:
+    the off-by-one shape where frozen_until was computed pre-append but
+    compared post-append made it a no-op and convergence unreachable."""
+    knob, state = _store_knob(value=4, lo=1, hi=16)
+    ctrl = TuneController(
+        _tc(cooldown_windows=1), [knob],
+        FakeSampler(lambda: (100.0, 1.0)),  # flat: every probe reverts
+    )
+    stats = drive(ctrl, max_windows=20)
+    assert stats["converged"]
+    assert stats["final"]["readahead"] == 4
+
+
+def test_knob_mul_integer_never_sticks_at_one():
+    k, state = _store_knob(value=1, lo=1, hi=8)
+    assert k.candidate(+1) == 2  # 1*2
+    k.actuate(1)
+    # Integer halving of 1 rounds back to 1 -> candidate must be None
+    # downward and the controller flips direction instead of stalling.
+    assert k.candidate(-1) is None
+
+
+def test_knob_float_bounds_and_add_mode():
+    k = Knob("hedge_delay_s", 0.05, lambda v: None, lo=0.01, hi=0.4,
+             mode="mul", integer=False)
+    assert k.candidate(+1) == pytest.approx(0.1)
+    assert k.candidate(-1) == pytest.approx(0.025)
+    k.actuate(0.4)
+    assert k.candidate(+1) is None  # pinned at hi
+    ka = Knob("prefetch_workers", 2, lambda v: None, lo=1, hi=4, mode="add")
+    assert ka.candidate(+1) == 3 and ka.candidate(-1) == 1
+
+
+def test_recorder_sampler_windows_incrementally():
+    from tpubench.metrics.recorder import LatencyRecorder
+
+    rec = LatencyRecorder("r")
+    state = {"bytes": 0, "t": 0.0}
+    s = RecorderSampler([rec], lambda: state["bytes"],
+                        clock=lambda: state["t"])
+    rec.record_ns(int(5e6))
+    rec.record_ns(int(10e6))
+    state["bytes"] = 1000
+    state["t"] = 2.0
+    w = s.sample()
+    assert w["goodput_bps"] == pytest.approx(500.0)
+    assert w["reads"] == 2
+    assert w["p99_ms"] == pytest.approx(10.0)
+    # Next window sees only NEW samples/bytes — and no samples = no p99
+    # (the guardrail skips the window instead of reusing stale tails).
+    state["t"] = 3.0
+    w2 = s.sample()
+    assert w2["reads"] == 0 and w2["p99_ms"] is None
+    assert w2["goodput_bps"] == 0.0
+
+
+def test_controller_thread_error_is_recorded_not_raised():
+    def boom():
+        raise RuntimeError("sampler died")
+
+    ctrl = TuneController(
+        _tc(window_s=0.01), [_store_knob()[0]], FakeSampler(boom)
+    )
+    ctrl.start()
+    deadline = time.monotonic() + 5
+    while ctrl.error is None and time.monotonic() < deadline:
+        time.sleep(0.005)
+    stats = ctrl.stop()
+    assert "sampler died" in (stats["error"] or "")
+
+
+# ------------------------------------------------------- live actuation --
+
+
+def _plan_and_cache(count=2, size=64 * 1024, chunk=16 * 1024, debug=True):
+    from tpubench.pipeline.cache import ChunkCache, ChunkKey
+    from tpubench.storage.base import iter_ranges
+    from tpubench.storage.fake import FakeBackend
+
+    be = FakeBackend.prepopulated("t/", count=count, size=size)
+    cache = ChunkCache(1 << 20, debug=debug)
+    plan = []
+    for i in range(count):
+        meta = be.stat(f"t/{i}")
+        plan += [
+            ChunkKey("b", f"t/{i}", meta.generation, s, ln)
+            for s, ln in iter_ranges(meta.size, chunk)
+        ]
+    return be, cache, plan
+
+
+def test_prefetcher_reclamp_shrink_cancels_beyond_window():
+    """Live depth shrink: queued entries beyond the new window are
+    cancelled, in-flight ones land through normal accounting, and the
+    cache's resident-unused counter stays exact (debug asserts armed)."""
+    from tpubench.pipeline.prefetch import Prefetcher, read_chunk
+
+    gate = threading.Event()
+
+    class SlowBackend:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def open_read(self, name, start=0, length=None):
+            gate.wait(5)
+            return self.inner.open_read(name, start=start, length=length)
+
+    be, cache, plan = _plan_and_cache()
+    slow = SlowBackend(be)
+    pf = Prefetcher(slow, cache, plan, workers=1, depth=8)
+    pf.advance(0)  # queue [0..8); the one worker blocks on chunk 0
+    time.sleep(0.05)
+    pf.reclamp(depth=2)  # live shrink: [2..8) beyond the new window
+    gate.set()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and pf.cancelled < 6:
+        time.sleep(0.01)
+    assert pf.cancelled >= 6
+    assert pf.stats()["depth"] == 2
+    # Consume the whole plan on the demand path: the debug cache
+    # asserts the resident-unused invariant at every mutation.
+    for i, k in enumerate(plan):
+        pf.advance(i)
+        cache.get_or_fetch(k, lambda k=k: read_chunk(be, k))
+    pf.advance(len(plan))
+    pf.close()
+    cache._assert_invariants_locked()
+    assert cache.unused_prefetched_bytes() == 0
+
+
+def test_prefetcher_reclamp_grow_refills_window():
+    from tpubench.pipeline.prefetch import Prefetcher
+
+    be, cache, plan = _plan_and_cache()
+    pf = Prefetcher(be, cache, plan, workers=2, depth=1)
+    pf.advance(0)
+    pf.reclamp(depth=len(plan))  # live grow: whole plan schedulable
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if all(cache.contains(k) for k in plan):
+            break
+        time.sleep(0.005)
+    pf.close()
+    assert all(cache.contains(k) for k in plan)
+    assert pf.stats()["depth"] == len(plan)
+
+
+def test_prefetcher_reclamp_byte_budget_live():
+    from tpubench.pipeline.prefetch import Prefetcher
+
+    be, cache, plan = _plan_and_cache()
+    chunk = plan[0].length
+    pf = Prefetcher(be, cache, plan, workers=1, depth=8,
+                    byte_budget=chunk)  # one chunk at a time
+    pf.advance(0)
+    time.sleep(0.2)
+    assert cache.stats()["prefetch_inserted_bytes"] <= 2 * chunk
+    pf.reclamp(byte_budget=len(plan) * chunk)  # open the throttle live
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if all(cache.contains(k) for k in plan[:8]):
+            break
+        time.sleep(0.005)
+    pf.close()
+    assert all(cache.contains(k) for k in plan[:8])
+
+
+def test_prefetcher_set_workers_live_grow_and_park():
+    from tpubench.pipeline.prefetch import Prefetcher
+
+    be, cache, plan = _plan_and_cache()
+    pf = Prefetcher(be, cache, plan, workers=1, depth=len(plan),
+                    max_workers=4)
+    st = pf.stats()
+    assert st["workers"] == 1 and st["workers_max"] == 4
+    pf.set_workers(4)
+    assert pf.active_workers == 4
+    pf.advance(0)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if all(cache.contains(k) for k in plan):
+            break
+        time.sleep(0.005)
+    pf.set_workers(1)  # live shrink parks, never kills
+    pf.close()  # parked threads must still join cleanly
+    assert all(cache.contains(k) for k in plan)
+
+
+def test_elastic_gate_parks_and_resumes():
+    from tpubench.workloads.common import ElasticGate
+
+    gate = ElasticGate(active=2, total=2)
+    cancel = threading.Event()
+    progress = [0, 0]
+    stop = threading.Event()
+
+    def worker(i):
+        while not stop.is_set():
+            if not gate.admit(i, cancel):
+                return
+            progress[i] += 1
+            time.sleep(0.002)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    time.sleep(0.05)
+    gate.set_active(1)  # park worker 1 live
+    time.sleep(0.05)
+    frozen = progress[1]
+    time.sleep(0.1)
+    assert progress[1] == frozen  # parked: no progress
+    assert progress[0] > 0
+    gate.set_active(2)  # resume live
+    time.sleep(0.1)
+    assert progress[1] > frozen
+    cancel.set()  # parked-or-not, cancel releases everyone
+    stop.set()
+    for t in ts:
+        t.join(3)
+        assert not t.is_alive()
+
+
+def test_hedged_backend_live_delay_override():
+    from tpubench.config import TailConfig
+    from tpubench.storage.fake import FakeBackend
+    from tpubench.storage.tail import HedgedBackend, find_tail_layer
+
+    hb = HedgedBackend(FakeBackend(), TailConfig(hedge=True,
+                                                 hedge_delay_s=0.05))
+    assert hb.hedge_delay() == pytest.approx(0.05)
+    hb.set_hedge_delay(0.01)
+    assert hb.hedge_delay() == pytest.approx(0.01)
+    # The rolling-p99 adaptive path floors at the override, exactly as
+    # it floors at the configured fixed delay.
+    hb.tail.hedge_from_p99 = True
+    for _ in range(32):
+        hb.note_first_byte(0.2)
+    assert hb.hedge_delay() == pytest.approx(0.2 * hb.tail.hedge_p99_scale)
+    hb.set_hedge_delay(0.5)
+    assert hb.hedge_delay() == pytest.approx(0.5)  # floor wins
+    assert find_tail_layer(hb, HedgedBackend) is hb
+
+
+# ------------------------------------------------------- config + CLI ----
+
+
+def test_validate_tune_config_rejections():
+    for field_name, bad in (
+        ("window_s", 0.0), ("warmup_windows", 0), ("p99_guard", 0.5),
+        ("epsilon", -0.1), ("freeze_after_reverts", 0), ("duration_s", -1.0),
+        # 0 would let an accepted fan-out shrink park workers forever
+        # (no wall-clock bound) — rejected, never treated as "no cap".
+        ("duration_s", 0.0),
+    ):
+        tc = TuneConfig(**{field_name: bad})
+        with pytest.raises(SystemExit, match=field_name):
+            validate_tune_config(tc)
+    with pytest.raises(SystemExit, match="unknown knob"):
+        validate_tune_config(TuneConfig(knobs=["workers", "warp_factor"]))
+
+
+def test_cli_tune_flags_reach_config(tmp_path):
+    from tpubench.cli import main
+
+    out = tmp_path / "cfg.json"
+    rc = main([
+        "read", "--tune", "--tune-window", "0.2", "--tune-warmup", "3",
+        "--tune-p99-guard", "4.5", "--tune-epsilon", "0.01",
+        "--tune-duration", "2.5", "--tune-knobs", "workers,hedge_delay_s",
+        "--save-config", str(out),
+    ])
+    assert rc == 0
+    cfg = BenchConfig.from_json(out.read_text())
+    t = cfg.tune
+    assert t.enabled and t.window_s == 0.2 and t.warmup_windows == 3
+    assert t.p99_guard == 4.5 and t.epsilon == 0.01 and t.duration_s == 2.5
+    assert t.knobs == ["workers", "hedge_delay_s"]
+
+
+def test_cli_rejects_bad_tune_values():
+    from tpubench.cli import main
+
+    with pytest.raises(SystemExit, match="p99_guard"):
+        main(["read", "--tune", "--tune-p99-guard", "0.5",
+              "--save-config", "/dev/null"])
+    with pytest.raises(SystemExit, match="unknown knob"):
+        main(["read", "--tune", "--tune-knobs", "nonsense",
+              "--save-config", "/dev/null"])
+
+
+def test_knob_drift_guard():
+    """CI satellite: every TuneConfig-actuated knob must (a) be in the
+    canonical TUNE_KNOBS set, (b) resolve to a real dataclass field in
+    tpubench.config, and (c) have a CLI flag — so the controller, the
+    config surface and the CLI can never silently diverge."""
+    from tpubench import cli
+
+    assert set(ACTUATED) == set(TUNE_KNOBS)
+    cfg = BenchConfig()
+    parser = argparse.ArgumentParser()
+    cli._add_common(parser)
+    dests = {a.dest for a in parser._actions}
+    for name, spec in ACTUATED.items():
+        obj = cfg
+        *parents, leaf = spec["config"]
+        for part in parents:
+            obj = getattr(obj, part)
+        assert any(f.name == leaf for f in dataclasses.fields(obj)), (
+            f"knob {name}: config field {'.'.join(spec['config'])} missing"
+        )
+        assert spec["cli"] in dests, (
+            f"knob {name}: CLI flag dest {spec['cli']!r} missing"
+        )
+
+
+def test_tune_profile_roundtrip_and_apply(tmp_path):
+    from tpubench.workloads.tune_cmd import (
+        PROFILE_FORMAT,
+        apply_tune_profile,
+        recommended_flags,
+    )
+
+    prof = tmp_path / "prof.json"
+    prof.write_text(json.dumps({
+        "format": PROFILE_FORMAT,
+        "recommended": {"workers": 3, "readahead": 4,
+                        "hedge_delay_s": 0.02},
+    }))
+    cfg = BenchConfig()
+    vals = apply_tune_profile(cfg, str(prof))
+    assert cfg.workload.workers == 3
+    assert cfg.pipeline.readahead == 4
+    assert cfg.transport.tail.hedge_delay_s == 0.02
+    assert vals["workers"] == 3
+    flags = recommended_flags(vals)
+    assert "--workers 3" in flags and "--readahead 4" in flags
+    # Wrong format fails loudly, never silently tunes nothing.
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"format": "nope"}))
+    with pytest.raises(SystemExit, match="not a tune profile"):
+        apply_tune_profile(BenchConfig(), str(bad))
+
+
+def test_cli_applies_tune_profile_to_other_subcommands(tmp_path):
+    from tpubench.cli import main
+    from tpubench.workloads.tune_cmd import PROFILE_FORMAT
+
+    prof = tmp_path / "prof.json"
+    prof.write_text(json.dumps({
+        "format": PROFILE_FORMAT, "recommended": {"workers": 5},
+    }))
+    out = tmp_path / "cfg.json"
+    assert main(["read", "--tune-profile", str(prof),
+                 "--save-config", str(out)]) == 0
+    assert BenchConfig.from_json(out.read_text()).workload.workers == 5
+    # An explicit flag on the same command line WINS over the profile.
+    assert main(["read", "--tune-profile", str(prof), "--workers", "8",
+                 "--save-config", str(out)]) == 0
+    assert BenchConfig.from_json(out.read_text()).workload.workers == 8
+
+
+# ----------------------------------------------------- online sessions ---
+
+
+def _ti_cfg(**kw) -> BenchConfig:
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 2
+    cfg.workload.threads = 2
+    cfg.workload.object_size = 256 * 1024
+    cfg.workload.granule_bytes = 32 * 1024
+    cfg.staging.mode = "none"
+    cfg.obs.export = "none"
+    cfg.pipeline.steps = 12
+    cfg.pipeline.batch_shards = 2
+    cfg.pipeline.readahead = 1
+    cfg.pipeline.prefetch_workers = 2
+    cfg.pipeline.step_compute_ms = 5.0
+    cfg.tune.window_s = 0.05
+    cfg.tune.warmup_windows = 1
+    for k, v in kw.items():
+        setattr(cfg.tune, k, v)
+    return cfg
+
+
+def test_train_ingest_online_controller_stamps_extra_and_journal(tmp_path):
+    from tpubench.workloads.train_ingest import run_train_ingest
+
+    cfg = _ti_cfg(enabled=True, knobs=["readahead", "prefetch_workers"])
+    cfg.obs.flight_journal = str(tmp_path / "fl.json")
+    res = run_train_ingest(cfg)
+    tn = res.extra.get("tune")
+    assert tn is not None and tn["enabled"]
+    assert tn["n_windows"] >= 1
+    assert tn["initial"] == {"readahead": 1, "prefetch_workers": 2}
+    assert set(tn["final"]) == {"readahead", "prefetch_workers"}
+    for w in tn["windows"]:
+        assert {"window", "goodput_bps", "values", "verdict"} <= set(w)
+    # The decisions rode the flight journal as kind="tune" records with
+    # tune notes, and the timeline renders/counts them.
+    doc = json.loads((tmp_path / "fl.json").read_text())
+    tune_recs = [r for r in doc["records"] if r.get("kind") == "tune"]
+    assert len(tune_recs) == tn["n_windows"]
+    assert all(n["kind"] == "tune" for r in tune_recs
+               for n in r.get("notes", ()))
+    from tpubench.workloads.report_cmd import run_timeline
+
+    out = run_timeline([str(tmp_path / "fl.json")])
+    assert "tune decisions:" in out
+    # A tuned workload result renders its convergence trace in `report`
+    # (and its A/B axis label says so), even outside `tpubench tune`.
+    from tpubench.metrics.report import write_result
+    from tpubench.workloads.report_cmd import run_report
+
+    rp = write_result(res, str(tmp_path), tag="tuned")
+    rep = run_report([rp])
+    assert "tuned" in rep and "operating point" in rep
+
+
+def test_read_online_session_is_duration_bounded_and_elastic():
+    """An online read tuning session must end at tune.duration_s even
+    though the controller may have parked workers mid-run (their read
+    calls can no longer gate completion)."""
+    from tpubench.workloads.read import run_read
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 4
+    cfg.workload.object_size = 64 * 1024
+    cfg.workload.read_calls_per_worker = 10_000_000  # would run ~forever
+    cfg.workload.granule_bytes = 16 * 1024
+    cfg.staging.mode = "none"
+    cfg.obs.export = "none"
+    cfg.tune.enabled = True
+    cfg.tune.window_s = 0.1
+    cfg.tune.duration_s = 1.0
+    cfg.tune.knobs = ["workers"]
+    t0 = time.monotonic()
+    res = run_read(cfg)
+    assert time.monotonic() - t0 < 10.0
+    assert res.errors == 0
+    tn = res.extra.get("tune")
+    assert tn is not None and tn["n_windows"] >= 3
+    assert tn["initial"]["workers"] == 4
+
+
+def test_native_executor_admission_cap_with_tuning():
+    """The native fetch executor under the controller: the runnable-queue
+    admission cap completes ALL reads (a shrink lowers concurrency, never
+    drops work) and stamps the tune trace."""
+    from tpubench.native.engine import get_engine
+    from tpubench.storage import open_backend
+    from tpubench.workloads.read import run_read
+
+    if get_engine() is None:
+        pytest.skip("native engine unavailable")
+    from tpubench.storage.fake import FakeBackend
+    from tpubench.storage.fake_server import FakeGcsServer
+
+    store = FakeBackend.prepopulated("tpubench/file_", count=3,
+                                     size=128 * 1024)
+    with FakeGcsServer(store) as srv:
+        cfg = BenchConfig()
+        cfg.transport.protocol = "http"
+        cfg.transport.endpoint = srv.endpoint
+        cfg.workload.bucket = "b"
+        cfg.workload.workers = 3
+        cfg.workload.read_calls_per_worker = 6
+        cfg.workload.object_size = 128 * 1024
+        cfg.workload.fetch_executor = "native"
+        cfg.staging.mode = "none"
+        cfg.obs.export = "none"
+        cfg.tune.enabled = True
+        cfg.tune.window_s = 0.05
+        cfg.tune.knobs = ["workers"]
+        be = open_backend(cfg)
+        try:
+            res = run_read(cfg, backend=be)
+        finally:
+            be.close()
+    assert res.errors == 0
+    assert res.bytes_total == 3 * 6 * 128 * 1024  # nothing dropped
+    assert res.extra.get("tune") is not None
+
+
+def test_cli_tune_subcommand_sweep_e2e(tmp_path, capsys):
+    from tpubench.cli import main
+
+    rc = main([
+        "tune", "--tune-mode", "sweep", "--tune-workload", "read",
+        "--protocol", "fake", "--workers", "2",
+        "--read-call-per-worker", "20", "--object-size", "65536",
+        "--staging", "none", "--export", "none",
+        "--tune-knobs", "workers", "--results-dir", str(tmp_path),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "static sweep" in out
+    assert "best static cell" in out
+    assert "recommended" in out
+
+
+# ------------------------------------------------ acceptance A/B (h2) ----
+
+
+def _h2_tune_cfg() -> BenchConfig:
+    cfg = BenchConfig()
+    cfg.transport.protocol = "http"
+    cfg.transport.http2 = True
+    cfg.workload.workers = 2
+    cfg.workload.threads = 2
+    cfg.workload.object_size = 256 * 1024
+    cfg.workload.granule_bytes = 32 * 1024
+    cfg.staging.mode = "none"
+    cfg.obs.export = "none"
+    # Shaped straggler fault plan from the chaos plane: 30% of streams
+    # stall mid-body — the tail readahead exists to hide.
+    cfg.transport.fault.stall_s = 0.05
+    cfg.transport.fault.stall_rate = 0.3
+    cfg.transport.fault.seed = 7
+    # The DEFAULT operating point is deliberately conservative: the
+    # adaptive arm must find a deeper one on its own.
+    cfg.pipeline.readahead = 1
+    cfg.pipeline.prefetch_workers = 2
+    cfg.pipeline.steps = 80
+    cfg.pipeline.batch_shards = 2
+    cfg.pipeline.step_compute_ms = 20.0
+    cfg.tune.knobs = ["readahead"]
+    cfg.tune.window_s = 0.2
+    cfg.tune.warmup_windows = 1
+    cfg.tune.epsilon = 0.02
+    cfg.tune.freeze_after_reverts = 2
+    # The guardrail must not bind on straggler noise in THIS experiment
+    # (stalls inflate single-window p99 ~50x by design; the
+    # guardrail-binding behavior is pinned deterministically above).
+    cfg.tune.p99_guard = 1000.0
+    cfg.tune.seed = 7
+    return cfg
+
+
+def test_tune_acceptance_static_vs_adaptive_ab_h2(tmp_path):
+    """ISSUE acceptance: hermetic static-vs-adaptive A/B against the
+    fake h2 server under a shaped straggler fault plan. The adaptive
+    session must converge to a DIFFERENT operating point than the
+    default config, its converged goodput must reach the best static
+    sweep cell minus 5%, it must never violate the p99 guardrail after
+    convergence — and `tpubench report` renders the whole story."""
+    from tpubench.native.engine import get_engine
+    from tpubench.workloads.tune_cmd import run_tune
+
+    if get_engine() is None:
+        pytest.skip("native engine unavailable (h2 client)")
+
+    def attempt():
+        res = run_tune(_h2_tune_cfg(), mode="ab", workload="train-ingest",
+                       profile_path=str(tmp_path / "prof.json"))
+        tn = res.extra["tune"]
+        ad = tn["adaptive"]
+        assert ad["converged"], ad
+        assert ad["windows_to_converge"] is not None
+        # Converged to a different operating point than the default.
+        assert ad["final"]["readahead"] != ad["initial"]["readahead"]
+        assert ad["final"]["readahead"] > 1
+        # Goodput >= best static sweep cell - 5%.
+        best = tn["sweep"]["best"]
+        ad_good = ad["converged_goodput_bps"]
+        assert ad_good is not None
+        assert ad_good >= 0.95 * best["goodput_bps"], (
+            f"adaptive {ad_good} vs static best {best['goodput_bps']} "
+            f"({best['values']})"
+        )
+        # Guardrail never violated after convergence.
+        base_p99 = ad["guard"]["baseline_p99_ms"]
+        guard = ad["guard"]["p99_guard"]
+        if base_p99:
+            for w in ad["windows"][ad["windows_to_converge"]:]:
+                if w["p99_ms"] is not None:
+                    assert w["p99_ms"] <= guard * base_p99
+        # The recommendation is reusable: profile written + flags line.
+        assert tn["recommended"]["readahead"] == ad["final"]["readahead"]
+        prof = json.loads((tmp_path / "prof.json").read_text())
+        assert prof["recommended"] == tn["recommended"]
+        assert "--readahead" in tn["recommended_flags"]
+        return res
+
+    # Two stochastic runs race real wall clocks on a shared CI box: one
+    # retry absorbs a pathological moment without weakening the
+    # acceptance criteria themselves (test_chaos h2 A/B precedent).
+    try:
+        res = attempt()
+    except AssertionError:
+        res = attempt()
+
+    # --- report rendering -------------------------------------------
+    from tpubench.metrics.report import write_result
+    from tpubench.workloads.report_cmd import run_report
+
+    path = write_result(res, str(tmp_path), tag="tune")
+    out = run_report([path])
+    assert "== tune (ab over train-ingest) ==" in out
+    assert "static sweep" in out
+    assert "static-vs-adaptive" in out
+    assert "converged in" in out
+    assert "recommended" in out

@@ -193,6 +193,36 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="disable the zero-copy slab datapath: chunks "
                         "materialize as bytes (2+ host-RAM copies per "
                         "chunk — the copies-per-byte A/B baseline arm)")
+    p.add_argument("--tune", action="store_true",
+                   help="adaptive autotuner: run the online controller "
+                        "during this run — worker fan-out, readahead "
+                        "depth/bytes, prefetch workers and hedge delay "
+                        "become live knobs driven by windowed goodput "
+                        "under a p99 guardrail (read / train-ingest)")
+    p.add_argument("--tune-window", type=float,
+                   help="tune decision window seconds (default 0.5)")
+    p.add_argument("--tune-warmup", type=int,
+                   help="baseline windows before the first probe "
+                        "(default 2)")
+    p.add_argument("--tune-p99-guard", type=float,
+                   help="p99 guardrail: probes whose window p99 exceeds "
+                        "baseline x this revert regardless of goodput "
+                        "(default 2.0)")
+    p.add_argument("--tune-epsilon", type=float,
+                   help="minimum relative goodput gain to accept a probe "
+                        "(default 0.05)")
+    p.add_argument("--tune-duration", type=float,
+                   help="online read tuning session length seconds "
+                        "(default 8; train-ingest stays step-bounded)")
+    p.add_argument("--tune-knobs",
+                   help="comma list of knobs the controller may actuate "
+                        "(default: workers,readahead,readahead_bytes,"
+                        "prefetch_workers,hedge_delay_s)")
+    p.add_argument("--tune-profile",
+                   help="tune profile JSON: `tpubench tune` WRITES the "
+                        "recommended operating point here; every other "
+                        "subcommand READS it and applies the recommended "
+                        "knob values over the config")
     p.add_argument("--retry-deadline", type=float,
                    help="per-op retry deadline (s); bounds the reference's "
                         "retry-forever default — set this with --fault-* "
@@ -243,6 +273,15 @@ def build_config(args) -> BenchConfig:
     w, t, s, o = cfg.workload, cfg.transport, cfg.staging, cfg.obs
     if args.preset and args.config:
         raise SystemExit("--preset and --config are mutually exclusive")
+    # --tune-profile on a normal workload applies a previously-written
+    # recommendation (on `tpubench tune` it is the OUTPUT path). Applied
+    # BEFORE the flag folding below, so an explicit flag on the same
+    # command line wins over the profile's recommendation.
+    if getattr(args, "tune_profile", None) and \
+            getattr(args, "cmd", None) != "tune":
+        from tpubench.workloads.tune_cmd import apply_tune_profile
+
+        apply_tune_profile(cfg, args.tune_profile)
     for attr, dest in (
         ("bucket", "bucket"), ("project", "project"), ("dir", "dir"),
         ("workers", "workers"), ("read_calls", "read_calls_per_worker"),
@@ -370,6 +409,22 @@ def build_config(args) -> BenchConfig:
     from tpubench.config import validate_pipeline_config
 
     validate_pipeline_config(pl)
+    tn = cfg.tune
+    if getattr(args, "tune", False):
+        tn.enabled = True
+    for attr, dest in (
+        ("tune_window", "window_s"), ("tune_warmup", "warmup_windows"),
+        ("tune_p99_guard", "p99_guard"), ("tune_epsilon", "epsilon"),
+        ("tune_duration", "duration_s"),
+    ):
+        v = getattr(args, attr, None)
+        if v is not None:
+            setattr(tn, dest, v)
+    if getattr(args, "tune_knobs", None):
+        tn.knobs = [k.strip() for k in args.tune_knobs.split(",") if k.strip()]
+    from tpubench.config import validate_tune_config
+
+    validate_tune_config(tn)
     if args.retry_deadline is not None:
         t.retry.deadline_s = args.retry_deadline
     if args.retry_max_attempts is not None:
@@ -714,6 +769,19 @@ def main(argv=None) -> int:
                        help="fault window start, seconds from run start")
     chaos.add_argument("--chaos-duration", type=float, default=2.0,
                        help="fault window length in seconds")
+    tune = add("tune", "adaptive ingest autotuner: offline coordinate "
+                       "sweep or online AIMD session over read/"
+                       "train-ingest; emits a convergence trace + a "
+                       "recommended-config block (reusable via "
+                       "--tune-profile)")
+    tune.add_argument("--tune-mode", choices=("sweep", "online", "ab"),
+                      default="online",
+                      help="sweep = offline coordinate sweep; online = "
+                           "one adaptive session; ab = both plus the "
+                           "static-vs-adaptive comparison")
+    tune.add_argument("--tune-workload", choices=("read", "train-ingest"),
+                      default="read",
+                      help="workload the tuning session drives")
     probe = add("probe", "host→HBM transfer-physics probe (fixed cost, "
                          "size sweep, burst/floor shaping, slow start)")
     probe.add_argument("--cycles", type=int, default=8,
@@ -929,6 +997,16 @@ def main(argv=None) -> int:
                 chaos_workload=args.chaos_workload,
             )
             print(format_scorecard(res.extra["chaos"]))
+        elif args.cmd == "tune":
+            from tpubench.workloads.tune_cmd import format_tune_block, run_tune
+
+            res = run_tune(
+                cfg,
+                mode=args.tune_mode,
+                workload=args.tune_workload,
+                profile_path=args.tune_profile or "",
+            )
+            print(format_tune_block(res.extra["tune"]))
         elif args.cmd == "probe":
             from tpubench.workloads.probe import run_probe
 

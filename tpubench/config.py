@@ -331,6 +331,111 @@ def validate_pipeline_config(pc: "PipelineConfig",
     # default readahead.
 
 
+# Knobs the tune controller may actuate (the canonical name set; the
+# controller's ACTUATED registry maps each to its config field and CLI
+# flag, and tests/test_tune.py pins that the three surfaces never drift).
+TUNE_KNOBS = (
+    "workers",
+    "readahead",
+    "readahead_bytes",
+    "prefetch_workers",
+    "hedge_delay_s",
+)
+
+
+@dataclass
+class TuneConfig:
+    """Adaptive ingest autotuner (tpubench/tune/): a congestion-control-
+    style online controller that adjusts worker fan-out, readahead
+    depth/bytes, prefetch workers and the hedge delay DURING a run, from
+    windowed goodput and p99 latency sampled off the run's own
+    recorders.
+
+    Objective: maximize goodput subject to a p99 inflation guardrail
+    (``p99 <= p99_guard x baseline p99``, baseline measured over the
+    warmup windows at the starting operating point). Policy is
+    AIMD-flavored hill climbing: one knob probed per window (multiplying
+    knobs double/halve, additive knobs step by one quantum); a probe
+    whose window improves goodput by ``epsilon`` within the guardrail is
+    accepted, anything else reverts. A knob that reverts
+    ``freeze_after_reverts`` times without an intervening accept freezes
+    for ``cooldown_windows`` (oscillation damping); when every knob is
+    frozen at once the session is CONVERGED and actuation stops — the
+    operating point holds for the rest of the run.
+
+    Off by default: ``enabled`` turns the online controller on inside
+    ``read`` and ``train-ingest``; ``tpubench tune`` drives offline
+    coordinate sweeps and online sessions as a workload of its own."""
+
+    enabled: bool = False
+    # Decision window (seconds): the controller samples goodput/p99 and
+    # makes one accept/revert decision per window.
+    window_s: float = 0.5
+    # Windows measured at the starting operating point before any probe
+    # (the guardrail's p99 baseline and the first goodput reference).
+    warmup_windows: int = 2
+    # Guardrail: a probe window whose p99 exceeds baseline_p99 x this is
+    # reverted regardless of goodput (the tail must not be traded away).
+    p99_guard: float = 2.0
+    # Minimum relative goodput gain for a probe to be accepted.
+    epsilon: float = 0.05
+    # Oscillation damping: reverts-without-accept before a knob freezes,
+    # and how many windows the freeze lasts. Once EVERY knob is frozen
+    # simultaneously the controller is converged and stops probing.
+    freeze_after_reverts: int = 2
+    cooldown_windows: int = 1_000_000  # effectively "until run end"
+    # Online read sessions are duration-bounded (a parked elastic worker
+    # could otherwise hold the run open forever); train-ingest stays
+    # step-bounded and ignores this.
+    duration_s: float = 8.0
+    # Which knobs to actuate (subset of TUNE_KNOBS); each workload uses
+    # the intersection with what it can actually actuate live.
+    knobs: list = field(default_factory=lambda: list(TUNE_KNOBS))
+    # Deterministic-rng seed (probe direction tie-breaks).
+    seed: int = 0
+
+
+def validate_tune_config(tc: "TuneConfig", where: str = "tune") -> None:
+    """Parse-time sanity for the tune knobs (validate_fault_config
+    style: one-line SystemExit at config load, not mid-run)."""
+    if tc.window_s <= 0 or tc.window_s != tc.window_s:
+        raise SystemExit(f"{where}.window_s={tc.window_s!r}: must be > 0")
+    if tc.warmup_windows < 1:
+        raise SystemExit(
+            f"{where}.warmup_windows={tc.warmup_windows!r}: must be >= 1"
+        )
+    if not (tc.p99_guard >= 1.0):  # also rejects NaN
+        raise SystemExit(
+            f"{where}.p99_guard={tc.p99_guard!r}: must be >= 1.0 "
+            "(1.0 = no tail inflation tolerated)"
+        )
+    if not (tc.epsilon >= 0.0):
+        raise SystemExit(f"{where}.epsilon={tc.epsilon!r}: must be >= 0")
+    if tc.freeze_after_reverts < 1:
+        raise SystemExit(
+            f"{where}.freeze_after_reverts={tc.freeze_after_reverts!r}: "
+            "must be >= 1"
+        )
+    if tc.cooldown_windows < 1:
+        raise SystemExit(
+            f"{where}.cooldown_windows={tc.cooldown_windows!r}: must be >= 1"
+        )
+    if not (tc.duration_s > 0.0):
+        # Online READ sessions are duration-bounded because a parked
+        # elastic worker can no longer gate completion: a zero/negative
+        # cap would let an accepted fan-out shrink hang the run forever.
+        raise SystemExit(
+            f"{where}.duration_s={tc.duration_s!r}: must be > 0 "
+            "(the online read session's wall-clock bound)"
+        )
+    unknown = sorted(set(tc.knobs) - set(TUNE_KNOBS))
+    if unknown:
+        raise SystemExit(
+            f"{where}.knobs: unknown knob(s) {unknown}; "
+            f"valid: {sorted(TUNE_KNOBS)}"
+        )
+
+
 @dataclass
 class TransportConfig:
     """L1 client construction knobs (reference ``main.go:30-42,62-117``)."""
@@ -527,6 +632,7 @@ class BenchConfig:
     dist: DistConfig = field(default_factory=DistConfig)
     obs: ObservabilityConfig = field(default_factory=ObservabilityConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
+    tune: TuneConfig = field(default_factory=TuneConfig)
 
     # ------------------------------------------------------------------ io --
     def to_dict(self) -> dict[str, Any]:
@@ -562,6 +668,7 @@ _SUBTYPES = {
     "dist": DistConfig,
     "obs": ObservabilityConfig,
     "pipeline": PipelineConfig,
+    "tune": TuneConfig,
     "retry": RetryConfig,
     "fault": FaultConfig,
     "tail": TailConfig,

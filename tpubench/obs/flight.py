@@ -475,6 +475,19 @@ def timeline_summary(records: list[dict]) -> dict:
         "stalls": sum(1 for n in notes if n.get("kind") == "stall"),
         "breaker_events": sum(1 for n in notes if n.get("kind") == "breaker"),
     }
+    # Autotuner attribution (tpubench/tune/): each controller decision is
+    # a kind="tune" record carrying a tune note, so the timeline can say
+    # when the operating point moved (and which windows accepted vs
+    # reverted) next to the reads those windows measured.
+    tune_notes = [n for n in notes if n.get("kind") == "tune"]
+    tune = {
+        "decisions": len(tune_notes),
+        "accepts": sum(1 for n in tune_notes if n.get("verdict") == "accept"),
+        "reverts": sum(
+            1 for n in tune_notes
+            if str(n.get("verdict", "")).startswith("revert")
+        ),
+    }
     # Ingest-pipeline attribution (PR 3): step records carry
     # stall_begin/stall_end only when the step actually waited for data,
     # so the stalled-step count and the stall_end segment stats below ARE
@@ -513,6 +526,7 @@ def timeline_summary(records: list[dict]) -> dict:
         "errors": errors,
         "retries": retries,
         "tail": tail,
+        "tune": tune,
         "pipeline": pipeline,
         "hosts": sorted({r.get("host", 0) for r in records}),
         "phases": _phase_stats(records),
@@ -560,6 +574,12 @@ def render_timeline(docs: list[dict]) -> str:
             f"tail events: hedges={tail['hedges']} "
             f"(wins={tail['hedge_wins']}) stalls={tail['stalls']} "
             f"breaker={tail['breaker_events']}"
+        )
+    tn = summ.get("tune", {})
+    if tn.get("decisions"):
+        lines.append(
+            f"tune decisions: {tn['decisions']} "
+            f"(accepts={tn['accepts']} reverts={tn['reverts']})"
         )
     pipe = summ.get("pipeline", {})
     if any(pipe.values()):

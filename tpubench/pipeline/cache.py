@@ -90,8 +90,14 @@ class ChunkCache:
     pipeline A/B), and single-flight dedup still applies.
     """
 
-    def __init__(self, capacity_bytes: int):
+    def __init__(self, capacity_bytes: int, debug: bool = False):
         self.capacity = max(0, int(capacity_bytes))
+        # debug=True re-derives the byte-accounting invariants after
+        # every mutation (O(entries) each — test harnesses only). The
+        # live-reclamp path (Prefetcher.reclamp) leans on exactly these:
+        # a depth/budget shrink mid-flight must never strand in-flight
+        # chunk bytes in the resident-unused counter.
+        self._debug = debug
         self.bytes = 0
         self._lock = threading.Lock()
         self._entries: "OrderedDict[ChunkKey, _Entry]" = OrderedDict()
@@ -125,6 +131,28 @@ class ChunkCache:
         self.prefetch_resident_unused = 0
 
     # ------------------------------------------------------------ internal --
+    def _assert_invariants_locked(self) -> None:
+        """Debug-mode accounting invariants (the resize-safety guard):
+        the directly-maintained resident-unused counter must equal the
+        sum over resident prefetched-but-unused entries, and the byte
+        total must match what is actually resident — whatever sequence
+        of inserts/evictions/invalidations/live-reclamps ran."""
+        resident = sum(len(e.data) for e in self._entries.values())
+        assert self.bytes == resident, (
+            f"cache bytes drift: counter={self.bytes} resident={resident}"
+        )
+        unused = sum(
+            len(e.data) for e in self._entries.values()
+            if e.origin == "prefetch" and not e.used
+        )
+        assert self.prefetch_resident_unused == unused, (
+            f"prefetch_resident_unused drift: "
+            f"counter={self.prefetch_resident_unused} actual={unused}"
+        )
+        assert 0 <= self.prefetch_resident_unused <= (
+            self.prefetch_inserted_bytes
+        )
+
     def _note_generation_locked(self, key: ChunkKey) -> None:
         """Eager invalidation: the first sighting of a newer generation
         drops every entry of the object's older generations."""
@@ -146,6 +174,8 @@ class ChunkCache:
             self.prefetch_used_bytes += len(e.data)
             self.prefetch_resident_unused -= len(e.data)
         e.used = True
+        if self._debug:
+            self._assert_invariants_locked()
 
     def _drop_locked(self, key: ChunkKey, reason: str = "evict") -> None:
         e = self._entries.pop(key)
@@ -165,6 +195,8 @@ class ChunkCache:
             # Drop the CACHE's reference only: a consumer still reading
             # the slab holds its own, so the memory outlives the entry.
             e.data.release()
+        if self._debug:
+            self._assert_invariants_locked()
 
     def _insert_locked(self, key: ChunkKey, data, origin: str) -> None:
         n = len(data)
@@ -201,6 +233,8 @@ class ChunkCache:
         if origin == "prefetch":
             self.prefetch_inserted_bytes += n
             self.prefetch_resident_unused += n
+        if self._debug:
+            self._assert_invariants_locked()
 
     def _hit_locked(self, key: ChunkKey, e: _Entry):
         self._entries.move_to_end(key)

@@ -139,6 +139,7 @@ class Prefetcher:
         transport: str = "",
         pool: Optional[SlabPool] = None,
         meter: Optional[CopyMeter] = None,
+        max_workers: int = 0,
     ):
         self._backend = backend
         self._cache = cache
@@ -169,14 +170,20 @@ class Prefetcher:
         # the run's recorder activation is known-live — a worker thread
         # resolving the ambient recorder at its own start time could race
         # the activation scope and silently record nothing.
-        n_workers = max(1, workers) if self._depth else 0
+        # max_workers pre-spawns a larger pool with only `workers` of it
+        # ACTIVE (the rest park on the condvar): the tune controller's
+        # prefetch_workers knob then grows/shrinks the live set without
+        # ever spawning mid-run.
+        n_active = max(1, workers) if self._depth else 0
+        n_threads = max(n_active, max_workers) if self._depth else 0
+        self._active_workers = n_active
         self._threads = [
             threading.Thread(
                 target=self._worker,
-                args=(_flight.active_worker(f"prefetch-{i}"),),
+                args=(i, _flight.active_worker(f"prefetch-{i}")),
                 name=f"prefetch-{i}", daemon=True,
             )
-            for i in range(n_workers)
+            for i in range(n_threads)
         ]
         for t in self._threads:
             t.start()
@@ -200,20 +207,74 @@ class Prefetcher:
                     self.depth_clamps += 1
             elif self._depth_effective < self._depth:
                 self._depth_effective += 1
-            hi = min(len(self._plan), self._cursor + self._depth_effective)
-            for i in range(self._cursor, hi):
-                if i in self._scheduled:
-                    continue
-                key = self._plan[i]
-                if self._budget and (
-                    self._outstanding_locked() + key.length > self._budget
-                ):
-                    break
-                if self._cache.contains(key):
-                    continue
-                self._scheduled.add(i)
-                heapq.heappush(self._heap, (i, key))
+            self._fill_locked()
             self._cond.notify_all()
+
+    def _fill_locked(self) -> None:
+        hi = min(len(self._plan), self._cursor + self._depth_effective)
+        for i in range(self._cursor, hi):
+            if i in self._scheduled:
+                continue
+            key = self._plan[i]
+            if self._budget and (
+                self._outstanding_locked() + key.length > self._budget
+            ):
+                break
+            if self._cache.contains(key):
+                continue
+            self._scheduled.add(i)
+            heapq.heappush(self._heap, (i, key))
+
+    def reclamp(self, depth: Optional[int] = None,
+                byte_budget: Optional[int] = None) -> None:
+        """Live depth/byte-budget re-clamp (the tune controller's
+        readahead actuation — no restart). A shrink drops QUEUED entries
+        beyond the new window (counted as cancelled; in-flight fetches
+        complete and land through the normal cache-insert accounting, so
+        the resident-unused counter stays exact — nothing is stranded);
+        growth takes effect immediately by re-filling the window."""
+        if not self._depth:
+            return  # constructed cold (no worker threads): knob is inert
+        with self._cond:
+            if depth is not None:
+                depth = max(1, int(depth))
+                if depth < self._depth:
+                    hi = self._cursor + depth
+                    keep = [(i, k) for i, k in self._heap if i < hi]
+                    for i, _ in self._heap:
+                        if i >= hi:
+                            self._scheduled.discard(i)
+                            self.cancelled += 1
+                    self._heap = keep
+                    heapq.heapify(self._heap)
+                grow = depth > self._depth
+                self._depth = depth
+                if grow:
+                    # A commanded grow resets the thrash clamp: the
+                    # controller asked for the window NOW; eviction
+                    # waste re-clamps it if the cache disagrees.
+                    self._depth_effective = depth
+                else:
+                    # Shrink: the clamp (if tighter) survives.
+                    self._depth_effective = max(
+                        1, min(self._depth_effective, depth)
+                    )
+            if byte_budget is not None:
+                self._budget = max(0, int(byte_budget))
+            self._fill_locked()
+            self._cond.notify_all()
+
+    def set_workers(self, n: int) -> None:
+        """Live worker fan-out: activate the first ``n`` of the
+        pre-spawned pool (parked threads resume on the condvar; threads
+        beyond the active count finish their current fetch, then park)."""
+        with self._cond:
+            self._active_workers = max(1, min(int(n), len(self._threads)))
+            self._cond.notify_all()
+
+    @property
+    def active_workers(self) -> int:
+        return self._active_workers
 
     def _outstanding_locked(self) -> int:
         # prefetch_resident_unused is the cache's directly-maintained
@@ -233,10 +294,14 @@ class Prefetcher:
             t.join()
 
     # -------------------------------------------------------------- worker --
-    def _worker(self, wf) -> None:
+    def _worker(self, widx: int, wf) -> None:
         while True:
             with self._cond:
-                while not self._heap and not self._stop:
+                # Parked workers (widx >= the live fan-out) wait without
+                # popping; set_workers() wakes them when the controller
+                # grows the pool back.
+                while (not self._heap or widx >= self._active_workers) \
+                        and not self._stop:
                     self._cond.wait()
                 if self._stop:
                     # Shutdown cancels queued readahead — close() must
@@ -330,7 +395,8 @@ class Prefetcher:
         return {
             "depth": self._depth,
             "depth_effective": self._depth_effective,
-            "workers": len(self._threads),
+            "workers": self._active_workers,
+            "workers_max": len(self._threads),
             "issued": self.issued,
             "completed": self.completed,
             "cancelled": self.cancelled,

@@ -709,6 +709,12 @@ class HedgedBackend(_WrapperBackend):
         self._fb_samples: deque = deque(maxlen=512)
         self._fb_p99: Optional[float] = None
         self._fb_since_p99 = 0
+        # Live override of the fixed delay (the tune controller's
+        # hedge-delay actuation): replaces tail.hedge_delay_s inside
+        # hedge_delay() without mutating shared config; the rolling-p99
+        # adaptive path still floors at it, exactly as it floors at the
+        # configured fixed delay.
+        self._delay_override: Optional[float] = None
         self.stats = {
             "reads": 0,
             "hedges": 0,
@@ -749,17 +755,26 @@ class HedgedBackend(_WrapperBackend):
         with self._lock:
             self.stats["stalls"] += 1
 
+    def set_hedge_delay(self, seconds: float) -> None:
+        """Live fixed-delay override (tune controller actuation)."""
+        with self._lock:
+            self._delay_override = max(0.0, float(seconds))
+
     def hedge_delay(self) -> float:
-        """The delay before a hedge launches: fixed, or the cached
-        p99(first-byte) × scale once enough samples exist (floored at the
-        fixed delay so a cold cache can't hedge-storm)."""
+        """The delay before a hedge launches: fixed (or its live tune
+        override), or the cached p99(first-byte) × scale once enough
+        samples exist (floored at the fixed delay so a cold cache can't
+        hedge-storm)."""
         t = self.tail
-        if t.hedge_from_p99:
-            with self._lock:
-                p99 = self._fb_p99
-            if p99 is not None:
-                return max(t.hedge_delay_s, p99 * t.hedge_p99_scale)
-        return t.hedge_delay_s
+        with self._lock:
+            base = (
+                self._delay_override
+                if self._delay_override is not None else t.hedge_delay_s
+            )
+            p99 = self._fb_p99
+        if t.hedge_from_p99 and p99 is not None:
+            return max(base, p99 * t.hedge_p99_scale)
+        return base
 
     def open_read(self, name: str, start: int = 0, length: Optional[int] = None):
         with self._lock:
@@ -790,6 +805,20 @@ def wrap_tail(
     if tail.hedge:
         b = HedgedBackend(b, tail, clock=clock, chunk_bytes=chunk_bytes)
     return b
+
+
+def find_tail_layer(backend, cls):
+    """First wrapper of type ``cls`` in the backend's ``.inner`` chain,
+    or None — how the tune controller reaches the HedgedBackend for its
+    live hedge-delay actuation without the workload threading it."""
+    b = backend
+    seen = 0
+    while b is not None and seen < 16:
+        seen += 1
+        if isinstance(b, cls):
+            return b
+        b = getattr(b, "inner", None)
+    return None
 
 
 def collect_tail_stats(backend) -> dict:

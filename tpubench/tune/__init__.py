@@ -1,0 +1,10 @@
+"""Adaptive ingest autotuner: the online controller that turns every
+previously-static performance knob (fan-out, readahead, hedge delay)
+into a controlled variable with a measurement loop and guardrails."""
+
+from tpubench.tune.controller import (  # noqa: F401
+    ACTUATED,
+    Knob,
+    RecorderSampler,
+    TuneController,
+)

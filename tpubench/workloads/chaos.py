@@ -247,6 +247,41 @@ def format_scorecard(chaos: dict) -> str:
 # -------------------------------------------------------------- workload --
 
 
+def spawn_hermetic_server(cfg: BenchConfig, fault_plan=None):
+    """In-process fake server speaking the real wire protocol (h1.1, or
+    the h2 server under ``transport.http2``), backed by a prepopulated
+    fake store carrying ``fault_plan`` — server-side injection, so
+    stalls/resets/truncation happen ON THE WIRE. Sets
+    ``cfg.transport.endpoint`` (caller restores it) and pre-loads the
+    C++ engine where the client path needs it, so first-use costs never
+    land inside a measured window. One definition shared by ``tpubench
+    chaos`` and ``tpubench tune`` — the two hermetic-session surfaces
+    must not drift. Returns the started server (caller stops it)."""
+    from tpubench.storage.fake import FakeBackend
+
+    w = cfg.workload
+    store = FakeBackend.prepopulated(
+        prefix=w.object_name_prefix,
+        count=max(w.workers, w.threads),
+        size=w.object_size,
+        fault=fault_plan,
+    )
+    if cfg.transport.http2:
+        from tpubench.storage.fake_h2_server import FakeH2Server
+
+        server = FakeH2Server(backend=store).start()
+    else:
+        from tpubench.storage.fake_server import FakeGcsServer
+
+        server = FakeGcsServer(backend=store).start()
+    cfg.transport.endpoint = server.endpoint
+    if cfg.transport.http2 or cfg.transport.native_receive:
+        from tpubench.native.engine import get_engine
+
+        get_engine()
+    return server
+
+
 def run_chaos(
     cfg: BenchConfig,
     timeline: Optional[list] = None,
@@ -314,38 +349,14 @@ def run_chaos(
         os.close(fd)
         cfg.obs.flight_journal = tmp_journal
 
-    from tpubench.storage.fake import FakeBackend, FaultPlan
+    from tpubench.storage.fake import FaultPlan
 
     server = None
     backend = None
     plan = FaultPlan(**fdict)
     try:
         if proto == "http":
-            # In-process server speaking the real wire protocol, backed by
-            # a fake store carrying the fault plan (server-side injection:
-            # stalls/resets/truncation happen ON THE WIRE).
-            store = FakeBackend.prepopulated(
-                prefix=w.object_name_prefix,
-                count=max(w.workers, w.threads),
-                size=w.object_size,
-                fault=plan,
-            )
-            if cfg.transport.http2:
-                from tpubench.storage.fake_h2_server import FakeH2Server
-
-                server = FakeH2Server(backend=store).start()
-            else:
-                from tpubench.storage.fake_server import FakeGcsServer
-
-                server = FakeGcsServer(backend=store).start()
-            cfg.transport.endpoint = server.endpoint
-            if cfg.transport.http2 or cfg.transport.native_receive:
-                # Load the C++ engine BEFORE arming: its first-use cost
-                # (dlopen, possibly a compile) must not eat the
-                # timeline's baseline window.
-                from tpubench.native.engine import get_engine
-
-                get_engine()
+            server = spawn_hermetic_server(cfg, fault_plan=plan)
 
         # Pre-build everything expensive (workload import, client
         # backend), then arm: timeline second 0 ≈ the first read, so the

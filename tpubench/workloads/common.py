@@ -36,6 +36,44 @@ class GroupResult:
         return len(self.errors)
 
 
+class ElasticGate:
+    """Live worker-fan-out gate for thread-pool workloads (the tune
+    controller's Python-path workers actuation).
+
+    All ``total`` threads are spawned up front; only the first
+    ``active`` are admitted through :meth:`admit` — the rest PARK on the
+    gate's condvar (not busy-waiting, not exiting) until the controller
+    grows the pool back or the run ends. Shrinks take effect at each
+    worker's next admit (its in-flight read completes normally — live
+    resize, never a mid-read cancel)."""
+
+    def __init__(self, active: int, total: int):
+        self.total = max(1, total)
+        self._active = max(1, min(active, self.total))
+        self._cond = threading.Condition()
+
+    @property
+    def active(self) -> int:
+        return self._active
+
+    def set_active(self, n: int) -> None:
+        with self._cond:
+            self._active = max(1, min(int(n), self.total))
+            self._cond.notify_all()
+
+    def admit(self, worker_id: int, cancel: threading.Event) -> bool:
+        """Block while ``worker_id`` is parked; True = proceed with the
+        next unit of work, False = the run was cancelled while parked.
+        The short wait timeout is only a safety net against a missed
+        cancel-set (cancel has no condvar of its own)."""
+        with self._cond:
+            while worker_id >= self._active:
+                if cancel.is_set():
+                    return False
+                self._cond.wait(0.05)
+        return not cancel.is_set()
+
+
 class WorkerGroup:
     """Run ``fn(worker_id, cancel_event)`` across N threads."""
 

@@ -255,24 +255,65 @@ def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunRe
     session = metrics_session_from_config(
         cfg, metrics, bytes_fn=lambda: bytes_total
     )
+    controller = None
     metrics.ingest.start()
     try:
         if session is not None:
             session.__enter__()
-        # One outstanding read per logical worker — the serial per-worker
-        # loop's concurrency shape; a completion of worker `wid`'s read
-        # refills the SAME worker (a fast object never accumulates extra
-        # in-flight reads while a slow one starves). A read awaiting a
-        # retry backoff keeps its worker serialized too: the next read of
-        # that worker submits only after this one finally settles.
-        per_worker_next = [1] * w.workers
-        for wid in range(w.workers):
-            submit(wid, 0)
+        # One outstanding read per logical worker, admitted through a
+        # LIVE fan-out cap — the serial per-worker loop's concurrency
+        # shape, with the cap itself a tune-controller knob. Workers
+        # with remaining reads and no read in flight sit in `runnable`;
+        # the pump admits them while outstanding < active. active ==
+        # w.workers (the default, tuning off) reproduces the old
+        # complete-one-refill-same-worker behavior; a shrink drains
+        # naturally (completions stop being refilled past the cap, and
+        # NO work is lost — the total read count still completes, just
+        # at the lower concurrency). A read awaiting a retry backoff
+        # keeps its in-flight slot, so its worker stays serialized.
+        from collections import deque
+
+        active = [w.workers]  # mutable cell: the tune workers actuator
+        per_worker_next = [0] * w.workers
+        runnable = deque(range(w.workers))
+        outstanding = 0
         completed = 0
-        idle_waits = 0
+
+        def pump() -> None:
+            nonlocal outstanding
+            while outstanding < active[0] and runnable:
+                wid = runnable.popleft()
+                submit(wid, per_worker_next[wid])
+                per_worker_next[wid] += 1
+                outstanding += 1
+
+        if getattr(cfg, "tune", None) is not None and cfg.tune.enabled:
+            from tpubench.tune.controller import (
+                Knob,
+                RecorderSampler,
+                TuneController,
+            )
+
+            knobs = []
+            if "workers" in set(cfg.tune.knobs) and w.workers > 1:
+                knobs.append(Knob(
+                    "workers", w.workers,
+                    lambda v: active.__setitem__(0, int(v)),
+                    lo=1, hi=w.workers, mode="mul",
+                ))
+            if knobs:
+                controller = TuneController(
+                    cfg.tune, knobs,
+                    RecorderSampler(
+                        [r for r, _ in recorders], lambda: bytes_total
+                    ),
+                )
+                controller.start()
+
+        pump()
 
         def handle(c: dict) -> None:
-            nonlocal completed, errors, first_error, bytes_total
+            nonlocal completed, errors, first_error, bytes_total, outstanding
             tag = c["tag"]
             wid = tag // reads_per
             read_rec, fb_rec = recorders[wid]
@@ -301,6 +342,7 @@ def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunRe
                     fb_rec.record_ns(c["first_byte_ns"] - c["start_ns"])
                 bytes_total += c["result"]
             completed += 1
+            outstanding -= 1
             if verdict != "ok" and w.abort_on_error:
                 # errgroup semantics (main.go:200-219): first (post-retry)
                 # error cancels the run — same contract as the Python path.
@@ -308,29 +350,35 @@ def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunRe
                     f"native fetch executor: read failed ({first_error})"
                 )
             if per_worker_next[wid] < reads_per:
-                submit(wid, per_worker_next[wid])
-                per_worker_next[wid] += 1
+                runnable.append(wid)
 
+        # With tuning live, the wait must wake often enough to apply a
+        # fan-out GROW promptly even when the shrunken pool completes
+        # slowly; the stall guard is wall-clock-based (120 s without a
+        # completion) so shorter waits don't change its meaning.
+        wait_cap_ms = 100 if controller is not None else 30_000
+        last_completion = time.monotonic()
         while completed < total_reads:
             for tag in retry.pop_due():
                 resubmit(tag)
+            pump()
             # Batched drain (tb_pool_next_batch): under fan-out the
             # workers land completions faster than Python processes them
             # — one wake takes the whole backlog in a single native lock
             # crossing instead of paying the handoff per completion (the
             # BENCH_r05 deficit attribution).
-            cs = pool.next_batch(timeout_ms=retry.next_due_in_ms(30_000))
+            cs = pool.next_batch(timeout_ms=retry.next_due_in_ms(wait_cap_ms))
             if not cs:
                 if retry.waiting:
                     continue  # timeout was just a backoff pause elapsing
-                idle_waits += 1
-                if idle_waits >= 4:  # 4 x 30 s with zero completions
+                if time.monotonic() - last_completion > 120:
                     raise RuntimeError("native fetch executor stalled (120s)")
                 continue
-            idle_waits = 0
+            last_completion = time.monotonic()
             for c in cs:
                 handle(c)
     finally:
+        tune_stats = controller.stop() if controller is not None else None
         # Stop the clock BEFORE teardown (thread joins + multi-MB munmaps
         # must not bias the measured window vs the Python path).
         metrics.ingest.stop()
@@ -359,6 +407,8 @@ def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunRe
         f"retries={retry.retries})"
     )
     res.extra["retries"] = retry.retries
+    if tune_stats is not None:
+        res.extra["tune"] = tune_stats
     if session is not None:
         res.extra["metrics_export"] = session.summary()
     if first_error:

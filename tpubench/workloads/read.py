@@ -42,7 +42,7 @@ from tpubench.storage.base import (
     read_object_into_sink,
     read_object_through,
 )
-from tpubench.workloads.common import WorkerGroup
+from tpubench.workloads.common import ElasticGate, WorkerGroup
 
 
 class Sink(Protocol):
@@ -70,6 +70,39 @@ class ZeroCopySink(Sink, Protocol):
 
 
 SinkFactory = Callable[[int], Sink]
+
+
+def _build_read_controller(cfg, read_recorders, bytes_fn, backend, gate,
+                           flight):
+    """Tune controller for the Python read path: live knobs are the
+    elastic worker fan-out and (when hedging is on) the hedge delay;
+    goodput/p99 sampled off the run's own per-worker recorders."""
+    from tpubench.storage.tail import HedgedBackend, find_tail_layer
+    from tpubench.tune.controller import (
+        Knob,
+        RecorderSampler,
+        TuneController,
+        hedge_delay_knob,
+    )
+
+    wanted = set(cfg.tune.knobs)
+    knobs = []
+    if "workers" in wanted and gate.total > 1:
+        knobs.append(Knob(
+            "workers", gate.active, gate.set_active,
+            lo=1, hi=gate.total, mode="mul",
+        ))
+    if "hedge_delay_s" in wanted and cfg.transport.tail.hedge:
+        hb = find_tail_layer(backend, HedgedBackend)
+        if hb is not None:
+            knobs.append(hedge_delay_knob(
+                cfg.transport.tail.hedge_delay_s, hb.set_hedge_delay,
+            ))
+    if not knobs:
+        return None
+    sampler = RecorderSampler(read_recorders, bytes_fn)
+    ring = flight.worker("tune") if flight is not None else None
+    return TuneController(cfg.tune, knobs, sampler, flight_ring=ring)
 
 
 @dataclass
@@ -103,6 +136,13 @@ class ReadWorkload:
         eng0 = peek_engine()
         native_stats0 = eng0.stats() if eng0 is not None else {}
 
+        # Adaptive tuning (tpubench/tune/): an elastic gate makes worker
+        # fan-out a LIVE knob — all threads spawn, the controller admits
+        # a subset; parked workers resume when it grows the pool back.
+        tune_on = getattr(self.cfg, "tune", None) is not None and \
+            self.cfg.tune.enabled
+        gate = ElasticGate(n, n) if tune_on else None
+
         def worker(i: int, cancel) -> None:
             read_rec, fb_rec = recorders[i]
             wf = flights[i]
@@ -127,6 +167,8 @@ class ReadWorkload:
             try:
                 for _ in range(w.read_calls_per_worker):
                     if cancel.is_set():
+                        break
+                    if gate is not None and not gate.admit(i, cancel):
                         break
                     with self.tracer.span(
                         "ReadObject", bucket=w.bucket, object=name
@@ -177,6 +219,24 @@ class ReadWorkload:
         metrics.ingest.start()
         group = WorkerGroup(abort_on_error=w.abort_on_error)
         result_errors = 0
+        controller = None
+        duration_timer = None
+        if gate is not None:
+            controller = _build_read_controller(
+                self.cfg, metrics.read_latency,
+                lambda: sum(worker_bytes), self.backend, gate, flight,
+            )
+            # Online read sessions are duration-bounded: a shrink parks
+            # workers with reads remaining, so read-count completion can
+            # no longer be the only exit. No controller (nothing
+            # actuatable) = no cap — the run must not silently truncate.
+            if controller is not None and self.cfg.tune.duration_s > 0:
+                import threading as _threading
+
+                duration_timer = _threading.Timer(
+                    self.cfg.tune.duration_s, group.cancel.set
+                )
+                duration_timer.daemon = True
         try:
             if session is not None:
                 session.__enter__()
@@ -186,9 +246,18 @@ class ReadWorkload:
                 # hbm_staged records to the same journal.
                 with (flight.activate() if flight is not None
                       else contextlib.nullcontext()):
+                    if controller is not None:
+                        controller.start()
+                    if duration_timer is not None:
+                        duration_timer.start()
                     gres = group.run(n, worker, name="read")
                 result_errors = gres.error_count
             finally:
+                if duration_timer is not None:
+                    duration_timer.cancel()
+                tune_stats = (
+                    controller.stop() if controller is not None else None
+                )
                 metrics.ingest.stop()
                 metrics.ingest.bytes = sum(worker_bytes)
                 # Stage-latency recorders created by sinks live in their
@@ -220,6 +289,8 @@ class ReadWorkload:
         )
         if session is not None:
             res.extra["metrics_export"] = session.summary()
+        if tune_stats is not None:
+            res.extra["tune"] = tune_stats
         # Native-receive connection accounting (connects/reuses/
         # stale_retries) — read from the pool only if one was actually
         # built, so this never constructs a pool as a side effect.

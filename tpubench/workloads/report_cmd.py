@@ -56,6 +56,11 @@ def _axis(run: dict) -> str:
         copies = (run.get("extra", {}).get("pipeline") or {}).get("copies")
         if copies and copies.get("mode"):
             bits.append(copies["mode"])
+    # Adaptive-vs-static is an A/B axis of its own: a run the controller
+    # drove must not render as a twin of its static sibling.
+    if (run.get("extra", {}).get("tune") or {}).get("enabled") or \
+            run.get("workload") == "tune":
+        bits.append("tuned")
     return " ".join(bits)
 
 
@@ -102,6 +107,22 @@ def summarize_run(run: dict, label: str = "") -> str:
         from tpubench.workloads.train_ingest import format_pipeline_scorecard
 
         lines.append(format_pipeline_scorecard(pipe))
+    tune = extra.get("tune")
+    if tune:
+        # Tune block: a `tpubench tune` result carries the full
+        # sweep/adaptive/recommendation body; a workload run that merely
+        # HAD the controller on carries its convergence trace — render
+        # both with the body the CLI printed live.
+        from tpubench.workloads.tune_cmd import format_tune_block
+
+        if "mode" in tune:
+            lines.append(format_tune_block(tune))
+        else:
+            lines.append(format_tune_block(
+                {"mode": "online", "workload": run.get("workload"),
+                 "adaptive": tune,
+                 "recommended": tune.get("final") or {}}
+            ))
     return "\n".join(lines)
 
 
@@ -159,6 +180,21 @@ def compare_runs(runs: list[dict]) -> str:
                     f"({cell(op_, '{}', 'copies', 'mode')}) vs "
                     f"{cell(bp, '{:.2f}', 'copies', 'copies_per_byte')} "
                     f"({cell(bp, '{}', 'copies', 'mode')})"
+                )
+        # Tune diff: a static run against its adaptive sibling compares
+        # on what the controller exists for — the converged operating
+        # point and when it got there — alongside the throughput ratio
+        # already printed above.
+        for side, label in ((other, "B"), (base, "A")):
+            tn = (side.get("extra", {}).get("tune") or {})
+            ad = tn.get("adaptive") if "mode" in tn else tn
+            if ad and ad.get("enabled"):
+                conv = ad.get("windows_to_converge")
+                lines.append(
+                    f"    tune[{label}]: {ad.get('initial')} -> "
+                    f"{ad.get('final')}"
+                    + (f", converged in {conv} windows"
+                       if ad.get("converged") else ", not converged")
                 )
         # Scorecard diff: two chaos runs (e.g. hedged vs unhedged over the
         # same timeline) compare on resilience, not just throughput.

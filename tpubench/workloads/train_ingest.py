@@ -58,6 +58,7 @@ from tpubench.obs.flight import (
 )
 from tpubench.pipeline.cache import ChunkCache, ChunkKey
 from tpubench.pipeline.prefetch import Prefetcher, fetch_chunk
+from tpubench.tune.controller import prefetch_workers_ceiling as _pf_ceiling
 from tpubench.storage import open_backend
 from tpubench.storage.base import StorageBackend, iter_ranges
 
@@ -251,6 +252,9 @@ class _TrainIngest:
             self._pod_stage_gather(mesh, reassemble, [b"\0" * (batch * chunk)])
 
         pf: Optional[Prefetcher] = None
+        controller = None
+        tune_stats = None
+        tune_on = getattr(cfg, "tune", None) is not None and cfg.tune.enabled
         activation = (
             flight.activate() if flight is not None
             else contextlib.nullcontext()
@@ -267,8 +271,22 @@ class _TrainIngest:
                         byte_budget=p.readahead_bytes,
                         transport=tlabel,
                         pool=pool, meter=meter,
+                        # Tuning pre-spawns headroom so the
+                        # prefetch_workers knob can grow the live pool
+                        # (ceiling shared with the sweep axes).
+                        max_workers=(
+                            _pf_ceiling(p.prefetch_workers)
+                            if tune_on else 0
+                        ),
                     )
                     pf.advance(0)
+                if tune_on:
+                    controller = _build_train_ingest_controller(
+                        cfg, fetch_rec, lambda: consumed_bytes,
+                        self.backend, pf, len(plan), flight,
+                    )
+                    if controller is not None:
+                        controller.start()
                 step_t0 = time.perf_counter_ns()
                 for step in range(total_steps):
                     lo = step * batch
@@ -401,6 +419,8 @@ class _TrainIngest:
                     step_rec.record_ns(now - step_t0)
                     step_t0 = now
         finally:
+            if controller is not None:
+                tune_stats = controller.stop()
             if pf is not None:
                 pf.close()
             if stager is not None:
@@ -482,6 +502,8 @@ class _TrainIngest:
             errors=errors,
         )
         res.extra["pipeline"] = pipe_extra
+        if tune_stats is not None:
+            res.extra["tune"] = tune_stats
         if sink_stats.get("staged_bytes"):
             res.extra["staged_bytes"] = sink_stats["staged_bytes"]
         from tpubench.storage.tail import collect_tail_stats
@@ -502,6 +524,59 @@ class _TrainIngest:
                     },
                 )
         return res
+
+
+def _build_train_ingest_controller(cfg, fetch_rec, bytes_fn, backend, pf,
+                                   plan_len, flight):
+    """Tune controller for train-ingest: live knobs are the prefetcher's
+    readahead depth / byte budget / worker fan-out (Prefetcher.reclamp /
+    set_workers) and the hedge delay; goodput is windowed consumed
+    bytes, the p99 guardrail watches demand-fetch latency."""
+    from tpubench.storage.tail import HedgedBackend, find_tail_layer
+    from tpubench.tune.controller import (
+        Knob,
+        RecorderSampler,
+        TuneController,
+        hedge_delay_knob,
+        readahead_ceiling,
+    )
+
+    p = cfg.pipeline
+    wanted = set(cfg.tune.knobs)
+    knobs = []
+    if "readahead" in wanted and pf is not None:
+        hi = min(readahead_ceiling(p.readahead), max(1, plan_len))
+        knobs.append(Knob(
+            "readahead", p.readahead,
+            lambda v: pf.reclamp(depth=v),
+            lo=1, hi=hi, mode="mul",
+        ))
+    if "readahead_bytes" in wanted and pf is not None \
+            and p.readahead_bytes > 0:
+        chunk = p.chunk_bytes or cfg.workload.granule_bytes
+        knobs.append(Knob(
+            "readahead_bytes", p.readahead_bytes,
+            lambda v: pf.reclamp(byte_budget=v),
+            lo=chunk, hi=8 * p.readahead_bytes, mode="mul",
+        ))
+    if "prefetch_workers" in wanted and pf is not None:
+        hi = pf.stats()["workers_max"]
+        if hi > 1:
+            knobs.append(Knob(
+                "prefetch_workers", pf.active_workers, pf.set_workers,
+                lo=1, hi=hi, mode="add",
+            ))
+    if "hedge_delay_s" in wanted and cfg.transport.tail.hedge:
+        hb = find_tail_layer(backend, HedgedBackend)
+        if hb is not None:
+            knobs.append(hedge_delay_knob(
+                cfg.transport.tail.hedge_delay_s, hb.set_hedge_delay,
+            ))
+    if not knobs:
+        return None
+    sampler = RecorderSampler([fetch_rec], bytes_fn)
+    ring = flight.worker("tune") if flight is not None else None
+    return TuneController(cfg.tune, knobs, sampler, flight_ring=ring)
 
 
 # -------------------------------------------------------------- rendering --
