@@ -107,12 +107,10 @@ def _cfg(total_mb: int, workers: int, slot_mb: int, sync: bool = True):
     cfg.staging.validate_checksum = False
     cfg.staging.slot_bytes = slot_mb * MB
     cfg.staging.double_buffer = not sync
+    # depth > 1 rides the overlapped staging executor (depth-K in-flight
+    # window, out-of-order completion) automatically; sync=True forces
+    # the serial single-slot ring via double_buffer=False.
     cfg.staging.depth = 3
-    if not sync:
-        # The overlapped config means the drain-THREAD pipeline (fetch
-        # never pays transfer completion); without this the ring drains
-        # inline and the "overlap" label would be a lie.
-        cfg.staging.drain = "thread"
     return cfg
 
 
@@ -232,6 +230,35 @@ def _tune_ab_cell() -> dict:
         "initial": tn.get("initial"),
         "final": tn.get("final"),
         "sleep_scale": _SLEEP_SCALE,
+    }
+
+
+def _staging_depth_cell(depth: int) -> dict:
+    """One cell of the staging-depth sweep: the staged config with the
+    overlapped executor's in-flight window at ``depth`` (1 = the serial
+    ring comparator), hermetic fake backend, deterministic bytes — so
+    BENCH_r06+ records where the overlap knee is on this host. Returns
+    the staged bandwidth plus the run's own overlap accounting
+    (extra["staging"])."""
+    from tpubench.staging.device import make_sink_factory
+    from tpubench.workloads.read import run_read
+
+    cfg = _cfg(32, 2, 8, sync=False)
+    cfg.staging.depth = depth
+    cfg.workload.seed = 7  # fixed seed: cells differ only in depth
+    res = run_read(cfg, sink_factory=make_sink_factory(cfg))
+    if res.errors:
+        raise RuntimeError(f"depth-{depth} cell had {res.errors} errors")
+    stg = res.extra.get("staging") or {}
+    return {
+        "depth": depth,
+        "staged_gbps_per_chip": round(res.extra["staged_gbps_per_chip"], 4),
+        "drain": stg.get("drain"),
+        "transfer_wait_s": stg.get("transfer_wait_s"),
+        "transfer_flight_s": stg.get("transfer_flight_s"),
+        "staging_efficiency": stg.get("staging_efficiency"),
+        "transfer_inflight": stg.get("transfer_inflight"),
+        "out_of_order_completions": stg.get("out_of_order_completions"),
     }
 
 
@@ -452,6 +479,21 @@ def main() -> int:
         tunnel.append(_tunnel_run(48, 16))
         host.append(_host_ram_run(96, 2))
 
+    # ---- Staging-depth sweep (refill): the overlapped executor's knee.
+    # Depth 1 is the serial-ring comparator; 2/4 measure how much
+    # transfer_wait the in-flight window hides on THIS host's tunnel
+    # (fixed seed, same window for all three cells so the quotients are
+    # budget-comparable).
+    depth_sweep: dict = {}
+    _sleep(45)
+    _ramp()
+    for d in (1, 2, 4):
+        try:
+            depth_sweep[str(d)] = _staging_depth_cell(d)
+        except Exception as e:  # one bad cell must not kill the sweep
+            print(f"# staging-depth cell d={d} failed: {e}", file=sys.stderr)
+        _sleep(2.0)
+
     # ---- Closing probe: physics fields + its own shaped verdict.
     probe = run_probe(BenchConfig(), cycles=4, sleep_s=2.0).extra
     if exec_srv is not None:
@@ -571,6 +613,7 @@ def main() -> int:
                     },
                 },
                 "efficiency_pairs": eff_pairs,
+                "staging_depth_sweep": depth_sweep,
                 "gap_breakdown": gap,
                 "fetch_only_ab": fetch_ab,
                 "tune_ab": tune_ab,

@@ -68,6 +68,14 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tune: adaptive autotuner (controller/sweep/actuation)"
     )
+    # Staging tests (overlapped executor: depth-K in-flight window,
+    # out-of-order completion, lease-release-at-completion, depth A/B)
+    # stay in tier-1 — same policy as `pipeline`/`slab`: not slow-marked,
+    # so the transfer-overlap regression guard runs on every pass; the
+    # marker exists for selective runs (`-m staging`).
+    config.addinivalue_line(
+        "markers", "staging: overlapped staging executor (in-flight window)"
+    )
     # Multihost tests are marker-gated (see tests/test_multihost.py):
     # they need working multi-process jax.distributed, which this
     # container lacks — tier-1 collects clean skips, not failures.

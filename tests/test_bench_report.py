@@ -251,7 +251,8 @@ def test_note_nexec_sentence_tracks_measurement():
 def test_bench_cfg_modes_wire_the_right_pipeline():
     """Pins the config each bench mode label actually runs (a round-5
     review caught 'overlap' measuring the inline-drain ring because the
-    drain knob was never set)."""
+    drain knob was never set; drain is now a deprecated no-op and
+    depth>1 always rides the overlapped executor)."""
     import bench
 
     sync = bench._cfg(32, 2, 8, sync=True)
@@ -259,8 +260,7 @@ def test_bench_cfg_modes_wire_the_right_pipeline():
     assert sync.staging.mode == "device_put"
     ov = bench._cfg(32, 2, 8, sync=False)
     assert ov.staging.double_buffer is True
-    assert ov.staging.drain == "thread"  # the drain-THREAD pipeline
-    assert ov.staging.depth == 3
+    assert ov.staging.depth == 3  # depth-K overlapped executor engages
 
 
 # ------------------------------------------------------- probe hardening --

@@ -76,6 +76,23 @@ def test_bench_end_to_end_cpu():
     assert "host_cores" in d and d["host_cores"] >= 1
     # Pallas ring really ran (its pair samples live under its config).
     assert len(d["samples"]["pallas_s8_w2"]) == 1
+    # Staging-depth sweep (PR 6): depth 1 is the serial comparator, 2/4
+    # the overlapped executor; the regression guard — depth > 1 never
+    # reports LOWER staging_efficiency than depth 1 (small tolerance for
+    # scheduler noise on a 1-core host).
+    sweep = d["staging_depth_sweep"]
+    assert set(sweep) == {"1", "2", "4"}
+    assert sweep["1"]["drain"] == "inline"
+    e1 = sweep["1"]["staging_efficiency"]
+    for k in ("2", "4"):
+        assert sweep[k]["drain"] == "overlap"
+        assert sweep[k]["staged_gbps_per_chip"] > 0
+        ek = sweep[k]["staging_efficiency"]
+        if e1 is not None and ek is not None:
+            assert ek >= e1 - 0.05, (
+                f"depth {k} staging_efficiency {ek} regressed below "
+                f"depth-1 {e1}"
+            )
 
 
 @pytest.mark.parametrize("value,frag", [

@@ -9,6 +9,8 @@ from tpubench.staging.device import DevicePutStager, make_sink_factory
 from tpubench.storage.base import deterministic_bytes
 from tpubench.workloads.read import run_read
 
+pytestmark = pytest.mark.staging
+
 
 def test_stager_lands_exact_bytes(jax_cpu_devices):
     data = deterministic_bytes("x", 300_000)
@@ -113,26 +115,28 @@ def test_read_workload_with_staging(jax_cpu_devices):
     assert res.extra["staged_bytes"] == res.bytes_total
 
 
-def test_stager_thread_drain_lands_exact_bytes(jax_cpu_devices):
-    """Threaded drain: a per-worker drainer owns transfer completion; all
-    bytes still land, stage latencies still recorded, counters coherent
-    after finish() joins the drainer."""
+def test_stager_overlap_lands_exact_bytes(jax_cpu_devices):
+    """Overlapped executor (depth > 1): the in-flight window's reaper
+    owns transfer completion; all bytes still land, stage latencies
+    still recorded, counters coherent after finish() joins the reaper,
+    and the new overlap counters are present."""
     data = deterministic_bytes("thr", 10 * 64 * 1024)
     st = DevicePutStager(
         0,
         granule_bytes=64 * 1024,
-        cfg=StagingConfig(
-            drain="thread", depth=3, slot_bytes=128 * 1024
-        ),
+        cfg=StagingConfig(depth=3, slot_bytes=128 * 1024),
     )
     mv = memoryview(data.tobytes())
     for off in range(0, len(mv), 64 * 1024):
         st.submit(mv[off : off + 64 * 1024])
     stats = st.finish()
-    assert stats["drain"] == "thread"
+    assert stats["drain"] == "overlap"
     assert stats["staged_bytes"] == 10 * 64 * 1024
     assert stats["transfers"] == 5
     assert len(stats["stage_recorder"]) == 5
+    assert stats["depth"] == 3
+    assert 1 <= stats["inflight_max"] <= 3
+    assert stats["transfer_flight_ns"] > 0
 
 
 def test_stager_thread_drain_validation_falls_back_inline(jax_cpu_devices):
@@ -288,29 +292,469 @@ def test_locked_sink_concurrent_producers_never_double_assign():
     assert all(c == per_producer for c in counts.values())
 
 
-def test_thread_drain_error_aborts_fetch_promptly(jax_cpu_devices, monkeypatch):
-    """A transfer failure in the drainer must abort the fetch at the next
-    acquire — not park the error until finish() while the fetch burns the
-    whole stream (the drainer frees failed slots, so without the acquire
-    check backpressure would never engage)."""
+def test_overlap_error_aborts_fetch_promptly(jax_cpu_devices, monkeypatch):
+    """A transfer failure in the window's reaper must abort the fetch at
+    the next acquire — not park the error until finish() while the fetch
+    burns the whole stream (the reaper frees failed slots, so without
+    the acquire check backpressure would never engage)."""
     from tpubench.config import StagingConfig
     from tpubench.staging import device as dev_mod
+    from tpubench.staging import executor as exec_mod
 
     cfg = StagingConfig()
     cfg.double_buffer = True
     cfg.depth = 2
-    cfg.drain = "thread"
     st = dev_mod.DevicePutStager(
         0, granule_bytes=1024, cfg=cfg, slot_bytes=2048
     )
-    assert st._drain_thread
+    assert st._overlap
 
     def boom(*a, **k):
         raise RuntimeError("device gone")
 
-    monkeypatch.setattr(dev_mod.jax, "device_put", boom)
+    monkeypatch.setattr(exec_mod.jax, "device_put", boom)
     data = memoryview(bytes(64 * 1024))  # many slots: must fail EARLY
     with pytest.raises(RuntimeError, match="device gone"):
         st.submit(data)
     with pytest.raises(RuntimeError, match="device gone"):
         st.finish()
+
+
+# ------------------------------------------- overlapped executor (PR 6) --
+# Deterministic fake engines: transfer completion is driven by the TEST
+# (ManualEngine) or by an injected per-transfer duration (DelayEngine) —
+# no jax, no real tunnel, so out-of-order completion, backpressure and
+# lease-release timing are assertable exactly.
+
+import threading  # noqa: E402
+import time  # noqa: E402
+
+from tpubench.staging.executor import (  # noqa: E402
+    InflightWindow,
+    StagerRegistry,
+)
+
+
+class ManualEngine:
+    """Transfers complete only when the test calls complete(i)."""
+
+    class H:
+        def __init__(self, array):
+            self.array = array
+            self.ready = threading.Event()
+
+    def __init__(self):
+        self.submitted: list = []
+        self.deleted: list = []
+
+    def submit(self, array, device):
+        h = self.H(array)
+        self.submitted.append(h)
+        return h
+
+    def probe(self, h):
+        return h.ready.is_set()
+
+    def wait(self, h):
+        if not h.ready.wait(timeout=10.0):
+            raise TimeoutError("manual transfer never completed")
+
+    def delete(self, h):
+        self.deleted.append(h)
+
+    def complete(self, i: int) -> None:
+        self.submitted[i].ready.set()
+
+
+class DelayEngine:
+    """Every transfer lands exactly delay_s after submission — the
+    injectable transfer-completion clock for the depth A/B."""
+
+    def __init__(self, delay_s: float):
+        self.delay_s = delay_s
+
+    def submit(self, array, device):
+        return time.perf_counter() + self.delay_s
+
+    def probe(self, due):
+        return time.perf_counter() >= due
+
+    def wait(self, due):
+        rem = due - time.perf_counter()
+        if rem > 0:
+            time.sleep(rem)
+
+    def delete(self, due):
+        pass
+
+
+def _eventually(pred, timeout=5.0, msg="condition never held"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.002)
+    raise AssertionError(msg)
+
+
+def test_window_completes_out_of_order():
+    """The reaper finalizes whichever transfer lands first, not launch
+    order: completing #2 frees its resources while #0/#1 are still in
+    flight, and the out-of-order counter says so."""
+    eng = ManualEngine()
+    w = InflightWindow(3, None, engine=eng)
+    done: list[int] = []
+    for i in range(3):
+        w.enqueue(bytes([i]), 1, on_complete=lambda i=i: done.append(i))
+    _eventually(lambda: len(eng.submitted) == 3)
+    eng.complete(2)
+    _eventually(lambda: done == [2], msg=f"completion order {done}")
+    eng.complete(0)
+    eng.complete(1)
+    w.close()
+    assert sorted(done) == [0, 1, 2]
+    assert done[0] == 2
+    s = w.stats()
+    assert s["out_of_order_completions"] >= 1
+    assert s["transfers"] == 3 and s["staged_bytes"] == 3
+    # Completed device buffers were delete()d (per-transfer HBM hygiene).
+    assert len(eng.deleted) == 3
+
+
+def test_window_backpressure_at_depth():
+    """enqueue blocks exactly when K transfers are pending, unblocks on
+    the first completion, and the blocked time lands in wait_ns."""
+    eng = ManualEngine()
+    w = InflightWindow(2, None, engine=eng)
+    w.enqueue(b"a", 1)
+    w.enqueue(b"b", 1)
+    _eventually(lambda: len(eng.submitted) == 2)
+    entered = threading.Event()
+    returned = threading.Event()
+
+    def third():
+        entered.set()
+        w.enqueue(b"c", 1)
+        returned.set()
+
+    t = threading.Thread(target=third, daemon=True)
+    t.start()
+    entered.wait(2.0)
+    time.sleep(0.05)
+    assert not returned.is_set(), "enqueue must block at depth K"
+    eng.complete(0)
+    assert returned.wait(2.0), "completion must unblock the producer"
+    eng.complete(1)
+    _eventually(lambda: len(eng.submitted) == 3)
+    eng.complete(2)
+    w.close()
+    assert w.stats()["transfer_wait_ns"] > 0
+
+
+def test_window_set_depth_live():
+    """Growing the window admits more in-flight transfers immediately;
+    shrinking re-engages backpressure at the new bound."""
+    eng = ManualEngine()
+    w = InflightWindow(1, None, engine=eng)
+    w.enqueue(b"a", 1)
+    _eventually(lambda: len(eng.submitted) == 1)
+    w.set_depth(3)
+    w.enqueue(b"b", 1)  # would deadlock at depth 1
+    w.enqueue(b"c", 1)
+    _eventually(lambda: len(eng.submitted) == 3)
+    for i in range(3):
+        eng.complete(i)
+    w.close()
+    assert w.depth == 3
+    assert w.stats()["inflight_max"] == 3
+
+
+def test_stager_lease_released_at_completion_not_submit():
+    """submit_owned hands the lease's reference to the window: it stays
+    held after submit returns (the transfer reads the slab) and releases
+    only when the bytes land — the fetch thread never blocks on the
+    tunnel, the slab never retires under an in-flight transfer."""
+    from tpubench.mem.slab import SlabPool
+
+    eng = ManualEngine()
+    pool = SlabPool(4096, 4, use_native=False)
+    st = DevicePutStager(
+        0, granule_bytes=1024, cfg=StagingConfig(depth=3),
+        slot_bytes=2048, transfer_engine=eng, device="fake-device",
+    )
+    lease = pool.lease(4096)
+    lease.view()[:] = b"\x05" * 4096
+    st.submit_owned(lease)
+    _eventually(lambda: len(eng.submitted) == 1)
+    time.sleep(0.02)
+    assert pool.leased == 1, "lease must survive submit"
+    eng.complete(0)
+    _eventually(lambda: pool.leased == 0,
+                msg="lease must release at transfer completion")
+    stats = st.finish()
+    assert stats["staged_bytes"] == 4096
+    assert pool.close()["leaked_slabs"] == 0
+
+
+def test_depth_ab_overlap_kills_transfer_wait():
+    """The hermetic depth A/B (acceptance): with a fixed 20 ms transfer
+    clock and a 5 ms producer, depth 3 overlaps transfers the depth-1
+    window must serialize (its producer blocks out delay − fill of every
+    transfer) — transfer_wait_s shrinks, goodput rises,
+    staging_efficiency strictly improves, and transfer wait is no longer
+    the dominant component at depth >= 2."""
+    delay, fill, n = 0.02, 0.005, 6
+
+    def run(depth: int):
+        w = InflightWindow(depth, None, engine=DelayEngine(delay))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            time.sleep(fill)  # the "fetch" filling the next buffer
+            w.enqueue(b"x" * 100, 100)
+        w.close()
+        return w.stats(), time.perf_counter() - t0
+
+    s1, wall1 = run(1)
+    s3, wall3 = run(3)
+    goodput1 = s1["staged_bytes"] / wall1
+    goodput3 = s3["staged_bytes"] / wall3
+    assert wall3 < wall1
+    assert goodput3 > goodput1 * 1.5
+    assert s3["transfer_wait_ns"] < s1["transfer_wait_ns"] / 2
+    assert s1["staging_efficiency"] < 0.35  # serial: waits out transfers
+    assert s3["staging_efficiency"] > s1["staging_efficiency"] + 0.3
+    # transfer_wait no longer dominant at depth >= 2: the wait the fetch
+    # thread still pays is a minority of the transfer flight time.
+    assert s3["transfer_wait_ns"] < 0.5 * s3["transfer_flight_ns"]
+    assert s3["inflight_max"] >= 2
+
+
+def test_overlap_flight_records_stamp_hbm_staged_at_completion():
+    """Journal-ordering satellite: with overlapped submits the stage
+    record's hbm_staged must stamp when the bytes LAND (reaper-side,
+    via flight.adopt_op), never at submit — stage_submit→hbm_staged
+    spans the injected transfer duration and every record stays
+    monotone."""
+    from tpubench.obs.flight import FlightRecorder, monotone
+
+    delay = 0.015
+    rec = FlightRecorder(capacity_per_worker=64)
+    with rec.activate():
+        st = DevicePutStager(
+            0, granule_bytes=1024, cfg=StagingConfig(depth=2),
+            slot_bytes=1024, transfer_engine=DelayEngine(delay),
+            device="fake-device",
+        )
+        st.submit(memoryview(bytes(3 * 1024)))
+        st.finish()
+    records = [r for r in rec.records() if r["kind"] == "stage"]
+    assert len(records) == 3
+    for r in records:
+        ph = r["phases"]
+        assert monotone(r), ph
+        assert "stage_submit" in ph and "stage_complete" in ph
+        assert ph["hbm_staged"] == ph["stage_complete"]
+        flight_ns = ph["hbm_staged"] - ph["stage_submit"]
+        assert flight_ns >= delay * 0.9 * 1e9, (
+            "hbm_staged stamped before the bytes landed"
+        )
+
+
+def test_stager_registry_replays_commanded_depth():
+    """The read workload's stagers attach AFTER the controller may have
+    moved the knob: a late attacher must join the tuned operating point,
+    and set_depth fans out to every attached ring."""
+
+    class _FakeStager:
+        def __init__(self):
+            self.depth = 3
+
+        def set_depth(self, d):
+            self.depth = int(d)
+            return self.depth
+
+    reg = StagerRegistry()
+    a = _FakeStager()
+    reg.attach(a)
+    reg.set_depth(6)
+    assert a.depth == 6
+    b = _FakeStager()
+    reg.attach(b)  # attaches after the command: replayed
+    assert b.depth == 6
+    assert len(reg) == 2
+
+
+def test_locked_sink_forwards_overlap_surface():
+    """Satellite: LockedSink must forward the whole stager surface —
+    finish() stats (incl. the new depth/overlap counters), set_depth,
+    submit_owned and flush — so concurrent-producer runs don't lose
+    staging metrics or tunability behind the wrapper."""
+    from tpubench.mem.slab import SlabPool
+    from tpubench.staging.device import LockedSink
+
+    eng = ManualEngine()
+    pool = SlabPool(2048, 2, use_native=False)
+    st = DevicePutStager(
+        0, granule_bytes=512, cfg=StagingConfig(depth=2),
+        slot_bytes=1024, transfer_engine=eng, device="fake-device",
+    )
+    sink = LockedSink(st)
+    assert sink.overlapped
+    assert sink.set_depth(4) == 4
+    assert sink.depth == 4
+    lease = pool.lease(1024)
+    lease.view()[:] = b"\x09" * 1024
+    sink.submit_owned(lease)
+    sink.submit(memoryview(bytes(1024)))
+    _eventually(lambda: len(eng.submitted) == 2)
+    eng.complete(0)
+    eng.complete(1)
+    stats = sink.finish()
+    assert stats["staged_bytes"] == 2048
+    assert stats["drain"] == "overlap"
+    assert stats["depth"] == 4
+    assert "inflight_max" in stats and "staging_efficiency" in stats
+    assert pool.close()["leaked_slabs"] == 0
+
+
+def test_staging_depth_knob_actuates_stager_live():
+    """Acceptance: --staging-depth is live-tunable by the PR 5
+    controller — the knob's actuate path moves a real stager's window
+    depth mid-run (train-ingest wiring passes stager.set_depth as the
+    knob setter)."""
+    from tpubench.tune.controller import Knob, staging_depth_ceiling
+
+    eng = ManualEngine()
+    st = DevicePutStager(
+        0, granule_bytes=512, cfg=StagingConfig(depth=2),
+        slot_bytes=512, transfer_engine=eng, device="fake-device",
+    )
+    knob = Knob(
+        "staging_depth", st.depth, st.set_depth,
+        lo=1, hi=staging_depth_ceiling(st.depth), mode="mul",
+    )
+    cand = knob.candidate(+1)
+    assert cand == 4
+    knob.actuate(cand)
+    assert st.depth == 4
+    knob.actuate(1)
+    assert st.depth == 1  # shrink: retires as transfers land
+    st.finish()
+
+
+def test_pipeline_config_rejects_depth_over_pool_budget():
+    """Satellite: staging_depth × slab bytes above the explicit slab-pool
+    budget fails at validate time with one line — not as counted
+    overflow leases an hour into a run."""
+    from tpubench.config import MB, PipelineConfig, validate_pipeline_config
+
+    pc = PipelineConfig(slab_bytes=2 * MB, pool_slabs=2)
+    staging = StagingConfig(depth=3)
+    with pytest.raises(SystemExit, match="slab-pool budget"):
+        validate_pipeline_config(pc, staging=staging)
+    # Enough pool room, or no explicit sizing, or staging off: accepted.
+    validate_pipeline_config(PipelineConfig(slab_bytes=2 * MB, pool_slabs=4),
+                             staging=staging)
+    validate_pipeline_config(PipelineConfig(), staging=staging)
+    validate_pipeline_config(pc, staging=StagingConfig(mode="none", depth=3))
+    validate_pipeline_config(pc)  # no staging context: pipeline-only checks
+    # Scope: configs that can never hold in-flight leases are accepted —
+    # the pod path builds no stager, pallas stages synchronously, and
+    # validation forces the serial ring.
+    validate_pipeline_config(
+        PipelineConfig(slab_bytes=2 * MB, pool_slabs=2, pod=True),
+        staging=staging,
+    )
+    validate_pipeline_config(
+        pc, staging=StagingConfig(mode="pallas", depth=3)
+    )
+    validate_pipeline_config(
+        pc, staging=StagingConfig(depth=3, validate_checksum=True)
+    )
+
+
+def test_staging_depth_ceiling_capped_by_pool():
+    """An explicitly sized slab pool caps the depth ceiling — neither
+    the sweep ladder nor a live grow probe may drive the window past
+    the budget validate_pipeline_config enforces (a depth cell above it
+    would SystemExit inside run_train_ingest and kill the whole
+    sweep)."""
+    from tpubench.config import MB, BenchConfig
+    from tpubench.tune.controller import staging_depth_ceiling
+    from tpubench.workloads.tune_cmd import sweep_axes
+
+    assert staging_depth_ceiling(3) == 6
+    assert staging_depth_ceiling(3, pool_slabs=3) == 3
+    assert staging_depth_ceiling(3, pool_slabs=0) == 6  # unsized: free
+
+    cfg = BenchConfig()
+    cfg.tune.knobs = ["staging_depth"]
+    cfg.pipeline.slab_bytes = 2 * MB
+    cfg.pipeline.pool_slabs = 3
+    cfg.staging.depth = 3
+    axes = sweep_axes(cfg, "train-ingest")
+    assert max(axes["staging_depth"]) <= 3
+    # The read workload holds no slab leases in the window: uncapped.
+    assert max(sweep_axes(cfg, "read")["staging_depth"]) > 3
+
+
+def test_set_depth_noop_after_finish(jax_cpu_devices):
+    """A tune grow fanned onto an already-finished stager (workers
+    finish at their own pace while the controller keeps probing) must
+    not allocate slot buffers nothing will ever free."""
+    st = DevicePutStager(0, granule_bytes=64, depth=3, slot_bytes=256)
+    st.submit(memoryview(bytes(range(64))))
+    st.finish()
+    before = len(st._slots)
+    assert st.set_depth(8) == st.depth  # no grow after teardown
+    assert len(st._slots) == before
+    assert st._native_bufs == []
+
+
+def test_cli_rejects_depth_over_pool_budget_and_bad_depth():
+    from tpubench.cli import main
+    from tpubench.config import MB
+
+    with pytest.raises(SystemExit, match="slab-pool budget"):
+        main(["read", "--pool-slabs", "2", "--slab-bytes", str(2 * MB),
+              "--staging-depth", "3", "--save-config", "/dev/null"])
+    with pytest.raises(SystemExit, match="must be >= 1"):
+        main(["read", "--staging-depth", "0", "--save-config", "/dev/null"])
+
+
+def test_cli_staging_depth_flag_folds_into_config(tmp_path):
+    import json
+
+    from tpubench.cli import main
+
+    out = tmp_path / "cfg.json"
+    main(["read", "--staging-depth", "5", "--save-config", str(out)])
+    cfg = json.loads(out.read_text())
+    assert cfg["staging"]["depth"] == 5
+
+
+def test_train_ingest_staging_block_and_zero_copy(jax_cpu_devices):
+    """End-to-end: train-ingest through the overlapped stager stages
+    slab leases directly (consumer refs released at completion — no
+    leaks), reports extra['staging'] with the in-flight gauge, and the
+    copies-per-byte contract still holds at exactly 1.0."""
+    from tpubench.workloads.train_ingest import run_train_ingest
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 2
+    cfg.workload.threads = 2
+    cfg.workload.object_size = 256 * 1024
+    cfg.workload.granule_bytes = 64 * 1024
+    cfg.pipeline.steps = 4
+    cfg.pipeline.batch_shards = 2
+    cfg.pipeline.readahead = 2
+    res = run_train_ingest(cfg)
+    stg = res.extra.get("staging")
+    assert stg is not None
+    assert stg["drain"] == "overlap"
+    assert stg["transfer_inflight"]["max"] >= 1
+    assert stg["staged_bytes"] == res.bytes_total
+    copies = res.extra["pipeline"]["copies"]
+    assert copies["copies_per_byte"] == 1.0
+    assert copies["pool"]["leaked_slabs"] == 0

@@ -88,8 +88,9 @@ def gap_breakdown(pair: dict, host_fetch_gbps: float) -> dict:
         put = bd.get("put_submit_s", 0.0)
         out["wall_s"] = round(wall, 4)
         out["transfer_wait_frac"] = round(wait / wall, 4)
-        if bd.get("drain") == "thread":
-            # The DRAINER owns submission+completion: its time runs
+        if bd.get("drain") in ("thread", "overlap"):
+            # The REAPER (or the legacy drainer, in pre-PR-6 result
+            # files) owns submission+completion: its time runs
             # concurrently with fetch, so it gets its own name and is
             # never subtracted from the fetch thread's wall (doing so
             # would make the fractions sum past 1 and lie about fetch).

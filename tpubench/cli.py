@@ -54,10 +54,16 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--open-files", type=int)
     p.add_argument("--staging", choices=("none", "device_put", "pallas"))
     p.add_argument("--no-double-buffer", action="store_true")
+    p.add_argument("--staging-depth", type=int, dest="staging_depth",
+                   help="in-flight staging window: how many host→HBM "
+                        "transfers the overlapped executor keeps pending "
+                        "at once, completed out of order (1 = fully "
+                        "synchronous; default 3; live-tunable via the "
+                        "staging_depth tune knob)")
     p.add_argument("--staging-drain", choices=("inline", "thread"),
-                   help="who completes in-flight host→HBM transfers: the "
-                        "fetch thread (inline) or a per-worker drainer "
-                        "thread (true fetch∥transfer overlap)")
+                   help="DEPRECATED no-op (kept for old scripts): depth>1 "
+                        "always rides the overlapped staging executor "
+                        "now; use --staging-depth 1 for the serial ring")
     p.add_argument("--validate", action="store_true", help="on-device checksum")
     p.add_argument("--enable-tracing", action="store_true")
     p.add_argument("--trace-sample-rate", type=float)
@@ -217,7 +223,7 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--tune-knobs",
                    help="comma list of knobs the controller may actuate "
                         "(default: workers,readahead,readahead_bytes,"
-                        "prefetch_workers,hedge_delay_s)")
+                        "prefetch_workers,hedge_delay_s,staging_depth)")
     p.add_argument("--tune-profile",
                    help="tune profile JSON: `tpubench tune` WRITES the "
                         "recommended operating point here; every other "
@@ -302,6 +308,13 @@ def build_config(args) -> BenchConfig:
         s.mode = args.staging
     if getattr(args, "staging_drain", None):
         s.drain = args.staging_drain
+    if getattr(args, "staging_depth", None) is not None:
+        if args.staging_depth < 1:
+            raise SystemExit(
+                f"--staging-depth {args.staging_depth}: must be >= 1 "
+                "(1 = fully synchronous staging)"
+            )
+        s.depth = args.staging_depth
     if args.no_double_buffer:
         s.double_buffer = False
     if args.validate:
@@ -408,7 +421,7 @@ def build_config(args) -> BenchConfig:
         pl.slab_pool = False
     from tpubench.config import validate_pipeline_config
 
-    validate_pipeline_config(pl)
+    validate_pipeline_config(pl, staging=s)
     tn = cfg.tune
     if getattr(args, "tune", False):
         tn.enabled = True
@@ -946,6 +959,10 @@ def main(argv=None) -> int:
 
             res = run_train_ingest(cfg)
             print(format_pipeline_scorecard(res.extra["pipeline"]))
+            if res.extra.get("staging"):
+                from tpubench.staging.stats import format_staging_block
+
+                print(format_staging_block(res.extra["staging"]))
         elif args.cmd == "pod-ingest":
             res = cmd_pod_ingest(cfg, args)
         elif args.cmd == "stream":

@@ -308,9 +308,11 @@ class PipelineConfig:
 
 
 def validate_pipeline_config(pc: "PipelineConfig",
-                             where: str = "pipeline") -> None:
+                             where: str = "pipeline",
+                             staging: "StagingConfig" = None) -> None:
     """Parse-time sanity for the pipeline knobs (same one-line SystemExit
-    style as validate_fault_config)."""
+    style as validate_fault_config). With ``staging`` supplied, also
+    cross-checks the overlapped staging window against the slab pool."""
     for name, lo in (
         ("cache_bytes", 0), ("readahead", 0), ("readahead_bytes", 0),
         ("prefetch_workers", 1), ("steps", 1), ("epochs", 1),
@@ -324,6 +326,34 @@ def validate_pipeline_config(pc: "PipelineConfig",
         v = getattr(pc, name)
         if not (v >= 0):  # also rejects NaN
             raise SystemExit(f"{where}.{name}={v!r}: must be >= 0")
+    if (
+        staging is not None and staging.mode == "device_put"
+        and staging.double_buffer and not staging.validate_checksum
+        and not pc.pod and pc.slab_pool
+        and pc.pool_slabs > 0 and pc.slab_bytes > 0
+    ):
+        # Scope: only the device_put overlapped window holds leases past
+        # submit — pallas stages synchronously, validation forces the
+        # serial ring, and the pod path never builds a stager at all.
+        # The overlapped executor holds one chunk lease per in-flight
+        # transfer until the bytes LAND (not until submit returns), so an
+        # explicitly-sized pool must have room for the in-flight window
+        # on top of the cache's working set. Without this check the
+        # misconfiguration only surfaces as counted overflow leases
+        # mid-run — an hour in, as pool-pressure noise, not as the
+        # config error it is.
+        depth = max(1, staging.depth)
+        inflight = depth * pc.slab_bytes
+        budget = pc.pool_slabs * pc.slab_bytes
+        if inflight > budget:
+            raise SystemExit(
+                f"staging.depth={depth} × {where}.slab_bytes="
+                f"{pc.slab_bytes} = {inflight} B of in-flight leases "
+                f"exceeds the slab-pool budget ({where}.pool_slabs="
+                f"{pc.pool_slabs} × {pc.slab_bytes} = {budget} B): every "
+                "overlapped transfer would overflow-lease — raise "
+                "--pool-slabs or lower --staging-depth"
+            )
     # The cross-field readahead/cache/chunk checks live in
     # run_train_ingest, where the effective chunk size is known AND only
     # the workload that actually constructs the pipeline pays them —
@@ -340,6 +370,7 @@ TUNE_KNOBS = (
     "readahead_bytes",
     "prefetch_workers",
     "hedge_delay_s",
+    "staging_depth",
 )
 
 
@@ -528,9 +559,11 @@ class StagingConfig:
     mode: str = "device_put"  # "none" (host RAM, reference parity) |
     # "device_put" | "pallas"
     double_buffer: bool = True  # overlap fetch with host→HBM DMA
-    # Slot ring depth when overlapping (double_buffer=True): how many slots
-    # can be in flight to HBM while the fetcher fills the next one.
-    # double_buffer=False forces a fully synchronous single slot.
+    # In-flight window depth when overlapping (double_buffer=True): how
+    # many host→HBM transfers the staging executor keeps pending at
+    # once, completing them OUT OF ORDER (staging/executor.py).
+    # double_buffer=False forces a fully synchronous single slot. Live:
+    # the tune controller actuates this via the `staging_depth` knob.
     depth: int = 3
     # Granule-aggregation target: fetched granules are packed into slots of
     # this size and shipped with ONE device_put per slot. Host→HBM transfer
@@ -550,14 +583,13 @@ class StagingConfig:
     # Fetch directly into the staging slot (sink acquire/commit) instead of
     # through a per-worker granule buffer that is then copied to the slot.
     zero_copy: bool = True
-    # Who completes in-flight host→HBM transfers when overlapping:
-    # "inline" — the fetch thread blocks on the oldest transfer at the
-    #   ring's backpressure point (acquire of a busy slot). Transfer-drive
-    #   time serializes with fetch: throughput ≤ harmonic(fetch, tunnel).
-    # "thread" — a per-worker drainer thread owns block_until_ready, so
-    #   fetch and transfer genuinely overlap (both release the GIL):
-    #   throughput → min(fetch, tunnel). Ignored when depth == 1 or
-    #   validate_checksum (validation needs orderly inline drains).
+    # DEPRECATED (kept so old config JSONs still load): depth > 1 now
+    # always rides the overlapped staging executor — a depth-K in-flight
+    # window whose reaper thread submits AND completes transfers out of
+    # order (staging/executor.py) — which supersedes both the old
+    # "inline" (fetch-thread drains) and "thread" (serial drainer)
+    # modes. depth == 1 and validate_checksum keep the serial inline
+    # ring (validation needs orderly drains).
     drain: str = "inline"
     # Shape landed arrays as (granule//lane, lane) uint8 so XLA tiles them;
     # lane=128 matches the TPU lane width.

@@ -73,6 +73,17 @@ class SlabLease:
         immutable snapshot, e.g. integrity checks)."""
         return bytes(self.view())
 
+    def as_numpy(self):
+        """Zero-copy 1-D uint8 numpy view of the leased payload — what
+        the overlapped staging executor ``device_put``s directly, so a
+        chunk goes wire → slab → HBM with no intermediate host copy.
+        The view aliases the slab: it is valid only while the caller's
+        reference is held (the executor's reaper releases at transfer
+        completion, which is exactly that lifetime)."""
+        import numpy as np
+
+        return np.frombuffer(self.view(), dtype=np.uint8)
+
     def incref(self) -> "SlabLease":
         with self._pool._lock:
             if self._refs <= 0:

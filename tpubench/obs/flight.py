@@ -56,6 +56,12 @@ JOURNAL_FORMAT = "tpubench-flight-v1"
 # and stall_begin/stall_end bracket a train-ingest step's data wait — so
 # `report timeline` attributes stalls (the stall_end segment IS the
 # stall duration) the same way it attributes connect/first_byte time.
+# Staging phases (PR 6): the overlapped executor splits a transfer into
+# stage_submit (the device_put left the reaper) and stage_complete (the
+# bytes LANDED in HBM; hbm_staged is stamped at the same instant) — the
+# stage_complete segment IS the transfer's flight time, and with
+# out-of-order completion it is the honest per-transfer quantity a
+# submit-time stamp would have corrupted.
 PHASES = (
     "enqueue",
     "cache_hit",
@@ -67,6 +73,8 @@ PHASES = (
     "body_complete",
     "stall_begin",
     "stall_end",
+    "stage_submit",
+    "stage_complete",
     "hbm_staged",
     "gather_complete",
 )
@@ -521,6 +529,26 @@ def timeline_summary(records: list[dict]) -> dict:
             if n.get("kind") == "slab" and n.get("event") == "overflow"
         ),
     }
+    # Overlapped-staging attribution (PR 6): every host→HBM transfer is a
+    # kind="stage" record whose stage_submit→stage_complete segment is
+    # its flight time, stamped at true completion by the window's reaper
+    # — so the timeline can say how many transfers ran and how many
+    # overlapped-submit records the journal carries.
+    stage_recs = [r for r in records if r.get("kind") == "stage"]
+    staging = {
+        "transfers": len(stage_recs),
+        "transfer_bytes": sum(r.get("bytes", 0) for r in stage_recs),
+        # Window transfers carry an explicit overlap note; the serial
+        # inline ring stamps stage_submit too, so phase presence alone
+        # cannot discriminate overlapped from synchronous transfers.
+        "overlapped": sum(
+            1 for r in stage_recs
+            if any(
+                n.get("kind") == "stage" and n.get("event") == "overlap"
+                for n in r.get("notes", ())
+            )
+        ),
+    }
     return {
         "records": len(records),
         "errors": errors,
@@ -528,6 +556,7 @@ def timeline_summary(records: list[dict]) -> dict:
         "tail": tail,
         "tune": tune,
         "pipeline": pipeline,
+        "staging": staging,
         "hosts": sorted({r.get("host", 0) for r in records}),
         "phases": _phase_stats(records),
         "stragglers": {
@@ -593,6 +622,13 @@ def render_timeline(docs: list[dict]) -> str:
                 f" slab_overflows={pipe['slab_overflows']}"
                 if pipe.get("slab_overflows") else ""
             )
+        )
+    stg = summ.get("staging", {})
+    if stg.get("transfers"):
+        lines.append(
+            f"staging: transfers={stg['transfers']} "
+            f"bytes={stg['transfer_bytes']} "
+            f"overlapped={stg['overlapped']}"
         )
     lines.append("phase segments (ms):")
     for name, s in summ["phases"].items():

@@ -4,6 +4,14 @@ aggregation.
 Pipeline shape (per worker): the network reader fills host slot *k* while
 slots *k-1, k-2, …* are in flight to HBM — fetch ∥ DMA overlap, bounded by
 ``depth`` (backpressure blocks the reader when every slot is in flight).
+Depth > 1 rides the overlapped staging executor
+(:mod:`tpubench.staging.executor`): a depth-K in-flight window whose
+reaper thread submits and completes transfers OUT OF ORDER, so the fetch
+thread pays transfer time only as backpressure when all K slots are
+pending — the ``transfer_wait_s``-killing shape BENCH_r05 motivated.
+Depth 1 (and validation mode) keeps the serial inline ring: submit, then
+complete on the fetch thread — the A/B comparator the depth sweep
+measures the executor against.
 
 Granule aggregation: fetch granules (reference: 2 MB, main.go:123-125) are
 packed into ``slot_bytes``-sized slots and shipped with ONE ``device_put``
@@ -31,7 +39,6 @@ are zero-padded at launch so the device sum sees only real bytes.
 
 from __future__ import annotations
 
-import queue
 import threading
 import time
 from typing import Callable, Optional
@@ -45,6 +52,8 @@ from tpubench.config import BenchConfig, StagingConfig
 from tpubench.mem.slab import SlabLease
 from tpubench.metrics.recorder import LatencyRecorder
 from tpubench.obs import flight as _flight
+from tpubench.staging.executor import InflightWindow, TransferEngine
+from tpubench.staging.stats import staging_efficiency
 
 
 @jax.jit
@@ -143,6 +152,7 @@ class DevicePutStager(GranuleAggregator):
         device=None,
         depth: Optional[int] = None,
         slot_bytes: Optional[int] = None,
+        transfer_engine: Optional[TransferEngine] = None,
     ):
         cfg = cfg or StagingConfig()
         self.cfg = cfg
@@ -163,121 +173,137 @@ class DevicePutStager(GranuleAggregator):
         slot_bytes = max(slot_bytes, granule_bytes)
         self._slot_bytes = ((slot_bytes + lane - 1) // lane) * lane
         self._shape = (self._slot_bytes // lane, lane)
-        self._native_bufs = []
-        self._slots = []
-        engine = None
+        self._alloc_engine = None
         if cfg.native_slots:
             from tpubench.native.engine import get_engine
 
-            engine = get_engine()
+            self._alloc_engine = get_engine()
+        self._native_bufs = []
+        self._slots: list = []
+        self._slot_views: list[memoryview] = []
+        self._lane = lane
         for _ in range(depth):
-            if engine is not None:
-                buf = engine.alloc(self._slot_bytes)
-                self._native_bufs.append(buf)
-                arr = buf.as_2d(lane)
-                arr[:] = 0
-                self._slots.append(arr)
-            else:
-                self._slots.append(np.zeros(self._shape, dtype=np.uint8))
-        self.native_slots = engine is not None
-        self._slot_views = [memoryview(s.reshape(-1)) for s in self._slots]
-        self._futures: list[Optional[jax.Array]] = [None] * depth
-        self._submit_ns = [0] * depth
-        self._true_bytes = [0] * depth
-        self._k = 0
+            self._alloc_slot()
+        self.native_slots = self._alloc_engine is not None
         self._fill = 0  # bytes of real payload in the current slot
         self.depth = depth
-        self.staged_bytes = 0
         self.transfers = 0
-        # Phase accounting for the pipeline-gap breakdown (round-5 task
-        # #1). transfer_wait_ns is always FETCH-THREAD time blocked on
-        # transfers (backpressure waits + inline drains). put_submit_ns
-        # semantics depend on the drain mode: inline → fetch-thread time
-        # inside device_put (wall − wait − submit ≈ fetch+overhead, and
-        # the depth-1 serial model falls out); thread → DRAINER-thread
-        # time in submit+start, CONCURRENT with fetch (never subtract it
-        # from the fetch thread's wall — gap_breakdown branches on the
-        # reported drain mode).
-        self.transfer_wait_ns = 0
-        self.put_submit_ns = 0
         self.stage_recorder = LatencyRecorder(f"w{worker_id}/stage")
-        # Flight recorder: one record per SLOT transfer (enqueue →
-        # hbm_staged) on the run's ambient recorder. Slot records are the
-        # honest per-phase hbm_staged source — slots aggregate granules
-        # across reads, so a per-READ hbm_staged stamp would be fiction.
-        # Ring ownership: inline drains run on the fetch thread, threaded
-        # drains on the drainer — exactly one appender either way.
+        # Flight recorder: one record per transfer (enqueue → stage_submit
+        # → stage_complete/hbm_staged) on the run's ambient recorder.
+        # Slot records are the honest per-phase hbm_staged source — slots
+        # aggregate granules across reads, so a per-READ hbm_staged stamp
+        # would be fiction. Ring ownership: serial drains append on the
+        # fetch thread, overlapped completions on the window's reaper —
+        # exactly one appender either way.
         self._flight = _flight.active_worker(f"w{worker_id}/stage")
         self._validate = cfg.validate_checksum
         self._host_sum = np.uint64(0)
         self._dev_sum = None
         if self._validate:
             self._dev_sum = jax.device_put(jnp.zeros((), jnp.uint32), self.device)
-        # Threaded drain: a per-worker drainer owns block_until_ready so the
-        # fetch thread never pays transfer-completion time (both sides
-        # release the GIL → true fetch ∥ transfer overlap). Validation keeps
-        # inline drains: the checksum accumulate must read the landed array
-        # before the slot is reused, which is an ordering the ring's inline
-        # backpressure provides for free.
-        self._drain_thread = (
-            cfg.drain == "thread" and depth > 1 and not self._validate
-        )
-        self._drain_q: Optional[queue.Queue] = None
-        self._drain_err: Optional[BaseException] = None
-        self._slot_free: list[threading.Event] = []
-        self._drainer: Optional[threading.Thread] = None
-        if self._drain_thread:
-            self._drain_q = queue.Queue()
-            self._slot_free = [threading.Event() for _ in range(depth)]
-            for e in self._slot_free:
-                e.set()
-            self._drainer = threading.Thread(
-                target=self._drain_loop, name=f"w{worker_id}-drain", daemon=True
+        # Overlapped executor (staging/executor.py): a depth-K in-flight
+        # window whose reaper submits and completes transfers out of
+        # order. Validation keeps the serial inline ring: the checksum
+        # accumulate must read the landed array before the slot is
+        # reused, an ordering inline backpressure provides for free.
+        # Depth 1 is the fully synchronous comparator by definition.
+        self._overlap = depth > 1 and not self._validate
+        # Public: workloads branch on this (an overlapped submit returns
+        # before the bytes land, so a step-level hbm_staged stamp at
+        # submit time would be fiction — the window's per-transfer
+        # records carry the honest completion stamp instead).
+        self.overlapped = self._overlap
+        self._window: Optional[InflightWindow] = None
+        # Serial-path state (depth 1 / validation).
+        self._futures: list[Optional[jax.Array]] = [None] * depth
+        self._submit_ns = [0] * depth
+        self._true_bytes = [0] * depth
+        self._k = 0
+        self.staged_bytes = 0
+        # Phase accounting for the pipeline-gap breakdown. transfer_wait_ns
+        # is always FETCH-THREAD time blocked on transfers (backpressure
+        # waits + inline drains). put_submit_ns semantics depend on the
+        # mode: inline → fetch-thread time inside device_put; overlap →
+        # REAPER-thread time in submission, CONCURRENT with fetch (never
+        # subtract it from the fetch thread's wall — gap_breakdown
+        # branches on the reported drain mode).
+        self.transfer_wait_ns = 0
+        self.put_submit_ns = 0
+        self.transfer_flight_ns = 0
+        self._inflight_samples: list[int] = []
+        if self._overlap:
+            self._window = InflightWindow(
+                depth, self.device,
+                engine=transfer_engine or TransferEngine(),
+                stage_recorder=self.stage_recorder,
+                flight_ring=self._flight,
+                name=f"w{worker_id}",
             )
-            self._drainer.start()
+            # Free-slot pool (out-of-order: availability is a free list,
+            # not a rotation); the window's reaper returns slots here.
+            self._free_cond = threading.Condition()
+            self._free: list[int] = list(range(depth))
+            self._retired: list[int] = []
+            self._slot_count = depth
+            self._target_depth = depth
+            self._cur: Optional[int] = None
+            self._closed = False
+
+    # ------------------------------------------------------------ slots ----
+    def _alloc_slot(self) -> int:
+        """Allocate one slot buffer (pinned native when available) and
+        return its index."""
+        if self._alloc_engine is not None:
+            buf = self._alloc_engine.alloc(self._slot_bytes)
+            self._native_bufs.append(buf)
+            arr = buf.as_2d(self._lane)
+            arr[:] = 0
+        else:
+            arr = np.zeros(self._shape, dtype=np.uint8)
+        self._slots.append(arr)
+        self._slot_views.append(memoryview(arr.reshape(-1)))
+        return len(self._slots) - 1
+
+    def _release_slot(self, k: int) -> None:
+        """Reaper callback: the slot's transfer settled (out of order)."""
+        with self._free_cond:
+            if self._slot_count > self._target_depth:
+                # A live shrink retires slots as their transfers land
+                # (buffers stay allocated until finish — freeing under a
+                # possible in-flight alias would be worse than the RAM).
+                self._retired.append(k)
+                self._slot_count -= 1
+            else:
+                self._free.append(k)
+            self._free_cond.notify_all()
+
+    def set_depth(self, depth: int) -> int:
+        """Live depth actuation (the ``staging_depth`` tune knob; no-op
+        narrowing to clamp on the serial path, which has no window)."""
+        depth = max(1, int(depth))
+        if not self._overlap:
+            return self.depth
+        with self._free_cond:
+            if self._closed:
+                # Workers finish at their own pace while the controller
+                # keeps probing: a grow fanned onto a torn-down stager
+                # must not allocate pinned buffers nothing will free.
+                return self.depth
+            self._target_depth = depth
+            while self._slot_count < depth:
+                k = self._retired.pop() if self._retired else self._alloc_slot()
+                self._slot_count += 1
+                self._free.append(k)
+            while self._slot_count > depth and self._free:
+                self._retired.append(self._free.pop())
+                self._slot_count -= 1
+            self._free_cond.notify_all()
+        self._window.set_depth(depth)
+        self.depth = depth
+        return depth
 
     # ------------------------------------------------------------ pipeline --
-    def _drain_loop(self) -> None:
-        """Drainer thread: SUBMITS and completes transfers in launch
-        order. Submission lives here, not in ``_launch``, because on some
-        runtimes (measured: the tunneled axon backend) ``device_put``
-        performs the whole transfer inside the submission call — a
-        fetch-thread submit would serialize fetch and transfer exactly
-        like the depth-1 ring and the "overlap" label would buy nothing.
-        Both sides release the GIL in their hot paths (numpy/socket copies
-        here, PJRT transfer there), so fetch ∥ transfer is real. All
-        accounting this thread mutates is read by the fetch thread only
-        after :meth:`finish` joins it."""
-        assert self._drain_q is not None
-        while True:
-            item = self._drain_q.get()
-            if item is None:
-                return
-            k, nbytes, enqueue_ns = item
-            try:
-                submit_ns = time.perf_counter_ns()
-                fut = jax.device_put(self._slots[k], self.device)
-                self.put_submit_ns += time.perf_counter_ns() - submit_ns
-                fut.block_until_ready()
-                # Stage latency from ENQUEUE, not dequeue: with overlap
-                # the queueing behind earlier slots is part of the
-                # quantity that sizes the pipeline (module docstring).
-                done_ns = time.perf_counter_ns()
-                self.stage_recorder.record_ns(done_ns - enqueue_ns)
-                if self._flight is not None:
-                    op = self._flight.begin(
-                        "slot", "device_put", enqueue_ns=enqueue_ns,
-                        install=False, kind="stage",
-                    )
-                    op.mark("hbm_staged", done_ns)
-                    op.finish(nbytes)
-                self.staged_bytes += nbytes
-            except BaseException as e:  # re-raised at the next acquire
-                if self._drain_err is None:
-                    self._drain_err = e
-            finally:
-                self._slot_free[k].set()
-
     def _drain_slot(self, k: int) -> None:
         fut = self._futures[k]
         if fut is None:
@@ -286,12 +312,15 @@ class DevicePutStager(GranuleAggregator):
         fut.block_until_ready()
         done_ns = time.perf_counter_ns()
         self.transfer_wait_ns += done_ns - t0
+        self.transfer_flight_ns += done_ns - self._submit_ns[k]
         self.stage_recorder.record_ns(done_ns - self._submit_ns[k])
         if self._flight is not None:
             op = self._flight.begin(
                 "slot", "device_put", enqueue_ns=self._submit_ns[k],
                 install=False, kind="stage",
             )
+            op.mark("stage_submit", self._submit_ns[k])
+            op.mark("stage_complete", done_ns)
             op.mark("hbm_staged", done_ns)
             op.finish(self._true_bytes[k])
         self.staged_bytes += self._true_bytes[k]
@@ -305,30 +334,42 @@ class DevicePutStager(GranuleAggregator):
         self._futures[k] = None
 
     def _launch(self) -> None:
-        """Ship the current slot (``_fill`` real bytes) to HBM and rotate
-        the ring. The next slot's prior transfer is drained lazily by the
-        next :meth:`acquire` — the backpressure point."""
+        """Ship the current slot (``_fill`` real bytes) to HBM. Overlap:
+        hand the filled slot to the window (reaper submits + completes;
+        the fetch thread pays neither). Serial: submit inline and drain
+        lazily at the next :meth:`acquire` — the old backpressure
+        point."""
+        nbytes = self._fill
+        self.transfers += 1
+        if self._overlap:
+            k = self._cur
+            slot = self._slots[k]
+            if nbytes < self._slot_bytes:
+                slot.reshape(-1)[nbytes:] = 0
+            self._fill = 0
+            self._cur = None
+            self._window.enqueue(
+                slot, nbytes,
+                on_complete=lambda k=k: self._release_slot(k),
+                label="slot",
+            )
+            return
         k = self._k
         slot = self._slots[k]
-        if self._fill < self._slot_bytes:
+        if nbytes < self._slot_bytes:
             # Partial slot (end of run / oversized granule remainder): zero
             # the tail so checksum/pad semantics stay exact. Full slots —
             # the steady state — skip this memset.
-            slot.reshape(-1)[self._fill :] = 0
-        self.transfers += 1
-        if self._drain_thread:
-            # Hand the FILLED slot to the drainer, which submits AND
-            # completes the transfer (see _drain_loop): the fetch thread
-            # pays neither, only the slot_free backpressure wait.
-            self._slot_free[k].clear()
-            self._drain_q.put((k, self._fill, time.perf_counter_ns()))
-        else:
-            submit_ns = time.perf_counter_ns()
-            fut = jax.device_put(slot, self.device)
-            self.put_submit_ns += time.perf_counter_ns() - submit_ns
-            self._submit_ns[k] = submit_ns
-            self._futures[k] = fut
-            self._true_bytes[k] = self._fill
+            slot.reshape(-1)[nbytes:] = 0
+        submit_ns = time.perf_counter_ns()
+        fut = jax.device_put(slot, self.device)
+        self.put_submit_ns += time.perf_counter_ns() - submit_ns
+        self._submit_ns[k] = submit_ns
+        self._futures[k] = fut
+        self._true_bytes[k] = nbytes
+        self._inflight_samples.append(
+            sum(1 for f in self._futures if f is not None)
+        )
         self._fill = 0
         self._k = (k + 1) % self.depth
         if self.depth == 1:
@@ -336,25 +377,53 @@ class DevicePutStager(GranuleAggregator):
             # before the fetcher can touch the slot again.
             self._drain_slot(k)
 
-    def _free_view(self) -> memoryview:
-        """Completing the current slot's prior in-flight transfer here is
-        the ring's backpressure point (wait on the drainer, or drain
-        inline)."""
-        k = self._k
-        if self._drain_thread:
-            if not self._slot_free[k].is_set():
-                t0 = time.perf_counter_ns()
-                self._slot_free[k].wait()
+    def _acquire_slot(self) -> int:
+        """Overlap path: a free slot to fill, blocking (= backpressure)
+        while every slot's transfer is still pending."""
+        with self._free_cond:
+            t0 = None
+            while not self._free:
+                if self._window.error is not None:
+                    break
+                if t0 is None:
+                    t0 = time.perf_counter_ns()
+                # Short timeout: a direct-lease transfer failure frees no
+                # slot, so the error check above must get to run.
+                self._free_cond.wait(0.05)
+            if t0 is not None:
                 self.transfer_wait_ns += time.perf_counter_ns() - t0
-            if self._drain_err is not None:
-                # A failed transfer must abort the fetch NOW: the drainer
-                # frees slots on failure (no deadlock), so without this
-                # check backpressure never engages and a dead device
-                # would let the fetch burn the whole measurement window.
-                raise self._drain_err
-        else:
-            self._drain_slot(k)
+            self._window.raise_if_failed()
+            return self._free.pop()
+
+    def _free_view(self) -> memoryview:
+        """The ring's backpressure point: a slot to fill, waiting out (or
+        inline-draining) a prior transfer when none is free."""
+        if self._overlap:
+            if self._cur is None:
+                self._cur = self._acquire_slot()
+            return self._slot_views[self._cur][self._fill :]
+        k = self._k
+        self._drain_slot(k)
         return self._slot_views[k][self._fill :]
+
+    def submit_owned(self, lease: SlabLease, label: str = "chunk") -> None:
+        """Direct zero-copy staging of a pinned slab lease: the transfer
+        reads straight out of the slab — no slot copy — and the LEASE'S
+        reference (which the caller hands over) is released by the
+        window's reaper only when the bytes have landed, never at
+        submit. Serial path (depth 1 / validation): degrade to the
+        copying slot path, releasing after the synchronous fill."""
+        if not self._overlap:
+            try:
+                self.submit(lease)
+            finally:
+                lease.release()
+            return
+        self.transfers += 1
+        self._window.enqueue(
+            lease.as_numpy(), len(lease), on_complete=lease.release,
+            label=label,
+        )
 
     def _precommit(self, n: int) -> None:
         if self._validate:
@@ -363,7 +432,7 @@ class DevicePutStager(GranuleAggregator):
             self._host_sum += chunk.sum(dtype=np.uint64)
 
     def finish(self) -> dict:
-        # Slot buffers are released even when a drain failed (a failed
+        # Slot buffers are released even when a transfer failed (a failed
         # worker must not leak depth × slot_bytes of pinned native memory
         # while the run's other failure domains keep going) — but only
         # after every in-flight transfer has settled, failed or not, so no
@@ -373,17 +442,16 @@ class DevicePutStager(GranuleAggregator):
             self.flush()
         except BaseException as e:
             err = e
-        if self._drain_thread:
-            # The tail of the transfer time is paid here (waiting for the
-            # drainer to complete in-flight slots): without counting it,
-            # the overlap config's gap breakdown would report near-zero
-            # transfer wait and dump all transfer time into "fetch".
-            t0 = time.perf_counter_ns()
-            self._drain_q.put(None)
-            self._drainer.join()
-            self.transfer_wait_ns += time.perf_counter_ns() - t0
+        if self._overlap:
+            with self._free_cond:
+                self._closed = True  # registry grows become no-ops
+            # The tail of the transfer time is paid inside close()'s
+            # drain: without counting it, the overlap config's gap
+            # breakdown would report near-zero transfer wait and dump
+            # all transfer time into "fetch".
+            self._window.close()
             if err is None:
-                err = self._drain_err
+                err = self._window.error
         else:
             for k in range(self.depth):
                 try:
@@ -399,17 +467,47 @@ class DevicePutStager(GranuleAggregator):
         if err is not None:
             raise err
         stats = {
-            "staged_bytes": self.staged_bytes,
-            "transfers": self.transfers,
             "slot_bytes": self._slot_bytes,
             "n_chips": self.n_chips,
             "native_slots": self.native_slots,
-            "drain": "thread" if self._drain_thread else "inline",
+            "drain": "overlap" if self._overlap else "inline",
             "stage_recorder": self.stage_recorder,
             "device": str(self.device),
-            "transfer_wait_ns": self.transfer_wait_ns,
-            "put_submit_ns": self.put_submit_ns,
+            "depth": self.depth,
+            "transfers": self.transfers,
         }
+        if self._overlap:
+            w = self._window.stats()
+            self.staged_bytes = w["staged_bytes"]
+            self.transfer_wait_ns = w["transfer_wait_ns"] + self.transfer_wait_ns
+            self.put_submit_ns = w["put_submit_ns"]
+            self.transfer_flight_ns = w["transfer_flight_ns"]
+            stats.update({
+                "staged_bytes": self.staged_bytes,
+                "transfer_wait_ns": self.transfer_wait_ns,
+                "put_submit_ns": self.put_submit_ns,
+                "transfer_flight_ns": self.transfer_flight_ns,
+                "inflight_p50": w["inflight_p50"],
+                "inflight_max": w["inflight_max"],
+                "out_of_order_completions": w["out_of_order_completions"],
+            })
+        else:
+            samples = np.asarray(
+                self._inflight_samples or [0], dtype=np.int64
+            )
+            stats.update({
+                "staged_bytes": self.staged_bytes,
+                "transfer_wait_ns": self.transfer_wait_ns,
+                "put_submit_ns": self.put_submit_ns,
+                "transfer_flight_ns": self.transfer_flight_ns,
+                "inflight_p50": float(np.percentile(samples, 50)),
+                "inflight_max": int(samples.max()),
+                "out_of_order_completions": 0,
+            })
+        stats["staging_efficiency"] = staging_efficiency(
+            stats["transfer_wait_ns"], stats["put_submit_ns"],
+            stats["transfer_flight_ns"], self._overlap,
+        )
         if self._validate:
             dev = int(jax.device_get(self._dev_sum))
             host = int(self._host_sum % np.uint64(2**32))
@@ -454,7 +552,37 @@ class LockedSink:
         with self._lock:
             self._inner.submit(mv)
 
+    def submit_owned(self, lease, label: str = "chunk") -> None:
+        """Direct lease staging stays atomic too: the enqueue mutates the
+        window's credit state, and the wrapped stager's transfer counter,
+        under the same lock as slot submits."""
+        with self._lock:
+            self._inner.submit_owned(lease, label=label)
+
+    def flush(self) -> None:
+        with self._lock:
+            self._inner.flush()
+
+    def set_depth(self, depth: int) -> int:
+        """Depth actuation forwards (the tune knob must reach the real
+        ring through the wrapper). Not under the submit lock: a shrink
+        blocked behind a long submit would stall the controller thread,
+        and the stager's own free-list lock already serializes it."""
+        return self._inner.set_depth(depth)
+
+    @property
+    def depth(self) -> int:
+        return self._inner.depth
+
+    @property
+    def overlapped(self) -> bool:
+        return getattr(self._inner, "overlapped", False)
+
     def finish(self) -> dict:
+        """Forwards the wrapped stager's FULL stats dict — staged bytes,
+        stage recorder, and the overlap counters (depth, in-flight gauge,
+        staging_efficiency) — so concurrent-producer runs don't lose
+        staging metrics behind the wrapper."""
         with self._lock:
             return self._inner.finish()
 
@@ -472,8 +600,13 @@ def budgeted_slot_bytes(cfg: BenchConfig) -> int:
     return max(cfg.workload.granule_bytes, min(s.slot_bytes, per_worker))
 
 
-def make_sink_factory(cfg: BenchConfig) -> Optional[Callable[[int], DevicePutStager]]:
-    """Staging sink factory for the read workload, from config."""
+def make_sink_factory(
+    cfg: BenchConfig,
+) -> Optional[Callable[[int], DevicePutStager]]:
+    """Staging sink factory for the read workload, from config. Live
+    ``staging_depth`` actuation is wired by the read workload itself,
+    which wraps whatever factory it is handed in a
+    :class:`~tpubench.staging.executor.StagerRegistry` attach."""
     mode = cfg.staging.mode
     if mode == "none":
         return None

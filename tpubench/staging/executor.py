@@ -1,0 +1,447 @@
+"""Overlapped staging executor: a depth-K in-flight window over host→HBM
+transfers with out-of-order completion.
+
+BENCH_r05's phase breakdowns showed staging wall time was ~85–90%
+``transfer_wait_s``: the pipeline fetched asynchronously but still
+*waited* on transfers — the depth-1 ring drained inline on the fetch
+thread, and the round-5 drainer completed transfers one at a time in
+launch order, so at most ONE transfer was ever on the tunnel. This module
+replaces both with the DMA-streaming shape (PAPERS.md arXiv 2603.10030):
+keep K transfers in flight simultaneously and complete them in whatever
+order the tunnel finishes them.
+
+:class:`InflightWindow` is the core: producers (the stager's fetch
+thread) ``enqueue`` filled buffers; a single **reaper** thread submits
+the ``jax.device_put`` calls (submission must not run on the fetch
+thread — on some runtimes, measured on the tunneled axon backend, the
+whole transfer happens inside the submission call) and then *polls* the
+per-slot futures (``jax.Array.is_ready``), finalizing whichever transfer
+lands first — out-of-order completion into the slot ring. Backpressure
+is the window credit: ``enqueue`` blocks only when all K slots are
+pending, and that blocked time is the run's ``transfer_wait_ns``.
+
+Completion discipline: the completed future is ``.delete()``d
+immediately (HBM is released per transfer, not at GC's leisure), and
+submission passes ``donate=`` when the runtime supports it so XLA never
+re-copies a buffer it can own. Each transfer's resources (a slot to
+free, a slab lease to release) are dropped by the reaper at
+*completion*, never at submit — a lease handed to the window stays alive
+until its bytes have actually landed.
+
+Everything is injectable for tests: :class:`TransferEngine` is the
+submit/probe/wait/delete surface (the deterministic fake in
+``tests/test_staging.py`` drives completion from a test-controlled
+clock), and the clock itself is a parameter.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+import jax
+
+from tpubench.obs import flight as _flight
+from tpubench.staging.stats import staging_efficiency
+
+
+class TransferEngine:
+    """Host→HBM transfer surface the window drives (default: jax).
+
+    ``submit`` starts an async transfer and returns a handle; ``probe``
+    is the non-blocking completion check (None = unsupported on this
+    runtime, which degrades the reaper to in-order blocking waits —
+    never to freeing a buffer a transfer might still read); ``wait``
+    blocks until the bytes have landed; ``delete`` releases the landed
+    device buffer immediately.
+    """
+
+    def __init__(self):
+        self._donate_ok = True
+
+    def submit(self, array, device):
+        if self._donate_ok:
+            try:
+                # Donation lets XLA take ownership instead of re-copying
+                # when the input is donatable; harmless (ignored) for
+                # committed host numpy buffers.
+                return jax.device_put(array, device, donate=True)
+            except TypeError:  # older jax without donate=
+                self._donate_ok = False
+        return jax.device_put(array, device)
+
+    def probe(self, handle) -> Optional[bool]:
+        is_ready = getattr(handle, "is_ready", None)
+        return bool(is_ready()) if is_ready is not None else None
+
+    def wait(self, handle) -> None:
+        handle.block_until_ready()
+
+    def delete(self, handle) -> None:
+        delete = getattr(handle, "delete", None)
+        if delete is not None:
+            delete()
+
+
+class _Transfer:
+    """One in-flight transfer: buffer, accounting stamps, and the
+    resources the reaper drops at completion."""
+
+    __slots__ = ("array", "nbytes", "on_complete", "op", "enqueue_ns",
+                 "seq", "handle", "submit_ns")
+
+    def __init__(self, array, nbytes: int, on_complete, op, enqueue_ns: int,
+                 seq: int):
+        self.array = array
+        self.nbytes = nbytes
+        self.on_complete = on_complete  # free the slot / release the lease
+        self.op = op  # flight record (kind="stage"), finished by the reaper
+        self.enqueue_ns = enqueue_ns
+        self.seq = seq
+        self.handle = None
+        self.submit_ns = 0
+
+
+class InflightWindow:
+    """Depth-K transfer window: submit queue + reaper + OOO completion.
+
+    One window per stager; the stager's fetch thread is the only
+    producer, the reaper the only consumer — all counters the reaper
+    mutates are read by the producer only under the shared lock or
+    after :meth:`close` joins the thread.
+    """
+
+    def __init__(
+        self,
+        depth: int,
+        device,
+        *,
+        engine: Optional[TransferEngine] = None,
+        stage_recorder=None,
+        flight_ring=None,
+        name: str = "stage",
+        poll_s: float = 0.0002,
+        clock: Callable[[], int] = time.perf_counter_ns,
+    ):
+        self._engine = engine or TransferEngine()
+        self._device = device
+        self._depth = max(1, int(depth))
+        self._recorder = stage_recorder
+        self._ring = flight_ring
+        self._poll_s = poll_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: list[_Transfer] = []
+        self._inflight: list[_Transfer] = []
+        self._seq = 0
+        self._stop = False
+        self.error: Optional[BaseException] = None
+        # Accounting (finalized values read after close()).
+        self.transfers = 0
+        self.staged_bytes = 0
+        self.wait_ns = 0  # producer-thread backpressure + drain-tail time
+        self.submit_ns = 0  # reaper time inside engine.submit (∥ fetch)
+        self.flight_ns = 0  # Σ per-transfer (complete − submit)
+        self.out_of_order = 0  # completions that overtook an older submit
+        self.inflight_samples: list[int] = []  # gauge, sampled per submit
+        self._reaper = threading.Thread(
+            target=self._run, name=f"{name}-reaper", daemon=True
+        )
+        self._reaper.start()
+
+    # ---------------------------------------------------------- producer --
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    def set_depth(self, depth: int) -> int:
+        """Live depth actuation (the ``staging_depth`` tune knob): grow
+        widens the credit window immediately; shrink lets in-flight
+        transfers drain down to the new bound naturally."""
+        with self._cond:
+            self._depth = max(1, int(depth))
+            self._cond.notify_all()
+            return self._depth
+
+    def raise_if_failed(self) -> None:
+        """A failed transfer must abort the producer NOW: the reaper
+        frees failed transfers' resources (no deadlock), so without
+        this check backpressure never engages and a dead device would
+        let the fetch burn the whole measurement window."""
+        if self.error is not None:
+            raise self.error
+
+    def enqueue(self, array, nbytes: int, on_complete=None,
+                label: str = "device_put",
+                enqueue_ns: Optional[int] = None) -> None:
+        """Hand a filled buffer to the window. Blocks (backpressure)
+        while K transfers are already pending; the blocked time is
+        ``wait_ns`` — the quantity this executor exists to shrink."""
+        enq = enqueue_ns if enqueue_ns is not None else self._clock()
+        op = None
+        if self._ring is not None:
+            op = self._ring.begin(
+                label, "device_put", enqueue_ns=enq, install=False,
+                kind="stage",
+            )
+            # The serial ring also stamps stage_submit, so the journal
+            # needs an explicit marker for window (overlapped) transfers
+            # — timeline_summary's `overlapped` counts this note.
+            op.note("stage", event="overlap")
+        with self._cond:
+            t0 = None
+            while (len(self._queue) + len(self._inflight) >= self._depth
+                   and self.error is None):
+                if t0 is None:
+                    t0 = self._clock()
+                self._cond.wait()
+            if t0 is not None:
+                self.wait_ns += self._clock() - t0
+            if self.error is not None:
+                if op is not None:
+                    # Abandon, don't finish: finish() appends to the
+                    # worker ring, and the reaper may be appending
+                    # failed in-flight ops to the SAME ring right now —
+                    # the ring is single-appender by design. This
+                    # transfer never entered the window; no record.
+                    op.abandon()
+                if on_complete is not None:
+                    on_complete()
+                raise self.error
+            self._queue.append(
+                _Transfer(array, int(nbytes), on_complete, op, enq, self._seq)
+            )
+            self._seq += 1
+            self._cond.notify_all()
+
+    def drain(self) -> None:
+        """Block until every enqueued transfer has settled (landed or
+        failed). The tail of the transfer time is paid here — without
+        counting it into ``wait_ns`` the overlapped config would report
+        near-zero transfer wait and dump all transfer time into
+        "fetch"."""
+        with self._cond:
+            t0 = self._clock()
+            while self._queue or self._inflight:
+                self._cond.wait()
+            self.wait_ns += self._clock() - t0
+
+    def close(self) -> None:
+        """Drain, stop the reaper, join. Idempotent."""
+        self.drain()
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._reaper.join()
+
+    def stats(self) -> dict:
+        samples = np.asarray(self.inflight_samples or [0], dtype=np.int64)
+        wait, flight = self.wait_ns, self.flight_ns
+        efficiency = staging_efficiency(
+            wait, self.submit_ns, flight, overlapped=True
+        )
+        return {
+            "depth": self._depth,
+            "transfers": self.transfers,
+            "staged_bytes": self.staged_bytes,
+            "transfer_wait_ns": wait,
+            "put_submit_ns": self.submit_ns,
+            "transfer_flight_ns": flight,
+            "out_of_order_completions": self.out_of_order,
+            "inflight_p50": float(np.percentile(samples, 50)),
+            "inflight_max": int(samples.max()),
+            "staging_efficiency": efficiency,
+        }
+
+    # ------------------------------------------------------------ reaper --
+    def _run(self) -> None:
+        try:
+            self._loop()
+        except BaseException as e:  # last-resort guard
+            # A reaper death without error-marking would deadlock the
+            # producer forever (enqueue/drain wait on window credit that
+            # can never free). Mark the error, fail every live transfer,
+            # and drop their resources so finish() can still tear down.
+            with self._cond:
+                if self.error is None:
+                    self.error = e
+                pending = self._queue + self._inflight
+                self._queue = []
+                self._inflight = []
+                self._cond.notify_all()
+            for tr in pending:
+                if tr.op is not None:
+                    tr.op.finish(error=e)
+                self._consume_callback(tr)
+
+    @staticmethod
+    def _consume_callback(tr: _Transfer) -> None:
+        """Run a transfer's on_complete exactly once (slot frees and
+        lease releases must never double-fire across failure paths)."""
+        cb, tr.on_complete = tr.on_complete, None
+        if cb is not None:
+            cb()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._inflight:
+                    if self._stop:
+                        return
+                    self._cond.wait()
+                # Snapshot WITHOUT clearing: a queued transfer keeps its
+                # window credit until _submit moves it to _inflight, so
+                # queued+inflight ≤ depth holds at all times — popping
+                # here would let the producer enqueue a fresh depth's
+                # worth while these are still on the tunnel.
+                batch = list(self._queue)
+            for tr in batch:
+                self._submit(tr)
+            self._complete_ready()
+
+    def _submit(self, tr: _Transfer) -> None:
+        try:
+            s0 = self._clock()
+            tr.handle = self._engine.submit(tr.array, self._device)
+            tr.submit_ns = s0
+            self.submit_ns += self._clock() - s0
+        except BaseException as e:  # raised at the producer's next enqueue
+            self._fail(tr, e)
+            return
+        if tr.op is not None:
+            tr.op.mark("stage_submit", tr.submit_ns)
+        with self._cond:
+            self._queue.remove(tr)
+            self._inflight.append(tr)
+            self.inflight_samples.append(len(self._inflight))
+
+    def _complete_ready(self) -> None:
+        """Finalize every READY in-flight transfer, first-landed first
+        (out-of-order w.r.t. submission); when nothing is ready yet,
+        wait a poll tick (new enqueues interrupt the wait) or — on
+        runtimes without a completion probe — block on the oldest."""
+        while True:
+            with self._cond:
+                inflight = list(self._inflight)
+                queued = bool(self._queue)
+            if not inflight or queued:
+                return  # nothing to do, or new submissions take priority
+            ready = None
+            probed = False
+            for tr in inflight:
+                ok = self._engine.probe(tr.handle)
+                if ok is None:
+                    break  # no probe on this runtime: in-order fallback
+                probed = True
+                if ok:
+                    ready = tr
+                    break
+            if ready is None and not probed:
+                # No completion probe on this runtime: block on the
+                # oldest (in-order degrade — never free a buffer a
+                # transfer might still read). With probe support we must
+                # NOT block here: a blocking wait would starve the
+                # submission of buffers the producer enqueues meanwhile,
+                # serializing the very transfers the window overlaps.
+                ready = inflight[0]
+            if ready is not None:
+                self._finalize(ready)
+                continue
+            with self._cond:
+                if self._queue:
+                    return
+                self._cond.wait(self._poll_s)
+
+    def _finalize(self, tr: _Transfer) -> None:
+        # The whole completion path is guarded, not just wait(): a
+        # delete()/recorder failure that escaped would kill the reaper
+        # with the transfer still holding window credit.
+        try:
+            self._engine.wait(tr.handle)
+            done = self._clock()
+            self.flight_ns += done - tr.submit_ns
+            if self._recorder is not None:
+                # Stage latency from ENQUEUE, not submit: with overlap
+                # the queueing behind earlier transfers is part of the
+                # quantity that sizes the pipeline.
+                self._recorder.record_ns(done - tr.enqueue_ns)
+            if tr.op is not None:
+                # The reaper adopts the op (hedge-producer discipline)
+                # so completion phases — including hbm_staged, which
+                # must stamp when the bytes LAND, not when submit
+                # returned — attach on the transfer's record from this
+                # helper thread.
+                _flight.adopt_op(tr.op)
+                try:
+                    _flight.note_phase("stage_complete", done)
+                    _flight.note_phase("hbm_staged", done)
+                    tr.op.finish(tr.nbytes)
+                finally:
+                    _flight.adopt_op(None)
+            self._engine.delete(tr.handle)
+        except BaseException as e:
+            self._fail(tr, e)
+            return
+        with self._cond:
+            self._inflight.remove(tr)
+            self.transfers += 1
+            self.staged_bytes += tr.nbytes
+            if any(o.seq < tr.seq for o in self._inflight):
+                self.out_of_order += 1
+            self._cond.notify_all()
+        self._consume_callback(tr)
+
+    def _fail(self, tr: _Transfer, e: BaseException) -> None:
+        if tr.op is not None:
+            tr.op.finish(error=e)
+        with self._cond:
+            if self.error is None:
+                self.error = e
+            if tr in self._queue:  # failed inside submit: still queued
+                self._queue.remove(tr)
+            if tr in self._inflight:
+                self._inflight.remove(tr)
+            self._cond.notify_all()
+        # Resources are freed even on failure (a dead device must not
+        # leak slots/leases); the producer aborts via raise_if_failed
+        # at its next acquire/enqueue.
+        self._consume_callback(tr)
+
+
+class StagerRegistry:
+    """Live-actuation fan-out for the ``staging_depth`` tune knob.
+
+    The read workload builds one stager per worker INSIDE the worker
+    threads, after the controller's knob list exists — so the knob
+    actuates this registry, and stagers attach as they are created.
+    A depth commanded before a stager attached is applied at attach
+    (late workers join the tuned operating point, not the config's)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stagers: list = []
+        self._depth: Optional[int] = None
+
+    def attach(self, stager):
+        if hasattr(stager, "set_depth"):
+            with self._lock:
+                self._stagers.append(stager)
+                depth = self._depth
+            if depth is not None:
+                stager.set_depth(depth)
+        return stager
+
+    def set_depth(self, depth: int) -> None:
+        with self._lock:
+            self._depth = int(depth)
+            stagers = list(self._stagers)
+        for st in stagers:
+            st.set_depth(depth)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._stagers)

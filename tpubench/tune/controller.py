@@ -63,6 +63,10 @@ ACTUATED = {
         "config": ("transport", "tail", "hedge_delay_s"),
         "cli": "hedge_delay",
     },
+    "staging_depth": {
+        "config": ("staging", "depth"),
+        "cli": "staging_depth",
+    },
 }
 assert tuple(sorted(ACTUATED)) == tuple(sorted(TUNE_KNOBS))
 
@@ -77,6 +81,18 @@ def readahead_ceiling(readahead: int) -> int:
 
 def prefetch_workers_ceiling(workers: int) -> int:
     return min(8, max(4, 2 * workers))
+
+
+def staging_depth_ceiling(depth: int, pool_slabs: int = 0) -> int:
+    """In-flight staging-window ceiling: past ~8 pending transfers the
+    tunnel is saturated and every extra slot only pins host memory.
+    ``pool_slabs`` (when the slab pool is explicitly sized) caps the
+    ceiling so neither the sweep ladder nor a live grow probe can drive
+    depth past the pool budget validate_pipeline_config enforces."""
+    hi = min(8, max(4, 2 * depth))
+    if pool_slabs > 0:
+        hi = max(1, min(hi, pool_slabs))
+    return hi
 
 
 def hedge_delay_knob(value: float, set_fn) -> "Knob":

@@ -394,6 +394,15 @@ class StreamedPodIngest:
                     object_checksums.append(dev_sum)
                     host = sum(int(s.sum(dtype=np.uint64)) for s in shards)
                     checks_ok = checks_ok and dev_sum == host % (1 << 32)
+                # Per-transfer HBM hygiene (the staging executor's
+                # delete() discipline): this object's staged shards and
+                # gathered copy are consumed — release the device memory
+                # now, not at GC's leisure N objects later, so a long
+                # stream's HBM footprint is one object, not the history.
+                for consumed in (arr, gathered):
+                    delete = getattr(consumed, "delete", None)
+                    if delete is not None:
+                        delete()
                 self._progress = {
                     "objects_done": max(k + 1, prior_done),
                     "resume_point": resume_point,

@@ -73,9 +73,11 @@ SinkFactory = Callable[[int], Sink]
 
 
 def _build_read_controller(cfg, read_recorders, bytes_fn, backend, gate,
-                           flight):
+                           flight, stager_registry=None):
     """Tune controller for the Python read path: live knobs are the
-    elastic worker fan-out and (when hedging is on) the hedge delay;
+    elastic worker fan-out, (when hedging is on) the hedge delay, and
+    (when staging overlaps) the staging executor's in-flight depth —
+    fanned out to every worker's ring through the stager registry;
     goodput/p99 sampled off the run's own per-worker recorders."""
     from tpubench.storage.tail import HedgedBackend, find_tail_layer
     from tpubench.tune.controller import (
@@ -83,6 +85,7 @@ def _build_read_controller(cfg, read_recorders, bytes_fn, backend, gate,
         RecorderSampler,
         TuneController,
         hedge_delay_knob,
+        staging_depth_ceiling,
     )
 
     wanted = set(cfg.tune.knobs)
@@ -98,6 +101,12 @@ def _build_read_controller(cfg, read_recorders, bytes_fn, backend, gate,
             knobs.append(hedge_delay_knob(
                 cfg.transport.tail.hedge_delay_s, hb.set_hedge_delay,
             ))
+    if stager_registry is not None:
+        depth0 = max(1, cfg.staging.depth)
+        knobs.append(Knob(
+            "staging_depth", depth0, stager_registry.set_depth,
+            lo=1, hi=staging_depth_ceiling(depth0), mode="mul",
+        ))
     if not knobs:
         return None
     sampler = RecorderSampler(read_recorders, bytes_fn)
@@ -142,12 +151,31 @@ class ReadWorkload:
         tune_on = getattr(self.cfg, "tune", None) is not None and \
             self.cfg.tune.enabled
         gate = ElasticGate(n, n) if tune_on else None
+        # Overlapped-staging depth as a live knob: workers build their
+        # stagers lazily inside the threads, so the controller actuates a
+        # registry that fans set_depth out to every attached ring (and
+        # replays the commanded depth onto late attachers).
+        stager_registry = None
+        sink_factory = self.sink_factory
+        if (
+            tune_on and sink_factory is not None
+            and "staging_depth" in self.cfg.tune.knobs
+            and self.cfg.staging.mode == "device_put"
+            and self.cfg.staging.double_buffer
+            and self.cfg.staging.depth > 1
+            and not self.cfg.staging.validate_checksum
+        ):
+            from tpubench.staging.executor import StagerRegistry
+
+            stager_registry = StagerRegistry()
+            base_factory = sink_factory
+            sink_factory = lambda i: stager_registry.attach(base_factory(i))  # noqa: E731
 
         def worker(i: int, cancel) -> None:
             read_rec, fb_rec = recorders[i]
             wf = flights[i]
             name = f"{w.object_name_prefix}{i}"  # main.go:121
-            sink = self.sink_factory(i) if self.sink_factory else None
+            sink = sink_factory(i) if sink_factory else None
             # Zero-copy route: fetch lands bytes directly in the staging
             # slot (sink.acquire/commit); otherwise stream through a reused
             # per-worker granule buffer (main.go:125) with optional copying
@@ -225,6 +253,7 @@ class ReadWorkload:
             controller = _build_read_controller(
                 self.cfg, metrics.read_latency,
                 lambda: sum(worker_bytes), self.backend, gate, flight,
+                stager_registry=stager_registry,
             )
             # Online read sessions are duration-bounded: a shrink parks
             # workers with reads remaining, so read-count completion can
@@ -340,6 +369,13 @@ class ReadWorkload:
                     res.extra["staging_breakdown"]["checksum_reduce_s"] = sum(
                         st.get("checksum_reduce_ns", 0) for st in live
                     ) / 1e9 / k
+            # Overlap story (extra["staging"]): depth, transfers-in-flight
+            # gauge, transfer wait vs flight, pooled staging_efficiency.
+            from tpubench.staging.stats import staging_extra
+
+            staging_block = staging_extra(sink_stats)
+            if staging_block is not None:
+                res.extra["staging"] = staging_block
         checks = [st["checksum_ok"] for st in sink_stats if "checksum_ok" in st]
         if checks:
             res.extra["checksum_ok"] = all(checks)

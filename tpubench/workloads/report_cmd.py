@@ -90,6 +90,14 @@ def summarize_run(run: dict, label: str = "") -> str:
     staged = extra.get("staged_gbps_per_chip")
     if staged is not None:
         lines.append(f"  staged GB/s/chip={staged:.4f}")
+    staging = extra.get("staging")
+    if staging:
+        # The overlap story: in-flight window depth, transfers-in-flight
+        # gauge, and how much transfer flight time was hidden from the
+        # fetch threads (staging_efficiency).
+        from tpubench.staging.stats import format_staging_block
+
+        lines.append(format_staging_block(staging))
     if "checksum_ok" in extra:
         lines.append(f"  checksum_ok={extra['checksum_ok']}")
     chaos = extra.get("chaos")

@@ -44,6 +44,7 @@ import numpy as np
 from tpubench.config import BenchConfig, validate_pipeline_config
 from tpubench.mem.slab import (
     CopyMeter,
+    SlabLease,
     SlabPool,
     payload_view,
     release_payload,
@@ -95,7 +96,7 @@ def build_plan(cfg: BenchConfig, backend: StorageBackend) -> list[ChunkKey]:
 def run_train_ingest(
     cfg: BenchConfig, backend: Optional[StorageBackend] = None
 ) -> RunResult:
-    validate_pipeline_config(cfg.pipeline)
+    validate_pipeline_config(cfg.pipeline, staging=cfg.staging)
     p = cfg.pipeline
     chunk = p.chunk_bytes or cfg.workload.granule_bytes
     if p.readahead > 0 and p.cache_bytes < chunk:
@@ -283,7 +284,7 @@ class _TrainIngest:
                 if tune_on:
                     controller = _build_train_ingest_controller(
                         cfg, fetch_rec, lambda: consumed_bytes,
-                        self.backend, pf, len(plan), flight,
+                        self.backend, pf, len(plan), flight, stager,
                     )
                     if controller is not None:
                         controller.start()
@@ -382,6 +383,7 @@ class _TrainIngest:
                         op.mark("stall_begin", first_block_ns)
                         op.mark("stall_end", last_block_ns)
                     # ---- stage the batch -------------------------------
+                    step_bytes = sum(len(d) for d in datas)
                     if p.pod:
                         staged_ns, gathered_ns = self._pod_stage_gather(
                             mesh, reassemble, datas
@@ -390,19 +392,41 @@ class _TrainIngest:
                             op.mark("hbm_staged", staged_ns)
                             op.mark("gather_complete", gathered_ns)
                     elif stager is not None:
-                        for data in datas:
-                            # The slab view stages IN PLACE: the sink's
-                            # slot fill reads straight out of the pinned
-                            # slab (no bytes() materialization between).
-                            stager.submit(payload_view(data))
-                        if op is not None:
+                        overlapped = getattr(stager, "overlapped", False)
+                        can_own = hasattr(stager, "submit_owned")
+                        for i, data in enumerate(datas):
+                            if (overlapped and can_own
+                                    and isinstance(data, SlabLease)):
+                                # Overlapped direct staging: the transfer
+                                # reads straight out of the pinned slab —
+                                # no slot copy — and THIS STEP'S consumer
+                                # reference rides with it, released by
+                                # the window's reaper only when the bytes
+                                # land (never at submit): the fetch/step
+                                # thread does not block on the tunnel.
+                                stager.submit_owned(data)
+                                datas[i] = None
+                            else:
+                                # The slab view stages IN PLACE: the
+                                # sink's slot fill reads straight out of
+                                # the pinned slab (no bytes()
+                                # materialization between).
+                                stager.submit(payload_view(data))
+                        if op is not None and not overlapped:
+                            # Synchronous staging only: an overlapped
+                            # submit returns before the bytes land, so
+                            # the step record carries no hbm_staged — the
+                            # window's per-transfer stage records stamp
+                            # it at true completion (reaper-side).
                             op.mark("hbm_staged")
-                    step_bytes = sum(len(d) for d in datas)
                     consumed_bytes += step_bytes
-                    # Staging consumed the views synchronously: drop this
-                    # step's consumer references so evicted slabs retire.
+                    # Drop the consumer references staging used
+                    # synchronously (handed-off leases release at
+                    # transfer completion instead) so evicted slabs
+                    # retire.
                     for data in datas:
-                        release_payload(data)
+                        if data is not None:
+                            release_payload(data)
                     stall_rec.record_ns(stall_ns)
                     if stall_ns > p.stall_threshold_ms * 1e6:
                         stalled_steps += 1
@@ -506,6 +530,11 @@ class _TrainIngest:
             res.extra["tune"] = tune_stats
         if sink_stats.get("staged_bytes"):
             res.extra["staged_bytes"] = sink_stats["staged_bytes"]
+        from tpubench.staging.stats import staging_extra
+
+        staging_block = staging_extra([sink_stats])
+        if staging_block is not None:
+            res.extra["staging"] = staging_block
         from tpubench.storage.tail import collect_tail_stats
 
         tail_stats = collect_tail_stats(self.backend)
@@ -527,10 +556,11 @@ class _TrainIngest:
 
 
 def _build_train_ingest_controller(cfg, fetch_rec, bytes_fn, backend, pf,
-                                   plan_len, flight):
+                                   plan_len, flight, stager=None):
     """Tune controller for train-ingest: live knobs are the prefetcher's
     readahead depth / byte budget / worker fan-out (Prefetcher.reclamp /
-    set_workers) and the hedge delay; goodput is windowed consumed
+    set_workers), the hedge delay, and the overlapped staging executor's
+    in-flight depth (stager.set_depth); goodput is windowed consumed
     bytes, the p99 guardrail watches demand-fetch latency."""
     from tpubench.storage.tail import HedgedBackend, find_tail_layer
     from tpubench.tune.controller import (
@@ -539,6 +569,7 @@ def _build_train_ingest_controller(cfg, fetch_rec, bytes_fn, backend, pf,
         TuneController,
         hedge_delay_knob,
         readahead_ceiling,
+        staging_depth_ceiling,
     )
 
     p = cfg.pipeline
@@ -572,6 +603,17 @@ def _build_train_ingest_controller(cfg, fetch_rec, bytes_fn, backend, pf,
             knobs.append(hedge_delay_knob(
                 cfg.transport.tail.hedge_delay_s, hb.set_hedge_delay,
             ))
+    if "staging_depth" in wanted and stager is not None \
+            and getattr(stager, "overlapped", False) \
+            and hasattr(stager, "set_depth"):
+        # In-flight leases come out of the slab pool: an explicitly
+        # sized pool caps how far a grow probe may drive the window.
+        pool_cap = p.pool_slabs if (p.slab_pool and p.slab_bytes > 0) else 0
+        knobs.append(Knob(
+            "staging_depth", stager.depth, stager.set_depth,
+            lo=1, hi=staging_depth_ceiling(stager.depth, pool_cap),
+            mode="mul",
+        ))
     if not knobs:
         return None
     sampler = RecorderSampler([fetch_rec], bytes_fn)

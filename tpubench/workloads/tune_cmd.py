@@ -48,6 +48,7 @@ _KNOB_FLAGS = {
     "readahead_bytes": "--readahead-bytes",
     "prefetch_workers": "--prefetch-workers",
     "hedge_delay_s": "--hedge-delay",
+    "staging_depth": "--staging-depth",
 }
 
 
@@ -121,7 +122,9 @@ def _ladder(around: int, lo: int, hi: int) -> list[int]:
 def sweep_axes(cfg: BenchConfig, workload: str) -> dict[str, list]:
     """Candidate values per knob axis (intersected with cfg.tune.knobs),
     derived from the config's own operating point."""
-    w, p, tail = cfg.workload, cfg.pipeline, cfg.transport.tail
+    from tpubench.tune.controller import staging_depth_ceiling
+
+    w, p, s, tail = cfg.workload, cfg.pipeline, cfg.staging, cfg.transport.tail
     axes: dict[str, list] = {}
     if workload == "read":
         if w.workers > 1:
@@ -145,6 +148,21 @@ def sweep_axes(cfg: BenchConfig, workload: str) -> dict[str, list]:
         if tail.hedge:
             d = tail.hedge_delay_s
             axes["hedge_delay_s"] = sorted({d / 4, d / 2, d, d * 2})
+    if s.mode != "none" and s.double_buffer and not p.pod:
+        # Same ladder the online knob explores (ceiling single-sourced in
+        # controller.py): depth 1 is the serial comparator cell, the rest
+        # find the overlap knee. An explicitly sized slab pool caps the
+        # ladder — a cell past the pool budget would SystemExit inside
+        # run_train_ingest's validate_pipeline_config and kill the sweep.
+        pool_cap = (
+            p.pool_slabs
+            if (workload != "read" and p.slab_pool and p.slab_bytes > 0)
+            else 0
+        )
+        axes["staging_depth"] = _ladder(
+            max(1, s.depth), 1,
+            staging_depth_ceiling(max(1, s.depth), pool_cap),
+        )
     wanted = set(cfg.tune.knobs)
     return {k: v for k, v in axes.items() if k in wanted}
 
