@@ -76,6 +76,14 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "staging: overlapped staging executor (in-flight window)"
     )
+    # Telemetry tests (live metrics registry, /metrics endpoint, journal
+    # tailing, `tpubench top`) stay in tier-1 — same policy as the
+    # other subsystem markers: not slow-marked, so the live-vs-post-hoc
+    # agreement guard runs on every pass; the marker exists for
+    # selective runs (`-m telemetry`).
+    config.addinivalue_line(
+        "markers", "telemetry: live telemetry plane (registry/endpoint/top)"
+    )
     # Multihost tests are marker-gated (see tests/test_multihost.py):
     # they need working multi-process jax.distributed, which this
     # container lacks — tier-1 collects clean skips, not failures.

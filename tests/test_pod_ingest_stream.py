@@ -209,6 +209,29 @@ def test_stream_resume_missing_snapshot_starts_fresh(jax_cpu_devices, tmp_path):
     assert res.extra["resume"]["prior_found"] is False
 
 
+def test_stream_resume_torn_snapshot_starts_fresh(
+    jax_cpu_devices, tmp_path, capsys
+):
+    """SnapshotWriter crash-resume: a truncated final snapshot (the
+    writer died mid-flush before the atomic rename, or the disk filled)
+    must be detected and skipped with a one-line warning — a torn write
+    never poisons the resume path with a JSON traceback."""
+    cfg = _cfg()
+    backend = FakeBackend.prepopulated(cfg.workload.object_name_prefix, 2, 120_000)
+    path = str(tmp_path / "snap.json")
+    with open(path, "w") as f:
+        f.write('{"objects_done": 2, "resume_point"')  # torn mid-key
+    res = run_pod_ingest_stream(
+        cfg, n_objects=2, backend=backend, resume_from=path
+    )
+    # Fresh start: everything re-fetched, prior treated as absent.
+    assert res.bytes_total == 2 * 120_000
+    assert res.extra["resume"]["objects_skipped"] == 0
+    assert res.extra["resume"]["prior_found"] is False
+    err = capsys.readouterr().err
+    assert "truncated/partial snapshot" in err
+
+
 def test_stream_resume_point_blocked_by_holes(jax_cpu_devices, tmp_path):
     """An object delivered WITH holes must stay re-fetchable: the
     snapshot's resume_point freezes at the degraded object even though

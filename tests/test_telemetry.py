@@ -1,0 +1,825 @@
+"""Live telemetry plane: registry, Prometheus/OTLP export, journal
+gzip/rotation, the live aggregator + ``tpubench top``, and the
+live-vs-post-hoc agreement acceptance (registry == report timeline)."""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpubench.config import (
+    BenchConfig,
+    TelemetryConfig,
+    validate_telemetry_config,
+)
+from tpubench.obs.exporters import OTLPMetricsExporter, load_snapshot
+from tpubench.obs.flight import (
+    PHASES,
+    FlightRecorder,
+    goodput_summary,
+    load_journals,
+    merge_journal_docs,
+    timeline_summary,
+)
+from tpubench.obs.telemetry import (
+    TelemetrySession,
+    build_registry,
+    metric_catalog,
+    phase_metric_name,
+    telemetry_from_config,
+)
+
+pytestmark = pytest.mark.telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------- registry ----
+
+
+def test_registry_requires_help_and_rejects_duplicates():
+    from tpubench.obs.telemetry import TelemetryRegistry
+
+    reg = TelemetryRegistry()
+    with pytest.raises(ValueError, match="help text is mandatory"):
+        reg.counter("x_total", "")
+    reg.counter("x_total", "a counter")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x_total", "now a gauge")
+
+
+def test_counter_gauge_histogram_semantics():
+    reg = build_registry()
+    c = reg.get("tpubench_reads_total")
+    c.inc()
+    c.inc(3)
+    assert c.value == 4
+    c.set_cumulative(2)  # stale cumulative sample can't go backwards
+    assert c.value == 4
+    g = reg.get("tpubench_goodput_gbps")
+    assert not g.known  # unset gauges are omitted from exposition
+    g.set(1.5)
+    assert g.known and g.value == 1.5
+    h = reg.get(phase_metric_name("first_byte"))
+    h.observe_ns(int(2.5e6))  # 2.5 ms -> the (2, 3] bucket
+    assert h.count == 1
+    assert h.counts[2] == 1  # bounds [1, 2, 3, ...): index 2 is (2, 3]
+    ex = h.exact_summary()
+    assert ex["count"] == 1 and abs(ex["p50_ms"] - 2.5) < 1e-6
+
+
+PROM_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9eE.+-]+$'
+)
+
+
+def _parse_prometheus(text: str) -> dict[str, float]:
+    """Validate exposition shape line-by-line; return sample name{labels}
+    -> value."""
+    samples: dict[str, float] = {}
+    typed: set[str] = set()
+    helped: set[str] = set()
+    for line in text.strip().splitlines():
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            assert parts[3] in ("counter", "gauge", "histogram"), line
+            typed.add(parts[2])
+            continue
+        assert PROM_LINE.match(line), f"malformed sample line: {line!r}"
+        key, val = line.rsplit(" ", 1)
+        samples[key] = float(val)
+    # Every sample's base name carries TYPE + HELP metadata.
+    for key in samples:
+        base = key.split("{", 1)[0]
+        base = re.sub(r"_(bucket|sum|count)$", "", base) \
+            if re.search(r"_(bucket|sum|count)$", base) else base
+        assert any(t == base or base.startswith(t) for t in typed), key
+    assert typed <= helped
+    return samples
+
+
+def test_prometheus_exposition_is_valid_and_histograms_cumulative():
+    reg = build_registry()
+    reg.get("tpubench_reads_total").inc(7)
+    reg.get("tpubench_native_transport_total").inc("bytes_on_wire", 123)
+    h = reg.get(phase_metric_name("first_byte"))
+    for ms in (0.5, 2.5, 2.6, 999.0, 1e6):
+        h.observe_ns(int(ms * 1e6))
+    text = reg.render_prometheus()
+    samples = _parse_prometheus(text)
+    assert samples["tpubench_reads_total"] == 7
+    assert samples['tpubench_native_transport_total{counter="bytes_on_wire"}'] == 123
+    name = phase_metric_name("first_byte")
+    # Bucket counts are cumulative and the +Inf bucket equals _count.
+    buckets = [
+        (k, v) for k, v in samples.items() if k.startswith(f"{name}_bucket")
+    ]
+    vals = [v for _, v in buckets]
+    assert vals == sorted(vals), "histogram buckets must be cumulative"
+    assert samples[f'{name}_bucket{{le="+Inf"}}'] == samples[f"{name}_count"] == 5
+    assert samples[f"{name}_sum"] > 0
+
+
+def test_metric_drift_guard_registry_readme_and_phases():
+    """The knob-drift discipline for metrics: every registered metric
+    has help text (enforced at registration) AND a row in the README
+    metric table; every PHASES entry maps to a registry histogram. A
+    new metric or a new phase without docs fails here, not in review."""
+    reg = build_registry()
+    catalog = metric_catalog()
+    # Registry <-> catalog: same names, helps non-empty.
+    assert set(reg.names()) == set(catalog)
+    assert all(catalog[n] for n in catalog)
+    assert all(reg.get(n).help for n in reg.names())
+    # Catalog <-> README metric table.
+    with open(os.path.join(REPO, "README.md")) as f:
+        readme = f.read()
+    documented = set(re.findall(r"tpubench_[a-z0-9_]+", readme))
+    missing = set(catalog) - documented
+    assert not missing, (
+        f"metrics registered but missing from the README metric table: "
+        f"{sorted(missing)}"
+    )
+    stale = {d for d in documented if d.startswith("tpubench_")} - set(catalog)
+    assert not stale, (
+        f"README documents metrics the registry no longer has: "
+        f"{sorted(stale)}"
+    )
+    # Every flight phase has its histogram (plus the total rollup).
+    from tpubench.obs.telemetry import Histogram
+
+    for p in PHASES + ("total",):
+        m = reg.get(phase_metric_name(p))
+        assert isinstance(m, Histogram), p
+
+
+# ----------------------------------------------------------- flight tap ----
+
+
+def _mk_records(flight: FlightRecorder, n=6, nbytes=1000):
+    wf = flight.worker("w0")
+    for i in range(n):
+        op = wf.begin(f"obj{i}", "fake")
+        op.mark("first_byte")
+        op.note("retry", attempt=1)
+        op.mark("body_complete")
+        op.finish(nbytes)
+
+
+def test_flight_tap_feeds_registry_and_counts_match_journal():
+    tc = TelemetryConfig(enabled=True)
+    sess = TelemetrySession(tc)
+    flight = FlightRecorder(capacity_per_worker=64)
+    sess.attach_flight(flight)
+    _mk_records(flight, n=6)
+    # Step + stage + cache records exercise the per-kind counters.
+    wf = flight.worker("steps")
+    sop = wf.begin("step0", "fake", install=False, kind="step")
+    sop.mark("stall_begin")
+    sop.mark("stall_end")
+    sop.finish(4096)
+    cop = flight.worker("consumer").begin("obj0", "fake", kind="cache")
+    cop.mark("cache_hit")
+    cop.finish(128)
+    reg = sess.registry
+    assert reg.get("tpubench_reads_total").value == 6
+    assert reg.get("tpubench_bytes_total").value == 6000
+    assert reg.get("tpubench_retries_total").value == 6
+    assert reg.get("tpubench_steps_total").value == 1
+    assert reg.get("tpubench_steps_with_data_wait_total").value == 1
+    assert reg.get("tpubench_cache_hits_total").value == 1
+    assert reg.get("tpubench_records_total").value == 8
+    # Phase histograms saw the segments.
+    assert reg.get(phase_metric_name("first_byte")).count == 6
+    assert reg.get(phase_metric_name("total")).count > 0
+    # Live goodput == goodput_summary over the ring's records (the
+    # agreement formula, single host).
+    gp_live = sess.feeder.goodput()
+    gp_journal = goodput_summary(flight.records())
+    assert gp_live["bytes"] == gp_journal["bytes"]
+    assert gp_live["gbps"] == pytest.approx(gp_journal["gbps"], rel=1e-9)
+
+
+def test_tap_survives_ring_overflow_and_errors_are_counted():
+    tc = TelemetryConfig(enabled=True)
+    sess = TelemetrySession(tc)
+    flight = FlightRecorder(capacity_per_worker=4)  # ring smaller than run
+    sess.attach_flight(flight)
+    _mk_records(flight, n=32)
+    # The tap saw every record even though the ring kept only 4.
+    assert sess.registry.get("tpubench_reads_total").value == 32
+    assert len(flight.records()) == 4
+    # A tap failure is swallowed + counted, never raised at the caller.
+    sess.registry.get("tpubench_reads_total")  # sanity: metric exists
+    bad = {"phases": None}  # phase_segments will explode on None
+    flight.worker("w0").append(bad)
+    assert sess.registry.get("tpubench_tap_errors_total").value == 1
+
+
+# ------------------------------------------------------------- endpoint ----
+
+
+def _scrape(port: int, path: str = "/metrics") -> tuple[str, str]:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5
+    ) as resp:
+        return resp.read().decode("utf-8"), resp.headers.get("Content-Type")
+
+
+def test_http_endpoint_metrics_and_snapshot():
+    tc = TelemetryConfig(enabled=True, port=0, interval_s=0.05)
+    sess = TelemetrySession(tc).start()
+    try:
+        flight = FlightRecorder(capacity_per_worker=64)
+        sess.attach_flight(flight)
+        _mk_records(flight, n=3)
+        body1, ctype = _scrape(sess.port)
+        assert "text/plain" in ctype and "version=0.0.4" in ctype
+        s1 = _parse_prometheus(body1)
+        _mk_records(flight, n=3)
+        body2, _ = _scrape(sess.port)
+        s2 = _parse_prometheus(body2)
+        # Counters are monotone between scrapes.
+        for key, v1 in s1.items():
+            if key.endswith("_total") or "_bucket" in key \
+                    or key.endswith("_count"):
+                assert s2.get(key, 0) >= v1, key
+        assert s2["tpubench_reads_total"] == 6
+        assert s2["tpubench_scrapes_total"] >= 1
+        snap_body, ctype = _scrape(sess.port, "/snapshot")
+        assert ctype == "application/json"
+        snap = json.loads(snap_body)
+        assert snap["counters"]["tpubench_reads_total"] == 6
+        assert "goodput" in snap and snap["goodput"]["bytes"] == 6000
+        # Unknown paths 404 without killing the server.
+        with pytest.raises(urllib.error.HTTPError):
+            _scrape(sess.port, "/nope")
+        body3, _ = _scrape(sess.port)
+        assert body3
+    finally:
+        summary = sess.close()
+    assert summary["port"] == sess.port
+    assert summary["scrapes"] >= 3
+    # Server is down after close.
+    with pytest.raises(Exception):
+        _scrape(sess.port)
+
+
+def test_otlp_dry_run_payload_shape():
+    tc = TelemetryConfig(enabled=True, otlp=True, otlp_interval_s=30.0)
+    sess = TelemetrySession(tc, resource={"transport": "fake"})
+    flight = FlightRecorder(capacity_per_worker=16)
+    sess.attach_flight(flight)
+    sess.start()
+    _mk_records(flight, n=2)
+    summary = sess.close()
+    otlp = summary["otlp"]
+    assert otlp["endpoint"] == "dry_run"
+    assert otlp["payloads"] >= 1  # guaranteed final flush
+    payload = otlp["payloads_captured"][-1]
+    rm = payload["resourceMetrics"][0]
+    attrs = {
+        a["key"]: a["value"]["stringValue"]
+        for a in rm["resource"]["attributes"]
+    }
+    assert attrs["transport"] == "fake"
+    metrics = {m["name"]: m for m in rm["scopeMetrics"][0]["metrics"]}
+    reads = metrics["tpubench_reads_total"]
+    assert reads["sum"]["isMonotonic"] is True
+    assert reads["sum"]["dataPoints"][0]["asDouble"] == 2.0
+    hist = metrics[phase_metric_name("first_byte")]["histogram"]
+    dp = hist["dataPoints"][0]
+    assert int(dp["count"]) == 2
+    assert len(dp["bucketCounts"]) == len(dp["explicitBounds"]) + 1
+
+
+def test_otlp_exporter_posts_to_endpoint(monkeypatch):
+    posted = []
+
+    def fake_urlopen(req, timeout=0):
+        posted.append(json.loads(req.data))
+
+        class _R:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        return _R()
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    exp = OTLPMetricsExporter(
+        lambda: {"counters": {"tpubench_reads_total": 1}},
+        endpoint="http://127.0.0.1:9/v1/metrics",
+    )
+    exp.export_once()
+    assert exp.posts == 1 and len(posted) == 1
+    assert posted[0]["resourceMetrics"]
+
+
+# ------------------------------------------------- journal gzip/rotation ----
+
+
+def test_journal_gzip_roundtrip(tmp_path, capsys):
+    flight = FlightRecorder(capacity_per_worker=16)
+    _mk_records(flight, n=4)
+    path = str(tmp_path / "j.json.gz")
+    flight.write_journal(path, extra={"workload": "read"})
+    with open(path, "rb") as f:
+        assert f.read(2) == b"\x1f\x8b"  # actually compressed on disk
+    docs = load_journals([path])
+    assert len(docs) == 1 and len(docs[0]["records"]) == 4
+    assert docs[0]["workload"] == "read"
+    # A truncated gzip stream degrades like truncated JSON: warn + skip.
+    raw = open(path, "rb").read()
+    torn = str(tmp_path / "torn.json.gz")
+    with open(torn, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    assert load_journals([torn]) == []
+    assert "skipped" in capsys.readouterr().err
+
+
+def test_journal_rotation_drops_oldest_with_counted_note(tmp_path):
+    flight = FlightRecorder(capacity_per_worker=512)
+    _mk_records(flight, n=200, nbytes=10)
+    path = str(tmp_path / "j.json")
+    full = flight.write_journal(str(tmp_path / "full.json"))
+    full_size = os.path.getsize(full)
+    cap = full_size // 3
+    flight.write_journal(path, max_bytes=cap)
+    assert os.path.getsize(path) <= cap
+    doc = json.loads(open(path).read())
+    assert doc["rotation_dropped"] > 0
+    assert flight.last_rotation_dropped == doc["rotation_dropped"]
+    kept = doc["records"]
+    assert len(kept) + doc["rotation_dropped"] == 200
+    # The NEWEST records survive (oldest segment dropped).
+    all_recs = flight.records()
+    assert kept[-1] == all_recs[-1]
+    assert kept[0] == all_recs[doc["rotation_dropped"]]
+    # Unbounded write unaffected.
+    assert json.loads(open(full).read()).get("rotation_dropped") is None
+
+
+# ------------------------------------------------------- load_snapshot -----
+
+
+def test_load_snapshot_tolerates_every_torn_state(tmp_path, capsys):
+    p = tmp_path / "snap.json"
+    assert load_snapshot(str(p)) is None  # missing: silent
+    p.write_text("")
+    assert load_snapshot(str(p)) is None
+    assert "empty snapshot" in capsys.readouterr().err
+    p.write_text('{"objects_done": 3, "byt')
+    assert load_snapshot(str(p)) is None
+    assert "truncated/partial snapshot" in capsys.readouterr().err
+    p.write_text("[1, 2, 3]")
+    assert load_snapshot(str(p)) is None
+    assert "not a JSON object" in capsys.readouterr().err
+    p.write_text('{"objects_done": 3}')
+    assert load_snapshot(str(p)) == {"objects_done": 3}
+    assert capsys.readouterr().err == ""
+
+
+# ------------------------------------------------------ live aggregator ----
+
+
+def _journal_with_host(path, host, n=8, nbytes=1000, slow_ns=0):
+    flight = FlightRecorder(capacity_per_worker=64, host=host)
+    wf = flight.worker("w0")
+    for i in range(n):
+        op = wf.begin(f"obj{i}", "fake")
+        op.mark("first_byte")
+        if slow_ns:
+            op.mark("body_complete", time.perf_counter_ns() + slow_ns)
+        else:
+            op.mark("body_complete")
+        op.finish(nbytes)
+    flight.write_journal(path, extra={"n_chips": 2, "workload": "read"})
+    return flight
+
+
+def test_live_aggregator_merges_hosts_and_names_straggler(tmp_path):
+    from tpubench.obs.live import LiveAggregator, render_top
+
+    base = str(tmp_path / "j.json")
+    _journal_with_host(base, host=0)
+    # Host 1 is the straggler: its reads take ~50 ms longer.
+    _journal_with_host(f"{base}.p1", host=1, slow_ns=50_000_000)
+    agg = LiveAggregator([base], window_s=60.0)
+    view = agg.poll()
+    assert [f["host"] for f in view["files"]] == [0, 1]
+    assert view["hosts"] == [0, 1]
+    assert view["n_chips"] == 4  # 2 per host
+    assert view["summary"]["records"] == 16
+    frame = render_top(view)
+    assert "hosts (slowest p99 first" in frame
+    assert "* host=1" in frame  # straggler marked
+    assert "goodput:" in frame and "GB/s/chip" in frame
+    # Color mode highlights the straggler row in ANSI red.
+    assert "\x1b[31;1m" in render_top(view, color=True)
+    # Unchanged files are not re-read; a new flush is picked up.
+    stamps_before = dict(agg._stamp)
+    agg.poll()
+    assert agg._stamp == stamps_before
+    _journal_with_host(base, host=0, n=12)
+    view2 = agg.poll()
+    assert view2["summary"]["records"] == 20
+
+
+def test_live_aggregator_survives_partial_and_missing_files(tmp_path):
+    from tpubench.obs.live import LiveAggregator, render_top
+
+    base = str(tmp_path / "j.json")
+    agg = LiveAggregator([base])
+    frame = render_top(agg.poll())
+    assert "waiting for journals" in frame
+    # A torn half-written file (non-atomic writer) keeps the last view.
+    _journal_with_host(base, host=0)
+    assert agg.poll()["summary"]["records"] == 8
+    with open(base, "w") as f:
+        f.write('{"format": "tpubench-flight-v1", "records": [')
+    view = agg.poll()
+    assert view["summary"]["records"] == 8  # previous good doc retained
+
+
+def test_top_once_cli_smoke(tmp_path, capsys):
+    from tpubench.cli import main
+
+    base = str(tmp_path / "j.json.gz")
+    _journal_with_host(base, host=0)
+    rc = main(["top", base, "--once"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "tpubench top" in out and "records=8" in out
+    assert "\x1b[" not in out  # --once prints a plain frame
+
+
+# ----------------------------------------------------------- config/CLI ----
+
+
+def test_validate_telemetry_config_rejects_bad_knobs():
+    for field, value in (
+        ("port", -2), ("port", 70000), ("interval_s", 0.0),
+        ("interval_s", float("nan")), ("otlp_interval_s", -1.0),
+        ("otlp_endpoint", "ftp://x"),
+    ):
+        tc = TelemetryConfig()
+        setattr(tc, field, value)
+        with pytest.raises(SystemExit, match=field):
+            validate_telemetry_config(tc)
+    validate_telemetry_config(TelemetryConfig(port=0, otlp=True))
+
+
+def test_cli_flags_fold_into_config(tmp_path):
+    from tpubench.cli import main
+
+    out = str(tmp_path / "cfg.json")
+    rc = main([
+        "read", "--save-config", out,
+        "--telemetry-port", "0", "--telemetry-interval", "0.25",
+        "--telemetry-otlp", "--journal-max-bytes", "4096",
+        "--flight-journal", str(tmp_path / "j.json.gz"),
+    ])
+    assert rc == 0
+    cfg = BenchConfig.from_json(open(out).read())
+    assert cfg.telemetry.enabled and cfg.telemetry.port == 0
+    assert cfg.telemetry.interval_s == 0.25
+    assert cfg.telemetry.otlp is True
+    assert cfg.obs.journal_max_bytes == 4096
+    assert cfg.obs.flight_journal.endswith(".gz")
+    # Round-trips through from_dict (new subconfig registered).
+    assert BenchConfig.from_dict(cfg.to_dict()).telemetry.port == 0
+
+
+def test_cli_rejects_bad_telemetry_flags(tmp_path):
+    from tpubench.cli import main
+
+    out = str(tmp_path / "cfg.json")
+    with pytest.raises(SystemExit):
+        main(["read", "--save-config", out, "--telemetry-port", "70000"])
+    with pytest.raises(SystemExit):
+        main(["read", "--save-config", out, "--journal-max-bytes", "-1"])
+    with pytest.raises(SystemExit):
+        main(["read", "--save-config", out, "--profile-steps", "5:2"])
+    with pytest.raises(SystemExit):
+        main(["read", "--save-config", out, "--profile-steps", "abc"])
+
+
+def test_telemetry_from_config_gating():
+    cfg = BenchConfig()
+    assert telemetry_from_config(cfg) is None  # off by default
+    cfg.telemetry.port = 0
+    cfg.telemetry.enabled = True
+    sess = telemetry_from_config(cfg)
+    assert sess is not None
+    assert sess.resource["transport"] == "http"
+
+
+# ---------------------------------------------------------- step profiler ----
+
+
+def test_parse_profile_steps():
+    from tpubench.obs.profiling import parse_profile_steps
+
+    assert parse_profile_steps("") is None
+    assert parse_profile_steps("2:5") == (2, 5)
+    for bad in ("5:2", "-1:3", "x:y", "3", "1:2:3"):
+        with pytest.raises(SystemExit):
+            parse_profile_steps(bad)
+
+
+def test_step_profiler_noop_without_dir_and_captures_errors(monkeypatch):
+    from tpubench.obs.profiling import StepProfiler
+
+    p = StepProfiler("", 0, 3)
+    p.on_step_begin(0)
+    p.on_step_end(3)
+    p.close()
+    assert p.info() is None and not p.active
+    # Unavailable profiling (start_trace raises) records WHY, never raises.
+    import jax
+
+    monkeypatch.setattr(
+        jax.profiler, "start_trace",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("no backend")),
+    )
+    p2 = StepProfiler("/tmp/nope", 1, 2)
+    p2.on_step_begin(0)  # window not entered yet
+    assert not p2.active and p2.error is None
+    p2.on_step_begin(1)
+    assert p2.error and "no backend" in p2.error
+    p2.on_step_end(2)
+    p2.close()
+    info = p2.info()
+    assert info["captured"] is False and "no backend" in info["error"]
+
+
+def test_train_ingest_profile_window_stamped(tmp_path, jax_cpu_devices):
+    from tpubench.workloads.train_ingest import run_train_ingest
+
+    cfg = _ti_cfg(tmp_path)
+    cfg.obs.profile_dir = str(tmp_path / "prof")
+    cfg.obs.profile_steps = "1:2"
+    res = run_train_ingest(cfg)
+    prof = res.extra["profile"]
+    assert prof["steps"] == [1, 2]
+    assert prof["dir"].endswith("prof")
+    assert prof["captured"] is True
+    assert os.path.isdir(prof["dir"])  # trace actually written
+
+
+# ------------------------------------------------------------ acceptance ----
+
+
+def _ti_cfg(tmp_path, steps=6, compute_ms=0.0) -> BenchConfig:
+    cfg = BenchConfig()
+    cfg.workload.workers = 2
+    cfg.workload.object_size = 256 * 1024
+    cfg.workload.granule_bytes = 64 * 1024
+    cfg.transport.protocol = "fake"
+    cfg.staging.mode = "none"
+    cfg.obs.export = "none"
+    cfg.pipeline.steps = steps
+    cfg.pipeline.epochs = 1
+    cfg.pipeline.batch_shards = 2
+    cfg.pipeline.readahead = 2
+    cfg.pipeline.step_compute_ms = compute_ms
+    return cfg
+
+
+def test_train_ingest_telemetry_e2e_acceptance(tmp_path, capsys):
+    """The issue's acceptance pin: a hermetic fake-backend train-ingest
+    with ``--telemetry-port 0`` serves valid Prometheus exposition with
+    monotone counters mid-run, ``tpubench top --once`` renders a frame
+    from the streamed journal, and the registry's final goodput / phase
+    p50/p99 / cache hit ratio agree with post-hoc ``report timeline``
+    on the same journal within 1%."""
+    import tpubench.workloads.train_ingest as ti
+
+    jpath = str(tmp_path / "flight.json.gz")
+    cfg = _ti_cfg(tmp_path, steps=10, compute_ms=25.0)
+    cfg.obs.flight_journal = jpath
+    cfg.telemetry.enabled = True
+    cfg.telemetry.port = 0
+    cfg.telemetry.interval_s = 0.05
+
+    sessions = []
+    real = ti.telemetry_from_config
+
+    def capture(c):
+        s = real(c)
+        sessions.append(s)
+        return s
+
+    orig = ti.telemetry_from_config
+    ti.telemetry_from_config = capture
+    result = {}
+    try:
+        t = threading.Thread(
+            target=lambda: result.update(res=ti.run_train_ingest(cfg))
+        )
+        t.start()
+        deadline = time.monotonic() + 30
+        while not sessions and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sessions, "telemetry session never created"
+        sess = sessions[0]
+        while sess.port is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # Scrape until the registry has seen work (mid-run for any
+        # non-degenerate schedule; monotonicity holds regardless).
+        s1 = {}
+        while time.monotonic() < deadline:
+            body, ctype = _scrape(sess.port)
+            assert "version=0.0.4" in ctype
+            s1 = _parse_prometheus(body)
+            if s1.get("tpubench_records_total", 0) > 0:
+                break
+            time.sleep(0.02)
+        assert s1.get("tpubench_records_total", 0) > 0
+        time.sleep(0.1)
+        s2 = _parse_prometheus(_scrape(sess.port)[0])
+        for key, v1 in s1.items():
+            if key.endswith("_total") or "_bucket" in key \
+                    or key.endswith("_count"):
+                assert s2.get(key, 0) >= v1, f"counter regressed: {key}"
+        # Mid-run journal stream: `tpubench top --once` renders a frame
+        # from the live aggregator while the run is (or was just) live.
+        from tpubench.cli import main as cli_main
+
+        assert os.path.exists(jpath), "journal not streamed mid-run"
+        assert cli_main(["top", jpath, "--once"]) == 0
+        frame = capsys.readouterr().out
+        assert "tpubench top" in frame and "goodput:" in frame
+        t.join(timeout=60)
+        assert not t.is_alive()
+    finally:
+        ti.telemetry_from_config = orig
+        if sessions and sessions[0] is not None:
+            sessions[0].close()
+    res = result["res"]
+    tel = res.extra["telemetry"]
+    assert tel["port"] == sess.port
+
+    # ---- live registry vs post-hoc report timeline: within 1% ----------
+    docs = load_journals([jpath])
+    summ = timeline_summary(merge_journal_docs(docs))
+    gp_live = tel["goodput"]["gbps"]
+    gp_post = summ["goodput"]["gbps"]
+    assert gp_post > 0
+    assert gp_live == pytest.approx(gp_post, rel=0.01)
+    for phase in ("total", "body_complete", "stall_end"):
+        post = summ["phases"].get(phase)
+        live = tel["phases"].get(phase_metric_name(phase))
+        if post is None:
+            continue
+        assert live is not None, phase
+        assert live["count"] == post["count"]
+        assert live["p50_ms"] == pytest.approx(post["p50_ms"], rel=0.01)
+        assert live["p99_ms"] == pytest.approx(post["p99_ms"], rel=0.01)
+    hits = tel["counters"].get("tpubench_cache_hits_total", 0)
+    misses = tel["counters"].get("tpubench_cache_misses_total", 0)
+    assert hits == summ["pipeline"]["cache_hits"]
+    assert misses == summ["pipeline"]["cache_misses"]
+    if hits + misses:
+        live_ratio = hits / (hits + misses)
+        post_ratio = summ["pipeline"]["cache_hits"] / (
+            summ["pipeline"]["cache_hits"] + summ["pipeline"]["cache_misses"]
+        )
+        assert live_ratio == pytest.approx(post_ratio, rel=0.01)
+    # The run also carries the usual result-side stamps.
+    assert res.extra["flight_journal"] == jpath
+
+
+# ------------------------------------------------------ review hardening ----
+
+
+def test_journal_gz_host_siblings_compressed(tmp_path):
+    """host_journal_path appends ``.p<idx>`` AFTER ``.gz`` — the non-zero
+    hosts must still honor the compression the base path asked for."""
+    from tpubench.obs.flight import host_journal_path
+
+    base = str(tmp_path / "j.json.gz")
+    flight = FlightRecorder(capacity_per_worker=16, host=1)
+    _mk_records(flight, n=4)
+    sibling = host_journal_path(base, 1, 2)
+    assert sibling.endswith(".gz.p1")
+    flight.write_journal(sibling, extra={"workload": "read"})
+    with open(sibling, "rb") as f:
+        assert f.read(2) == b"\x1f\x8b"  # compressed, not plain JSON
+    docs = load_journals([sibling])
+    assert len(docs) == 1 and len(docs[0]["records"]) == 4
+
+
+def test_rotation_total_counts_each_record_once(tmp_path):
+    """Every flush re-serializes the full ring and re-drops the same
+    oldest records; the cumulative total must not inflate per tick."""
+    flight = FlightRecorder(capacity_per_worker=512)
+    _mk_records(flight, n=200, nbytes=10)
+    full = flight.write_journal(str(tmp_path / "full.json"))
+    cap = os.path.getsize(full) // 3
+    path = str(tmp_path / "j.json")
+    flight.write_journal(path, max_bytes=cap)
+    first = flight.last_rotation_dropped
+    assert first > 0
+    assert flight.rotation_dropped_total == first
+    # Identical re-flush re-drops the SAME records: total unchanged.
+    flight.write_journal(path, max_bytes=cap)
+    assert flight.last_rotation_dropped == first
+    assert flight.rotation_dropped_total == first
+    # New records push the drop-front deeper; the total grows only by
+    # the records dropped for the first time (== the latest per-write
+    # count while the front moves monotonically).
+    _mk_records(flight, n=50, nbytes=10)
+    flight.write_journal(path, max_bytes=cap)
+    assert flight.last_rotation_dropped >= first
+    assert flight.rotation_dropped_total == flight.last_rotation_dropped
+
+    # The registry counter rides the cumulative delta, not the per-write
+    # count: two ticks over an unchanged ring count the drops once.
+    sess = TelemetrySession(TelemetryConfig(enabled=True))
+    flight2 = FlightRecorder(capacity_per_worker=512)
+    _mk_records(flight2, n=200, nbytes=10)
+    sess.stream_journal(flight2, str(tmp_path / "t.json"), max_bytes=cap)
+    sess.tick()
+    sess.tick()
+    counter = sess.registry.get("tpubench_journal_rotated_records_total")
+    assert counter.value == flight2.rotation_dropped_total
+    sess.close()
+
+
+def test_histogram_exact_samples_bounded():
+    """Exact-sample memory is bounded: past EXACT_SAMPLE_CAP the list
+    decimates deterministically but count stays exact and subsampled
+    percentiles stay accurate."""
+    from tpubench.obs.telemetry import EXACT_SAMPLE_CAP, Histogram
+
+    h = Histogram("tpubench_test_ms", "bounded tail")
+    n = EXACT_SAMPLE_CAP * 2 + 137
+    for i in range(n):
+        h.observe_ns((i + 1) * 1000)
+    assert len(h._ns) < EXACT_SAMPLE_CAP
+    ex = h.exact_summary()
+    assert ex["count"] == h.count == n
+    assert ex["sample_stride"] > 1
+    # Uniform ramp: p50 ~= n/2 us.
+    assert ex["p50_ms"] == pytest.approx(n / 2 * 1000 / 1e6, rel=0.02)
+    # Under the cap the exact bit-for-bit path is untouched.
+    small = Histogram("tpubench_small_ms", "under cap")
+    small.observe_ns(2_500_000)
+    assert small.exact_summary() == {
+        "count": 1, "p50_ms": 2.5, "p99_ms": 2.5,
+    }
+
+
+def test_cli_telemetry_port_minus_one_stays_off(tmp_path):
+    """--telemetry-port -1 is the documented 'off' value: it must not
+    flip the master switch and put a tap on the hot read path."""
+    from tpubench.cli import main
+
+    out = str(tmp_path / "cfg.json")
+    assert main(["read", "--save-config", out,
+                 "--telemetry-port", "-1"]) == 0
+    cfg = BenchConfig.from_json(open(out).read())
+    assert cfg.telemetry.port == -1
+    assert cfg.telemetry.enabled is False
+    assert cfg.telemetry.active is False
+    assert telemetry_from_config(cfg) is None
+    # OTLP without an endpoint port is still a valid combination.
+    assert main(["read", "--save-config", out,
+                 "--telemetry-port", "-1", "--telemetry-otlp"]) == 0
+    cfg = BenchConfig.from_json(open(out).read())
+    assert cfg.telemetry.active is True and cfg.telemetry.port == -1
+
+
+def test_live_aggregator_pod_global_chips_merge_by_max(tmp_path):
+    """Pod workloads stamp the mesh-GLOBAL chip count into every host's
+    journal: the aggregator merges those by max (a 2-host 16-chip pod is
+    16 chips, not 32); per-host stamps still sum."""
+    from tpubench.obs.live import LiveAggregator
+
+    base = str(tmp_path / "j.json")
+    for idx, path in enumerate([base, f"{base}.p1"]):
+        flight = FlightRecorder(capacity_per_worker=16, host=idx)
+        wf = flight.worker("w0")
+        op = wf.begin("obj", "fake")
+        op.mark("first_byte")
+        op.mark("body_complete")
+        op.finish(1000)
+        flight.write_journal(path, extra={
+            "workload": "pod_ingest", "n_chips": 16, "chips_global": True,
+        })
+    view = LiveAggregator([base], window_s=60.0).poll()
+    assert view["n_chips"] == 16

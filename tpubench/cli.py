@@ -70,11 +70,42 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--trace-exporter", choices=("console", "cloud_trace"),
                    help="span export path (with --enable-tracing)")
     p.add_argument("--profile-dir", help="capture a jax.profiler xplane trace here")
+    p.add_argument("--profile-steps",
+                   help="train-ingest: bound the jax.profiler capture to "
+                        "steps N:M (inclusive; profiles the steady "
+                        "state, not warmup); path + window stamped into "
+                        "extra[\"profile\"]; no-op when jax profiling "
+                        "is unavailable")
     p.add_argument("--flight-journal",
                    help="write the per-host flight-recorder journal JSON "
                         "here (per-read phase timelines; multi-host "
-                        "processes suffix .p<idx>); render with "
-                        "`tpubench report timeline <paths...>`")
+                        "processes suffix .p<idx>; a .gz path writes "
+                        "gzip-compressed); render with "
+                        "`tpubench report timeline <paths...>` or watch "
+                        "live with `tpubench top <path>`")
+    p.add_argument("--journal-max-bytes", type=int,
+                   help="size bound for each journal write: a flush "
+                        "that would exceed it drops the OLDEST records "
+                        "with a counted rotation_dropped note (0 = "
+                        "unbounded) — long runs streaming journals "
+                        "can't fill the disk")
+    p.add_argument("--telemetry-port", type=int,
+                   help="serve live run telemetry over loopback HTTP: "
+                        "Prometheus text exposition at /metrics + JSON "
+                        "/snapshot (0 = ephemeral port, printed at "
+                        "start; off by default)")
+    p.add_argument("--telemetry-interval", type=float,
+                   help="telemetry registry tick seconds: gauge refresh, "
+                        "recorder/native-counter sampling and the "
+                        "in-run journal stream cadence (default 1.0)")
+    p.add_argument("--telemetry-otlp", action="store_true",
+                   help="periodic OTLP-shaped JSON metric export "
+                        "(dry-run capture stamped into the result "
+                        "unless --telemetry-otlp-endpoint is set)")
+    p.add_argument("--telemetry-otlp-endpoint",
+                   help="POST OTLP/HTTP JSON metric payloads here every "
+                        "telemetry.otlp_interval_s (implies "
+                        "--telemetry-otlp; stdlib urllib, no SDK)")
     p.add_argument("--flight-records", type=int,
                    help="flight-recorder ring capacity per worker "
                         "(newest records kept; 0 disables the layer)")
@@ -327,8 +358,38 @@ def build_config(args) -> BenchConfig:
         o.trace_exporter = args.trace_exporter
     if args.profile_dir:
         o.profile_dir = args.profile_dir
+    if getattr(args, "profile_steps", None):
+        o.profile_steps = args.profile_steps
+        # Validate the window at parse time (one-line SystemExit on a
+        # malformed spec), not at step N of the run.
+        from tpubench.obs.profiling import parse_profile_steps
+
+        parse_profile_steps(o.profile_steps)
     if getattr(args, "flight_journal", None):
         o.flight_journal = args.flight_journal
+    if getattr(args, "journal_max_bytes", None) is not None:
+        if args.journal_max_bytes < 0:
+            raise SystemExit(
+                f"--journal-max-bytes {args.journal_max_bytes}: must be "
+                ">= 0 (0 = unbounded)"
+            )
+        o.journal_max_bytes = args.journal_max_bytes
+    tel = cfg.telemetry
+    if getattr(args, "telemetry_port", None) is not None:
+        tel.port = args.telemetry_port
+        # -1 is the documented "off" value — it must not flip the master
+        # switch (the registry tap sits on the hot read path).
+        tel.enabled = args.telemetry_port >= 0
+    if getattr(args, "telemetry_interval", None) is not None:
+        tel.interval_s = args.telemetry_interval
+    if getattr(args, "telemetry_otlp", False):
+        tel.otlp = True
+    if getattr(args, "telemetry_otlp_endpoint", None):
+        tel.otlp = True
+        tel.otlp_endpoint = args.telemetry_otlp_endpoint
+    from tpubench.config import validate_telemetry_config
+
+    validate_telemetry_config(tel)
     if getattr(args, "flight_records", None) is not None:
         if args.flight_records < 0:
             raise SystemExit(
@@ -824,6 +885,30 @@ def main(argv=None) -> int:
                             "receive loop)")
     add("info", "print effective config and environment")
     add("preflight", "validate auth/bucket/DirectPath/engine before a run")
+    topp = sub.add_parser(
+        "top",
+        help="live terminal dashboard over streaming flight journals: "
+             "rolling goodput GB/s(/chip), per-phase p50/p99, cache hit "
+             "ratio, staging/hedge/breaker/tune counters, straggler-host "
+             "highlighting; tails <journal>(.p<idx>)(.gz) files as the "
+             "run flushes them (--telemetry-port streams every tick)",
+    )
+    topp.add_argument("journals", nargs="+",
+                      help="flight-journal base path(s); per-host "
+                           ".p<idx> siblings are discovered "
+                           "automatically")
+    topp.add_argument("--interval", type=float, default=2.0,
+                      help="refresh seconds (default 2)")
+    topp.add_argument("--once", action="store_true",
+                      help="print a single plain frame and exit "
+                           "(tests/CI)")
+    topp.add_argument("--window", type=float, default=10.0,
+                      help="rolling-goodput window seconds (default 10)")
+    topp.add_argument("--no-color", action="store_true",
+                      help="plain frames (no ANSI highlighting)")
+    topp.add_argument("--frames", type=int,
+                      help="exit after N refreshes (default: run until "
+                           "Ctrl-C)")
     rep = sub.add_parser(
         "report",
         help="summarize/compare result JSONs (percentile blocks, A/B "
@@ -837,6 +922,17 @@ def main(argv=None) -> int:
                           "followed by flight-journal paths")
 
     args = top.parse_args(argv)
+    if args.cmd == "top":
+        # Live dashboard: jax-free, no common config (like report) —
+        # runnable on a coordinator VM that never touches a device.
+        from tpubench.obs.live import run_top
+
+        return run_top(
+            args.journals, interval_s=args.interval, once=args.once,
+            window_s=args.window,
+            color=False if args.no_color else None,
+            iterations=args.frames,
+        )
     if args.cmd == "report":
         # Offline post-processing: no jax, no common config needed.
         from tpubench.workloads.report_cmd import run_report, run_timeline
@@ -948,7 +1044,11 @@ def main(argv=None) -> int:
     topo = _bringup(cfg)
     from tpubench.obs.profiling import maybe_profile
 
-    with maybe_profile(cfg.obs.profile_dir):
+    # train-ingest owns its capture (StepProfiler: step-windowed trace,
+    # extra["profile"] stamp) — wrapping it here too would nest two
+    # jax.profiler traces, which the runtime rejects.
+    outer_profile = "" if args.cmd == "train-ingest" else cfg.obs.profile_dir
+    with maybe_profile(outer_profile):
         if args.cmd == "read":
             res = cmd_read(cfg, args)
         elif args.cmd == "train-ingest":

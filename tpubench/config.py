@@ -468,6 +468,70 @@ def validate_tune_config(tc: "TuneConfig", where: str = "tune") -> None:
 
 
 @dataclass
+class TelemetryConfig:
+    """Live telemetry plane (tpubench/obs/telemetry.py): an in-process
+    pull-based metrics registry — counters, gauges, and fixed-bucket
+    latency histograms on the reference view's bucket bounds — fed
+    incrementally from the flight channel, the run's latency recorders
+    and the native ``tb_stats_*`` counters while the run is in flight.
+
+    Exposed three ways: a tiny stdlib-only HTTP endpoint (Prometheus
+    text exposition at ``/metrics`` + JSON ``/snapshot``), periodic
+    OTLP-shaped JSON export through the exporters machinery, and the
+    journal stream the live aggregator behind ``tpubench top`` tails.
+    All off by default — the reference pushes to Cloud Monitoring every
+    30 s or is blind; this is the same signal, scrapeable locally."""
+
+    # Master switch for the in-run registry; implied by port >= 0 or
+    # otlp, so `--telemetry-port 0` alone turns the plane on.
+    enabled: bool = False
+    # HTTP endpoint port: -1 = no endpoint, 0 = ephemeral (the OS picks;
+    # the run prints the bound port), >0 = fixed. Loopback only.
+    port: int = -1
+    # Registry tick (seconds): gauge refresh, recorder/native-counter
+    # sampling, and the in-run journal stream cadence.
+    interval_s: float = 1.0
+    # Periodic OTLP-shaped JSON metric export (resourceMetrics/
+    # scopeMetrics shape). Without an endpoint the payloads are captured
+    # dry-run (stamped into the result for tests/offline upload);
+    # with otlp_endpoint set they POST via stdlib urllib — no new deps.
+    otlp: bool = False
+    otlp_interval_s: float = 30.0
+    otlp_endpoint: str = ""
+
+    @property
+    def active(self) -> bool:
+        return self.enabled or self.port >= 0 or self.otlp
+
+
+def validate_telemetry_config(tc: "TelemetryConfig",
+                              where: str = "telemetry") -> None:
+    """Parse-time sanity for the telemetry knobs (one-line SystemExit at
+    config load — the validate_fault_config style)."""
+    if not (-1 <= tc.port <= 65535):
+        raise SystemExit(
+            f"{where}.port={tc.port!r}: must be -1 (off), 0 (ephemeral) "
+            "or a valid TCP port"
+        )
+    if not (tc.interval_s > 0):  # also rejects NaN
+        raise SystemExit(
+            f"{where}.interval_s={tc.interval_s!r}: must be > 0"
+        )
+    if not (tc.otlp_interval_s > 0):
+        raise SystemExit(
+            f"{where}.otlp_interval_s={tc.otlp_interval_s!r}: must be > 0"
+        )
+    if tc.otlp_endpoint and not (
+        tc.otlp_endpoint.startswith("http://")
+        or tc.otlp_endpoint.startswith("https://")
+    ):
+        raise SystemExit(
+            f"{where}.otlp_endpoint={tc.otlp_endpoint!r}: must be an "
+            "http(s) URL (the OTLP/HTTP JSON receiver)"
+        )
+
+
+@dataclass
 class TransportConfig:
     """L1 client construction knobs (reference ``main.go:30-42,62-117``)."""
 
@@ -641,6 +705,11 @@ class ObservabilityConfig:
     # (SURVEY §5.1: the DMA/collective path profiled first-class, replacing
     # the reference's attach-an-external-profiler sleeps).
     profile_dir: str = ""
+    # train-ingest only: bound the capture to a step window "N:M"
+    # (inclusive; e.g. "2:5" traces steps 2..5). Empty = the whole step
+    # loop. Parsed/validated by obs.profiling.parse_profile_steps; a
+    # no-op when jax profiling is unavailable.
+    profile_steps: str = ""
     # Flight recorder (obs/flight.py): per-worker ring capacity of
     # structured per-read phase records (enqueue/connect/first_byte/
     # body_complete/hbm_staged/gather_complete + retry annotations) — the
@@ -650,8 +719,16 @@ class ObservabilityConfig:
     # Non-empty = write the per-host flight journal JSON here at end of
     # run (stream: periodically, riding the SnapshotWriter flush path).
     # Multi-host processes suffix ".p<idx>" (snapshot-file convention);
-    # `tpubench report timeline <paths...>` merges them pod-wide.
+    # `tpubench report timeline <paths...>` merges them pod-wide. A
+    # ".gz" suffix writes the journal gzip-compressed (readers — report
+    # timeline and the live aggregator — decompress transparently).
     flight_journal: str = ""
+    # Size bound (bytes, on the serialized JSON doc) for each journal
+    # write: when a flush would exceed it, the OLDEST records are
+    # dropped and counted in the doc's `rotation_dropped` field — a
+    # long serve-shaped run streaming journals every telemetry tick
+    # must not fill the disk. 0 = unbounded.
+    journal_max_bytes: int = 0
 
 
 @dataclass
@@ -665,6 +742,7 @@ class BenchConfig:
     obs: ObservabilityConfig = field(default_factory=ObservabilityConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     tune: TuneConfig = field(default_factory=TuneConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 
     # ------------------------------------------------------------------ io --
     def to_dict(self) -> dict[str, Any]:
@@ -701,6 +779,7 @@ _SUBTYPES = {
     "obs": ObservabilityConfig,
     "pipeline": PipelineConfig,
     "tune": TuneConfig,
+    "telemetry": TelemetryConfig,
     "retry": RetryConfig,
     "fault": FaultConfig,
     "tail": TailConfig,

@@ -6,6 +6,11 @@ from tpubench.obs.flight import (  # noqa: F401
     flight_from_config,
     render_timeline,
 )
+from tpubench.obs.telemetry import (  # noqa: F401
+    TelemetryRegistry,
+    TelemetrySession,
+    telemetry_from_config,
+)
 from tpubench.obs.tracing import (  # noqa: F401
     NoopTracer,
     RecordingTracer,

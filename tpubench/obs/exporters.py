@@ -274,6 +274,173 @@ class SnapshotWriter:
         self._periodic.close()
 
 
+def load_snapshot(path: str) -> Optional[dict]:
+    """Crash-tolerant read of a SnapshotWriter file (the resume path's
+    loader): a missing, empty or truncated/partial JSON snapshot — a
+    torn write from a crash mid-flush, or a ``.tmp`` that never got its
+    atomic rename — returns ``None`` with a one-line stderr warning
+    instead of poisoning the reader with a traceback. The run then
+    starts from scratch, which is exactly what a corrupt checkpoint
+    must mean."""
+    import sys
+
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            raw = f.read()
+    except OSError as e:
+        print(f"warning: {path}: unreadable snapshot ({e}), ignored",
+              file=sys.stderr)
+        return None
+    if not raw.strip():
+        print(f"warning: {path}: empty snapshot, ignored", file=sys.stderr)
+        return None
+    try:
+        doc = json.loads(raw)
+    except json.JSONDecodeError as e:
+        print(
+            f"warning: {path}: truncated/partial snapshot "
+            f"({e.msg} at char {e.pos}), ignored",
+            file=sys.stderr,
+        )
+        return None
+    if not isinstance(doc, dict):
+        print(
+            f"warning: {path}: snapshot is not a JSON object "
+            f"({type(doc).__name__}), ignored",
+            file=sys.stderr,
+        )
+        return None
+    return doc
+
+
+class OTLPMetricsExporter:
+    """OTLP-shaped JSON metric export (resourceMetrics/scopeMetrics/
+    metrics — the OTLP/HTTP JSON wire shape) off a snapshot function,
+    periodic via :class:`PeriodicExporter` like every other exporter
+    here. Without an endpoint every payload is captured dry-run (the
+    CloudMonitoringExporter discipline: tests and offline uploaders
+    assert on ``exported``); with an endpoint set, payloads POST via
+    stdlib urllib — no OTel SDK, no new hard deps."""
+
+    def __init__(self, snapshot_fn: Callable[[], dict],
+                 endpoint: str = "", resource: Optional[dict] = None,
+                 keep_payloads: int = 64):
+        self._fn = snapshot_fn
+        self.endpoint = endpoint
+        self.resource = dict(resource or {})
+        self.exported: list[dict] = []  # dry-run / latest-payload capture
+        self._keep = max(1, keep_payloads)
+        self.posts = 0
+
+    def build_payload(self) -> dict:
+        """One OTLP ExportMetricsServiceRequest-shaped dict from the
+        registry snapshot: counters → monotonic cumulative sums, gauges
+        → gauge points, histograms → explicit-bounds histogram points."""
+        snap = self._fn()
+        now_ns = time.time_ns()
+        metrics = []
+        for name, c in snap.get("counters", {}).items():
+            points = []
+            if isinstance(c, dict):
+                # Labeled family (registry snapshot shape:
+                # {"label": <key>, "children": {<value>: n}}).
+                key = c.get("label", "label")
+                for lv, v in sorted(c.get("children", {}).items()):
+                    points.append({
+                        "asDouble": float(v),
+                        "timeUnixNano": str(now_ns),
+                        "attributes": [{
+                            "key": key,
+                            "value": {"stringValue": str(lv)},
+                        }],
+                    })
+            else:
+                points.append({
+                    "asDouble": float(c),
+                    "timeUnixNano": str(now_ns),
+                })
+            metrics.append({
+                "name": name,
+                "sum": {
+                    "dataPoints": points,
+                    "aggregationTemporality": 2,  # CUMULATIVE
+                    "isMonotonic": True,
+                },
+            })
+        for name, v in snap.get("gauges", {}).items():
+            metrics.append({
+                "name": name,
+                "gauge": {"dataPoints": [{
+                    "asDouble": float(v), "timeUnixNano": str(now_ns),
+                }]},
+            })
+        for name, h in snap.get("histograms", {}).items():
+            metrics.append({
+                "name": name,
+                "histogram": {
+                    "dataPoints": [{
+                        "count": str(h.get("count", 0)),
+                        "sum": float(h.get("sum_ms", 0.0)),
+                        "explicitBounds": [
+                            float(b) for b in h.get("bounds_ms", [])
+                        ],
+                        "bucketCounts": [
+                            str(c) for c in h.get("counts", [])
+                        ],
+                        "timeUnixNano": str(now_ns),
+                    }],
+                    "aggregationTemporality": 2,
+                },
+            })
+        return {
+            "resourceMetrics": [{
+                "resource": {"attributes": [
+                    {"key": k, "value": {"stringValue": str(v)}}
+                    for k, v in self.resource.items()
+                ]},
+                "scopeMetrics": [{
+                    "scope": {"name": "tpubench"},
+                    "metrics": metrics,
+                }],
+            }],
+        }
+
+    def export_once(self) -> None:
+        payload = self.build_payload()
+        self.exported.append(payload)
+        if len(self.exported) > self._keep:
+            # Keep the newest payloads: a day-long run's dry-run capture
+            # must not grow without bound.
+            del self.exported[: len(self.exported) - self._keep]
+        if self.endpoint:
+            import urllib.request
+
+            req = urllib.request.Request(
+                self.endpoint,
+                data=json.dumps(payload).encode("utf-8"),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10):
+                pass
+            self.posts += 1
+
+    def summary(self, periodic: Optional["PeriodicExporter"] = None) -> dict:
+        out = {
+            "payloads": len(self.exported),
+            "posts": self.posts,
+            "endpoint": self.endpoint or "dry_run",
+        }
+        if periodic is not None:
+            out["flushes"] = periodic.flush_count
+            if periodic.error_count:
+                out["flush_errors"] = periodic.error_count
+                out["last_error"] = periodic.last_error
+        return out
+
+
 class MetricsExportSession:
     """In-run periodic metric export — the reference's L2 core behavior
     (view + histogram pushed to Cloud Monitoring every 30 s DURING the run,

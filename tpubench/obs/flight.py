@@ -36,11 +36,12 @@ as the stream snapshot files); :func:`merge_journal_docs` +
 
 from __future__ import annotations
 
+import gzip
 import json
 import os
 import threading
 import time
-from typing import Iterable, Optional
+from typing import Callable, Iterable, Optional
 
 import numpy as np
 
@@ -211,14 +212,22 @@ class FlightOp:
 class WorkerFlight:
     """One worker thread's private bounded record ring (newest kept)."""
 
-    __slots__ = ("name", "capacity", "_buf", "_pos", "total")
+    __slots__ = ("name", "capacity", "_buf", "_pos", "total", "tap")
 
-    def __init__(self, name: str, capacity: int):
+    def __init__(self, name: str, capacity: int,
+                 tap: Optional[Callable[[dict], None]] = None):
         self.name = name
         self.capacity = max(1, capacity)
         self._buf: list[dict] = []
         self._pos = 0
         self.total = 0  # appends ever; total - len(buf) = dropped
+        # Live-telemetry tap (obs/telemetry.py): called once per appended
+        # record, on the appending worker's thread, BEFORE ring overwrite
+        # can drop it — so the registry sees every record even when the
+        # journal keeps only the newest. Contract: the tap must not
+        # raise (the telemetry feeder catches and counts its own
+        # errors); None = no live consumer.
+        self.tap = tap
 
     def begin(self, object_name: str, transport: str = "",
               enqueue_ns: Optional[int] = None,
@@ -228,6 +237,9 @@ class WorkerFlight:
 
     def append(self, rec: dict) -> None:
         self.total += 1
+        tap = self.tap
+        if tap is not None:
+            tap(rec)
         if len(self._buf) < self.capacity:
             self._buf.append(rec)
             return
@@ -245,6 +257,19 @@ class WorkerFlight:
         return buf[pos:] + buf[:pos]
 
 
+def _is_gz_path(path: str) -> bool:
+    """True when the journal path should be written gzip-compressed: a
+    bare ``.gz`` suffix OR a per-host ``.gz.p<idx>`` sibling
+    (:func:`host_journal_path` appends the process suffix after the
+    extension, and the non-zero hosts must honor the compression the
+    base path asked for)."""
+    base = os.path.basename(path)
+    if base.endswith(".gz"):
+        return True
+    stem, _, tail = base.rpartition(".")
+    return tail.startswith("p") and tail[1:].isdigit() and stem.endswith(".gz")
+
+
 class FlightRecorder:
     """Per-run registry of worker rings + journal/summary rendering."""
 
@@ -253,6 +278,22 @@ class FlightRecorder:
         self.host = host
         self._workers: dict[str, WorkerFlight] = {}
         self._lock = threading.Lock()
+        self._tap: Optional[Callable[[dict], None]] = None
+        # Rotation accounting: successive flushes re-serialize the same
+        # ring and re-drop the same oldest records, so the cumulative
+        # counter only counts records NEWER than the last rotation
+        # watermark (each record counted at most once).
+        self.rotation_dropped_total = 0
+        self._rotation_watermark_ns = -1
+
+    def set_tap(self, tap: Optional[Callable[[dict], None]]) -> None:
+        """Install a per-record live consumer on every ring (existing and
+        future) — the telemetry registry's feed. The tap runs on the
+        appending worker's thread and must not raise."""
+        with self._lock:
+            self._tap = tap
+            for wf in self._workers.values():
+                wf.tap = tap
 
     def activate(self) -> "_Activation":
         """Install as the run's ambient recorder for the scope: layers
@@ -267,7 +308,9 @@ class FlightRecorder:
         with self._lock:
             wf = self._workers.get(name)
             if wf is None:
-                wf = self._workers[name] = WorkerFlight(name, self.capacity)
+                wf = self._workers[name] = WorkerFlight(
+                    name, self.capacity, tap=self._tap
+                )
             return wf
 
     def records(self) -> list[dict]:
@@ -300,14 +343,45 @@ class FlightRecorder:
             doc.update(extra)
         return doc
 
-    def write_journal(self, path: str, extra: Optional[dict] = None) -> str:
+    def write_journal(self, path: str, extra: Optional[dict] = None,
+                      max_bytes: int = 0) -> str:
         """Atomic per-host journal write (same torn-JSON-proof discipline
-        as SnapshotWriter)."""
+        as SnapshotWriter). A ``.gz`` path writes gzip-compressed (so do
+        its ``.gz.p<idx>`` per-host siblings);
+        ``max_bytes`` > 0 bounds the SERIALIZED doc size by dropping the
+        oldest records (counted in the doc's ``rotation_dropped``) — the
+        disk-safety valve for long runs streaming journals every tick."""
         doc = self.journal(extra)
+        payload = json.dumps(doc)
+        self.last_rotation_dropped = 0
+        if max_bytes > 0 and len(payload) > max_bytes:
+            records = doc["records"]
+            # Records are sorted oldest-first; drop from the front until
+            # the doc fits (per-record sizes include the separator).
+            over = len(payload) - max_bytes
+            dropped = 0
+            fresh = 0
+            while records and over > 0:
+                rec = records[0]
+                over -= len(json.dumps(rec)) + 2
+                del records[0]
+                dropped += 1
+                enq = rec["phases"].get("enqueue", 0)
+                if enq > self._rotation_watermark_ns:
+                    fresh += 1
+                    self._rotation_watermark_ns = enq
+            doc["rotation_dropped"] = dropped
+            self.last_rotation_dropped = dropped
+            self.rotation_dropped_total += fresh
+            payload = json.dumps(doc)
         tmp = f"{path}.tmp"
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(tmp, "w") as f:
-            json.dump(doc, f)
+        if _is_gz_path(path):
+            with gzip.open(tmp, "wt", encoding="utf-8") as f:
+                f.write(payload)
+        else:
+            with open(tmp, "w") as f:
+                f.write(payload)
         os.replace(tmp, path)
         return path
 
@@ -462,6 +536,58 @@ def straggler_attribution(records: list[dict], by: str = "host"
     return rows
 
 
+def record_span_ns(rec: dict) -> tuple[Optional[int], Optional[int]]:
+    """(first, last) phase timestamps of a record, or (None, None) when
+    it carries no phases."""
+    ph = rec.get("phases", {})
+    ts = [ph[p] for p in PHASES if p in ph]
+    if not ts:
+        return None, None
+    return min(ts), max(ts)
+
+
+def goodput_summary(records: list[dict]) -> dict:
+    """Journal goodput: delivered bytes over the records' observed wall
+    span, per host (perf_counter timestamps are host-relative, so spans
+    never mix hosts) and summed pod-wide.
+
+    Byte credit follows the scorecard discipline: ``step`` records carry
+    a train-ingest run's consumed bytes (each chunk counted once per
+    step); without steps, ``read``-kind records' owner-credited bytes
+    are the goodput. THIS is the formula the live telemetry registry
+    computes incrementally — ``tpubench top``, the ``/snapshot``
+    endpoint and ``report timeline`` must agree because they share it.
+    """
+    per_host: dict = {}
+    for rec in records:
+        t0, t1 = record_span_ns(rec)
+        if t0 is None:
+            continue
+        h = per_host.setdefault(rec.get("host", 0), {
+            "t0": t0, "t1": t1, "read_bytes": 0, "step_bytes": 0,
+            "steps": 0,
+        })
+        h["t0"] = min(h["t0"], t0)
+        h["t1"] = max(h["t1"], t1)
+        kind = rec.get("kind", "read")
+        if kind == "step":
+            h["steps"] += 1
+            h["step_bytes"] += rec.get("bytes", 0)
+        elif kind == "read" and not rec.get("error"):
+            h["read_bytes"] += rec.get("bytes", 0)
+    hosts = {}
+    total_bytes = 0
+    total_gbps = 0.0
+    for host, h in sorted(per_host.items(), key=lambda kv: str(kv[0])):
+        nbytes = h["step_bytes"] if h["steps"] else h["read_bytes"]
+        wall_s = (h["t1"] - h["t0"]) / 1e9
+        gbps = (nbytes / 1e9) / wall_s if wall_s > 0 else 0.0
+        hosts[host] = {"bytes": nbytes, "wall_s": wall_s, "gbps": gbps}
+        total_bytes += nbytes
+        total_gbps += gbps
+    return {"bytes": total_bytes, "gbps": total_gbps, "hosts": hosts}
+
+
 def timeline_summary(records: list[dict]) -> dict:
     """Journal → {phases: per-segment p50/p99, stragglers, counts}."""
     errors = sum(1 for r in records if r.get("error"))
@@ -557,6 +683,7 @@ def timeline_summary(records: list[dict]) -> dict:
         "tune": tune,
         "pipeline": pipeline,
         "staging": staging,
+        "goodput": goodput_summary(records),
         "hosts": sorted({r.get("host", 0) for r in records}),
         "phases": _phase_stats(records),
         "stragglers": {
@@ -597,6 +724,12 @@ def render_timeline(docs: list[dict]) -> str:
     if not records:
         lines.append("  (no records)")
         return "\n".join(lines)
+    gp = summ.get("goodput", {})
+    if gp.get("bytes"):
+        lines.append(
+            f"goodput: {gp['gbps']:.4f} GB/s over {gp['bytes']} bytes "
+            f"({len(gp.get('hosts', {}))} host(s))"
+        )
     tail = summ.get("tail", {})
     if any(tail.values()):
         lines.append(
@@ -656,20 +789,38 @@ def render_timeline(docs: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def read_journal_text(path: str) -> str:
+    """Raw journal text, decompressing gzip transparently (detected by
+    magic bytes, not the filename — a rotated/renamed .gz still reads).
+    A truncated gzip stream raises like truncated JSON parses: callers
+    treat both as a partial file."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    if raw[:2] == b"\x1f\x8b":
+        raw = gzip.decompress(raw)
+    return raw.decode("utf-8", errors="replace")
+
+
 def load_journals(paths: Iterable[str]) -> list[dict]:
     """Load journal docs, degrading gracefully on partial files: an empty
     or truncated journal (a run died mid-flush, or the stream writer was
     killed between SnapshotWriter flushes) is SKIPPED with a one-line
     warning instead of a traceback — one dead host must not make the
-    pod's other journals unreadable. A well-formed JSON doc that is not
-    a flight journal is still a hard error (wrong file, not a partial
-    one)."""
+    pod's other journals unreadable. Gzip journals (``.gz``) decompress
+    transparently. A well-formed JSON doc that is not a flight journal
+    is still a hard error (wrong file, not a partial one)."""
     import sys
 
     docs = []
     for p in paths:
-        with open(p) as f:
-            raw = f.read()
+        try:
+            raw = read_journal_text(p)
+        except (OSError, EOFError, gzip.BadGzipFile) as e:
+            print(
+                f"warning: {p}: unreadable flight journal ({e}), skipped",
+                file=sys.stderr,
+            )
+            continue
         if not raw.strip():
             print(f"warning: {p}: empty flight journal, skipped",
                   file=sys.stderr)

@@ -28,6 +28,93 @@ def maybe_profile(profile_dir: str) -> Iterator[None]:
         yield
 
 
+def parse_profile_steps(spec: str) -> tuple[int, int] | None:
+    """``obs.profile_steps`` "N:M" → (N, M) inclusive step window, None
+    when empty. Malformed specs fail at parse time with a one-line
+    SystemExit (the validate_fault_config style)."""
+    if not spec:
+        return None
+    parts = spec.split(":")
+    try:
+        if len(parts) != 2:
+            raise ValueError
+        lo, hi = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise SystemExit(
+            f"obs.profile_steps={spec!r}: expected \"N:M\" "
+            "(inclusive step window, e.g. 2:5)"
+        ) from None
+    if lo < 0 or hi < lo:
+        raise SystemExit(
+            f"obs.profile_steps={spec!r}: must satisfy 0 <= N <= M"
+        )
+    return lo, hi
+
+
+class StepProfiler:
+    """Step-windowed ``jax.profiler`` capture for the train-ingest loop:
+    the trace starts when the step counter enters [start, stop] and
+    stops when it leaves — profiling a steady-state slice (steps N..M)
+    instead of burying the signal under warmup/compile steps.
+
+    A no-op that records WHY when jax profiling is unavailable (no jax,
+    profiler API missing, or a second trace already active): the run
+    must never fail because its observer couldn't attach."""
+
+    def __init__(self, profile_dir: str, start_step: int, stop_step: int):
+        self.dir = profile_dir
+        self.start_step = start_step
+        self.stop_step = stop_step
+        self.active = False
+        self.captured = False
+        self.error: str | None = None
+
+    def on_step_begin(self, step: int) -> None:
+        if (not self.dir or self.active or self.captured
+                or step != self.start_step):
+            return
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.dir)
+            self.active = True
+        except Exception as e:  # noqa: BLE001 — observer must not kill the run
+            self.error = f"{type(e).__name__}: {e}"
+
+    def on_step_end(self, step: int) -> None:
+        if self.active and step >= self.stop_step:
+            self._stop()
+
+    def close(self) -> None:
+        """Stop a still-open capture (short runs whose stop step never
+        arrived) so the trace file is complete."""
+        if self.active:
+            self._stop()
+
+    def _stop(self) -> None:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            self.captured = True
+        except Exception as e:  # noqa: BLE001
+            self.error = f"{type(e).__name__}: {e}"
+        self.active = False
+
+    def info(self) -> dict | None:
+        """The ``extra["profile"]`` stamp; None when profiling is off."""
+        if not self.dir:
+            return None
+        out = {
+            "dir": self.dir,
+            "steps": [self.start_step, self.stop_step],
+            "captured": self.captured,
+        }
+        if self.error:
+            out["error"] = self.error
+        return out
+
+
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
     """Named host-side region inside a capture (shows as a TraceAnnotation

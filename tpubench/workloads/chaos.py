@@ -335,7 +335,14 @@ def run_chaos(
         "endpoint": cfg.transport.endpoint,
         "flight_records": cfg.obs.flight_records,
         "flight_journal": cfg.obs.flight_journal,
+        "journal_max_bytes": cfg.obs.journal_max_bytes,
     }
+    # The scorecard segments a COMPLETE journal by completion time:
+    # size-bounded rotation could silently drop the baseline window's
+    # records and skew goodput-retention toward the fault window, so
+    # rotation is off for the scorecard's own journal (restored below;
+    # the ring was just sized to hold every expected read anyway).
+    cfg.obs.journal_max_bytes = 0
     reads_expected = w.read_calls_per_worker
     if chaos_workload == "train-ingest":
         pl = cfg.pipeline
@@ -445,3 +452,4 @@ def run_chaos(
         cfg.transport.endpoint = cfg_restore["endpoint"]
         cfg.obs.flight_records = cfg_restore["flight_records"]
         cfg.obs.flight_journal = cfg_restore["flight_journal"]
+        cfg.obs.journal_max_bytes = cfg_restore["journal_max_bytes"]

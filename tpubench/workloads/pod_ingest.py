@@ -87,6 +87,35 @@ class PodIngestWorkload:
         flight = flight_from_config(self.cfg)
         tlabel = transport_label(self.cfg)
 
+        # Live telemetry: short burst workload, but the registry still
+        # taps every shard record (per-phase histograms + byte counters)
+        # and the endpoint stays scrapeable for the run's duration.
+        from tpubench.obs.telemetry import telemetry_from_config
+
+        jpath_stream = (
+            host_journal_path(
+                self.cfg.obs.flight_journal, pid, jax.process_count()
+            )
+            if self.cfg.obs.flight_journal else None
+        )
+        tel = telemetry_from_config(self.cfg)
+        tel_summary = None
+        if tel is not None:
+            tel.resource["workload"] = "pod_ingest"
+            tel.set_chips(n)
+            if flight is not None:
+                tel.attach_flight(flight)
+                if jpath_stream:
+                    tel.stream_journal(
+                        flight, jpath_stream,
+                        extra_fn=lambda: {
+                            "workload": "pod_ingest", "n_chips": n,
+                            "chips_global": True,
+                        },
+                        max_bytes=self.cfg.obs.journal_max_bytes,
+                    )
+            tel.start()
+
         def fetch(k: int, cancel) -> None:
             op = (
                 flight.worker(f"shard{local_idx[k]}").begin(name, tlabel)
@@ -207,14 +236,20 @@ class PodIngestWorkload:
         )
         if pod_op is not None:
             pod_op.finish(delivered)
+        if tel is not None:
+            # The pod record above was the last append: registry final.
+            # (All session threads are daemons, so an aborting run can
+            # never be held open by its observer.)
+            tel_summary = tel.close()
+            res.extra["telemetry"] = tel_summary
         if flight is not None:
             res.extra["flight"] = flight.summary()
-            if self.cfg.obs.flight_journal:
+            if jpath_stream:
                 res.extra["flight_journal"] = flight.write_journal(
-                    host_journal_path(
-                        self.cfg.obs.flight_journal, pid, jax.process_count()
-                    ),
-                    extra={"workload": "pod_ingest"},
+                    jpath_stream,
+                    extra={"workload": "pod_ingest", "n_chips": n,
+                           "chips_global": True},
+                    max_bytes=self.cfg.obs.journal_max_bytes,
                 )
         # One-burst workload: cloud export is a single final flush of the
         # stage-separated numbers (the periodic loop belongs to the long

@@ -164,12 +164,13 @@ class StreamedPodIngest:
         prior_done = 0
         prior_resume = 0
         if resume_path:
-            import json as _json
-            import os as _os
+            from tpubench.obs.exporters import load_snapshot
 
-            if _os.path.exists(resume_path):
-                with open(resume_path) as f:
-                    prior = _json.load(f)
+            # Crash-tolerant load: a torn/partial snapshot (killed
+            # mid-flush) is a one-line warning + fresh start, never a
+            # traceback that blocks the resume path entirely.
+            prior = load_snapshot(resume_path)
+            if prior is not None:
                 # resume_point = consecutively COMPLETE objects from stream
                 # start (objects delivered with holes do not advance it, so
                 # a resume re-fetches them instead of baking the holes in).
@@ -271,14 +272,37 @@ class StreamedPodIngest:
             if flight is not None and self.cfg.obs.flight_journal
             else None
         )
+        # write_journal (not SnapshotWriter's raw dump) so in-run flushes
+        # get the same .gz compression and size-bounded rotation as every
+        # other journal writer; PeriodicExporter keeps the cadence + the
+        # guaranteed final flush.
         flight_ctx = (
-            SnapshotWriter(
-                flight.journal, flight_path, interval_s=5.0,
-                process_index=pid,
+            PeriodicExporter(
+                lambda: flight.write_journal(
+                    flight_path,
+                    extra={"workload": "pod_ingest_stream", "n_chips": n,
+                           "chips_global": True},
+                    max_bytes=self.cfg.obs.journal_max_bytes,
+                ),
+                interval_s=5.0,
             )
             if flight_path
             else None
         )
+
+        # Live telemetry: flight tap + scrapeable endpoint; the journal
+        # stream above already feeds `tpubench top`, so the session does
+        # not double-write it.
+        from tpubench.obs.telemetry import telemetry_from_config
+
+        tel = telemetry_from_config(self.cfg)
+        tel_summary = None
+        if tel is not None:
+            tel.resource["workload"] = "pod_ingest_stream"
+            tel.set_chips(n)
+            if flight is not None:
+                tel.attach_flight(flight)
+            tel.start()
 
         # In-run cloud export (metrics_exporter.go:36-58): stream progress
         # gauges every metrics_interval_s during the run + final flush — a
@@ -420,6 +444,9 @@ class StreamedPodIngest:
             if cloud_periodic is not None:
                 cloud_periodic.close()  # guaranteed final flush
                 cloud_exp.close()
+            if tel is not None:
+                # The stream loop is done appending: registry final.
+                tel_summary = tel.close()
         wall = time.perf_counter() - t_wall0
 
         device_s = stage_s + gather_s
@@ -459,6 +486,8 @@ class StreamedPodIngest:
         )
         if cloud_exp is not None:
             res.extra["metrics_export"] = cloud_exp.summary(cloud_periodic)
+        if tel_summary is not None:
+            res.extra["telemetry"] = tel_summary
         if flight is not None:
             res.extra["flight"] = flight.summary()
             if flight_path:

@@ -145,6 +145,32 @@ class ReadWorkload:
         eng0 = peek_engine()
         native_stats0 = eng0.stats() if eng0 is not None else {}
 
+        # Live telemetry (obs/telemetry.py): registry fed record-by-record
+        # off the flight tap, read latency sampled incrementally off the
+        # per-worker recorders, journal streamed each tick for `top`.
+        from tpubench.obs.telemetry import telemetry_from_config
+
+        jpath_stream = None
+        if self.cfg.obs.flight_journal:
+            d = self.cfg.dist
+            jpath_stream = host_journal_path(
+                self.cfg.obs.flight_journal, d.process_id, d.num_processes
+            )
+        tel = telemetry_from_config(self.cfg)
+        tel_summary = None
+        if tel is not None:
+            tel.resource["workload"] = "read"
+            if flight is not None:
+                tel.attach_flight(flight)
+                if jpath_stream:
+                    tel.stream_journal(
+                        flight, jpath_stream,
+                        extra_fn=lambda: {"workload": "read"},
+                        max_bytes=self.cfg.obs.journal_max_bytes,
+                    )
+            tel.attach_recorders(metrics.read_latency)
+            tel.start()
+
         # Adaptive tuning (tpubench/tune/): an elastic gate makes worker
         # fan-out a LIVE knob — all threads spawn, the controller admits
         # a subset; parked workers resume when it grows the pool back.
@@ -300,6 +326,20 @@ class ReadWorkload:
             if session is not None:
                 # Guaranteed final flush — now with complete counters.
                 session.__exit__(None, None, None)
+            if tel is not None:
+                # Workers have joined and every sink finished: the tapped
+                # record set is final. Closed in the finally so the HTTP
+                # server and tick thread never outlive a failed run.
+                from tpubench.staging.stats import staging_extra as _sx
+
+                _blk = _sx(sink_stats)
+                tel.set_chips(
+                    int(sink_stats[0].get("n_chips", 1) or 1)
+                    if sink_stats else 1
+                )
+                tel_summary = tel.close(
+                    final_extra={"staging": _blk} if _blk else None
+                )
 
         wall = metrics.ingest.seconds
         gbps = metrics.ingest.gbps()
@@ -318,6 +358,8 @@ class ReadWorkload:
         )
         if session is not None:
             res.extra["metrics_export"] = session.summary()
+        if tel_summary is not None:
+            res.extra["telemetry"] = tel_summary
         if tune_stats is not None:
             res.extra["tune"] = tune_stats
         # Native-receive connection accounting (connects/reuses/
@@ -393,15 +435,13 @@ class ReadWorkload:
                 res.extra["native_transport"] = native_delta
         if flight is not None:
             res.extra["flight"] = flight.summary()
-            jpath = self.cfg.obs.flight_journal
-            if jpath:
-                d = self.cfg.dist
-                extra = {"workload": "read"}
+            if jpath_stream:
+                extra = {"workload": "read", "n_chips": n_chips}
                 if native_delta:
                     extra["native_transport"] = native_delta
                 res.extra["flight_journal"] = flight.write_journal(
-                    host_journal_path(jpath, d.process_id, d.num_processes),
-                    extra=extra,
+                    jpath_stream, extra=extra,
+                    max_bytes=self.cfg.obs.journal_max_bytes,
                 )
         return res
 

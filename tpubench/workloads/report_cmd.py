@@ -115,6 +115,27 @@ def summarize_run(run: dict, label: str = "") -> str:
         from tpubench.workloads.train_ingest import format_pipeline_scorecard
 
         lines.append(format_pipeline_scorecard(pipe))
+    tel = extra.get("telemetry")
+    if tel:
+        # Live-telemetry stamp: where the run was scrapeable and what
+        # the registry's final rollup said — the post-hoc counterpart of
+        # `tpubench top` (and the agreement surface the acceptance test
+        # pins against `report timeline`).
+        gp = tel.get("goodput", {})
+        line = (
+            f"  telemetry: scrapes={tel.get('scrapes', 0)} "
+            f"ticks={tel.get('ticks', 0)} "
+            f"live goodput={gp.get('gbps', 0.0):.4f} GB/s"
+        )
+        if tel.get("port") is not None:
+            line += f" (served on :{tel['port']})"
+        otlp = tel.get("otlp")
+        if otlp:
+            line += (
+                f"  otlp: {otlp.get('payloads', 0)} payloads -> "
+                f"{otlp.get('endpoint', 'dry_run')}"
+            )
+        lines.append(line)
     tune = extra.get("tune")
     if tune:
         # Tune block: a `tpubench tune` result carries the full

@@ -57,6 +57,8 @@ from tpubench.obs.flight import (
     host_journal_path,
     transport_label,
 )
+from tpubench.obs.profiling import StepProfiler, parse_profile_steps
+from tpubench.obs.telemetry import telemetry_from_config
 from tpubench.pipeline.cache import ChunkCache, ChunkKey
 from tpubench.pipeline.prefetch import Prefetcher, fetch_chunk
 from tpubench.tune.controller import prefetch_workers_ceiling as _pf_ceiling
@@ -240,6 +242,38 @@ class _TrainIngest:
         consumed_bytes = 0
         compute_s = p.step_compute_ms / 1e3
 
+        # Live telemetry (obs/telemetry.py): registry fed record-by-record
+        # off the flight tap, demand-fetch latency sampled each tick, and
+        # the journal streamed so `tpubench top` can watch the run.
+        jpath_stream = None
+        if cfg.obs.flight_journal:
+            jpath_stream = host_journal_path(
+                cfg.obs.flight_journal, cfg.dist.process_id,
+                cfg.dist.num_processes,
+            )
+        tel = telemetry_from_config(cfg)
+        if tel is not None:
+            tel.resource["workload"] = "train_ingest"
+            if flight is not None:
+                tel.attach_flight(flight)
+                if jpath_stream:
+                    tel.stream_journal(
+                        flight, jpath_stream,
+                        extra_fn=lambda: {"workload": "train_ingest"},
+                        max_bytes=cfg.obs.journal_max_bytes,
+                    )
+            tel.attach_recorders([fetch_rec])
+            tel.start()
+
+        # Step-windowed jax.profiler capture (obs/profiling.py): owns the
+        # trace for this workload (the CLI's whole-run wrap steps aside);
+        # defaults to the full step loop when no window is configured.
+        prof_window = parse_profile_steps(cfg.obs.profile_steps) \
+            or (0, total_steps - 1)
+        profiler = StepProfiler(
+            cfg.obs.profile_dir, prof_window[0], prof_window[1]
+        )
+
         stager = self._make_stager()
         mesh = reassemble = None
         if p.pod:
@@ -255,6 +289,7 @@ class _TrainIngest:
         pf: Optional[Prefetcher] = None
         controller = None
         tune_stats = None
+        tel_summary = None
         tune_on = getattr(cfg, "tune", None) is not None and cfg.tune.enabled
         activation = (
             flight.activate() if flight is not None
@@ -290,6 +325,7 @@ class _TrainIngest:
                         controller.start()
                 step_t0 = time.perf_counter_ns()
                 for step in range(total_steps):
+                    profiler.on_step_begin(step)
                     lo = step * batch
                     keys = plan[lo : lo + batch]
                     op = (
@@ -439,16 +475,33 @@ class _TrainIngest:
                         time.sleep(compute_s)
                     if op is not None:
                         op.finish(step_bytes)
+                    profiler.on_step_end(step)
                     now = time.perf_counter_ns()
                     step_rec.record_ns(now - step_t0)
                     step_t0 = now
         finally:
+            profiler.close()
             if controller is not None:
                 tune_stats = controller.stop()
             if pf is not None:
                 pf.close()
             if stager is not None:
                 sink_stats = stager.finish() or {}
+            if tel is not None:
+                # stager.finish() above drained the window's reaper, so
+                # every stage record has landed: the registry is final.
+                # Closed HERE (not after result assembly) so the HTTP
+                # server and tick thread never outlive a failed run.
+                from tpubench.staging.stats import staging_extra as _sx
+
+                _blk = _sx([sink_stats]) if sink_stats else None
+                if p.pod and mesh is not None:
+                    tel.set_chips(int(mesh.devices.size))
+                else:
+                    tel.set_chips(int(sink_stats.get("n_chips", 1) or 1))
+                tel_summary = tel.close(
+                    final_extra={"staging": _blk} if _blk else None
+                )
         wall = (time.perf_counter_ns() - t_run0) / 1e9
 
         # ------------------------------------------------------- result ----
@@ -528,6 +581,11 @@ class _TrainIngest:
         res.extra["pipeline"] = pipe_extra
         if tune_stats is not None:
             res.extra["tune"] = tune_stats
+        if tel_summary is not None:
+            res.extra["telemetry"] = tel_summary
+        prof_info = profiler.info()
+        if prof_info is not None:
+            res.extra["profile"] = prof_info
         if sink_stats.get("staged_bytes"):
             res.extra["staged_bytes"] = sink_stats["staged_bytes"]
         from tpubench.staging.stats import staging_extra
@@ -542,15 +600,19 @@ class _TrainIngest:
             res.extra["tail"] = tail_stats
         if flight is not None:
             res.extra["flight"] = flight.summary()
-            jpath = cfg.obs.flight_journal
-            if jpath:
-                d = cfg.dist
+            if jpath_stream:
                 res.extra["flight_journal"] = flight.write_journal(
-                    host_journal_path(jpath, d.process_id, d.num_processes),
+                    jpath_stream,
                     extra={
                         "workload": "train_ingest",
                         "pipeline_copies": pipe_extra["copies"],
+                        "n_chips": n_chips,
+                        # Pod path stamps the mesh-global chip count (the
+                        # same number on every host); the local stager
+                        # stamp is per-host.
+                        "chips_global": bool(p.pod and mesh is not None),
                     },
+                    max_bytes=cfg.obs.journal_max_bytes,
                 )
         return res
 

@@ -215,6 +215,13 @@ def run_sweep(cfg: BenchConfig, workload: str,
     def run_cell(values: dict) -> dict:
         c = _clone(cfg)
         c.tune.enabled = False
+        # One short cell per knob value: a dozen bind/teardown cycles of
+        # the telemetry HTTP endpoint (and OTLP flush loops) are churn,
+        # not signal — the plane stays on for the ONLINE/adaptive arm,
+        # which is the long-lived run `tpubench top` watches.
+        c.telemetry.enabled = False
+        c.telemetry.port = -1
+        c.telemetry.otlp = False
         apply_knob_values(c, values)
         if before_run is not None:
             before_run()
