@@ -233,6 +233,61 @@ def _tune_ab_cell() -> dict:
     }
 
 
+def _coop_cache_cell() -> dict:
+    """Coop-vs-per-host A/B on the hermetic simulated pod (BENCH_r06+):
+    2- and 4-host threaded pods over the loopback peer channel, fixed
+    seed, Zipf-hot object set, shared fake origin — each pod size run
+    once with cooperative routing and once as N independent per-host
+    caches (the identical machinery with routing disabled, so the delta
+    IS the cooperation). Emits ``origin_bytes_per_pod`` both arms plus
+    the saved ratio; the smoke guard pins that coop never fetches more
+    origin bytes than the baseline. CPU-only and jax-free, so it rides
+    the quiet-CPU segment with the fetch/tune A/Bs."""
+    from tpubench.pipeline.coop import run_coop_sim
+
+    out: dict = {}
+    for n_hosts in (2, 4):
+        kw = dict(
+            n_hosts=n_hosts, n_objects=4, object_bytes=2 * MB,
+            chunk_bytes=256 * 1024, accesses_per_host=96, alpha=1.2,
+            seed=7,
+        )
+        coop = run_coop_sim(coop=True, **kw)
+        base = run_coop_sim(coop=False, **kw)
+        if coop["errors"] or base["errors"]:
+            raise RuntimeError(
+                f"coop cell ({n_hosts} hosts) had host errors: "
+                f"{coop['errors'] or base['errors']}"
+            )
+        cb, bb = coop["origin_bytes_per_pod"], base["origin_bytes_per_pod"]
+        out[str(n_hosts)] = {
+            "n_hosts": n_hosts,
+            "coop_origin_bytes_per_pod": cb,
+            "baseline_origin_bytes_per_pod": bb,
+            "origin_bytes_saved_ratio": (
+                round(1.0 - cb / bb, 4) if bb else None
+            ),
+            "max_origin_fetches_per_chunk": (
+                coop["max_origin_fetches_per_chunk"]
+            ),
+            "baseline_max_origin_fetches_per_chunk": (
+                base["max_origin_fetches_per_chunk"]
+            ),
+            "pod_hit_ratio": (
+                round(coop["pod_hit_ratio"], 4)
+                if coop["pod_hit_ratio"] is not None else None
+            ),
+            "peer_hit_ratio": (
+                round(coop["peer_hit_ratio"], 4)
+                if coop["peer_hit_ratio"] is not None else None
+            ),
+            "peer_hits": coop["peer_hits"],
+            "peer_bytes": coop["peer_bytes"],
+            "pod_coalesced": coop["pod_coalesced"],
+        }
+    return out
+
+
 def _staging_depth_cell(depth: int) -> dict:
     """One cell of the staging-depth sweep: the staged config with the
     overlapped executor's in-flight window at ``depth`` (1 = the serial
@@ -351,6 +406,14 @@ def main() -> int:
         tune_ab = _tune_ab_cell()
     except Exception as e:  # noqa: BLE001 — the bench must not die here
         print(f"# tune A/B failed: {e}", file=sys.stderr)
+
+    # Coop-vs-per-host cache A/B: hermetic threaded pod, CPU-only and
+    # jax-free — same quiet-CPU segment as the fetch/tune A/Bs.
+    coop_cache: dict = {}
+    try:
+        coop_cache = _coop_cache_cell()
+    except Exception as e:  # noqa: BLE001 — the bench must not die here
+        print(f"# coop cache A/B failed: {e}", file=sys.stderr)
 
     dev = jax.local_devices()[0]  # first jax touch: AFTER the quiet-CPU A/B
 
@@ -617,6 +680,7 @@ def main() -> int:
                 "gap_breakdown": gap,
                 "fetch_only_ab": fetch_ab,
                 "tune_ab": tune_ab,
+                "coop_cache": coop_cache,
                 "shaped_verdict": shaped,
                 "probe_divergence_factor": pdf,
                 "host_cores": _usable_cores(),

@@ -75,4 +75,18 @@ res = run_pod_ingest(cfg, backend=backend, verify=True)
 assert res.errors == 0, res.extra
 assert res.n_chips == 4 * nproc
 
+# 3. Real-ICI lockstep peer broadcast (the coop cache's `--coop-channel
+# ici` transport): every process enters the collective with the same
+# (owner, key); only the owner contributes bytes, and every process —
+# owner included — receives the owner's chunk off the mesh.
+from tpubench.dist.peer import IciPeerChannel  # noqa: E402
+from tpubench.pipeline.cache import ChunkKey  # noqa: E402
+
+chunk = deterministic_bytes("mh/chunk", 50_000).tobytes()
+ch = IciPeerChannel(mesh=mesh, host_id=pid)
+ckey = ChunkKey("b", "mh/chunk", 1, 0, len(chunk))
+got = ch.broadcast(0, chunk if pid == 0 else None, ckey)
+assert got == chunk, "ICI peer broadcast returned different bytes"
+assert ch.stats()["multiprocess"]
+
 print(f"multihost-ok process={pid}")

@@ -84,6 +84,15 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "telemetry: live telemetry plane (registry/endpoint/top)"
     )
+    # Coop tests (pod-scale cooperative chunk cache: consistent-hash
+    # ring, peer channels, pod-wide single-flight, straggler demotion)
+    # stay in tier-1 — same policy as the other subsystem markers: the
+    # hermetic multi-"host" suite runs threaded hosts over the loopback
+    # peer channel in-process, so it needs no TPU or multihost env; the
+    # real-ICI channel rides the env-gated `multihost` marker instead.
+    config.addinivalue_line(
+        "markers", "coop: cooperative chunk cache (ring/peer/single-flight)"
+    )
     # Multihost tests are marker-gated (see tests/test_multihost.py):
     # they need working multi-process jax.distributed, which this
     # container lacks — tier-1 collects clean skips, not failures.

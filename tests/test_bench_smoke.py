@@ -80,6 +80,18 @@ def test_bench_end_to_end_cpu():
     # the overlapped executor; the regression guard — depth > 1 never
     # reports LOWER staging_efficiency than depth 1 (small tolerance for
     # scheduler noise on a 1-core host).
+    # Coop-cache A/B cell (PR 8): 2/4-host simulated pods, with the
+    # regression guard — coop never fetches MORE origin bytes than the
+    # per-host baseline, and pod-wide single-flight holds (exactly one
+    # origin fetch per chunk across the whole pod).
+    coop = d["coop_cache"]
+    assert set(coop) == {"2", "4"}
+    for n, c in coop.items():
+        assert (c["coop_origin_bytes_per_pod"]
+                <= c["baseline_origin_bytes_per_pod"]), (
+            f"{n}-host coop cell fetched more origin bytes than baseline"
+        )
+        assert c["max_origin_fetches_per_chunk"] == 1
     sweep = d["staging_depth_sweep"]
     assert set(sweep) == {"1", "2", "4"}
     assert sweep["1"]["drain"] == "inline"

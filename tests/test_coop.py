@@ -1,0 +1,1299 @@
+"""Pod-scale cooperative chunk cache: consistent-hash ownership ring,
+peer channels, pod-wide single-flight, straggler demotion, and the
+hermetic coop-vs-per-host A/B acceptance (threaded multi-"host" pod over
+the loopback peer channel — no TPU, no network, no multihost env)."""
+
+import threading
+import time
+
+import pytest
+
+from tpubench.config import BenchConfig, CoopConfig, validate_coop_config
+from tpubench.pipeline.cache import ChunkCache, ChunkKey
+from tpubench.pipeline.coop import (
+    CoopCache,
+    HashRing,
+    LoopbackBroker,
+    LoopbackChannel,
+    PeerBackend,
+    PeerMissError,
+    chunk_point,
+    decode_chunk_name,
+    encode_chunk_name,
+    run_coop_sim,
+    wrap_peer_backend,
+    zipf_plan,
+)
+from tpubench.storage.base import ObjectMeta, StorageError
+
+pytestmark = pytest.mark.coop
+
+MB = 1024 * 1024
+
+
+def key(name="o", gen=1, start=0, length=100, bucket="b") -> ChunkKey:
+    return ChunkKey(bucket, name, gen, start, length)
+
+
+def _keys(n: int, length: int = 1024) -> list[ChunkKey]:
+    return [
+        ChunkKey("b", f"obj_{i // 8}", 1, (i % 8) * length, length)
+        for i in range(n)
+    ]
+
+
+# ------------------------------------------------------- consistent hash ---
+
+
+def test_ring_ownership_identical_across_hosts():
+    """Every host computes the same owner for every key from the same
+    membership, regardless of construction order — ownership needs no
+    coordination."""
+    a = HashRing([0, 1, 2, 3])
+    b = HashRing([3, 1, 0, 2])  # same membership, different order
+    for k in _keys(500):
+        assert a.owner(k) == b.owner(k)
+
+
+def test_ring_join_remaps_about_one_over_n():
+    """Adding one host to an N-host ring moves ~1/(N+1) of the keys —
+    never an order of magnitude more (the rehash-minimality property
+    virtual nodes exist for)."""
+    n = 5
+    ks = _keys(2000)
+    before = {k: HashRing(range(n)).owner(k) for k in ks}
+    grown = HashRing(range(n + 1))
+    moved = sum(1 for k in ks if grown.owner(k) != before[k])
+    expected = len(ks) / (n + 1)
+    assert moved <= 2.0 * expected, (
+        f"{moved}/{len(ks)} keys moved on a join; expected ~{expected:.0f}"
+    )
+    # Every moved key moved TO the new host (consistent hashing: a join
+    # only steals keys, it never shuffles them between old hosts).
+    for k in ks:
+        if grown.owner(k) != before[k]:
+            assert grown.owner(k) == n
+
+
+def test_ring_leave_remaps_only_the_leavers_keys():
+    n = 5
+    ks = _keys(2000)
+    full = HashRing(range(n))
+    before = {k: full.owner(k) for k in ks}
+    shrunk = HashRing(range(n - 1))  # host n-1 left
+    for k in ks:
+        if before[k] != n - 1:
+            assert shrunk.owner(k) == before[k], (
+                "a key not owned by the leaver moved on its departure"
+            )
+        else:
+            assert shrunk.owner(k) != n - 1
+
+
+def test_ring_demote_restore_returns_exact_original_points():
+    ring = HashRing([0, 1, 2])
+    ks = _keys(600)
+    before = {k: ring.owner(k) for k in ks}
+    assert ring.demote(1)
+    assert ring.demoted == {1}
+    assert ring.active_hosts == {0, 2}
+    for k in ks:
+        owner = ring.owner(k)
+        assert owner != 1
+        if before[k] != 1:
+            # Demotion is rehash-minimal too: only the straggler's keys
+            # move.
+            assert owner == before[k]
+    assert not ring.demote(1)  # idempotent
+    assert ring.restore(1)
+    assert {k: ring.owner(k) for k in ks} == before
+    assert not ring.restore(1)
+
+
+def test_ring_empty_and_single_host():
+    assert HashRing([]).owner(key()) is None
+    ring = HashRing([7])
+    assert ring.owner(key()) == 7
+    ring.demote(7)
+    assert ring.owner(key()) is None  # all demoted = empty lookup
+
+
+def test_chunk_point_hashes_full_identity():
+    """The ring position covers (bucket, object, generation, range):
+    stable across calls, distinct across any component change."""
+    k = key()
+    assert chunk_point(k) == chunk_point(key())
+    assert chunk_point(k) != chunk_point(key(gen=2))
+    assert chunk_point(k) != chunk_point(key(start=100))
+    assert chunk_point(k) != chunk_point(key(bucket="other"))
+
+
+# ------------------------------------------------ peer backend + channel ---
+
+
+def test_encode_decode_chunk_name_roundtrip():
+    k = ChunkKey("bkt", "dir/obj.bin", 42, 4096, 1024)
+    assert decode_chunk_name(encode_chunk_name(k), 4096, 1024) == k
+
+
+class _FlakyChannel:
+    """PeerChannel double: fails transiently ``fail`` times, then
+    serves ``data``."""
+
+    lockstep = False
+
+    def __init__(self, host_id: int, data: bytes, fail: int = 0,
+                 miss: bool = False):
+        self.host_id = host_id
+        self._data = data
+        self._fail = fail
+        self._miss = miss
+        self.requests = 0
+
+    def request(self, owner: int, k: ChunkKey) -> bytes:
+        self.requests += 1
+        if self._miss:
+            raise PeerMissError("owner shed")
+        if self._fail > 0:
+            self._fail -= 1
+            raise StorageError("peer channel flake", transient=True,
+                               code=503)
+        return self._data
+
+    def close(self) -> None:
+        pass
+
+
+def _retry_cfg():
+    cfg = BenchConfig()
+    r = cfg.transport.retry
+    r.policy = "always"
+    r.max_attempts = 4
+    r.initial_backoff_s = 0.0
+    r.max_backoff_s = 0.0
+    return r
+
+
+def test_peer_backend_composes_under_retry():
+    """A transient channel error re-asks the owner through the ordinary
+    RetryingBackend — the peer tier is a backend like any other."""
+    k = key(length=8)
+    ring = HashRing([0, 1])
+    # Force ownership to the remote host by picking a key host 1 owns.
+    while ring.owner(k) != 1:
+        k = ChunkKey("b", k.object, k.generation, k.start + 8, 8)
+    ch = _FlakyChannel(0, b"x" * 8, fail=2)
+    be = wrap_peer_backend(ch, ring, _retry_cfg())
+    r = be.open_read(encode_chunk_name(k), start=k.start, length=k.length)
+    buf = bytearray(8)
+    assert r.readinto(memoryview(buf)) == 8
+    assert bytes(buf) == b"x" * 8
+    assert ch.requests == 3  # 2 transient failures + 1 success
+
+
+def test_peer_miss_is_non_transient_and_surfaces_immediately():
+    k = key(length=8)
+    ring = HashRing([0, 1])
+    while ring.owner(k) != 1:
+        k = ChunkKey("b", k.object, k.generation, k.start + 8, 8)
+    ch = _FlakyChannel(0, b"", miss=True)
+    be = wrap_peer_backend(ch, ring, _retry_cfg())
+    with pytest.raises(PeerMissError):
+        be.open_read(encode_chunk_name(k), start=k.start, length=k.length)
+    assert ch.requests == 1  # retry stack did NOT re-ask
+
+
+def test_peer_backend_short_serve_is_transient():
+    k = key(length=8)
+    ring = HashRing([0, 1])
+    while ring.owner(k) != 1:
+        k = ChunkKey("b", k.object, k.generation, k.start + 8, 8)
+    be = PeerBackend(_FlakyChannel(0, b"xy"), ring)  # 2 B for an 8 B ask
+    with pytest.raises(StorageError) as ei:
+        be.open_read(encode_chunk_name(k), start=k.start, length=k.length)
+    assert ei.value.transient
+
+
+def test_peer_retry_attempts_are_bounded():
+    """An unbounded origin retry policy (max_attempts=0 = forever) must
+    not park a read behind a persistently failing peer: the peer tier
+    caps attempts — the origin fallback is always available."""
+    from tpubench.pipeline.coop import PEER_MAX_ATTEMPTS
+
+    k = key(length=8)
+    ring = HashRing([0, 1])
+    while ring.owner(k) != 1:
+        k = ChunkKey("b", k.object, k.generation, k.start + 8, 8)
+    cfg = _retry_cfg()
+    cfg.max_attempts = 0  # the gax default: retry forever
+    ch = _FlakyChannel(0, b"x" * 8, fail=10**6)
+    be = wrap_peer_backend(ch, ring, cfg)
+    with pytest.raises(StorageError):
+        be.open_read(encode_chunk_name(k), start=k.start, length=k.length)
+    assert ch.requests == PEER_MAX_ATTEMPTS
+
+
+def test_peer_backend_self_owned_key_is_a_miss():
+    """The peer backend only serves REMOTE chunks: a ring lookup landing
+    on self (or an empty ring) is a definitive miss — the coop layer
+    fetches origin instead."""
+    ring = HashRing([0])
+    be = PeerBackend(_FlakyChannel(0, b""), ring)
+    k = key(length=8)
+    with pytest.raises(PeerMissError):
+        be.open_read(encode_chunk_name(k), start=k.start, length=k.length)
+    with pytest.raises(ValueError, match="length"):
+        be.open_read(encode_chunk_name(k))  # unranged peer read
+
+
+def test_loopback_broker_routes_and_sheds():
+    broker = LoopbackBroker()
+    served: list[ChunkKey] = []
+
+    def serve(k: ChunkKey):
+        served.append(k)
+        return b"z" * k.length
+
+    broker.register(1, serve)
+    ch = LoopbackChannel(broker, 0)
+    assert ch.request(1, key(length=4)) == b"zzzz"
+    assert len(served) == 1
+    # Unregistered host: DEFINITIVE miss, not transient — retrying a
+    # broker that has never seen the host can't make it appear, and the
+    # origin fallback is one step away.
+    with pytest.raises(PeerMissError):
+        ch.request(9, key())
+    broker.register(2, lambda k: None)  # shedding owner
+    with pytest.raises(PeerMissError):
+        ch.request(2, key())
+    ch.close()  # unregisters host 0 only
+    assert ch.request(1, key(length=1)) == b"z"
+
+
+# -------------------------------------------------- CoopCache unit paths ---
+
+
+def _pod(n_hosts: int, origin, **kw):
+    """N CoopCaches over one loopback broker + shared origin callable
+    (origin(key) -> bytes). Returns (broker, ring, [CoopCache])."""
+    broker = LoopbackBroker()
+    ring = HashRing(range(n_hosts))
+    coops = []
+    for h in range(n_hosts):
+        cc = CoopCache(
+            ChunkCache(64 * MB),
+            host_id=h,
+            ring=ring,
+            channel=LoopbackChannel(broker, h),
+            origin_fetch=origin,
+            **kw,
+        )
+        broker.register(h, cc.serve)
+        coops.append(cc)
+    return broker, ring, coops
+
+
+def _owned_by(ring: HashRing, host: int, length: int = 64) -> ChunkKey:
+    k = ChunkKey("b", "hot", 1, 0, length)
+    while ring.owner(k) != host:
+        k = ChunkKey("b", k.object, 1, k.start + length, length)
+    return k
+
+
+def test_follower_miss_resolves_over_peer_channel():
+    fetches: list[ChunkKey] = []
+
+    def origin(k: ChunkKey) -> bytes:
+        fetches.append(k)
+        return b"d" * k.length
+
+    _, ring, coops = _pod(2, origin)
+    k = _owned_by(ring, 1)
+    got = coops[0].fetch(k)  # host 0 is a follower for k
+    assert got == b"d" * k.length
+    assert len(fetches) == 1  # the OWNER fetched origin, exactly once
+    s0, s1 = coops[0].stats(), coops[1].stats()
+    assert s0["peer_requests"] == 1 and s0["peer_hits"] == 1
+    assert s0["peer_hit_ratio"] == 1.0
+    assert s0["peer_bytes"] == k.length
+    assert s0["origin_fetches"] == 0
+    assert s1["peer_serves"] == 1 and s1["owner_fetches"] == 1
+    # The owner's cache now holds the chunk: a second follower ask is a
+    # serve-side cache hit, still zero new origin fetches.
+    assert coops[0].fetch(k) == b"d" * k.length
+    assert len(fetches) == 1
+
+
+def test_owner_fetches_origin_directly():
+    fetches: list[ChunkKey] = []
+
+    def origin(k: ChunkKey) -> bytes:
+        fetches.append(k)
+        return b"d" * k.length
+
+    _, ring, coops = _pod(2, origin)
+    k = _owned_by(ring, 0)
+    assert coops[0].fetch(k) == b"d" * k.length
+    s = coops[0].stats()
+    assert s["owner_fetches"] == 1 and s["peer_requests"] == 0
+
+
+def test_disabled_coop_is_plain_origin():
+    fetches: list[ChunkKey] = []
+
+    def origin(k: ChunkKey) -> bytes:
+        fetches.append(k)
+        return b"d" * k.length
+
+    _, ring, coops = _pod(2, origin, enabled=False)
+    k = _owned_by(ring, 1)
+    assert coops[0].fetch(k) == b"d" * k.length
+    assert coops[0].stats()["peer_requests"] == 0
+    assert len(fetches) == 1
+    assert coops[1].serve(k) is None  # disabled hosts shed
+    # Live re-enable (the `coop` tune knob): routing resumes.
+    for c in coops:
+        c.set_enabled(True)
+    k2 = _owned_by(ring, 1, length=32)
+    coops[0].fetch(k2)
+    assert coops[0].stats()["peer_requests"] == 1
+
+
+def test_single_host_pod_routes_nothing():
+    fetches: list[ChunkKey] = []
+
+    def origin(k: ChunkKey) -> bytes:
+        fetches.append(k)
+        return b"d" * k.length
+
+    _, _, coops = _pod(1, origin)
+    coops[0].fetch(key(length=16))
+    s = coops[0].stats()
+    assert s["peer_requests"] == 0 and s["origin_fetches"] == 1
+
+
+def test_peer_miss_falls_back_to_origin():
+    """An owner over budget sheds; the follower's remedy is its own
+    origin fetch — counted as a peer miss, never an error."""
+    def origin(k: ChunkKey) -> bytes:
+        return b"d" * k.length
+
+    _, ring, coops = _pod(2, origin, peer_budget_bytes=8)
+    k = _owned_by(ring, 1, length=64)  # 64 B ask > 8 B serve budget
+    assert coops[0].fetch(k) == b"d" * k.length
+    s0, s1 = coops[0].stats(), coops[1].stats()
+    assert s0["peer_misses"] == 1 and s0["peer_hits"] == 0
+    assert s0["origin_fetches"] == 1
+    assert s1["budget_rejects"] == 1 and s1["peer_serves"] == 0
+    # Live budget raise (the peer_budget_bytes tune knob) un-sheds.
+    coops[1].set_peer_budget(1 * MB)
+    k2 = _owned_by(ring, 1, length=32)
+    coops[0].fetch(k2)
+    assert coops[0].stats()["peer_hits"] == 1
+
+
+def test_serve_error_sheds_and_is_counted():
+    def origin(k: ChunkKey) -> bytes:
+        raise RuntimeError("origin down")
+
+    _, ring, coops = _pod(2, origin)
+    k = _owned_by(ring, 1)
+    assert coops[1].serve(k) is None
+    assert coops[1].stats()["serve_errors"] == 1
+
+
+def test_pod_wide_single_flight_concurrent_misses_one_origin_fetch():
+    """The acceptance race: N hosts miss the SAME chunk concurrently —
+    followers' peer requests and the owner's local demand all coalesce
+    on the owner's in-flight fetch; origin is asked exactly once."""
+    n_hosts = 3
+    fetch_counts: dict[ChunkKey, int] = {}
+    ledger = threading.Lock()
+    release = threading.Event()
+
+    def origin(k: ChunkKey) -> bytes:
+        with ledger:
+            fetch_counts[k] = fetch_counts.get(k, 0) + 1
+        release.wait(5.0)  # hold every concurrent ask in the window
+        return b"d" * k.length
+
+    _, ring, coops = _pod(n_hosts, origin)
+    k = _owned_by(ring, 0)
+    results: list[object] = [None] * n_hosts
+    barrier = threading.Barrier(n_hosts + 1)
+
+    def run_host(i: int) -> None:
+        cc = coops[i]
+        barrier.wait()
+        results[i] = cc.cache.get_or_fetch(k, lambda: cc.fetch(k))
+
+    threads = [
+        threading.Thread(target=run_host, args=(i,)) for i in range(n_hosts)
+    ]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    time.sleep(0.1)  # let every host reach the in-flight fetch
+    release.set()
+    for t in threads:
+        t.join(10.0)
+    assert all(r == b"d" * k.length for r in results)
+    assert fetch_counts == {k: 1}, (
+        f"pod-wide single-flight leaked origin fetches: {fetch_counts}"
+    )
+    total_coalesced = sum(c.stats()["pod_coalesced"] for c in coops)
+    owner_serves = coops[0].stats()["peer_serves"]
+    assert owner_serves == n_hosts - 1
+    assert total_coalesced >= 1, (
+        "concurrent peer serves never joined the owner's in-flight fetch"
+    )
+
+
+# ---------------------------------------------------- straggler demotion ---
+
+
+def test_apply_straggler_table_demotes_and_restores():
+    def origin(k: ChunkKey) -> bytes:
+        return b"d" * k.length
+
+    _, ring, coops = _pod(3, origin)
+    slow = [
+        {"host": 1, "tail_share": 0.9, "p99_ms": 50.0},
+        {"host": 0, "tail_share": 0.1, "p99_ms": 1.0},
+        {"host": 2, "tail_share": 0.0, "p99_ms": 1.0},
+    ]
+    out = coops[0].apply_straggler_table(slow)
+    assert out == {"demoted": [1], "restored": []}
+    assert ring.demoted == {1}
+    for k in _keys(300):
+        assert ring.owner(k) != 1
+    # A demoted owner answers peers with pass-through (shed).
+    k = _owned_by(HashRing([1]), 1)  # any key — host 1 sheds regardless
+    assert coops[1].serve(k) is None
+    # A later clean table restores it.
+    clean = [
+        {"host": h, "tail_share": 0.33, "p99_ms": 1.0} for h in range(3)
+    ]
+    out = coops[0].apply_straggler_table(clean)
+    assert out == {"demoted": [], "restored": [1]}
+    assert ring.demoted == set()
+    s = coops[0].stats()
+    assert s["demotions"] == 1 and s["restores"] == 1
+
+
+def test_apply_straggler_table_single_row_never_demotes():
+    """One host owning the whole tail of a one-host table is not a
+    straggler — there is nobody to compare against (and demoting the
+    only host would just disable the ring)."""
+    def origin(k: ChunkKey) -> bytes:
+        return b"d" * k.length
+
+    _, ring, coops = _pod(2, origin)
+    out = coops[0].apply_straggler_table(
+        [{"host": 0, "tail_share": 1.0, "p99_ms": 9.0}]
+    )
+    assert out == {"demoted": [], "restored": []}
+
+
+def test_maybe_refresh_demotions_is_rate_limited():
+    def origin(k: ChunkKey) -> bytes:
+        return b"d" * k.length
+
+    now = [0.0]
+    calls = []
+
+    class _Flight:
+        def records(self):
+            calls.append(1)
+            return []
+
+    broker = LoopbackBroker()
+    ring = HashRing([0, 1])
+    cc = CoopCache(
+        ChunkCache(MB), host_id=0, ring=ring,
+        channel=LoopbackChannel(broker, 0), origin_fetch=origin,
+        demote_interval_s=2.0, clock=lambda: now[0],
+    )
+    fl = _Flight()
+    cc.maybe_refresh_demotions(fl)
+    assert not calls  # interval not yet elapsed at t=0
+    now[0] = 2.5
+    cc.maybe_refresh_demotions(fl)
+    assert len(calls) == 1
+    cc.maybe_refresh_demotions(fl)
+    assert len(calls) == 1  # rate-limited
+    now[0] = 5.0
+    cc.maybe_refresh_demotions(fl)
+    assert len(calls) == 2
+
+
+def test_routed_fetch_stamps_monotone_peer_phases():
+    """A peer-served miss stamps peer_request→peer_hit on the ambient
+    flight op, and a shed one stamps peer_request→peer_miss before the
+    origin fallback — both in PHASES order (journal monotonicity)."""
+    from tpubench.obs.flight import FlightRecorder, monotone
+
+    def origin(k: ChunkKey) -> bytes:
+        return b"d" * k.length
+
+    flight = FlightRecorder()
+    wf = flight.worker("w0")
+    _, ring, coops = _pod(2, origin)
+    k_hit = _owned_by(ring, 1)
+    op = wf.begin(k_hit.object, "peer")
+    with op:
+        coops[0].fetch(k_hit)
+        op.finish(k_hit.length)
+    coops[1].set_enabled(False)  # owner sheds: follower falls to origin
+    k_miss = _owned_by(ring, 1, length=32)
+    op = wf.begin(k_miss.object, "peer")
+    with op:
+        coops[0].fetch(k_miss)
+        op.finish(k_miss.length)
+    recs = flight.records()
+    assert len(recs) == 2
+    hit, miss = recs
+    assert "peer_request" in hit["phases"] and "peer_hit" in hit["phases"]
+    assert "peer_miss" not in hit["phases"]
+    assert "peer_request" in miss["phases"] and "peer_miss" in miss["phases"]
+    assert "peer_hit" not in miss["phases"]
+    assert all(monotone(r) for r in recs), recs
+
+
+def test_demotion_emits_coop_flight_records():
+    from tpubench.obs.flight import FlightRecorder
+
+    def origin(k: ChunkKey) -> bytes:
+        return b"d" * k.length
+
+    flight = FlightRecorder()
+    broker = LoopbackBroker()
+    ring = HashRing([0, 1, 2])
+    cc = CoopCache(
+        ChunkCache(MB), host_id=0, ring=ring,
+        channel=LoopbackChannel(broker, 0), origin_fetch=origin,
+        flight_ring=flight.worker("coop"),
+    )
+    cc.apply_straggler_table([
+        {"host": 2, "tail_share": 0.8, "p99_ms": 50.0},
+        {"host": 0, "tail_share": 0.1, "p99_ms": 1.0},
+    ])
+    cc.apply_straggler_table([
+        {"host": h, "tail_share": 0.3, "p99_ms": 1.0} for h in range(3)
+    ])
+    recs = flight.records()
+    notes = [n for r in recs for n in r.get("notes", ())
+             if n.get("kind") == "coop"]
+    assert [n["event"] for n in notes] == ["demote", "restore"]
+    assert all(n["host"] == 2 for n in notes)
+
+
+# --------------------------------------------------------- zipf + the sim ---
+
+
+def test_zipf_plan_deterministic_and_hot_headed():
+    objects = [
+        ObjectMeta(name=f"o{i}", size=4 * 1024, generation=1)
+        for i in range(4)
+    ]
+    a = zipf_plan(objects, 1024, 200, seed=9)
+    b = zipf_plan(objects, 1024, 200, seed=9)
+    assert a == b
+    assert len(a) == 200
+    counts: dict[ChunkKey, int] = {}
+    for k in a:
+        counts[k] = counts.get(k, 0) + 1
+    ranked = sorted(counts.values(), reverse=True)
+    # Zipf shape: the hottest chunk dominates the tail.
+    assert ranked[0] >= 5 * ranked[-1]
+    with pytest.raises(ValueError, match="empty"):
+        zipf_plan([], 1024, 10)
+
+
+def test_sim_two_hosts_coop_never_fetches_more_than_baseline():
+    coop = run_coop_sim(n_hosts=2, accesses_per_host=48, seed=5)
+    base = run_coop_sim(n_hosts=2, accesses_per_host=48, seed=5, coop=False)
+    assert not coop["errors"] and not base["errors"]
+    assert coop["origin_bytes_per_pod"] <= base["origin_bytes_per_pod"]
+    assert coop["max_origin_fetches_per_chunk"] == 1
+    assert base["max_origin_fetches_per_chunk"] >= 1
+    assert coop["backend_opens"] == coop["origin_fetches_per_pod"]
+
+
+def test_sim_straggler_delay_shapes_peer_transfer_tail():
+    """The broker's per-host serve delay exists so the demotion tests
+    and bench can shape a straggler; a delayed owner shows up in the
+    requesters' transfer percentiles."""
+    res = run_coop_sim(
+        n_hosts=2, accesses_per_host=24, seed=2,
+        host_delay_s={0: 0.01, 1: 0.01},
+    )
+    assert not res["errors"]
+    p50s = [
+        h["coop"]["transfer_p50_ms"] for h in res["per_host"]
+        if h["coop"]["transfer_p50_ms"] is not None
+    ]
+    assert p50s and all(p >= 10.0 for p in p50s)
+
+
+def test_acceptance_coop_vs_per_host_ab_zipf_pod():
+    """THE acceptance criterion: a >=2-host (here 4) simulated pod on a
+    Zipf-hot object set fetches >= ~40% fewer origin GCS bytes with the
+    cooperative cache than the per-host-cache baseline; pod-wide
+    single-flight yields exactly one origin fetch per hot chunk
+    generation; and the local zero-copy guard still proves <= 1.0
+    copies/byte with the slab pool under the peer path."""
+    kw = dict(
+        n_hosts=4, accesses_per_host=96, alpha=1.2, seed=3, slab_pool=True,
+    )
+    coop = run_coop_sim(coop=True, **kw)
+    base = run_coop_sim(coop=False, **kw)
+    assert not coop["errors"], coop["errors"]
+    assert not base["errors"], base["errors"]
+    drop = 1.0 - coop["origin_bytes_per_pod"] / base["origin_bytes_per_pod"]
+    assert drop >= 0.40, (
+        f"coop origin bytes dropped only {drop:.1%} vs per-host "
+        f"({coop['origin_bytes_per_pod']} vs {base['origin_bytes_per_pod']})"
+    )
+    # Pod-wide single-flight: every chunk generation fetched from origin
+    # exactly once across the WHOLE pod...
+    assert coop["max_origin_fetches_per_chunk"] == 1
+    # ...while the per-host baseline re-fetched hot chunks per host.
+    assert base["max_origin_fetches_per_chunk"] >= 2
+    # The bytes the pod did not re-fetch arrived over the peer channel.
+    assert coop["peer_hits"] > 0
+    assert coop["peer_hit_ratio"] == 1.0  # nothing shed in this run
+    # Zero-copy guard: peer-received bytes land in leased slabs — the
+    # local path stays at <= 1.0 host-RAM copies per delivered byte.
+    assert coop["copies_per_byte_ok"]
+    assert base["copies_per_byte_ok"]
+
+
+# ------------------------------------------------------ config + CLI fold ---
+
+
+def test_validate_coop_config_rejections():
+    for field, value, frag in [
+        ("hosts", -1, "hosts"),
+        ("host_id", -2, "host_id"),
+        ("vnodes", 0, "vnodes"),
+        ("peer_budget_bytes", -1, "peer_budget_bytes"),
+        ("channel", "dcn", "channel"),
+        ("demote_share", 0.0, "demote_share"),
+        ("demote_share", 1.5, "demote_share"),
+        ("demote_share", float("nan"), "demote_share"),
+        ("demote_interval_s", 0.0, "demote_interval_s"),
+    ]:
+        cc = CoopConfig()
+        setattr(cc, field, value)
+        with pytest.raises(SystemExit) as ei:
+            validate_coop_config(cc)
+        assert frag in str(ei.value)
+    cc = CoopConfig(hosts=2, host_id=2)
+    with pytest.raises(SystemExit, match="outside the pod"):
+        validate_coop_config(cc)
+    validate_coop_config(CoopConfig())  # defaults are valid
+    validate_coop_config(CoopConfig(hosts=4, host_id=3, channel="ici"))
+
+
+def test_cli_coop_flags_build_config(tmp_path):
+    from tpubench.cli import main
+
+    cfg_path = tmp_path / "cfg.json"
+    rc = main([
+        "read", "--protocol", "fake", "--coop", "--coop-hosts", "4",
+        "--coop-host-id", "2", "--coop-vnodes", "16",
+        "--peer-budget-bytes", "1048576", "--coop-channel", "loopback",
+        "--no-coop-demote",
+        "--save-config", str(cfg_path),
+    ])
+    assert rc == 0
+    cfg = BenchConfig.from_json(cfg_path.read_text())
+    co = cfg.coop
+    assert co.enabled
+    assert co.hosts == 4 and co.host_id == 2 and co.vnodes == 16
+    assert co.peer_budget_bytes == 1048576
+    assert co.channel == "loopback"
+    assert not co.demote
+
+
+def test_cli_rejects_bad_coop_values():
+    from tpubench.cli import main
+
+    with pytest.raises(SystemExit) as ei:
+        main(["read", "--protocol", "fake", "--coop",
+              "--coop-hosts", "2", "--coop-host-id", "5"])
+    assert "outside the pod" in str(ei.value)
+
+
+def test_coop_from_config_off_and_degenerate():
+    from tpubench.pipeline.coop import coop_from_config
+
+    cfg = BenchConfig()
+    cache = ChunkCache(MB)
+    assert coop_from_config(cfg, cache, lambda k: b"") is None
+    cfg.coop.enabled = True  # 1-process pod: built, but routes nothing
+    coop = coop_from_config(cfg, cache, lambda k: b"x" * 16)
+    assert coop is not None
+    assert coop.host_id == 0 and len(coop.ring.hosts) == 1
+    assert coop.fetch(key(length=16)) == b"x" * 16
+    assert coop.stats()["peer_requests"] == 0
+    coop.close()
+
+
+def test_coop_from_config_multiprocess_loopback_collapses(capsys):
+    """A PRIVATE loopback broker spans one process: building a
+    multi-host ring over it would route most misses at peers that can
+    never answer. The membership collapses to this host (zero routing)
+    with a one-line warning pointing at the ici channel."""
+    from tpubench.pipeline.coop import coop_from_config
+
+    cfg = BenchConfig()
+    cfg.coop.enabled = True
+    cfg.dist.num_processes = 4
+    cfg.dist.process_id = 2
+    coop = coop_from_config(cfg, ChunkCache(MB), lambda k: b"y" * 8)
+    assert coop.host_id == 2
+    assert coop.ring.hosts == {2}  # nothing routes, nothing hangs
+    assert coop.fetch(key(length=8)) == b"y" * 8
+    assert coop.stats()["peer_requests"] == 0
+    err = capsys.readouterr().err
+    assert "loopback channel cannot reach" in err
+    assert "--coop-channel ici" in err
+    coop.close()
+
+
+def test_train_ingest_rejects_lockstep_with_async_consumers(
+        jax_cpu_devices):
+    """The lockstep (ICI) channel moves bytes by collectives every host
+    must enter together: asynchronous prefetch workers (or readahead-
+    seeded cache divergence) would hang the mesh, so train-ingest
+    refuses the combination loudly."""
+    from tpubench.workloads.train_ingest import run_train_ingest
+
+    cfg = BenchConfig()
+    cfg.workload.workers = 2
+    cfg.workload.object_size = 128 * 1024
+    cfg.workload.granule_bytes = 64 * 1024
+    cfg.transport.protocol = "fake"
+    cfg.staging.mode = "none"
+    cfg.obs.export = "none"
+    cfg.pipeline.steps = 2
+    cfg.pipeline.readahead = 2  # async consumers + lockstep = refused
+    cfg.coop.enabled = True
+    cfg.coop.channel = "ici"
+    with pytest.raises(SystemExit, match="lockstep"):
+        run_train_ingest(cfg)
+
+
+def test_local_transfer_rows_demote_a_slow_owner():
+    """The demotion signal a REAL pod host has locally: its own peer
+    transfer round-trips grouped by owner. An owner whose serves own
+    the slow decile of the requester's recent transfers is demoted —
+    no cross-host flight table needed."""
+    from tpubench.obs.flight import FlightRecorder
+
+    def origin(k: ChunkKey) -> bytes:
+        return b"d" * k.length
+
+    now = [0.0]
+    broker = LoopbackBroker()
+    ring = HashRing(range(3))
+    coops = []
+    for h in range(3):
+        cc = CoopCache(
+            ChunkCache(64 * MB), host_id=h, ring=ring,
+            channel=LoopbackChannel(broker, h), origin_fetch=origin,
+            demote_interval_s=1.0, clock=lambda: now[0],
+        )
+        # Host 1 is the straggler: every serve pays 5 ms.
+        broker.register(h, cc.serve, delay_s=0.005 if h == 1 else 0.0)
+        coops.append(cc)
+    # Host 0 pulls enough distinct chunks from both peers to fill the
+    # sample window past the minimum (>= 16) with a clear tail.
+    pulled = {1: 0, 2: 0}
+    start = 0
+    while min(pulled.values()) < 12:
+        k = ChunkKey("b", "o", 1, start, 64)
+        start += 64
+        owner = ring.owner(k)
+        if owner in pulled:
+            coops[0].fetch(k)
+            pulled[owner] += 1
+    rows = coops[0]._local_transfer_rows()
+    by_host = {r["host"]: r for r in rows}
+    assert by_host[1]["tail_share"] >= 0.5
+    assert by_host[2]["tail_share"] < 0.5
+    now[0] = 2.0
+    coops[0].maybe_refresh_demotions(FlightRecorder())
+    assert ring.demoted == {1}
+    assert coops[0].stats()["demotions"] == 1
+    # Demotion consumed its evidence: host 1's slow samples are purged
+    # (a demoted owner receives no new requests, so stale samples would
+    # otherwise flag it forever), host 2's survive.
+    assert all(o != 1 for o, _ in coops[0]._transfer_ns)
+    assert any(o == 2 for o, _ in coops[0]._transfer_ns)
+    # Probation re-probe, not exile: with no fresh slow evidence the
+    # next refresh restores the host — if it is still slow, its new
+    # round-trips re-demote it.
+    now[0] = 4.0
+    coops[0].maybe_refresh_demotions(FlightRecorder())
+    assert ring.demoted == set()
+    assert coops[0].stats()["restores"] == 1
+
+
+def test_per_host_estimate_excludes_serve_driven_owner_fetches():
+    """An owner fetching origin ONLY to answer a peer must not inflate
+    the per-host-cache estimate: those bytes already appear in the
+    requester's peer_bytes, and a true per-host baseline would never
+    have fetched them on the owner at all."""
+
+    def origin(k: ChunkKey) -> bytes:
+        return b"e" * k.length
+
+    broker = LoopbackBroker()
+    ring = HashRing(range(2))
+    coops = []
+    for h in range(2):
+        cc = CoopCache(
+            ChunkCache(64 * MB), host_id=h, ring=ring,
+            channel=LoopbackChannel(broker, h), origin_fetch=origin,
+        )
+        broker.register(h, cc.serve)
+        coops.append(cc)
+    # A chunk OWNED by host 0, consumed ONLY by host 1.
+    k = key(length=256)
+    while ring.owner(k) != 0:
+        k = ChunkKey("b", k.object, k.generation, k.start + 256, 256)
+    coops[1].fetch(k)
+    s0, s1 = coops[0].stats(), coops[1].stats()
+    assert s0["origin_bytes"] == 256  # the serve's owner fetch
+    assert s0["serve_origin_bytes"] == 256
+    assert s0["per_host_origin_estimate_bytes"] == 0  # host 0 consumed 0
+    assert s1["peer_bytes"] == 256
+    assert s1["per_host_origin_estimate_bytes"] == 256
+    # Pod-aggregate estimate == the true per-host baseline (256 B: only
+    # host 1 would have fetched) — not 512 (the double-count).
+    assert (s0["per_host_origin_estimate_bytes"]
+            + s1["per_host_origin_estimate_bytes"]) == 256
+
+
+def test_peer_retry_backoff_is_shrunk_to_peer_scale():
+    """The origin gax schedule (1 s initial, x2, 30 s cap) must not
+    park a transient peer re-ask for seconds when the origin fallback
+    is one step away — the peer tier caps the backoff."""
+    from tpubench.pipeline.coop import (
+        PEER_BACKOFF_INITIAL_S,
+        PEER_BACKOFF_MAX_S,
+    )
+
+    cfg = BenchConfig().transport.retry  # gax defaults: 1 s / 30 s
+    be = wrap_peer_backend(_FlakyChannel(0, b"x"), HashRing([0, 1]), cfg)
+    assert be.retry.initial_backoff_s == PEER_BACKOFF_INITIAL_S
+    assert be.retry.max_backoff_s == PEER_BACKOFF_MAX_S
+    # An already-faster schedule is left alone.
+    fast = _retry_cfg()  # 0.0 / 0.0
+    be = wrap_peer_backend(_FlakyChannel(0, b"x"), HashRing([0, 1]), fast)
+    assert be.retry.initial_backoff_s == 0.0
+    assert be.retry.max_backoff_s == 0.0
+
+
+def test_peer_backend_reports_serving_owner():
+    """Transfer samples are attributed to the owner the LAST attempt
+    landed on (the ring is re-resolved per attempt, so a demotion
+    between retries can redirect the re-ask mid-read)."""
+    k = key(length=8)
+    ring = HashRing([0, 1])
+    while ring.owner(k) != 1:
+        k = ChunkKey("b", k.object, k.generation, k.start + 8, 8)
+    be = PeerBackend(_FlakyChannel(0, b"x" * 8), ring)
+    assert be.last_serving_owner() is None
+    be.open_read(encode_chunk_name(k), start=k.start, length=k.length)
+    assert be.last_serving_owner() == 1
+
+
+def test_tune_sweep_axes_include_coop_when_enabled():
+    from tpubench.workloads.tune_cmd import sweep_axes
+
+    cfg = BenchConfig()
+    cfg.tune.knobs = ["coop", "peer_budget_bytes"]
+    assert sweep_axes(cfg, "train-ingest") == {}  # coop off: no axes
+    cfg.coop.enabled = True
+    cfg.coop.peer_budget_bytes = 1 << 20
+    axes = sweep_axes(cfg, "train-ingest")
+    assert axes["coop"] == [0, 1]
+    assert (1 << 20) in axes["peer_budget_bytes"]
+    assert len(axes["peer_budget_bytes"]) == 4
+    # Only train-ingest builds a CoopCache: a read-workload coop axis
+    # would sweep identical-noise cells. And lockstep routing is not a
+    # knob (a cell at coop=0 would desynchronize the collectives).
+    assert sweep_axes(cfg, "read") == {}
+    cfg.coop.channel = "ici"
+    assert sweep_axes(cfg, "train-ingest") == {}
+
+
+def test_controller_excludes_coop_knobs_under_lockstep():
+    """Per-host tune controllers diverge; a lockstep pod where one host
+    parks at coop=0 stops entering the collectives the others wait in.
+    Lockstep coop must contribute NO live knobs."""
+    from tpubench.metrics.recorder import LatencyRecorder
+    from tpubench.workloads.train_ingest import (
+        _build_train_ingest_controller,
+    )
+
+    class _Coop:
+        peer_budget_bytes = 1 << 20
+        enabled = True
+
+        def __init__(self, lockstep):
+            self.lockstep = lockstep
+
+        def set_peer_budget(self, v):
+            pass
+
+        def set_enabled(self, v):
+            pass
+
+    cfg = BenchConfig()
+    cfg.tune.enabled = True
+    cfg.tune.knobs = ["coop", "peer_budget_bytes"]
+    rec = LatencyRecorder("read")
+    args = (cfg, rec, lambda: 0, None, None, 8, None)
+    assert _build_train_ingest_controller(
+        *args, coop=_Coop(lockstep=True)
+    ) is None
+    assert _build_train_ingest_controller(
+        *args, coop=_Coop(lockstep=False)
+    ) is not None
+
+
+def test_read_coop_flag_prints_noop_notice(tmp_path, capsys):
+    """`read --coop` must not silently run the plain per-host path as
+    if it were a coop arm — the quiet no-op would poison an A/B."""
+    from tpubench.cli import main
+
+    rc = main([
+        "read", "--protocol", "fake", "--coop", "--workers", "1",
+        "--read-call-per-worker", "1", "--object-size", "65536",
+        "--staging", "none", "--results-dir", str(tmp_path),
+    ])
+    assert rc == 0
+    assert "--coop has no effect" in capsys.readouterr().err
+
+
+# ----------------------------------------------- observability surfaces ----
+
+
+def _peer_records() -> list[dict]:
+    """Hand-built journal records carrying the coop phases/notes the
+    timeline and telemetry attribute."""
+    base = 1_000_000
+    return [
+        {  # follower read served by a peer
+            "object": "o1", "worker": "w0", "kind": "read", "host": 0,
+            "bytes": 4096, "phases": {
+                "enqueue": base, "cache_miss": base + 10,
+                "peer_request": base + 20, "peer_hit": base + 2020,
+            }, "notes": [],
+        },
+        {  # follower shed by the owner, fell through to origin
+            "object": "o2", "worker": "w0", "kind": "read", "host": 0,
+            "bytes": 4096, "phases": {
+                "enqueue": base, "cache_miss": base + 10,
+                "peer_request": base + 20, "peer_miss": base + 1020,
+                "connect": base + 1120, "first_byte": base + 2120,
+                "body_complete": base + 3120,
+            }, "notes": [],
+        },
+        {  # the owner's one permitted origin fetch
+            "object": "o1", "worker": "w1", "kind": "read", "host": 1,
+            "bytes": 4096, "phases": {
+                "enqueue": base, "cache_miss": base + 10,
+                "owner_fetch": base + 20, "connect": base + 120,
+                "first_byte": base + 1120, "body_complete": base + 2120,
+            }, "notes": [],
+        },
+        {  # a demotion decision record
+            "object": "coop/demote/host2", "worker": "coop",
+            "kind": "coop", "host": 0, "bytes": 0,
+            "phases": {"enqueue": base + 9000},
+            "notes": [{"kind": "coop", "event": "demote", "host": 2}],
+        },
+        {  # ...and its restore
+            "object": "coop/restore/host2", "worker": "coop",
+            "kind": "coop", "host": 0, "bytes": 0,
+            "phases": {"enqueue": base + 9900},
+            "notes": [{"kind": "coop", "event": "restore", "host": 2}],
+        },
+    ]
+
+
+def test_timeline_summary_counts_coop_attribution():
+    from tpubench.obs.flight import timeline_summary
+
+    summ = timeline_summary(_peer_records())
+    coop = summ["coop"]
+    assert coop["peer_requests"] == 2
+    assert coop["peer_transfers"] == 1
+    assert coop["peer_bytes"] == 4096
+    assert coop["peer_misses"] == 1
+    assert coop["owner_fetches"] == 1
+    assert coop["demotions"] == 1
+    assert coop["restores"] == 1
+
+
+def test_render_timeline_shows_coop_line():
+    from tpubench.obs.flight import render_timeline
+
+    out = render_timeline([{"records": _peer_records()}])
+    assert "coop: peer_transfers=1" in out
+    assert "owner_fetches=1" in out
+    assert "demotions=1 restores=1" in out
+    # Runs without any coop activity render no coop line.
+    quiet = [r for r in _peer_records() if r["kind"] != "coop"]
+    for r in quiet:
+        r["phases"] = {"enqueue": 1, "connect": 2, "body_complete": 3}
+    assert "coop:" not in render_timeline([{"records": quiet}])
+
+
+def test_telemetry_feeder_counts_peer_metrics():
+    from tpubench.obs.telemetry import FlightFeeder, build_registry
+
+    reg = build_registry()
+    feeder = FlightFeeder(reg)
+    for rec in _peer_records():
+        feeder(rec)
+    assert reg.get("tpubench_peer_requests_total").value == 2
+    assert reg.get("tpubench_peer_hits_total").value == 1
+    assert reg.get("tpubench_peer_misses_total").value == 1
+    assert reg.get("tpubench_peer_bytes_total").value == 4096
+    assert reg.get("tpubench_owner_fetches_total").value == 1
+    assert reg.get("tpubench_coop_demotions_total").value == 1
+    assert reg.get("tpubench_coop_restores_total").value == 1
+
+
+def test_top_frame_renders_peer_hit_bits():
+    from tpubench.obs.flight import timeline_summary
+    from tpubench.obs.live import render_top
+
+    summ = timeline_summary(_peer_records())
+    view = {
+        "files": [{"path": "j.p0", "host": 0, "age_s": 0.1,
+                   "dropped": 0, "rotation_dropped": 0}],
+        "hosts": [0, 1], "summary": summ, "window_s": 5.0,
+        "rolling": {"gbps": 0.0}, "n_chips": 1,
+    }
+    out = render_top(view)
+    assert "peer hit 50.0%" in out
+    assert "coop demotions=1/restores=1" in out
+
+
+# -------------------------------------------------- report + train-ingest ---
+
+
+def _coop_run_doc(tag: str, coop_stats: dict, gbps: float) -> dict:
+    return {
+        "workload": "train_ingest", "gbps": gbps, "summaries": {},
+        "config": {
+            "transport": {"protocol": "fake"},
+            "pipeline": {"readahead": 2},
+            "coop": {"enabled": bool(coop_stats)},
+        },
+        "extra": {"pipeline": {
+            "stall": {"stalled_fraction": 0.1, "p99_ms": 2.0},
+            "cache": {"hit_ratio": 0.5, "hits": 10, "misses": 10,
+                      "evictions": 0, "resident_bytes": 0,
+                      "coalesced": 0},
+            **({"coop": coop_stats} if coop_stats else {}),
+        }},
+    }
+
+
+def _coop_stats(origin_bytes=1000, peer_bytes=3000) -> dict:
+    return {
+        "enabled": True, "host_id": 0, "hosts": 4, "active_hosts": 3,
+        "demoted_hosts": [3], "peer_requests": 30, "peer_hits": 28,
+        "peer_misses": 2, "peer_hit_ratio": 28 / 30,
+        "peer_bytes": peer_bytes, "peer_serves": 12,
+        "peer_served_bytes": 12000, "serve_errors": 0,
+        "budget_rejects": 3, "peer_budget_bytes": 1 << 20,
+        "pod_coalesced": 4, "origin_fetches": 5,
+        "origin_bytes": origin_bytes, "owner_fetches": 5,
+        "per_host_origin_estimate_bytes": origin_bytes + peer_bytes,
+        "demotions": 1, "restores": 0,
+        "transfer_p50_ms": 1.5, "transfer_p99_ms": 9.0,
+    }
+
+
+def test_scorecard_renders_coop_line():
+    from tpubench.workloads.train_ingest import format_pipeline_scorecard
+
+    pipe = _coop_run_doc("coop", _coop_stats(), 1.0)["extra"]["pipeline"]
+    out = format_pipeline_scorecard(pipe)
+    assert "coop: hosts=3/4" in out
+    assert "pod_coalesced=4" in out
+    assert "origin=1000B vs per-host-est=4000B" in out
+    assert "saved 75.0%" in out
+    assert "transfer p50=1.50 ms p99=9.00 ms" in out
+    assert "demotions=1/restores=0" in out
+    assert "budget_rejects=3" in out
+    # The per-host baseline arm renders no coop line.
+    pipe_base = _coop_run_doc("base", {}, 1.0)["extra"]["pipeline"]
+    assert "coop:" not in format_pipeline_scorecard(pipe_base)
+
+
+def test_report_ab_diff_labels_coop_axis(tmp_path):
+    import json
+
+    from tpubench.workloads.report_cmd import run_report
+
+    base = _coop_run_doc("base", {}, 1.0)
+    coop = _coop_run_doc("coop", _coop_stats(), 1.4)
+    p_base, p_coop = tmp_path / "base.json", tmp_path / "coop.json"
+    p_base.write_text(json.dumps(base))
+    p_coop.write_text(json.dumps(coop))
+    out = run_report([str(p_base), str(p_coop)])
+    assert "coop]" in out  # the coop axis bit on the A/B label
+    assert "coop: origin_bytes 1000 vs n/a" in out
+    assert "peer hit 93.3% vs n/a" in out
+    assert "pod_coalesced 4 vs n/a" in out
+
+
+def test_train_ingest_e2e_coop_stamp_and_scorecard(tmp_path):
+    """Coop through the real workload: a single-process pod degenerates
+    to owner-local fetches (zero routing overhead) but the stats block
+    is stamped, validated, journaled and rendered end-to-end."""
+    from tpubench.metrics.report import write_result
+    from tpubench.workloads.report_cmd import run_report
+    from tpubench.workloads.train_ingest import run_train_ingest
+
+    cfg = BenchConfig()
+    cfg.workload.workers = 2
+    cfg.workload.object_size = 256 * 1024
+    cfg.workload.granule_bytes = 64 * 1024
+    cfg.transport.protocol = "fake"
+    cfg.staging.mode = "none"
+    cfg.obs.export = "none"
+    cfg.pipeline.steps = 4
+    cfg.pipeline.batch_shards = 2
+    cfg.coop.enabled = True
+    res = run_train_ingest(cfg)
+    co = res.extra["pipeline"]["coop"]
+    assert co["enabled"] and co["hosts"] == 1
+    assert co["origin_fetches"] > 0
+    assert co["peer_requests"] == 0  # a pod of one has no peers
+    p = write_result(res, str(tmp_path), tag="coop")
+    out = run_report([p])
+    assert "coop: hosts=1/1" in out
+
+
+def test_prefetcher_routes_misses_through_fetch_fn():
+    """Readahead misses resolve through the routed (coop) fetch — the
+    prefetcher warms the cache through the same owner-routing the
+    demand path uses."""
+    from tpubench.pipeline.prefetch import Prefetcher
+    from tpubench.storage.fake import FakeBackend
+
+    backend = FakeBackend.prepopulated(prefix="p/o_", count=2, size=4096)
+    plan = [
+        ChunkKey("", m.name, m.generation, 0, 4096)
+        for m in backend.list("p/o_")
+    ]
+    routed: list[ChunkKey] = []
+
+    def fetch_fn(k: ChunkKey) -> bytes:
+        routed.append(k)
+        return b"r" * k.length
+
+    cache = ChunkCache(MB)
+    pf = Prefetcher(backend, cache, plan, depth=2, fetch_fn=fetch_fn)
+    pf.advance(0)
+    deadline = time.monotonic() + 5.0
+    while len(routed) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    pf.close()
+    assert sorted(r.object for r in routed) == sorted(
+        k.object for k in plan
+    )
+    assert cache.get(plan[0]) == b"r" * 4096
+
+
+# ------------------------------------------------------------ ICI channel ---
+
+
+def test_ici_channel_broadcast_roundtrip(jax_cpu_devices):
+    """Hermetic ICI path on the simulated CPU mesh: the owner's bytes
+    ride the shard/reassemble NamedSharding all-gather and come back
+    bit-identical (single-process degenerate case — every mesh slot is
+    local, so only the owner's call is needed)."""
+    from tpubench.dist.peer import IciPeerChannel
+
+    ch = IciPeerChannel(host_id=2)
+    assert ch.lockstep
+    for nbytes in (128, 1000, 4096):  # incl. a non-lane-multiple
+        k = ChunkKey("b", "obj", 1, 0, nbytes)
+        data = bytes(range(256)) * (nbytes // 256 + 1)
+        data = data[:nbytes]
+        out = ch.broadcast(2, data, k)
+        assert out == data
+    st = ch.stats()
+    assert st["broadcasts"] == 3
+    assert st["broadcast_bytes"] == 128 + 1000 + 4096
+    assert not st["multiprocess"]
+    with pytest.raises(NotImplementedError):
+        ch.request(0, ChunkKey("b", "o", 1, 0, 8))
+    with pytest.raises(ValueError, match="contributed no data"):
+        ch.broadcast(1, None, ChunkKey("b", "o", 1, 0, 8))
+    ch.close()
+
+
+def test_coop_lockstep_owner_path_counts(jax_cpu_devices):
+    """CoopCache over the lockstep channel, owner side: the fetch
+    contributes the chunk to the broadcast and still lands/counts it
+    as the owner's one origin fetch."""
+    from tpubench.dist.peer import IciPeerChannel
+
+    fetches: list[ChunkKey] = []
+
+    def origin(k: ChunkKey) -> bytes:
+        fetches.append(k)
+        return b"L" * k.length
+
+    ring = HashRing([0, 1])
+    ch = IciPeerChannel(host_id=0)
+    cc = CoopCache(
+        ChunkCache(MB), host_id=0, ring=ring, channel=ch,
+        origin_fetch=origin,
+    )
+    k = _owned_by(ring, 0, length=256)
+    assert cc.fetch(k) == b"L" * 256
+    assert len(fetches) == 1
+    s = cc.stats()
+    assert s["owner_fetches"] == 1 and s["peer_requests"] == 0
+    assert ch.stats()["broadcasts"] == 1
+    cc.close()
+
+
+# ------------------------------------------------------------- bench cell ---
+
+
+def test_bench_coop_cache_cell_shape_and_guard():
+    """The bench's coop_cache cell (BENCH_r06+): 2- and 4-host simulated
+    pods, fixed seed, Zipf-hot set, hermetic fake backend — and the
+    smoke regression guard: coop NEVER fetches more origin bytes than
+    the per-host baseline."""
+    import bench
+
+    cell = bench._coop_cache_cell()
+    assert set(cell) == {"2", "4"}
+    for n, c in cell.items():
+        assert c["coop_origin_bytes_per_pod"] <= c["baseline_origin_bytes_per_pod"], (
+            f"{n}-host coop fetched MORE origin bytes than per-host"
+        )
+        assert c["max_origin_fetches_per_chunk"] == 1
+        assert c["origin_bytes_saved_ratio"] >= 0.0
+        assert c["peer_hits"] > 0
+    # More hosts share more: the 4-host pod saves at least as much as
+    # the 2-host pod (strictly more on this seed).
+    assert (cell["4"]["origin_bytes_saved_ratio"]
+            >= cell["2"]["origin_bytes_saved_ratio"])

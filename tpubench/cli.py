@@ -230,6 +230,33 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="disable the zero-copy slab datapath: chunks "
                         "materialize as bytes (2+ host-RAM copies per "
                         "chunk — the copies-per-byte A/B baseline arm)")
+    p.add_argument("--coop", action="store_true",
+                   help="cooperative chunk cache: consistent-hash chunk "
+                        "ownership across the pod's hosts, peer-first "
+                        "miss resolution, pod-wide single-flight (only "
+                        "the owner fetches a chunk from origin) and "
+                        "straggler-aware owner demotion")
+    p.add_argument("--coop-hosts", type=int,
+                   help="hosts on the ownership ring (default 0 = "
+                        "--num-processes)")
+    p.add_argument("--coop-host-id", type=int,
+                   help="this host's ring id (default -1 = --process-id)")
+    p.add_argument("--coop-vnodes", type=int,
+                   help="virtual nodes per host on the consistent-hash "
+                        "ring (default 64)")
+    p.add_argument("--peer-budget-bytes", type=int,
+                   help="serve-side byte budget: bytes concurrently "
+                        "served to peers never exceed this — past it the "
+                        "owner sheds and peers fall back to origin "
+                        "(0 = unbounded; live-tunable)")
+    p.add_argument("--coop-channel", choices=("auto", "loopback", "ici"),
+                   help="peer transport: loopback = in-process "
+                        "request/reply; ici = lockstep broadcast over "
+                        "the pod mesh (plan-synchronized pod workloads "
+                        "only); auto = loopback")
+    p.add_argument("--no-coop-demote", action="store_true",
+                   help="disable straggler-aware owner demotion (keep "
+                        "slow-decile hosts on the ownership ring)")
     p.add_argument("--tune", action="store_true",
                    help="adaptive autotuner: run the online controller "
                         "during this run — worker fan-out, readahead "
@@ -483,6 +510,24 @@ def build_config(args) -> BenchConfig:
     from tpubench.config import validate_pipeline_config
 
     validate_pipeline_config(pl, staging=s)
+    co = cfg.coop
+    if getattr(args, "coop", False):
+        co.enabled = True
+    for attr, dest in (
+        ("coop_hosts", "hosts"), ("coop_host_id", "host_id"),
+        ("coop_vnodes", "vnodes"),
+        ("peer_budget_bytes", "peer_budget_bytes"),
+    ):
+        v = getattr(args, attr, None)
+        if v is not None:
+            setattr(co, dest, v)
+    if getattr(args, "coop_channel", None):
+        co.channel = args.coop_channel
+    if getattr(args, "no_coop_demote", False):
+        co.demote = False
+    from tpubench.config import validate_coop_config
+
+    validate_coop_config(co)
     tn = cfg.tune
     if getattr(args, "tune", False):
         tn.enabled = True

@@ -361,6 +361,82 @@ def validate_pipeline_config(pc: "PipelineConfig",
     # default readahead.
 
 
+@dataclass
+class CoopConfig:
+    """Pod-scale cooperative chunk cache (tpubench/pipeline/coop.py):
+    consistent-hash chunk ownership across the pod's hosts, peer-first
+    miss resolution over a peer channel, pod-wide single-flight (only
+    the owner ever fetches a chunk from origin), and straggler-aware
+    owner demotion fed by the flight recorder's per-host tables.
+
+    Off by default — the per-host cache is the baseline arm of the
+    coop-vs-per-host A/B the scorecard reports (origin GCS bytes per
+    POD, not per host)."""
+
+    enabled: bool = False
+    # Pod membership: number of hosts on the ring (0 = dist.num_processes)
+    # and this host's id (-1 = dist.process_id). Explicit values exist
+    # for embedding harnesses (the hermetic multi-"host" sim).
+    hosts: int = 0
+    host_id: int = -1
+    # Virtual nodes per host: more = smoother key balance, identical
+    # rehash-minimality (~1/N of keys move on a join/leave either way).
+    vnodes: int = 64
+    # Serve-side byte budget: bytes concurrently being served to peers
+    # never exceed this — past it the owner sheds (peers fall back to
+    # origin) instead of queueing unboundedly. 0 = unbounded. Live: the
+    # `peer_budget_bytes` tune knob actuates it.
+    peer_budget_bytes: int = 0
+    # Peer transport: "loopback" = in-process request/reply (hermetic
+    # tests, single-host dev); "ici" = lockstep broadcast over the pod
+    # mesh (dist/peer.py — plan-synchronized pod workloads only);
+    # "auto" = loopback.
+    channel: str = "auto"
+    # Straggler demotion: owners whose per-host flight table places them
+    # in the slowest decile (tail_share >= demote_share) leave the ring
+    # until a later table clears them; the recorder scan runs at most
+    # once per demote_interval_s.
+    demote: bool = True
+    demote_share: float = 0.5
+    demote_interval_s: float = 2.0
+
+
+def validate_coop_config(cc: "CoopConfig", where: str = "coop") -> None:
+    """Parse-time sanity for the coop knobs (one-line SystemExit at
+    config load — the validate_fault_config style)."""
+    if cc.hosts < 0:
+        raise SystemExit(f"{where}.hosts={cc.hosts!r}: must be >= 0 "
+                         "(0 = dist.num_processes)")
+    if cc.host_id < -1:
+        raise SystemExit(f"{where}.host_id={cc.host_id!r}: must be >= -1 "
+                         "(-1 = dist.process_id)")
+    if cc.hosts and cc.host_id >= cc.hosts:
+        raise SystemExit(
+            f"{where}.host_id={cc.host_id} is outside the pod "
+            f"({where}.hosts={cc.hosts})"
+        )
+    if cc.vnodes < 1:
+        raise SystemExit(f"{where}.vnodes={cc.vnodes!r}: must be >= 1")
+    if cc.peer_budget_bytes < 0:
+        raise SystemExit(
+            f"{where}.peer_budget_bytes={cc.peer_budget_bytes!r}: must be "
+            ">= 0 (0 = unbounded)"
+        )
+    if cc.channel not in ("auto", "loopback", "ici"):
+        raise SystemExit(
+            f"{where}.channel={cc.channel!r}: must be auto|loopback|ici"
+        )
+    if not (0.0 < cc.demote_share <= 1.0):  # also rejects NaN
+        raise SystemExit(
+            f"{where}.demote_share={cc.demote_share!r}: must be in (0, 1]"
+        )
+    if not (cc.demote_interval_s > 0):
+        raise SystemExit(
+            f"{where}.demote_interval_s={cc.demote_interval_s!r}: "
+            "must be > 0"
+        )
+
+
 # Knobs the tune controller may actuate (the canonical name set; the
 # controller's ACTUATED registry maps each to its config field and CLI
 # flag, and tests/test_tune.py pins that the three surfaces never drift).
@@ -371,6 +447,8 @@ TUNE_KNOBS = (
     "prefetch_workers",
     "hedge_delay_s",
     "staging_depth",
+    "peer_budget_bytes",
+    "coop",
 )
 
 
@@ -743,6 +821,7 @@ class BenchConfig:
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     tune: TuneConfig = field(default_factory=TuneConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    coop: CoopConfig = field(default_factory=CoopConfig)
 
     # ------------------------------------------------------------------ io --
     def to_dict(self) -> dict[str, Any]:
@@ -780,6 +859,7 @@ _SUBTYPES = {
     "pipeline": PipelineConfig,
     "tune": TuneConfig,
     "telemetry": TelemetryConfig,
+    "coop": CoopConfig,
     "retry": RetryConfig,
     "fault": FaultConfig,
     "tail": TailConfig,

@@ -1,0 +1,148 @@
+"""ICI peer-transfer channel for the cooperative chunk cache.
+
+The loopback channel (:mod:`tpubench.pipeline.coop`) is request/reply —
+right for threads in one process, impossible over ICI, where data moves
+by COLLECTIVES that every participant must enter together. This channel
+therefore speaks the ``lockstep`` variant of the peer interface: for
+each cooperatively-fetched chunk, EVERY host calls
+:meth:`IciPeerChannel.broadcast` with the same ``(owner, key)`` — the
+owner contributes the chunk bytes, the others contribute nothing — and
+the payload rides the existing ``dist.shard``/``make_reassemble``
+NamedSharding path (the owner's slot of a mesh-sharded uint8 array,
+all-gathered over ICI), after which every host slices the owner's slot
+back out. No new transport: the same jitted all-gather the pod-ingest
+workloads already ride, reused as a byte mover.
+
+Scope (documented, enforced by the workload guard): lockstep requires
+*plan-synchronized* misses — every host walks the same access plan in
+the same order with identical cache configuration, the shape of the
+``pipeline.pod`` train-ingest path. Asynchronous consumers (readahead
+prefetch workers, independent read pools) must use the loopback/DCN
+request-reply channel instead; a desynchronized collective would hang
+the pod. Hermetic single-process tests drive the identical code path
+on the simulated CPU mesh (all shards local, the degenerate case of
+``jax.make_array_from_single_device_arrays``); the real multi-process
+rendezvous is exercised by the env-gated multihost suite.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from tpubench.pipeline.cache import ChunkKey
+
+
+class IciPeerChannel:
+    """Lockstep peer channel over the pod mesh (module docstring).
+
+    One jitted reassemble, built lazily (jit specializes per padded
+    input shape internally — a steady chunk size compiles exactly
+    once). ``host_id`` defaults to
+    ``jax.process_index()``; on a single-process (simulated) mesh,
+    "host" h maps to mesh slot h directly, so hermetic tests exercise
+    the same slotting the multi-process path uses.
+    """
+
+    lockstep = True
+
+    def __init__(self, mesh=None, axis: str = "pod",
+                 host_id: Optional[int] = None, lane: int = 128):
+        import jax
+
+        from tpubench.dist.reassemble import make_mesh
+
+        self._mesh = mesh if mesh is not None else make_mesh(axis=axis)
+        self._axis = axis
+        self._lane = lane
+        self.host_id = (
+            int(host_id) if host_id is not None else jax.process_index()
+        )
+        self._multiprocess = jax.process_count() > 1
+        self._reassemble = None  # built once; jit respecializes per shape
+        self.broadcasts = 0
+        self.broadcast_bytes = 0
+
+    # ------------------------------------------------------------ helpers --
+    def _slot_for_host(self, host: int) -> int:
+        """The mesh slot carrying ``host``'s payload: its first local
+        chip in mesh order (multi-process), or slot ``host`` itself on
+        a single-process simulated mesh."""
+        devices = list(self._mesh.devices.reshape(-1))
+        if self._multiprocess:
+            for i, d in enumerate(devices):
+                if d.process_index == host:
+                    return i
+            raise ValueError(f"host {host} owns no device in the mesh")
+        return host % len(devices)
+
+    def _reassemble_fn(self):
+        if self._reassemble is None:
+            from tpubench.dist.reassemble import make_reassemble
+
+            self._reassemble = make_reassemble(self._mesh, self._axis)
+        return self._reassemble
+
+    # ------------------------------------------------------------- surface --
+    def broadcast(self, owner: int, data: Optional[bytes],
+                  key: ChunkKey) -> bytes:
+        """Collective chunk transfer: every host enters with the same
+        ``(owner, key)``; only the owner passes ``data``. Returns the
+        owner's bytes on every host (including the owner — callers there
+        usually already hold the payload and ignore the echo)."""
+        import jax
+
+        from tpubench.dist.reassemble import (
+            local_mesh_devices,
+            shard_to_device_array,
+        )
+
+        lane = self._lane
+        nbytes = key.length
+        rows = max(1, math.ceil(nbytes / lane))
+        slot = self._slot_for_host(owner)
+        devices = list(self._mesh.devices.reshape(-1))
+        n = len(devices)
+        local = (
+            local_mesh_devices(self._mesh) if self._multiprocess else devices
+        )
+        shards = []
+        for d in local:
+            buf = np.zeros(rows * lane, dtype=np.uint8)
+            idx = devices.index(d)
+            if idx == slot:
+                if data is None:
+                    raise ValueError(
+                        f"host {self.host_id} owns broadcast slot {slot} "
+                        "but contributed no data"
+                    )
+                buf[:nbytes] = np.frombuffer(data, dtype=np.uint8)
+            shards.append(buf)
+        arr = shard_to_device_array(shards, self._mesh, self._axis, lane)
+        gathered, _ = self._reassemble_fn()(arr)
+        out = np.asarray(jax.device_get(gathered))
+        self.broadcasts += 1
+        self.broadcast_bytes += nbytes
+        assert out.shape[0] == n
+        return out[slot].reshape(-1)[:nbytes].tobytes()
+
+    def request(self, owner: int, key: ChunkKey) -> bytes:
+        """Request/reply is not expressible over bare collectives —
+        the coop layer detects ``lockstep`` and uses broadcast."""
+        raise NotImplementedError(
+            "IciPeerChannel is lockstep-only: use broadcast() "
+            "(the CoopCache routes through it automatically)"
+        )
+
+    def close(self) -> None:
+        self._reassemble = None
+
+    def stats(self) -> dict:
+        return {
+            "broadcasts": self.broadcasts,
+            "broadcast_bytes": self.broadcast_bytes,
+            "mesh_devices": int(self._mesh.devices.size),
+            "multiprocess": self._multiprocess,
+        }

@@ -63,11 +63,21 @@ JOURNAL_FORMAT = "tpubench-flight-v1"
 # stage_complete segment IS the transfer's flight time, and with
 # out-of-order completion it is the honest per-transfer quantity a
 # submit-time stamp would have corrupted.
+# Coop phases (PR 8): a miss routed to a peer owner stamps peer_request
+# when the ask leaves, then peer_hit (the owner served — the peer_hit
+# segment IS the peer transfer round-trip) or peer_miss (the owner shed;
+# the read falls through to origin, so connect/first_byte follow on the
+# SAME record). owner_fetch marks an origin read made AS the chunk's
+# ring owner (the one fetch pod-wide single-flight permits).
 PHASES = (
     "enqueue",
     "cache_hit",
     "cache_miss",
     "prefetch_issue",
+    "peer_request",
+    "peer_hit",
+    "peer_miss",
+    "owner_fetch",
     "connect",
     "stream_open",
     "first_byte",
@@ -655,6 +665,37 @@ def timeline_summary(records: list[dict]) -> dict:
             if n.get("kind") == "slab" and n.get("event") == "overflow"
         ),
     }
+    # Cooperative-cache attribution (PR 8): a peer-routed miss carries
+    # peer_request plus its resolution (peer_hit = the transfer landed;
+    # peer_miss = the owner shed and origin served the same record);
+    # owner_fetch marks the one origin read pod-wide single-flight
+    # permits. Demotion/restore decisions are kind="coop" records with a
+    # coop note, so the timeline can say when the ring rebalanced.
+    peer_hit_recs = [
+        r for r in records if "peer_hit" in r.get("phases", {})
+    ]
+    coop_notes = [n for n in notes if n.get("kind") == "coop"]
+    coop = {
+        "peer_requests": sum(
+            1 for r in records if "peer_request" in r.get("phases", {})
+        ),
+        "peer_transfers": len(peer_hit_recs),
+        "peer_bytes": sum(
+            r.get("bytes", 0) for r in peer_hit_recs if not r.get("error")
+        ),
+        "peer_misses": sum(
+            1 for r in records if "peer_miss" in r.get("phases", {})
+        ),
+        "owner_fetches": sum(
+            1 for r in records if "owner_fetch" in r.get("phases", {})
+        ),
+        "demotions": sum(
+            1 for n in coop_notes if n.get("event") == "demote"
+        ),
+        "restores": sum(
+            1 for n in coop_notes if n.get("event") == "restore"
+        ),
+    }
     # Overlapped-staging attribution (PR 6): every host→HBM transfer is a
     # kind="stage" record whose stage_submit→stage_complete segment is
     # its flight time, stamped at true completion by the window's reaper
@@ -682,6 +723,7 @@ def timeline_summary(records: list[dict]) -> dict:
         "tail": tail,
         "tune": tune,
         "pipeline": pipeline,
+        "coop": coop,
         "staging": staging,
         "goodput": goodput_summary(records),
         "hosts": sorted({r.get("host", 0) for r in records}),
@@ -755,6 +797,16 @@ def render_timeline(docs: list[dict]) -> str:
                 f" slab_overflows={pipe['slab_overflows']}"
                 if pipe.get("slab_overflows") else ""
             )
+        )
+    coop = summ.get("coop", {})
+    if any(coop.values()):
+        lines.append(
+            f"coop: peer_transfers={coop['peer_transfers']} "
+            f"bytes={coop['peer_bytes']} "
+            f"(requests={coop['peer_requests']} "
+            f"misses={coop['peer_misses']}) "
+            f"owner_fetches={coop['owner_fetches']} "
+            f"demotions={coop['demotions']} restores={coop['restores']}"
         )
     stg = summ.get("staging", {})
     if stg.get("transfers"):

@@ -211,6 +211,18 @@ def render_top(view: dict, color: bool = False) -> str:
     bits = []
     if hits + misses:
         bits.append(f"cache hit {hits / (hits + misses):.1%}")
+    coop = summ.get("coop", {})
+    if coop.get("peer_requests"):
+        bits.append(
+            f"peer hit {coop['peer_transfers'] / coop['peer_requests']:.1%} "
+            f"({coop['peer_transfers']} transfers, "
+            f"{coop['peer_bytes']}B)"
+        )
+    if coop.get("demotions") or coop.get("restores"):
+        bits.append(
+            f"coop demotions={coop.get('demotions', 0)}"
+            f"/restores={coop.get('restores', 0)}"
+        )
     stg = summ.get("staging", {})
     if stg.get("transfers"):
         bits.append(

@@ -321,6 +321,19 @@ COUNTER_METRICS = {
     "tpubench_cache_hits_total": "chunk-cache hit records",
     "tpubench_cache_misses_total": "chunk-cache miss records",
     "tpubench_prefetch_issues_total": "readahead prefetch issues",
+    "tpubench_peer_requests_total":
+        "cooperative-cache misses routed to a peer owner",
+    "tpubench_peer_hits_total":
+        "peer requests served by the owner (origin fetches avoided)",
+    "tpubench_peer_misses_total":
+        "peer requests the owner shed (fell back to origin)",
+    "tpubench_peer_bytes_total": "chunk bytes received over the peer channel",
+    "tpubench_owner_fetches_total":
+        "origin fetches made as the chunk's ring owner",
+    "tpubench_coop_demotions_total":
+        "straggler owners demoted off the ownership ring",
+    "tpubench_coop_restores_total":
+        "demoted owners restored to the ownership ring",
     "tpubench_slab_overflows_total": "slab-pool overflow leases",
     "tpubench_stage_transfers_total": "host-to-HBM staging transfers",
     "tpubench_stage_bytes_total": "bytes staged to HBM",
@@ -349,6 +362,8 @@ GAUGE_METRICS = {
         "(goodput_summary formula)",
     "tpubench_goodput_gbps_per_chip": "goodput divided by staged chip count",
     "tpubench_cache_hit_ratio": "cache hits / (hits + misses), record-derived",
+    "tpubench_peer_hit_ratio":
+        "peer hits / peer requests, record-derived (coop cache)",
     "tpubench_staging_efficiency":
         "fraction of transfer flight time hidden from the fetch threads",
 }
@@ -469,6 +484,16 @@ class FlightFeeder:
             reg.get("tpubench_cache_misses_total").inc()
         if "prefetch_issue" in phases:
             reg.get("tpubench_prefetch_issues_total").inc()
+        if "peer_request" in phases:
+            reg.get("tpubench_peer_requests_total").inc()
+        if "peer_hit" in phases:
+            reg.get("tpubench_peer_hits_total").inc()
+            if not rec.get("error"):
+                reg.get("tpubench_peer_bytes_total").inc(nbytes)
+        if "peer_miss" in phases:
+            reg.get("tpubench_peer_misses_total").inc()
+        if "owner_fetch" in phases:
+            reg.get("tpubench_owner_fetches_total").inc()
         for n in rec.get("notes", ()):
             nk = n.get("kind")
             if nk == "retry":
@@ -491,6 +516,11 @@ class FlightFeeder:
                     reg.get("tpubench_tune_reverts_total").inc()
             elif nk == "slab" and n.get("event") == "overflow":
                 reg.get("tpubench_slab_overflows_total").inc()
+            elif nk == "coop":
+                if n.get("event") == "demote":
+                    reg.get("tpubench_coop_demotions_total").inc()
+                elif n.get("event") == "restore":
+                    reg.get("tpubench_coop_restores_total").inc()
             elif nk == "stage" and n.get("event") == "overlap":
                 reg.get("tpubench_stage_overlapped_total").inc()
 
@@ -642,6 +672,11 @@ class TelemetrySession:
         misses = reg.get("tpubench_cache_misses_total").value
         if hits + misses > 0:
             reg.get("tpubench_cache_hit_ratio").set(hits / (hits + misses))
+        preq = reg.get("tpubench_peer_requests_total").value
+        if preq > 0:
+            reg.get("tpubench_peer_hit_ratio").set(
+                reg.get("tpubench_peer_hits_total").value / preq
+            )
 
     def tick(self) -> None:
         with self.registry.lock:

@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import heapq
 import threading
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 from tpubench.mem.slab import CopyMeter, SlabPool, release_payload
 from tpubench.obs import flight as _flight
@@ -140,11 +140,17 @@ class Prefetcher:
         pool: Optional[SlabPool] = None,
         meter: Optional[CopyMeter] = None,
         max_workers: int = 0,
+        fetch_fn: Optional[Callable[[ChunkKey], object]] = None,
     ):
         self._backend = backend
         self._cache = cache
         self._pool = pool
         self._meter = meter
+        # Routed miss fetch (the cooperative cache's peer-first path):
+        # when given, readahead misses resolve through it instead of a
+        # direct origin read — the prefetcher warms the cache through
+        # the SAME owner-routing/single-flight the demand path uses.
+        self._fetch_fn = fetch_fn
         self._plan = list(plan)
         self._depth = max(0, depth)
         self._depth_effective = self._depth
@@ -337,8 +343,12 @@ class Prefetcher:
                     op.mark("prefetch_issue")
                 data, source = self._cache.get_or_fetch_info(
                     key,
-                    lambda: fetch_chunk(self._backend, key,
-                                        pool=self._pool, meter=self._meter),
+                    (lambda: self._fetch_fn(key))
+                    if self._fetch_fn is not None
+                    else lambda: fetch_chunk(
+                        self._backend, key,
+                        pool=self._pool, meter=self._meter,
+                    ),
                     origin="prefetch", consumer=False,
                 )
                 if source == "fetched":

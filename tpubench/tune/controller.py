@@ -67,6 +67,14 @@ ACTUATED = {
         "config": ("staging", "depth"),
         "cli": "staging_depth",
     },
+    "peer_budget_bytes": {
+        "config": ("coop", "peer_budget_bytes"),
+        "cli": "peer_budget_bytes",
+    },
+    "coop": {
+        "config": ("coop", "enabled"),
+        "cli": "coop",
+    },
 }
 assert tuple(sorted(ACTUATED)) == tuple(sorted(TUNE_KNOBS))
 

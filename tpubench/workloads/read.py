@@ -454,6 +454,18 @@ def run_read(
 ) -> RunResult:
     owns_backend = backend is None
     tracer = tracer or NoopTracer()
+    if getattr(cfg, "coop", None) is not None and cfg.coop.enabled:
+        # The cooperative cache lives in the pipeline miss path, which
+        # only train-ingest drives — say so instead of silently running
+        # the plain per-host read (every other knob either wires in or
+        # rejects; a quiet no-op would poison an A/B).
+        import sys
+
+        print(
+            "read: --coop has no effect on this workload (the "
+            "cooperative cache rides the train-ingest pipeline miss "
+            "path)", file=sys.stderr,
+        )
     # The backend gets the same tracer: its per-request spans nest under
     # the workload's ReadObject spans (OC-bridge analog).
     backend = backend or open_backend(cfg, tracer=tracer)

@@ -51,6 +51,10 @@ def _axis(run: dict) -> str:
     if run.get("workload") == "train_ingest":
         ra = (cfg.get("pipeline") or {}).get("readahead", 0)
         bits.append(f"readahead={ra}" if ra else "cold")
+        # Coop-vs-per-host is the pod-cache A/B's axis: a cooperative
+        # run must not render as a twin of its per-host-cache baseline.
+        if (cfg.get("coop") or {}).get("enabled"):
+            bits.append("coop")
         # Slab-vs-bytes is the copies A/B's axis: label it so the diff
         # table reads "slab vs bytes", not two identical rows.
         copies = (run.get("extra", {}).get("pipeline") or {}).get("copies")
@@ -200,6 +204,22 @@ def compare_runs(runs: list[dict]) -> str:
                 f"{cell(op_, '{:.1%}', 'cache', 'hit_ratio')} vs "
                 f"{cell(bp, '{:.1%}', 'cache', 'hit_ratio')}"
             )
+            if op_.get("coop") or bp.get("coop"):
+                # Coop-vs-per-host diff: the axis that matters is origin
+                # bytes fetched (per pod) — the per-host baseline pays
+                # them N times; peer hit ratio says where they went
+                # instead.
+                lines.append(
+                    "    coop: origin_bytes "
+                    f"{cell(op_, '{}', 'coop', 'origin_bytes')} vs "
+                    f"{cell(bp, '{}', 'coop', 'origin_bytes')}, "
+                    "peer hit "
+                    f"{cell(op_, '{:.1%}', 'coop', 'peer_hit_ratio')} vs "
+                    f"{cell(bp, '{:.1%}', 'coop', 'peer_hit_ratio')}, "
+                    "pod_coalesced "
+                    f"{cell(op_, '{}', 'coop', 'pod_coalesced')} vs "
+                    f"{cell(bp, '{}', 'coop', 'pod_coalesced')}"
+                )
             if op_.get("copies") or bp.get("copies"):
                 # The zero-copy A/B's headline: host-RAM writes per
                 # delivered chunk byte (slab = 1.00, legacy bytes >= 2).

@@ -235,6 +235,36 @@ class _TrainIngest:
         consumer_wf = flight.worker("consumer") if flight is not None else None
         step_wf = flight.worker("steps") if flight is not None else None
 
+        # Cooperative chunk cache (tpubench/pipeline/coop.py): misses
+        # whose consistent-hash owner is a peer resolve over the peer
+        # channel instead of origin; demand and prefetch misses alike
+        # route through coop.fetch, so pod-wide single-flight covers
+        # both. None when cfg.coop is off — the per-host baseline arm.
+        from tpubench.pipeline.coop import coop_from_config
+
+        def origin_fetch(key: ChunkKey):
+            return fetch_chunk(self.backend, key, pool=pool, meter=meter)
+
+        coop = coop_from_config(
+            cfg, cache, origin_fetch, pool=pool, meter=meter, flight=flight,
+        )
+        if coop is not None and coop.lockstep and not (
+            p.pod and p.readahead == 0
+        ):
+            # A lockstep (ICI) channel moves bytes by COLLECTIVES every
+            # host must enter together, in the same order: only the
+            # plan-synchronized pod demand path qualifies. Asynchronous
+            # prefetch workers — or per-host cache divergence seeded by
+            # readahead — desynchronize the broadcasts and hang the
+            # mesh, so refuse loudly instead.
+            raise SystemExit(
+                "coop: the ici (lockstep) channel requires the "
+                "plan-synchronized pod path (--pipeline-pod) with "
+                "--readahead 0; use the request/reply channel for "
+                "asynchronous consumers"
+            )
+        routed_fetch = coop.fetch if coop is not None else origin_fetch
+
         step_rec = LatencyRecorder("step")
         stall_rec = LatencyRecorder("stall")
         fetch_rec = LatencyRecorder("read")
@@ -307,6 +337,7 @@ class _TrainIngest:
                         byte_budget=p.readahead_bytes,
                         transport=tlabel,
                         pool=pool, meter=meter,
+                        fetch_fn=routed_fetch if coop is not None else None,
                         # Tuning pre-spawns headroom so the
                         # prefetch_workers knob can grow the live pool
                         # (ceiling shared with the sweep axes).
@@ -320,6 +351,7 @@ class _TrainIngest:
                     controller = _build_train_ingest_controller(
                         cfg, fetch_rec, lambda: consumed_bytes,
                         self.backend, pf, len(plan), flight, stager,
+                        coop=coop,
                     )
                     if controller is not None:
                         controller.start()
@@ -359,10 +391,7 @@ class _TrainIngest:
                             try:
                                 data, source = cache.get_or_fetch_info(
                                     key,
-                                    lambda k=key: fetch_chunk(
-                                        self.backend, k,
-                                        pool=pool, meter=meter,
-                                    ),
+                                    lambda k=key: routed_fetch(k),
                                 )
                             except BaseException as e:
                                 # errgroup semantics (read.py parity): a
@@ -471,6 +500,19 @@ class _TrainIngest:
                     # "trains" — that overlap is the whole point.
                     if pf is not None:
                         pf.advance(lo + batch)
+                    if (coop is not None and cfg.coop.demote
+                            and not coop.lockstep
+                            and flight is not None):
+                        # Straggler demotion off the run's own per-host
+                        # flight tables + locally-observed per-owner
+                        # transfer tails (rate-limited inside). NEVER
+                        # under a lockstep channel: demotion mutates the
+                        # per-host ring from per-host signals, and hosts
+                        # whose rings disagree slice different mesh
+                        # slots out of the same broadcast — silent
+                        # zero-filled chunks. Lockstep pods keep a
+                        # static ring.
+                        coop.maybe_refresh_demotions(flight)
                     if compute_s:
                         time.sleep(compute_s)
                     if op is not None:
@@ -485,6 +527,8 @@ class _TrainIngest:
                 tune_stats = controller.stop()
             if pf is not None:
                 pf.close()
+            if coop is not None:
+                coop.close()
             if stager is not None:
                 sink_stats = stager.finish() or {}
             if tel is not None:
@@ -531,6 +575,8 @@ class _TrainIngest:
                 "chunk_bytes": p.chunk_bytes or w.granule_bytes,
             },
         }
+        if coop is not None:
+            pipe_extra["coop"] = coop.stats()
         # Copies-per-byte: the zero-copy datapath's proof (and the A/B's
         # headline axis) — host-RAM writes of chunk payload per delivered
         # byte; 1.0 = written once off the wire, never copied again.
@@ -618,12 +664,14 @@ class _TrainIngest:
 
 
 def _build_train_ingest_controller(cfg, fetch_rec, bytes_fn, backend, pf,
-                                   plan_len, flight, stager=None):
+                                   plan_len, flight, stager=None, coop=None):
     """Tune controller for train-ingest: live knobs are the prefetcher's
     readahead depth / byte budget / worker fan-out (Prefetcher.reclamp /
-    set_workers), the hedge delay, and the overlapped staging executor's
-    in-flight depth (stager.set_depth); goodput is windowed consumed
-    bytes, the p99 guardrail watches demand-fetch latency."""
+    set_workers), the hedge delay, the overlapped staging executor's
+    in-flight depth (stager.set_depth), and the cooperative cache's
+    serve budget / on-off routing (coop.set_peer_budget / set_enabled);
+    goodput is windowed consumed bytes, the p99 guardrail watches
+    demand-fetch latency."""
     from tpubench.storage.tail import HedgedBackend, find_tail_layer
     from tpubench.tune.controller import (
         Knob,
@@ -676,6 +724,30 @@ def _build_train_ingest_controller(cfg, fetch_rec, bytes_fn, backend, pf,
             lo=1, hi=staging_depth_ceiling(stager.depth, pool_cap),
             mode="mul",
         ))
+    if coop is not None and coop.lockstep:
+        # Per-host controllers diverge: one host parking at coop=0 stops
+        # entering the collectives the others still wait in (mesh hang),
+        # and the serve budget is meaningless on the broadcast path.
+        # Lockstep routing is not a live knob.
+        coop = None
+    if "peer_budget_bytes" in wanted and coop is not None \
+            and coop.peer_budget_bytes > 0:
+        # A configured serve budget is live-resizable; 0 (unbounded) has
+        # no meaningful probe neighborhood, so the knob stays inert.
+        chunk = p.chunk_bytes or cfg.workload.granule_bytes
+        knobs.append(Knob(
+            "peer_budget_bytes", coop.peer_budget_bytes,
+            coop.set_peer_budget,
+            lo=chunk, hi=8 * coop.peer_budget_bytes, mode="mul",
+        ))
+    if "coop" in wanted and coop is not None:
+        # Binary routing knob: the controller may discover that on this
+        # pod/workload the peer round-trip loses to origin and park the
+        # run at coop=0 (set_enabled takes truthy ints).
+        knobs.append(Knob(
+            "coop", int(coop.enabled), coop.set_enabled,
+            lo=0, hi=1, mode="add",
+        ))
     if not knobs:
         return None
     sampler = RecorderSampler([fetch_rec], bytes_fn)
@@ -723,6 +795,35 @@ def format_pipeline_scorecard(pipe: dict) -> str:
             if cache.get("generation_invalidations") else ""
         )
     )
+    co = pipe.get("coop")
+    if co:
+        phr = co.get("peer_hit_ratio")
+        est = co.get("per_host_origin_estimate_bytes", 0)
+        ob = co.get("origin_bytes", 0)
+        saved = (1.0 - ob / est) if est else None
+        line = (
+            f"  coop: hosts={co.get('active_hosts', 0)}"
+            f"/{co.get('hosts', 0)} "
+            f"peer_hits={co.get('peer_hits', 0)} "
+            f"misses={co.get('peer_misses', 0)} "
+            f"hit_ratio={f'{phr:.1%}' if phr is not None else 'n/a'} "
+            f"pod_coalesced={co.get('pod_coalesced', 0)}  "
+            f"origin={ob}B vs per-host-est={est}B"
+            + (f" (saved {saved:.1%})" if saved else "")
+        )
+        if co.get("transfer_p50_ms") is not None:
+            line += (
+                f"  transfer p50={co['transfer_p50_ms']:.2f} ms "
+                f"p99={co['transfer_p99_ms']:.2f} ms"
+            )
+        if co.get("demotions") or co.get("restores"):
+            line += (
+                f"  demotions={co.get('demotions', 0)}"
+                f"/restores={co.get('restores', 0)}"
+            )
+        if co.get("budget_rejects"):
+            line += f"  budget_rejects={co['budget_rejects']}"
+        lines.append(line)
     if pf:
         eff = pf.get("efficiency")
         lines.append(

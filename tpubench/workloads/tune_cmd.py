@@ -163,6 +163,19 @@ def sweep_axes(cfg: BenchConfig, workload: str) -> dict[str, list]:
             max(1, s.depth), 1,
             staging_depth_ceiling(max(1, s.depth), pool_cap),
         )
+    if cfg.coop.enabled and workload != "read" \
+            and cfg.coop.channel != "ici":
+        # The routing switch is a 2-cell axis (the sweep's answer to "is
+        # the peer round-trip worth it on this pod/workload"); a
+        # configured serve budget sweeps the same neighborhood the
+        # online knob probes (0 = unbounded has no neighborhood). Only
+        # train-ingest builds a CoopCache (a read-workload coop axis
+        # would sweep identical-noise cells), and lockstep routing is
+        # not a knob (see _build_train_ingest_controller).
+        axes["coop"] = [0, 1]
+        b = cfg.coop.peer_budget_bytes
+        if b > 0:
+            axes["peer_budget_bytes"] = sorted({b // 2, b, b * 2, b * 4})
     wanted = set(cfg.tune.knobs)
     return {k: v for k, v in axes.items() if k in wanted}
 
