@@ -288,6 +288,116 @@ def _coop_cache_cell() -> dict:
     return out
 
 
+def _trace_overhead_cell() -> dict:
+    """Tracing-on vs tracing-off goodput on the hermetic fake backend
+    (BENCH_r06+): the SAME read config (fixed seed, staging off, flight
+    recorder at its default — identical in both arms), once with the
+    tracer disabled and once at FULL tracing (--enable-tracing, sample
+    rate 1.0 — every read's span recorded and every flight record
+    stamped under a live trace context). Arms run as back-to-back pairs
+    with alternating order; best-of goodputs and the paired ratios are
+    the cell's A/B data.
+
+    The <2% smoke GUARD deliberately does not compare those wall-clock
+    goodputs: on a share-capped 1-core container the run-to-run spread
+    of a ~100 ms window is 2-3x (measured), so no wall estimator can
+    resolve a 2% differential without minutes of samples. Instead the
+    guard metric is deterministic by construction:
+    ``overhead_frac = marginal tracing cost per read / per-read wall``,
+    where the numerator is a tight-loop median of the FULL per-read
+    tracing work (tracer span + flight op with trace ids + record
+    append — thousands of iterations, so preemption spikes average
+    out) and the denominator is the per-read duration implied by the
+    best measured goodput. A real regression (per-read flush, O(bytes)
+    span work) moves the numerator 10x+ and trips the guard; scheduler
+    noise cannot."""
+    from tpubench.config import BenchConfig
+    from tpubench.obs.flight import FlightRecorder
+    from tpubench.obs.tracing import RecordingTracer
+    from tpubench.workloads.read import run_read
+
+    workers, size = 2, 8 * MB
+
+    def cfg_for(traced: bool) -> "BenchConfig":
+        cfg = BenchConfig()
+        cfg.transport.protocol = "fake"
+        cfg.workload.workers = workers
+        cfg.workload.read_calls_per_worker = 8
+        cfg.workload.object_size = size
+        cfg.workload.granule_bytes = 2 * MB
+        cfg.workload.seed = 7  # arms differ ONLY in the tracer
+        cfg.staging.mode = "none"
+        cfg.obs.export = "none"
+        cfg.obs.enable_tracing = traced
+        cfg.obs.trace_sample_rate = 1.0
+        return cfg
+
+    def one(traced: bool) -> float:
+        from tpubench.obs.tracing import tracer_session
+
+        # run_read only traces when handed a tracer — build it from the
+        # arm's config (tracer_session: the CLI's flush-on-exit path),
+        # or the "traced" arm would silently run the NoopTracer and the
+        # A/B would compare two identical untraced runs.
+        c = cfg_for(traced)
+        with tracer_session(c) as tracer:
+            res = run_read(c, tracer=tracer)
+        if res.errors:
+            raise RuntimeError(
+                f"trace-overhead traced={traced} arm had "
+                f"{res.errors} errors"
+            )
+        return res.gbps
+
+    one(False)  # warmup (allocator/page-cache), discarded
+    reps = 3
+    best = {"off": 0.0, "on": 0.0}
+    ratios = []
+    for i in range(reps):
+        order = (False, True) if i % 2 == 0 else (True, False)
+        pair = {}
+        for traced in order:
+            pair["on" if traced else "off"] = one(traced)
+        best["off"] = max(best["off"], pair["off"])
+        best["on"] = max(best["on"], pair["on"])
+        if pair["off"] > 0:
+            ratios.append(round(pair["on"] / pair["off"], 4))
+
+    # Marginal per-read tracing cost: the complete traced-read shape —
+    # a recorded tracer span enclosing a flight op that allocates trace
+    # ids, joins the span's context, stamps phases and appends its
+    # record — repeated in a tight loop; median over batches.
+    tracer = RecordingTracer(sample_rate=1.0)
+    rec = FlightRecorder(capacity_per_worker=256)
+    wf = rec.worker("bench")
+    n = 2000
+    batches = []
+    for _ in range(9):
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            with tracer.span("ReadObject", object="o"):
+                op = wf.begin("o", "fake")
+                op.mark("first_byte")
+                op.mark("body_complete")
+                op.finish(1)
+        batches.append((time.perf_counter_ns() - t0) / n)
+        tracer.spans.clear()
+    tracing_ns = statistics.median(batches)
+    per_read_ns = (
+        size * workers / (best["off"] * 1e9) * 1e9 if best["off"] else None
+    )
+    overhead = tracing_ns / per_read_ns if per_read_ns else None
+    return {
+        "reps": reps,
+        "untraced_gbps": round(best["off"], 4),
+        "traced_gbps": round(best["on"], 4),
+        "paired_ratios": ratios,
+        "tracing_ns_per_read": round(tracing_ns, 1),
+        "per_read_ns": round(per_read_ns, 1) if per_read_ns else None,
+        "overhead_frac": round(overhead, 5) if overhead is not None else None,
+    }
+
+
 def _staging_depth_cell(depth: int) -> dict:
     """One cell of the staging-depth sweep: the staged config with the
     overlapped executor's in-flight window at ``depth`` (1 = the serial
@@ -414,6 +524,14 @@ def main() -> int:
         coop_cache = _coop_cache_cell()
     except Exception as e:  # noqa: BLE001 — the bench must not die here
         print(f"# coop cache A/B failed: {e}", file=sys.stderr)
+
+    # Tracing-on vs -off overhead A/B: hermetic fake backend, CPU-only
+    # and jax-free — same quiet-CPU segment as the other A/B cells.
+    trace_overhead: dict = {}
+    try:
+        trace_overhead = _trace_overhead_cell()
+    except Exception as e:  # noqa: BLE001 — the bench must not die here
+        print(f"# trace overhead A/B failed: {e}", file=sys.stderr)
 
     dev = jax.local_devices()[0]  # first jax touch: AFTER the quiet-CPU A/B
 
@@ -681,6 +799,7 @@ def main() -> int:
                 "fetch_only_ab": fetch_ab,
                 "tune_ab": tune_ab,
                 "coop_cache": coop_cache,
+                "trace_overhead": trace_overhead,
                 "shaped_verdict": shaped,
                 "probe_divergence_factor": pdf,
                 "host_cores": _usable_cores(),

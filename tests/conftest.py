@@ -93,6 +93,15 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "coop: cooperative chunk cache (ring/peer/single-flight)"
     )
+    # Trace-plane tests (causal span trees: per-trace sampling, context
+    # propagation, journal stitching, critical-path attribution, the
+    # span-drift guard) stay in tier-1 — same policy as the other
+    # subsystem markers: not slow-marked, so the cross-host stitch and
+    # the drift guard run on every pass; the marker exists for
+    # selective runs (`-m tracing`).
+    config.addinivalue_line(
+        "markers", "tracing: causal trace plane (context/stitch/blame)"
+    )
     # Multihost tests are marker-gated (see tests/test_multihost.py):
     # they need working multi-process jax.distributed, which this
     # container lacks — tier-1 collects clean skips, not failures.

@@ -92,6 +92,26 @@ def test_bench_end_to_end_cpu():
             f"{n}-host coop cell fetched more origin bytes than baseline"
         )
         assert c["max_origin_fetches_per_chunk"] == 1
+    # Trace-overhead A/B cell (PR 9): tracing-on vs tracing-off goodput
+    # on the fake backend, fixed seed, interleaved arms — with the
+    # regression guard on the cell's DETERMINISTIC metric: the marginal
+    # per-read tracing cost (tight-loop median of span + flight op +
+    # trace-id stamping) must stay under 2% of the per-read wall at the
+    # measured goodput. Wall-clock A/B goodputs ride along as data but
+    # are NOT gated (a share-capped 1-core container's run-to-run
+    # spread is 2-3x — far coarser than a 2% differential).
+    tov = d["trace_overhead"]
+    assert tov["untraced_gbps"] > 0 and tov["traced_gbps"] > 0
+    assert tov["tracing_ns_per_read"] > 0
+    assert len(tov["paired_ratios"]) == tov["reps"]
+    assert tov["overhead_frac"] is not None
+    assert tov["overhead_frac"] < 0.02, (
+        f"full tracing costs {tov['overhead_frac']:.2%} of a read "
+        f"({tov['tracing_ns_per_read']} ns per read against "
+        f"{tov['per_read_ns']} ns per read at the measured "
+        f"{tov['untraced_gbps']} GB/s) — the trace plane must stay "
+        "under 2%"
+    )
     sweep = d["staging_depth_sweep"]
     assert set(sweep) == {"1", "2", "4"}
     assert sweep["1"]["drain"] == "inline"

@@ -1,0 +1,850 @@
+"""Causal trace plane (PR 9): per-TRACE sampling, context propagation
+through flight ops and helper threads, journal→span-tree stitching
+(including the cross-host peer hop), tail-based sampling, critical-path
+attribution + the p99 blame table, OTLP trace export, OpenMetrics
+exemplars, the span-drift guard, and the tracer flush-on-exit audit.
+
+The cross-host acceptance test runs a hermetic 2-host pod (threaded
+hosts over the loopback peer channel) and asserts ONE stitched trace
+per cross-host read: the owner host's serve span parents under the
+requester's peer_request segment after journal merge. Critical-path
+NAMING is pinned on hand-built records with explicit nanosecond stamps
+(the deterministic fake clock): every duration is chosen, so the
+dominant-child walk has exactly one right answer.
+"""
+
+import json
+import os
+import re
+import threading
+import warnings
+
+import pytest
+
+import _otel_double
+
+_otel_double.install()
+
+from tpubench.config import BenchConfig
+from tpubench.obs import flight as flight_mod
+from tpubench.obs import tracing as tracing_mod
+from tpubench.obs.flight import PHASES, FlightRecorder, load_journals, merge_journal_docs
+from tpubench.obs.trace import (
+    NOTE_SPANS,
+    SPAN_KINDS,
+    assemble_traces,
+    blame_table,
+    critical_path,
+    head_sampled,
+    otlp_trace_payload,
+    render_trace_report,
+    span_catalog,
+    tail_sample,
+)
+from tpubench.obs.tracing import (
+    OtelTracer,
+    RecordingTracer,
+    TraceContext,
+    adopt_trace,
+    current_trace,
+    derive_span_id,
+    trace_scope,
+    tracer_session,
+)
+
+pytestmark = pytest.mark.tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_tls():
+    """Trace/op thread-locals must be clean on entry (an earlier test
+    module's aborted run must not become this module's ambient parent)
+    and never leak between tests here."""
+    flight_mod.adopt_op(None)
+    adopt_trace(None)
+    yield
+    flight_mod.adopt_op(None)
+    adopt_trace(None)
+
+
+# ------------------------------------------------- per-trace sampling ------
+
+
+def test_sampling_is_per_trace_never_orphans_children():
+    """The satellite fix: the decision is drawn ONCE at the trace root;
+    children inherit it verbatim. The old per-span draw could record a
+    child under a dropped parent — an orphan no tool can stitch."""
+    tr = RecordingTracer(sample_rate=0.5, seed=11)
+    for _ in range(40):
+        with tr.span("root"):
+            with tr.span("child"):
+                with tr.span("grandchild"):
+                    pass
+    assert tr.spans, "rate 0.5 over 40 roots must record something"
+    by_trace: dict = {}
+    for sp in tr.spans:
+        assert sp.trace_id and sp.span_id
+        by_trace.setdefault(sp.trace_id, []).append(sp)
+    for tid, spans in by_trace.items():
+        # A kept trace is kept WHOLE: root + child + grandchild.
+        assert len(spans) == 3, f"partial trace {tid}: {spans}"
+        roots = [s for s in spans if not s.parent_id]
+        assert len(roots) == 1
+        ids = {s.span_id for s in spans}
+        for s in spans:
+            if s.parent_id:
+                assert s.parent_id in ids, "orphan span in a kept trace"
+
+
+def test_unsampled_root_suppresses_descendants():
+    tr = RecordingTracer(sample_rate=0.0)
+    with tr.span("root"):
+        # The unsampled context is still installed (one decision for
+        # the whole tree) …
+        ctx = current_trace()
+        assert ctx is not None and not ctx.sampled
+        with tr.span("child"):
+            pass
+    assert tr.spans == []
+    assert current_trace() is None
+
+
+def test_nested_spans_link_ids():
+    tr = RecordingTracer(sample_rate=1.0)
+    with tr.span("a") as a:
+        with tr.span("b") as b:
+            assert b.trace_id == a.trace_id
+            assert b.parent_id == a.span_id
+    assert a.parent_id == ""
+
+
+def test_trace_scope_restores_and_none_is_noop():
+    outer = TraceContext("t" * 32, "s" * 16)
+    adopt_trace(outer)
+    inner = TraceContext("u" * 32, "p" * 16)
+    with trace_scope(inner):
+        assert current_trace() is inner
+        with trace_scope(None):  # no branching needed at call sites
+            assert current_trace() is inner
+    assert current_trace() is outer
+    adopt_trace(None)
+
+
+# ------------------------------------------- flight-op trace identity ------
+
+
+def test_flight_op_roots_a_fresh_trace_without_ambient_context():
+    rec = FlightRecorder(capacity_per_worker=8)
+    op = rec.worker("w0").begin("obj", "fake")
+    assert op.trace_id and op.span_id and op.parent_id is None
+    op.finish(10)
+    r = rec.records()[0]
+    assert r["trace_id"] == op.trace_id
+    assert r["span_id"] == op.span_id
+    assert "parent_id" not in r
+
+
+def test_flight_op_joins_enclosing_tracer_span():
+    rec = FlightRecorder(capacity_per_worker=8)
+    tr = RecordingTracer(sample_rate=1.0)
+    with tr.span("ReadObject") as sp:
+        op = rec.worker("w0").begin("obj", "fake")
+        op.finish(10)
+    r = rec.records()[0]
+    assert r["trace_id"] == sp.trace_id
+    assert r["parent_id"] == sp.span_id
+
+
+def test_nested_op_parents_under_outer_op():
+    rec = FlightRecorder(capacity_per_worker=8)
+    outer = rec.worker("w0").begin("outer", "fake")
+    inner = rec.worker("w1").begin("inner", "fake")
+    assert inner.trace_id == outer.trace_id
+    assert inner.parent_id == outer.span_id
+    inner.finish(1)
+    # Finishing the inner op restores the outer op's trace position.
+    assert current_trace().span_id == outer.span_id
+    outer.finish(1)
+
+
+def test_flight_op_preserves_unsampled_decision_through_nesting():
+    """The sampled bit must survive the span → op → span sandwich: an
+    op begun inside an UNSAMPLED tracer span inherits the decision, and
+    a tracer span nested under that op (a backend client span) stays
+    suppressed — not recorded as an orphan of a dropped root."""
+    rec = FlightRecorder(capacity_per_worker=8)
+    tr = RecordingTracer(sample_rate=0.0)
+    with tr.span("ReadObject"):
+        op = rec.worker("w0").begin("obj", "fake")
+        assert not current_trace().sampled
+        with tr.span("client-request"):
+            pass
+        op.finish(1)
+    assert tr.spans == [], "descendants of an unsampled root leaked"
+    # The flight RECORD is still journaled (journals are the trace
+    # store; their sampling happens at merge time) — only tracer spans
+    # obey the head decision.
+    assert len(rec.records()) == 1
+
+
+def test_otel_tracer_installs_trace_context():
+    """OtelTracer honors the same contract as RecordingTracer: its span
+    scopes a TraceContext, so flight ops begun inside join the exported
+    span's trace instead of rooting their own."""
+    rec = FlightRecorder(capacity_per_worker=8)
+    tracer = OtelTracer(
+        sample_rate=1.0, service_name="tpubench", transport="fake",
+    )
+    with tracer.span("ReadObject"):
+        ctx = current_trace()
+        assert ctx is not None and ctx.sampled
+        op = rec.worker("w0").begin("obj", "fake")
+        op.finish(1)
+    assert current_trace() is None
+    r = rec.records()[0]
+    assert r["trace_id"] == ctx.trace_id
+    assert r["parent_id"] == ctx.span_id
+
+
+def test_peer_hop_ctx_inherits_the_reads_sampling_decision():
+    """The hop context a peer request travels under must carry the
+    read's per-trace sampled bit — the owner side otherwise records
+    sampled spans under a dropped root (the orphan class again, across
+    hosts this time)."""
+    from tpubench.pipeline.coop import CoopCache
+
+    rec = FlightRecorder(capacity_per_worker=4)
+    adopt_trace(TraceContext("t" * 32, "p" * 16, sampled=False))
+    op = rec.worker("w").begin("o", "fake")
+    hop = CoopCache._peer_hop_ctx(None)  # self unused: thread-local only
+    assert hop.trace_id == op.trace_id
+    assert hop.span_id == derive_span_id(op.span_id, "peer_request")
+    assert hop.sampled is False
+    op.finish(1)
+
+
+def test_peer_wire_lane_roundtrips_sampled_bit():
+    np = pytest.importorskip("numpy")
+    from tpubench.dist.peer import _CTX_BYTES, _decode_ctx, _encode_ctx
+
+    for sampled in (True, False):
+        buf = np.zeros(64, dtype=np.uint8)
+        _encode_ctx(buf, TraceContext("ab" * 16, "cd" * 8, sampled))
+        ctx = _decode_ctx(buf)
+        assert ctx is not None
+        assert (ctx.trace_id, ctx.span_id) == ("ab" * 16, "cd" * 8)
+        assert ctx.sampled is sampled
+    assert _decode_ctx(np.zeros(_CTX_BYTES, dtype=np.uint8)) is None
+
+
+def test_adopt_op_carries_trace_position_to_helper_thread():
+    """The hedge-producer/staging-reaper discipline: adopting the
+    consumer's op adopts its trace position, so records the helper
+    begins parent under the read."""
+    rec = FlightRecorder(capacity_per_worker=8)
+    op = rec.worker("w0").begin("obj", "fake")
+    seen: dict = {}
+
+    def helper():
+        flight_mod.adopt_op(op)
+        try:
+            child = rec.worker("helper").begin("nested", "fake")
+            seen["trace"] = child.trace_id
+            seen["parent"] = child.parent_id
+            child.finish(1)
+        finally:
+            flight_mod.adopt_op(None)
+
+    t = threading.Thread(target=helper)
+    t.start()
+    t.join()
+    op.finish(1)
+    assert seen["trace"] == op.trace_id
+    assert seen["parent"] == op.span_id
+
+
+def test_aborted_pod_ingest_leaves_no_ambient_trace(jax_cpu_devices):
+    """Regression: the pod-level object op used to install itself on the
+    main thread; an aborting run left its trace position dangling, and
+    every LATER trace in the process parented under a dead span (one
+    giant unstitchable trace). The op is side-channel now — an aborted
+    run must leave the thread trace-clean (the abort path also closes
+    the object record with its error instead of dropping it)."""
+    from tpubench.storage import FakeBackend
+    from tpubench.storage.base import StorageError
+    from tpubench.workloads.pod_ingest import run_pod_ingest
+
+    class Failing(FakeBackend):
+        def open_read(self, name, start=0, length=None):
+            raise StorageError("injected", transient=False)
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.object_size = 64 * 1024
+    cfg.workload.abort_on_error = True
+    with pytest.raises(StorageError):
+        run_pod_ingest(cfg, backend=Failing())
+    assert current_trace() is None
+    assert flight_mod.current_op() is None
+
+
+# -------------------------------------------------------------- stitch ------
+
+
+def _rec(span_id, phases, *, trace_id="t1", parent_id=None, kind="read",
+         host=0, notes=None, obj="o", nbytes=0, error=None, worker="w0"):
+    r = {
+        "worker": worker, "object": obj, "transport": "fake",
+        "kind": kind, "phases": dict(phases), "bytes": nbytes,
+        "trace_id": trace_id, "span_id": span_id, "host": host,
+    }
+    if parent_id:
+        r["parent_id"] = parent_id
+    if notes:
+        r["notes"] = list(notes)
+    if error:
+        r["error"] = error
+    return r
+
+
+def test_assemble_synthesizes_phase_segments_with_start_keyed_ids():
+    recs = [_rec("a" * 16, {
+        "enqueue": 0, "connect": 10, "first_byte": 30, "body_complete": 100,
+    })]
+    traces, stats = assemble_traces(recs)
+    assert stats["traces"] == 1 and stats["orphans"] == 0
+    root = traces[0].roots[0]
+    assert (root.start_ns, root.end_ns) == (0, 100)
+    segs = {c.name: c for c in root.children}
+    assert set(segs) == {"connect", "first_byte", "body_complete"}
+    assert segs["connect"].duration_ns == 10
+    assert segs["first_byte"].duration_ns == 20
+    assert segs["body_complete"].duration_ns == 70
+    # Ids are keyed by the segment's START phase — the only name the
+    # propagation side knows when a hop begins.
+    assert segs["first_byte"].span_id == derive_span_id("a" * 16, "connect")
+
+
+def test_assemble_stitches_cross_host_serve_under_peer_segment():
+    read_sid = "b" * 16
+    hop_id = derive_span_id(read_sid, "peer_request")
+    recs = [
+        _rec(read_sid, {"enqueue": 0, "peer_request": 5, "peer_hit": 100},
+             host=0),
+        _rec("c" * 16, {"enqueue": 40, "owner_fetch": 41,
+                        "body_complete": 90},
+             parent_id=hop_id, kind="serve", host=1),
+    ]
+    traces, stats = assemble_traces(recs)
+    assert stats["traces"] == 1, "cross-host read must stitch to ONE trace"
+    assert stats["cross_host_edges"] == 1
+    assert stats["orphans"] == 0
+    root = traces[0].roots[0]
+    hop = next(c for c in root.children if c.span_id == hop_id)
+    assert hop.name == "peer_hit"  # the round-trip segment
+    serves = [c for c in hop.children if c.kind == "serve"]
+    assert len(serves) == 1 and serves[0].host == 1
+    assert {c.name for c in serves[0].children} >= {"body_complete"}
+
+
+def test_assemble_keeps_orphans_visible_as_tree_tops():
+    """A record whose parent is outside the journal (a tracer span) is
+    counted as an orphan but still ROOTS its trace — a traced run's
+    reads must participate in duration/blame rollups exactly like an
+    untraced run's parentless reads."""
+    recs = [_rec("d" * 16, {"enqueue": 0, "body_complete": 10},
+                 parent_id="9" * 16)]
+    traces, stats = assemble_traces(recs)
+    assert stats["orphans"] == 1
+    assert traces[0].orphans and traces[0].roots
+    assert traces[0].duration_ns == 10
+    rows = blame_table(traces, slow_fraction=1.0)
+    assert rows and rows[0]["span"] == "body_complete"
+
+
+def test_retry_and_hedge_notes_become_annotation_spans():
+    recs = [_rec("e" * 16, {"enqueue": 0, "body_complete": 100}, notes=[
+        {"kind": "retry", "t": 10, "attempt": 1, "backoff_s": 2e-8},
+        {"kind": "hedge", "event": "launch", "t": 30},
+        {"kind": "hedge", "event": "win", "t": 70},
+    ])]
+    traces, _ = assemble_traces(recs)
+    root = traces[0].roots[0]
+    byname = {c.name: c for c in root.children}
+    assert byname["retry"].duration_ns == 20  # covers the backoff pause
+    assert byname["hedge"].start_ns == 30
+    assert byname["hedge"].end_ns == 70  # launch → win verdict
+
+
+def test_records_without_trace_ids_do_not_stitch_but_do_not_crash():
+    traces, stats = assemble_traces([
+        {"worker": "w", "object": "o", "kind": "read",
+         "phases": {"enqueue": 0, "body_complete": 5}, "bytes": 5},
+    ])
+    assert traces == [] and stats["traces"] == 0
+
+
+# ------------------------------------------------------------ sampling ------
+
+
+def test_head_sampled_is_deterministic_and_rate_shaped():
+    tid = "80000000" + "0" * 24
+    assert head_sampled(tid, 1.0)
+    assert not head_sampled(tid, 0.0)
+    # 0x80000000/0xFFFFFFFF ≈ 0.5: kept at 0.6, dropped at 0.4 — and the
+    # same answer every call (no RNG: every host and re-run agree).
+    assert head_sampled(tid, 0.6)
+    assert not head_sampled(tid, 0.4)
+
+
+def _traces_with_durations(durs_ms):
+    recs = []
+    for i, d in enumerate(durs_ms):
+        recs.append(_rec(f"{i:016x}", {"enqueue": 0,
+                                       "body_complete": int(d * 1e6)},
+                         trace_id=f"{i:032x}"))
+    traces, _ = assemble_traces(recs)
+    return traces
+
+
+def test_tail_sample_keeps_slowest_decile_whole_and_bounds_memory():
+    traces = _traces_with_durations(range(1, 41))
+    kept, stats = tail_sample(traces, slow_fraction=0.1, head_rate=0.0)
+    assert stats["slow"] == 4
+    kept_ids = {t.trace_id for t in kept}
+    slowest = sorted(traces, key=lambda t: -t.duration_ns)[:4]
+    assert {t.trace_id for t in slowest} <= kept_ids
+    # Decision is per-TRACE: a kept tree keeps every span.
+    for t in kept:
+        assert t.span_count() == 2  # root + one segment
+    bounded, bstats = tail_sample(traces, slow_fraction=1.0, head_rate=0.0,
+                                  max_keep=5)
+    assert len(bounded) == 5 and bstats["bound_dropped"] == 35
+    # Slowest win the bound.
+    assert min(t.duration_ns for t in bounded) >= 36 * 1e6
+
+
+# ---------------------------------------- critical path + blame table ------
+
+
+def test_critical_path_names_dominant_child_deterministic_clock():
+    """The deterministic fake clock: every phase stamp is an explicit
+    nanosecond, so the dominant child has exactly one right answer —
+    the injected 80 ms first_byte wait."""
+    recs = [_rec("f" * 16, {
+        "enqueue": 0, "connect": 5_000_000, "first_byte": 85_000_000,
+        "body_complete": 100_000_000,
+    })]
+    traces, _ = assemble_traces(recs)
+    path = critical_path(traces[0].roots[0])
+    assert path and path[-1].name == "first_byte"
+    rows = blame_table(traces, slow_fraction=1.0)
+    assert rows[0]["span"] == "first_byte"
+
+
+def test_critical_path_descends_cross_host_into_owner_fetch():
+    """Injected-delay critical path across the hop: the owner's origin
+    fetch owns the hop's wall time, so the walk descends requester →
+    hop segment → serve → owner_fetch segment."""
+    read_sid = "a1" * 8
+    hop_id = derive_span_id(read_sid, "peer_request")
+    serve_sid = "b2" * 8
+    recs = [
+        _rec(read_sid,
+             {"enqueue": 0, "peer_request": 1_000_000,
+              "peer_hit": 100_000_000}, host=0),
+        # Owner side (its own perf_counter base): fetch dominates.
+        _rec(serve_sid,
+             {"enqueue": 0, "owner_fetch": 1_000_000,
+              "body_complete": 96_000_000},
+             parent_id=hop_id, kind="serve", host=1),
+    ]
+    traces, _ = assemble_traces(recs)
+    path = critical_path(traces[0].roots[0])
+    names = [(p.kind if not p.synth else "", p.name) for p in path]
+    assert names[0] == ("", "peer_hit")
+    assert ("serve", "o") in names
+    assert path[-1].synth and path[-1].name == "body_complete"
+
+
+def test_critical_path_stops_when_no_child_dominates():
+    """A 50 ms hop whose serve took 0.5 ms terminates at the hop —
+    unexplained time belongs to the span itself, never its fastest
+    descendant."""
+    read_sid = "c3" * 8
+    hop_id = derive_span_id(read_sid, "peer_request")
+    recs = [
+        _rec(read_sid, {"enqueue": 0, "peer_request": 1_000_000,
+                        "peer_hit": 51_000_000}, host=0),
+        _rec("d4" * 8, {"enqueue": 0, "body_complete": 500_000},
+             parent_id=hop_id, kind="serve", host=1),
+    ]
+    traces, _ = assemble_traces(recs)
+    path = critical_path(traces[0].roots[0])
+    assert path[-1].name == "peer_hit"
+
+
+# -------------------------------------------------- 2-host acceptance ------
+
+
+def _loopback_pod(tmp_path, owner_delay_s=0.0):
+    from tpubench.pipeline.cache import ChunkCache, ChunkKey
+    from tpubench.pipeline.coop import (
+        CoopCache,
+        HashRing,
+        LoopbackBroker,
+        LoopbackChannel,
+    )
+    from tpubench.pipeline.prefetch import fetch_chunk
+    from tpubench.storage.fake import FakeBackend
+
+    chunk = 64 * 1024
+    backend = FakeBackend.prepopulated(prefix="tr/file_", count=4,
+                                       size=4 * chunk)
+    ring = HashRing(range(2))
+    broker = LoopbackBroker()
+    hosts = []
+    for h in range(2):
+        rec = FlightRecorder(capacity_per_worker=64, host=h)
+
+        def origin_fetch(key, _h=h):
+            if _h == 1 and owner_delay_s:
+                import time
+
+                time.sleep(owner_delay_s)
+            return fetch_chunk(backend, key)
+
+        cc = CoopCache(
+            ChunkCache(16 * 1024 * 1024), host_id=h, ring=ring,
+            channel=LoopbackChannel(broker, h), origin_fetch=origin_fetch,
+            flight_recorder=rec,
+        )
+        broker.register(h, cc.serve)
+        hosts.append((cc, rec))
+    # Chunk keys owned by host 1 (the cross-host hop from host 0).
+    keys = []
+    for meta in backend.list("tr/file_"):
+        off = 0
+        while off < meta.size:
+            n = min(chunk, meta.size - off)
+            k = ChunkKey("", meta.name, meta.generation, off, n)
+            if ring.owner(k) == 1:
+                keys.append(k)
+            off += n
+    assert keys, "ring placed no chunk on host 1 — widen the object set"
+    return hosts, keys
+
+
+def test_two_host_stitch_one_trace_per_cross_host_read(tmp_path):
+    """The acceptance criterion: a hermetic 2-host coop run yields ONE
+    stitched trace per cross-host read — the owner host's serve span
+    (carrying its owner_fetch) parents under the requester's
+    peer_request hop segment after journal merge."""
+    from tpubench.mem.slab import release_payload
+
+    hosts, keys = _loopback_pod(tmp_path)
+    (cc0, rec0), (cc1, rec1) = hosts
+    n_reads = 3
+    for key in keys[:n_reads]:
+        op = rec0.worker("w0").begin(key.object, "peer")
+        payload = cc0.cache.get_or_fetch(key, lambda k=key: cc0.fetch(k))
+        release_payload(payload)
+        op.finish(key.length)
+    assert cc0.peer_hits == n_reads
+    j0 = str(tmp_path / "h0.json")
+    j1 = str(tmp_path / "h1.json")
+    rec0.write_journal(j0)
+    rec1.write_journal(j1)
+    docs = load_journals([j0, j1])
+    records = merge_journal_docs(docs)
+    traces, stats = assemble_traces(records)
+    assert stats["orphans"] == 0
+    assert stats["cross_host_edges"] == n_reads
+    read_traces = [t for t in traces
+                   if t.roots and t.roots[0].kind == "read"]
+    assert len(read_traces) == n_reads
+    for t in read_traces:
+        root = t.roots[0]
+        assert root.host == 0
+        hop_id = derive_span_id(root.span_id, "peer_request")
+        hop = next(c for c in root.children if c.span_id == hop_id)
+        serves = [c for c in hop.children if c.kind == "serve"]
+        assert len(serves) == 1, "exactly one owner-side span per hop"
+        serve = serves[0]
+        assert serve.host == 1
+        assert serve.trace_id == root.trace_id
+        assert "owner_fetch" in serve.record["phases"]
+    # The owner's serve records never rooted their own traces: every
+    # cross-host read is ONE tree, not two.
+    assert not any(t.roots and t.roots[0].kind == "serve" for t in traces)
+
+
+def test_two_host_report_trace_blames_injected_owner_delay(tmp_path):
+    """report trace on the merged journals names the owner-side fetch
+    as the dominant child when the delay is injected there."""
+    from tpubench.mem.slab import release_payload
+
+    hosts, keys = _loopback_pod(tmp_path, owner_delay_s=0.05)
+    (cc0, rec0), (cc1, rec1) = hosts
+    key = keys[0]
+    op = rec0.worker("w0").begin(key.object, "peer")
+    payload = cc0.cache.get_or_fetch(key, lambda: cc0.fetch(key))
+    release_payload(payload)
+    op.finish(key.length)
+    j0, j1 = str(tmp_path / "h0.json"), str(tmp_path / "h1.json")
+    rec0.write_journal(j0)
+    rec1.write_journal(j1)
+    docs = load_journals([j0, j1])
+    traces, _ = assemble_traces(merge_journal_docs(docs))
+    root = [t for t in traces if t.roots[0].kind == "read"][0].roots[0]
+    path = critical_path(root)
+    # requester hop segment → owner serve → the delayed fetch segment.
+    assert any(p.kind == "serve" and not p.synth for p in path), (
+        f"critical path never crossed hosts: {[p.name for p in path]}"
+    )
+    assert path[-1].host == 1
+    out = render_trace_report(docs)
+    assert "cross_host_edges=1" in out
+    assert "[host 1] serve" in out
+    assert "p99 blame" in out
+
+
+# ------------------------------------------------------- report trace ------
+
+
+def _journal_from_hermetic_run(tmp_path):
+    from tpubench.workloads.read import run_read
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 2
+    cfg.workload.read_calls_per_worker = 3
+    cfg.workload.object_size = 64 * 1024
+    cfg.obs.enable_tracing = True
+    cfg.obs.trace_sample_rate = 1.0
+    cfg.obs.flight_journal = str(tmp_path / "fl.json")
+    with tracer_session(cfg) as tracer:
+        res = run_read(cfg, tracer=tracer)
+    assert res.errors == 0
+    return cfg.obs.flight_journal
+
+
+def test_report_trace_cli_end_to_end(tmp_path, capsys):
+    from tpubench.cli import main
+
+    jpath = _journal_from_hermetic_run(tmp_path)
+    rc = main(["report", "trace", jpath, "--show-traces", "2"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "== trace report:" in out
+    assert "sampling: kept" in out
+    assert "trace " in out  # at least one rendered tree
+
+
+def test_report_trace_requires_a_journal_path():
+    from tpubench.cli import main
+
+    with pytest.raises(SystemExit, match="report trace"):
+        main(["report", "trace"])
+
+
+def test_report_trace_degrades_on_pretrace_journal(tmp_path, capsys):
+    """A journal that predates the trace plane (no span ids) renders a
+    one-line explanation, not a traceback."""
+    p = tmp_path / "old.json"
+    p.write_text(json.dumps({
+        "format": "tpubench-flight-v1", "host": 0, "dropped": 0,
+        "records": [{"worker": "w", "object": "o", "kind": "read",
+                     "phases": {"enqueue": 1, "body_complete": 5},
+                     "bytes": 5}],
+    }))
+    out = render_trace_report(load_journals([str(p)]))
+    assert "no traceable records" in out
+
+
+# ----------------------------------------------------------- OTLP/HTTP -----
+
+
+def test_otlp_trace_payload_shape_and_resolvable_parents():
+    recs = [
+        _rec("a" * 16, {"enqueue": 0, "peer_request": 5, "peer_hit": 50},
+             nbytes=5),
+        _rec("b" * 16, {"enqueue": 10, "body_complete": 60},
+             parent_id=derive_span_id("a" * 16, "peer_request"),
+             kind="serve", host=1, error="StallError: x"),
+        {"kind": "read", "phases": {"enqueue": 0}},  # pre-trace: skipped
+    ]
+    payload = otlp_trace_payload(recs, resource={"service.name": "tpubench"})
+    spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    by_id = {s["spanId"]: s for s in spans}
+    assert by_id["a" * 16]["traceId"] == "t1"
+    assert "parentSpanId" not in by_id["a" * 16]
+    assert by_id["b" * 16]["status"]["code"] == 2
+    # Every intra-journal parent resolves WITHIN the export: the
+    # synthesized segment spans ship too, so the serve record's derived
+    # parent (the peer hop segment) is a real span in the payload — an
+    # OTLP backend renders the cross-host stitch, not orphans.
+    for s in spans:
+        pid = s.get("parentSpanId")
+        if pid:
+            assert pid in by_id, f"unresolvable parent {pid} in export"
+    res_attrs = payload["resourceSpans"][0]["resource"]["attributes"]
+    assert {"key": "service.name",
+            "value": {"stringValue": "tpubench"}} in res_attrs
+
+
+def test_otlp_trace_exporter_dry_run_and_endpoint_rewrite():
+    from tpubench.obs.exporters import OTLPTraceExporter
+
+    recs = [_rec("a" * 16, {"enqueue": 0, "body_complete": 50})]
+    exp = OTLPTraceExporter(lambda: recs,
+                            endpoint="http://c:4318/v1/metrics")
+    assert exp.endpoint == "http://c:4318/v1/traces"
+    dry = OTLPTraceExporter(lambda: recs)
+    dry.export_once()
+    # record + its synthesized body_complete segment
+    assert dry.posts == 0 and dry.spans_exported == 2
+    assert dry.summary()["endpoint"] == "dry_run"
+
+
+# ----------------------------------------------------------- exemplars -----
+
+
+def test_openmetrics_exposition_carries_trace_exemplars():
+    from tpubench.obs.telemetry import build_registry, phase_metric_name
+
+    reg = build_registry()
+    h = reg.get(phase_metric_name("first_byte"))
+    h.observe_ns(5_000_000)  # no trace id: no exemplar
+    h.observe_ns(87_000_000, trace_id="4f2a" * 8)
+    om = reg.render_prometheus(openmetrics=True)
+    assert 'trace_id="' + "4f2a" * 8 + '"' in om
+    assert om.rstrip().endswith("# EOF")
+    # OpenMetrics 1.0: counter FAMILIES are declared without `_total`
+    # (samples keep the suffix) — a `*_total counter` TYPE line fails a
+    # stock Prometheus OpenMetrics parse and kills the whole scrape.
+    assert "# TYPE tpubench_records counter" in om
+    assert "# TYPE tpubench_records_total counter" not in om
+    assert "\ntpubench_records_total " in om
+    plain = reg.render_prometheus()
+    assert "trace_id" not in plain and "# EOF" not in plain
+    # The 0.0.4 exposition keeps its historical suffixed declaration.
+    assert "# TYPE tpubench_records_total counter" in plain
+
+
+# --------------------------------------------------- flush-on-exit ---------
+
+
+class _SpyTracer:
+    def __init__(self):
+        self.shutdowns = 0
+
+    def span(self, name, **attrs):  # pragma: no cover — unused
+        raise AssertionError
+
+    def shutdown(self):
+        self.shutdowns += 1
+
+
+def test_tracer_session_shuts_down_on_success_and_error(monkeypatch):
+    spies = []
+
+    def fake_make(cfg):
+        spy = _SpyTracer()
+        spies.append(spy)
+        return spy
+
+    monkeypatch.setattr(tracing_mod, "make_tracer", fake_make)
+    cfg = BenchConfig()
+    with tracer_session(cfg):
+        pass
+    assert spies[0].shutdowns == 1
+    with pytest.raises(RuntimeError):
+        with tracer_session(cfg):
+            raise RuntimeError("workload died")
+    assert spies[1].shutdowns == 1, "a dying run still flushes its spans"
+
+
+def test_cli_shutdown_coverage_audit():
+    """The satellite audit: every subcommand that builds a tracer closes
+    it through the ONE tracer_session discipline — read, chaos and tune
+    — and `top` (jax-free journal dashboard) builds no tracer at all, so
+    there is nothing to flush there."""
+    with open(os.path.join(REPO, "tpubench", "cli.py")) as f:
+        cli_src = f.read()
+    assert cli_src.count("with tracer_session(cfg) as tracer") >= 3, (
+        "read/chaos/tune must all wrap their runs in tracer_session"
+    )
+    # No stray construction path that could skip the finally-shutdown.
+    assert "make_tracer(" not in cli_src
+    with open(os.path.join(REPO, "tpubench", "obs", "live.py")) as f:
+        live_src = f.read()
+    assert "make_tracer" not in live_src and "Tracer" not in live_src
+
+
+def test_otel_shutdown_flush_error_degrades_to_one_warning():
+    """The broken-SDK shape the satellite pins: an exporter raising in
+    shutdown() (endpoint gone, processor torn down) degrades to a
+    one-line warning — the run's results are already written; a
+    traceback here would mask the real outcome."""
+    from opentelemetry.sdk.trace.export import SimpleSpanProcessor
+    from opentelemetry.sdk.trace.export.in_memory_span_exporter import (
+        InMemorySpanExporter,
+    )
+
+    class _BrokenExporter(InMemorySpanExporter):
+        def shutdown(self):
+            raise ConnectionError("collector gone")
+
+    tracer = OtelTracer(
+        sample_rate=1.0, service_name="tpubench", transport="fake",
+        span_processor=SimpleSpanProcessor(_BrokenExporter()),
+    )
+    with tracer.span("ReadObject"):
+        pass
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        tracer.shutdown()  # must NOT raise
+    msgs = [str(w.message) for w in caught]
+    assert any("flush failed" in m and "ConnectionError" in m for m in msgs)
+
+
+# ------------------------------------------------------ span-drift guard ---
+
+
+def test_span_drift_guard_catalog_phases_and_readme():
+    """Three surfaces, one truth (the PR 7 metric-guard discipline):
+    the span catalog, the flight PHASES tuple, and the README span
+    table. A new phase or span kind that skips any surface fails
+    tier-1, not review."""
+    cat = span_catalog()
+    # Every phase is a synthesized child-span name with documented help.
+    for p in PHASES:
+        assert p in cat and cat[p], f"phase {p} missing from span catalog"
+    for k in list(SPAN_KINDS) + list(NOTE_SPANS):
+        assert k in cat and cat[k]
+    # Catalog <-> README span table (the "### Span catalog" section).
+    with open(os.path.join(REPO, "README.md")) as f:
+        readme = f.read()
+    m = re.search(r"### Span catalog\n(.*?)\n## ", readme, re.S)
+    assert m, "README lost its '### Span catalog' section"
+    documented = set(re.findall(r"^\| `([a-z_]+)` \|", m.group(1), re.M))
+    missing = set(cat) - documented
+    assert not missing, f"spans missing from the README table: {missing}"
+    stale = documented - set(cat)
+    assert not stale, f"README documents spans the plane no longer emits: {stale}"
+    # Every record kind the codebase writes is a catalogued span kind.
+    known = set(SPAN_KINDS)
+    src_kinds = set()
+    for root, _dirs, files in os.walk(os.path.join(REPO, "tpubench")):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(root, fn)) as f:
+                src_kinds |= set(
+                    re.findall(r"""kind=["']([a-z_]+)["']""", f.read())
+                )
+    unknown = src_kinds - known
+    assert not unknown, f"record kinds emitted but not catalogued: {unknown}"

@@ -648,20 +648,20 @@ def _bringup(cfg: BenchConfig) -> dict:
 
 
 def cmd_read(cfg: BenchConfig, args) -> RunResult:
-    from tpubench.obs.tracing import make_tracer
+    from tpubench.obs.tracing import tracer_session
     from tpubench.staging.device import make_sink_factory
     from tpubench.workloads.read import run_read
 
-    tracer = make_tracer(cfg)
-    try:
+    # Flush-on-exit (trace_exporter.go:55-60) via the ONE shared
+    # discipline: without the session's finally-shutdown, batched spans
+    # (console/cloud_trace exporters) are dropped at process exit — the
+    # reference's lost-final-flush bug class. chaos and tune ride the
+    # same context manager (the shutdown-coverage audit in
+    # tests/test_trace_plane.py pins all three).
+    with tracer_session(cfg) as tracer:
         return run_read(
             cfg, tracer=tracer, sink_factory=make_sink_factory(cfg)
         )
-    finally:
-        # Flush-on-exit (trace_exporter.go:55-60): without this, batched
-        # spans (console/cloud_trace exporters) are dropped at process exit
-        # — the reference's lost-final-flush bug class.
-        tracer.shutdown()
 
 
 def cmd_pod_ingest(cfg: BenchConfig, args) -> RunResult:
@@ -960,11 +960,26 @@ def main(argv=None) -> int:
              "deltas, sweep tables — replaces the reference's matplotlib "
              "recipe, README.md:15-36); `report timeline <journals...>` "
              "merges flight journals into the pod-level per-phase "
-             "p50/p99 + straggler report",
+             "p50/p99 + straggler report; `report trace <journals...>` "
+             "stitches them into cross-host span trees with tail-based "
+             "sampling, critical-path attribution and the p99 blame "
+             "table",
     )
     rep.add_argument("results", nargs="+",
-                     help="result/sweep JSON paths — or `timeline` "
-                          "followed by flight-journal paths")
+                     help="result/sweep JSON paths — or `timeline`/"
+                          "`trace` followed by flight-journal paths")
+    rep.add_argument("--head-sample", type=float, default=0.05,
+                     help="report trace: unbiased per-trace head-sample "
+                          "rate kept IN ADDITION to the slowest decile "
+                          "(default 0.05; decided from the trace id, so "
+                          "every host and re-run keeps the same traces)")
+    rep.add_argument("--slow-keep", type=int, default=512,
+                     help="report trace: memory bound on kept trees "
+                          "(slowest win; default 512 — the EXACT_SAMPLE_"
+                          "CAP discipline)")
+    rep.add_argument("--show-traces", type=int, default=3,
+                     help="report trace: how many slowest span trees to "
+                          "print in full (default 3)")
 
     args = top.parse_args(argv)
     if args.cmd == "top":
@@ -980,16 +995,27 @@ def main(argv=None) -> int:
         )
     if args.cmd == "report":
         # Offline post-processing: no jax, no common config needed.
-        from tpubench.workloads.report_cmd import run_report, run_timeline
+        from tpubench.workloads.report_cmd import (
+            run_report,
+            run_timeline,
+            run_trace,
+        )
 
-        if args.results and args.results[0] == "timeline":
+        if args.results and args.results[0] in ("timeline", "trace"):
+            mode = args.results[0]
             if len(args.results) < 2:
                 raise SystemExit(
-                    "report timeline: at least one flight-journal path "
+                    f"report {mode}: at least one flight-journal path "
                     "required (workload runs write one under "
                     "--flight-journal)"
                 )
-            print(run_timeline(args.results[1:]))
+            if mode == "timeline":
+                print(run_timeline(args.results[1:]))
+            else:
+                print(run_trace(
+                    args.results[1:], head_rate=args.head_sample,
+                    max_keep=args.slow_keep, show=args.show_traces,
+                ))
             return 0
         print(run_report(args.results))
         return 0
@@ -1153,21 +1179,31 @@ def main(argv=None) -> int:
                 for fname in timeline[0][2]:
                     setattr(cfg.transport.fault, fname,
                             getattr(defaults, fname))
-            res = run_chaos(
-                cfg,
-                timeline=timeline,
-                chaos_workload=args.chaos_workload,
-            )
+            from tpubench.obs.tracing import tracer_session
+
+            # Same flush-on-exit coverage as the primary workloads: a
+            # chaos run with --enable-tracing must not drop its batched
+            # spans at process exit.
+            with tracer_session(cfg) as tracer:
+                res = run_chaos(
+                    cfg,
+                    timeline=timeline,
+                    chaos_workload=args.chaos_workload,
+                    tracer=tracer,
+                )
             print(format_scorecard(res.extra["chaos"]))
         elif args.cmd == "tune":
+            from tpubench.obs.tracing import tracer_session
             from tpubench.workloads.tune_cmd import format_tune_block, run_tune
 
-            res = run_tune(
-                cfg,
-                mode=args.tune_mode,
-                workload=args.tune_workload,
-                profile_path=args.tune_profile or "",
-            )
+            with tracer_session(cfg) as tracer:
+                res = run_tune(
+                    cfg,
+                    mode=args.tune_mode,
+                    workload=args.tune_workload,
+                    profile_path=args.tune_profile or "",
+                    tracer=tracer,
+                )
             print(format_tune_block(res.extra["tune"]))
         elif args.cmd == "probe":
             from tpubench.workloads.probe import run_probe

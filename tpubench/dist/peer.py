@@ -32,7 +32,42 @@ from typing import Optional
 
 import numpy as np
 
+from tpubench.obs.tracing import TraceContext
 from tpubench.pipeline.cache import ChunkKey
+
+# Trace-context lane (PR 9): a follower's slot in the broadcast is
+# otherwise all-zero padding, so its first 25 bytes carry the
+# requester's trace context — flag byte (also encoding the per-trace
+# sampled bit: 0xA5 sampled, 0xA4 unsampled) + 16-byte trace id +
+# 8-byte span id. After the all-gather EVERY host holds every slot, so
+# the owner recovers which remote spans caused this collective transfer
+# and records them as trace LINKS (a collective has no single remote
+# parent — all followers entered together).
+_CTX_FLAG_SAMPLED = 0xA5
+_CTX_FLAG_UNSAMPLED = 0xA4
+_CTX_BYTES = 1 + 16 + 8
+
+
+def _encode_ctx(buf: np.ndarray, ctx: TraceContext) -> None:
+    if buf.shape[0] < _CTX_BYTES:
+        return  # sub-25-byte slot (degenerate tiny chunk): skip the lane
+    flag = _CTX_FLAG_SAMPLED if ctx.sampled else _CTX_FLAG_UNSAMPLED
+    raw = bytes([flag]) + bytes.fromhex(
+        ctx.trace_id.zfill(32)[:32]
+    ) + bytes.fromhex(ctx.span_id.zfill(16)[:16])
+    buf[:_CTX_BYTES] = np.frombuffer(raw, dtype=np.uint8)
+
+
+def _decode_ctx(slot: np.ndarray) -> Optional[TraceContext]:
+    if slot.shape[0] < _CTX_BYTES or int(slot[0]) not in (
+        _CTX_FLAG_SAMPLED, _CTX_FLAG_UNSAMPLED,
+    ):
+        return None
+    raw = slot[1:_CTX_BYTES].tobytes()
+    return TraceContext(
+        raw[:16].hex(), raw[16:24].hex(),
+        int(slot[0]) == _CTX_FLAG_SAMPLED,
+    )
 
 
 class IciPeerChannel:
@@ -64,6 +99,7 @@ class IciPeerChannel:
         self._reassemble = None  # built once; jit respecializes per shape
         self.broadcasts = 0
         self.broadcast_bytes = 0
+        self._last_links: list[TraceContext] = []
 
     # ------------------------------------------------------------ helpers --
     def _slot_for_host(self, host: int) -> int:
@@ -87,11 +123,16 @@ class IciPeerChannel:
 
     # ------------------------------------------------------------- surface --
     def broadcast(self, owner: int, data: Optional[bytes],
-                  key: ChunkKey) -> bytes:
+                  key: ChunkKey, ctx: Optional[TraceContext] = None
+                  ) -> bytes:
         """Collective chunk transfer: every host enters with the same
         ``(owner, key)``; only the owner passes ``data``. Returns the
         owner's bytes on every host (including the owner — callers there
-        usually already hold the payload and ignore the echo)."""
+        usually already hold the payload and ignore the echo). A
+        follower's ``ctx`` (its peer-hop trace context) rides its own
+        otherwise-zero slot; :meth:`last_request_links` returns the
+        contexts recovered from the most recent gather — the owner
+        records them as trace links."""
         import jax
 
         from tpubench.dist.reassemble import (
@@ -103,6 +144,7 @@ class IciPeerChannel:
         nbytes = key.length
         rows = max(1, math.ceil(nbytes / lane))
         slot = self._slot_for_host(owner)
+        self_slot = self._slot_for_host(self.host_id)
         devices = list(self._mesh.devices.reshape(-1))
         n = len(devices)
         local = (
@@ -119,6 +161,8 @@ class IciPeerChannel:
                         "but contributed no data"
                     )
                 buf[:nbytes] = np.frombuffer(data, dtype=np.uint8)
+            elif idx == self_slot and ctx is not None:
+                _encode_ctx(buf, ctx)
             shards.append(buf)
         arr = shard_to_device_array(shards, self._mesh, self._axis, lane)
         gathered, _ = self._reassemble_fn()(arr)
@@ -126,7 +170,20 @@ class IciPeerChannel:
         self.broadcasts += 1
         self.broadcast_bytes += nbytes
         assert out.shape[0] == n
+        links = []
+        for i in range(n):
+            if i == slot:
+                continue
+            c = _decode_ctx(out[i].reshape(-1))
+            if c is not None:
+                links.append(c)
+        self._last_links = links
         return out[slot].reshape(-1)[:nbytes].tobytes()
+
+    def last_request_links(self) -> list[TraceContext]:
+        """Follower trace contexts recovered from the most recent
+        broadcast's gather (empty when no follower was traced)."""
+        return list(self._last_links)
 
     def request(self, owner: int, key: ChunkKey) -> bytes:
         """Request/reply is not expressible over bare collectives —

@@ -315,6 +315,31 @@ def load_snapshot(path: str) -> Optional[dict]:
     return doc
 
 
+def _otlp_export(exp, payload: dict) -> None:
+    """Shared dry-run-capture + POST tail of the OTLP exporters (metrics
+    and traces ride the SAME machinery — one copy, so a future retry/
+    auth/compression change cannot silently miss one): append to the
+    bounded newest-kept capture window, then POST when an endpoint is
+    configured (stdlib urllib, 10 s timeout)."""
+    exp.exported.append(payload)
+    if len(exp.exported) > exp._keep:
+        # Keep the newest payloads: a day-long run's dry-run capture
+        # must not grow without bound.
+        del exp.exported[: len(exp.exported) - exp._keep]
+    if exp.endpoint:
+        import urllib.request
+
+        req = urllib.request.Request(
+            exp.endpoint,
+            data=json.dumps(payload).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10):
+            pass
+        exp.posts += 1
+
+
 class OTLPMetricsExporter:
     """OTLP-shaped JSON metric export (resourceMetrics/scopeMetrics/
     metrics — the OTLP/HTTP JSON wire shape) off a snapshot function,
@@ -408,24 +433,7 @@ class OTLPMetricsExporter:
         }
 
     def export_once(self) -> None:
-        payload = self.build_payload()
-        self.exported.append(payload)
-        if len(self.exported) > self._keep:
-            # Keep the newest payloads: a day-long run's dry-run capture
-            # must not grow without bound.
-            del self.exported[: len(self.exported) - self._keep]
-        if self.endpoint:
-            import urllib.request
-
-            req = urllib.request.Request(
-                self.endpoint,
-                data=json.dumps(payload).encode("utf-8"),
-                headers={"Content-Type": "application/json"},
-                method="POST",
-            )
-            with urllib.request.urlopen(req, timeout=10):
-                pass
-            self.posts += 1
+        _otlp_export(self, self.build_payload())
 
     def summary(self, periodic: Optional["PeriodicExporter"] = None) -> dict:
         out = {
@@ -439,6 +447,47 @@ class OTLPMetricsExporter:
                 out["flush_errors"] = periodic.error_count
                 out["last_error"] = periodic.last_error
         return out
+
+
+class OTLPTraceExporter:
+    """OTLP-shaped JSON TRACE export over the run's flight records —
+    the span twin of :class:`OTLPMetricsExporter`, riding the same
+    dry-run-capture / stdlib-urllib-POST machinery. ``records_fn``
+    yields the journal records (the trace store); payload shape comes
+    from :func:`tpubench.obs.trace.otlp_trace_payload`. A metrics
+    endpoint ending in ``/v1/metrics`` is rewritten to ``/v1/traces``
+    (the OTLP/HTTP path convention); any other endpoint is used as-is.
+    """
+
+    def __init__(self, records_fn: Callable[[], list],
+                 endpoint: str = "", resource: Optional[dict] = None,
+                 keep_payloads: int = 4):
+        self._fn = records_fn
+        self.endpoint = (
+            endpoint.replace("/v1/metrics", "/v1/traces")
+            if endpoint else ""
+        )
+        self.resource = dict(resource or {})
+        self.exported: list[dict] = []
+        self._keep = max(1, keep_payloads)
+        self.posts = 0
+        self.spans_exported = 0
+
+    def export_once(self) -> None:
+        from tpubench.obs.trace import otlp_trace_payload
+
+        payload = otlp_trace_payload(self._fn(), resource=self.resource)
+        spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        self.spans_exported += len(spans)
+        _otlp_export(self, payload)
+
+    def summary(self) -> dict:
+        return {
+            "payloads": len(self.exported),
+            "spans": self.spans_exported,
+            "posts": self.posts,
+            "endpoint": self.endpoint or "dry_run",
+        }
 
 
 class MetricsExportSession:

@@ -46,6 +46,13 @@ from typing import Callable, Iterable, Optional
 import numpy as np
 
 from tpubench.metrics.percentiles import summarize_ns
+from tpubench.obs.tracing import (
+    TraceContext,
+    adopt_trace,
+    current_trace,
+    new_span_id,
+    new_trace_id,
+)
 
 JOURNAL_FORMAT = "tpubench-flight-v1"
 
@@ -105,8 +112,14 @@ def adopt_op(op: Optional["FlightOp"]) -> None:
     consumer's op so backend-level phases/annotations (connect,
     first_byte, breaker events) still land on the read's record.
     Appends from two threads interleave but never tear (GIL-atomic
-    list/dict ops; first-stamp-wins already governs phase marks)."""
+    list/dict ops; first-stamp-wins already governs phase marks).
+
+    Adopting an op also adopts its TRACE position (and None clears
+    both): any record the helper thread begins while working for the
+    read — a staging-slot transfer completed by the reaper, a nested
+    fetch — parents under the read's span in the trace tree."""
     _tls.op = op
+    adopt_trace(op.trace_context() if op is not None else None)
 
 
 def note_phase(phase: str, ns: Optional[int] = None) -> None:
@@ -128,10 +141,21 @@ def annotate(kind: str, **info) -> None:
 class FlightOp:
     """One in-flight read: phase stamps + annotations, appended to the
     owning ring at :meth:`finish`. Context-manager use finishes with the
-    exception (if any) recorded as the op's error."""
+    exception (if any) recorded as the op's error.
+
+    Every op is also a SPAN in the causal trace plane: it allocates a
+    ``span_id``, joins the thread's ambient :class:`TraceContext` (the
+    enclosing tracer span, workload step, or in-flight read) as a child
+    — or roots a fresh trace when none is active — and, when installed,
+    becomes the thread's trace position so nested records parent under
+    it. The ids ride the journal record (``trace_id``/``span_id``/
+    ``parent_id``), which is what lets ``tpubench report trace`` stitch
+    per-host journals into cross-host span trees."""
 
     __slots__ = ("_ring", "worker", "object", "transport", "kind",
-                 "phases", "notes", "bytes", "error", "_done", "_installed")
+                 "phases", "notes", "bytes", "error", "_done", "_installed",
+                 "trace_id", "span_id", "parent_id", "_prev_ctx",
+                 "_sampled")
 
     def __init__(self, ring: "WorkerFlight", object_name: str,
                  transport: str, enqueue_ns: Optional[int] = None,
@@ -142,7 +166,9 @@ class FlightOp:
         self.transport = transport
         # "read": one network read (the straggler tables compare these);
         # "object": a pod-level fetch→stage→gather span; "stage": one
-        # staging-slot transfer.
+        # staging-slot transfer; "serve": an origin fetch made to answer
+        # a peer's request (owner side of a coop hop — excluded from
+        # goodput byte credit: the requester's record carries the bytes).
         self.kind = kind
         self.phases: dict[str, int] = {
             "enqueue": enqueue_ns if enqueue_ns is not None
@@ -152,12 +178,36 @@ class FlightOp:
         self.bytes = 0
         self.error: Optional[str] = None
         self._done = False
+        self.span_id = new_span_id()
+        ctx = current_trace()
+        if ctx is not None:
+            self.trace_id = ctx.trace_id
+            self.parent_id = ctx.span_id
+            # The per-trace sampling decision rides through the op: a
+            # tracer span nested under this op (backend client spans)
+            # must inherit the ROOT's draw, not re-default to sampled —
+            # or an unsampled trace's descendants would record as
+            # orphans of spans that were never kept.
+            self._sampled = ctx.sampled
+        else:
+            self.trace_id = new_trace_id()
+            self.parent_id = None
+            self._sampled = True
+        self._prev_ctx = None
         # install=False: side-channel records (e.g. staging-slot records
         # created while a read op is in flight on the same thread) must
         # not displace the thread's current op.
         self._installed = install
         if install:
             _tls.op = self
+            self._prev_ctx = ctx
+            adopt_trace(self.trace_context())
+
+    def trace_context(self) -> TraceContext:
+        """This op's position in the trace tree — what children (nested
+        records, helper threads, remote peers) parent under. Carries
+        the trace's sampling decision forward."""
+        return TraceContext(self.trace_id, self.span_id, self._sampled)
 
     def mark(self, phase: str, ns: Optional[int] = None) -> None:
         # First stamp wins (e.g. "connect" fires once even when a stale
@@ -186,6 +236,7 @@ class FlightOp:
         self._done = True
         if self._installed and getattr(_tls, "op", None) is self:
             _tls.op = None
+            adopt_trace(self._prev_ctx)
 
     def finish(self, nbytes: int = 0, error: Optional[BaseException] = None
                ) -> None:
@@ -194,6 +245,7 @@ class FlightOp:
         self._done = True
         if self._installed and getattr(_tls, "op", None) is self:
             _tls.op = None
+            adopt_trace(self._prev_ctx)
         self.bytes = int(nbytes)
         if error is not None:
             self.error = f"{type(error).__name__}: {error}"
@@ -204,7 +256,11 @@ class FlightOp:
             "kind": self.kind,
             "phases": self.phases,
             "bytes": self.bytes,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
         }
+        if self.parent_id is not None:
+            rec["parent_id"] = self.parent_id
         if self.notes:
             rec["notes"] = self.notes
         if self.error:

@@ -127,10 +127,18 @@ class Histogram:
     decimates deterministically — keep every other retained sample,
     double the keep stride — so a serve-shaped run can tick for days
     without the registry's RSS growing, while runs under the cap (every
-    hermetic test) keep the full-fidelity bit-for-bit identity."""
+    hermetic test) keep the full-fidelity bit-for-bit identity.
+
+    Each bucket additionally keeps its LAST observed exemplar — the
+    (value, trace_id) pair of the newest observation that landed there,
+    when the observer supplied a trace id. Rendered only in the
+    OpenMetrics exposition (``Accept: application/openmetrics-text``):
+    the slow buckets' exemplars are exactly the trace ids ``tpubench
+    report trace`` resolves — the scrape-side handle from "the p99
+    bucket grew" to "THIS read's span tree"."""
 
     __slots__ = ("name", "help", "bounds", "counts", "count", "sum_ms",
-                 "_ns", "_stride", "_phase")
+                 "_ns", "_stride", "_phase", "exemplars")
 
     def __init__(self, name: str, help_: str,
                  bounds_ms: Optional[Sequence[float]] = None):
@@ -143,10 +151,15 @@ class Histogram:
         self._ns: list[int] = []
         self._stride = 1
         self._phase = 0
+        # bucket index -> (value_ms, trace_id); last-write-wins.
+        self.exemplars: dict[int, tuple[float, str]] = {}
 
-    def observe_ns(self, ns: int) -> None:
+    def observe_ns(self, ns: int, trace_id: Optional[str] = None) -> None:
         ms = ns / 1e6
-        self.counts[bisect_right(self.bounds, ms)] += 1
+        idx = bisect_right(self.bounds, ms)
+        self.counts[idx] += 1
+        if trace_id:
+            self.exemplars[idx] = (ms, trace_id)
         self.count += 1
         self.sum_ms += ms
         self._phase += 1
@@ -225,20 +238,35 @@ class TelemetryRegistry:
         return {n: m.help for n, m in self._metrics.items()}
 
     # ---------------------------------------------------------- render ----
-    def render_prometheus(self) -> str:
-        """Prometheus text exposition (format 0.0.4): HELP/TYPE pairs,
-        cumulative histogram buckets with the ``+Inf`` terminator."""
+    def render_prometheus(self, openmetrics: bool = False) -> str:
+        """Prometheus text exposition (format 0.0.4 by default): HELP/
+        TYPE pairs, cumulative histogram buckets with the ``+Inf``
+        terminator. ``openmetrics=True`` renders the OpenMetrics shape
+        instead — bucket lines carry their trace-id exemplars
+        (``# {trace_id="..."} <value>``) and the body ends with
+        ``# EOF`` — the exposition that links a slow histogram bucket
+        to the exact trace ``report trace`` can resolve."""
         with self.lock:
             lines: list[str] = []
             for name in sorted(self._metrics):
                 m = self._metrics[name]
                 help_ = m.help.replace("\\", "\\\\").replace("\n", "\\n")
-                lines.append(f"# HELP {name} {help_}")
+                # OpenMetrics 1.0 names a counter FAMILY without the
+                # `_total` suffix (samples keep it); declaring the
+                # family as `*_total counter` fails a stock Prometheus
+                # OpenMetrics parse and takes the whole scrape down.
+                # 0.0.4 keeps the historical suffixed declaration.
+                family = name
+                if (openmetrics
+                        and isinstance(m, (Counter, LabeledCounter))
+                        and name.endswith("_total")):
+                    family = name[: -len("_total")]
+                lines.append(f"# HELP {family} {help_}")
                 if isinstance(m, Counter):
-                    lines.append(f"# TYPE {name} counter")
+                    lines.append(f"# TYPE {family} counter")
                     lines.append(f"{name} {_fmt(m.value)}")
                 elif isinstance(m, LabeledCounter):
-                    lines.append(f"# TYPE {name} counter")
+                    lines.append(f"# TYPE {family} counter")
                     for lv in sorted(m.children):
                         lines.append(
                             f'{name}{{{m.label}="{lv}"}} '
@@ -250,17 +278,28 @@ class TelemetryRegistry:
                         lines.append(f"{name} {_fmt(m.value)}")
                 elif isinstance(m, Histogram):
                     lines.append(f"# TYPE {name} histogram")
+
+                    def _exemplar(idx: int, hist=m) -> str:
+                        if not openmetrics or idx not in hist.exemplars:
+                            return ""
+                        ms, tid = hist.exemplars[idx]
+                        return f' # {{trace_id="{tid}"}} {repr(float(ms))}'
+
                     cum = 0
-                    for bound, c in zip(m.bounds, m.counts):
+                    for i, (bound, c) in enumerate(zip(m.bounds, m.counts)):
                         cum += c
                         lines.append(
                             f'{name}_bucket{{le="{_fmt(bound)}"}} {cum}'
+                            + _exemplar(i)
                         )
                     lines.append(
                         f'{name}_bucket{{le="+Inf"}} {m.count}'
+                        + _exemplar(len(m.bounds))
                     )
                     lines.append(f"{name}_sum {repr(float(m.sum_ms))}")
                     lines.append(f"{name}_count {m.count}")
+            if openmetrics:
+                lines.append("# EOF")
             return "\n".join(lines) + "\n"
 
     def snapshot(self) -> dict:
@@ -453,8 +492,12 @@ class FlightFeeder:
         reg = self.reg
         reg.get("tpubench_records_total").inc()
         phases = rec.get("phases", {})
+        # Trace-id exemplar per observation: the record's trace id rides
+        # into the bucket it lands in, so an OpenMetrics scrape can walk
+        # from a fat p99 bucket straight to the trace tree.
+        tid = rec.get("trace_id")
         for name, dur in phase_segments(rec).items():
-            reg.get(phase_metric_name(name)).observe_ns(dur)
+            reg.get(phase_metric_name(name)).observe_ns(dur, trace_id=tid)
         t0, t1 = record_span_ns(rec)
         if t0 is not None:
             self.t0_ns = t0 if self.t0_ns is None else min(self.t0_ns, t0)
@@ -546,8 +589,21 @@ def _make_server(session: "TelemetrySession", port: int):
         def do_GET(self):  # noqa: N802 — stdlib API
             path = self.path.split("?", 1)[0].rstrip("/") or "/"
             if path == "/metrics":
-                body = session.render_prometheus().encode("utf-8")
-                ctype = "text/plain; version=0.0.4; charset=utf-8"
+                # Content negotiation: an OpenMetrics scraper (Accept:
+                # application/openmetrics-text) gets bucket exemplars
+                # linking slow buckets to trace ids; plain scrapers get
+                # unchanged 0.0.4 text.
+                om = "application/openmetrics-text" in (
+                    self.headers.get("Accept") or ""
+                )
+                body = session.render_prometheus(
+                    openmetrics=om
+                ).encode("utf-8")
+                ctype = (
+                    "application/openmetrics-text; version=1.0.0; "
+                    "charset=utf-8" if om
+                    else "text/plain; version=0.0.4; charset=utf-8"
+                )
             elif path == "/snapshot":
                 body = json.dumps(session.snapshot()).encode("utf-8")
                 ctype = "application/json"
@@ -704,11 +760,11 @@ class TelemetrySession:
                     self._rotation_seen = total
 
     # ------------------------------------------------------- endpoints ----
-    def render_prometheus(self) -> str:
+    def render_prometheus(self, openmetrics: bool = False) -> str:
         with self.registry.lock:
             self.scrapes += 1
             self.registry.get("tpubench_scrapes_total").inc()
-        return self.registry.render_prometheus()
+        return self.registry.render_prometheus(openmetrics=openmetrics)
 
     def snapshot(self) -> dict:
         snap = self.registry.snapshot()
@@ -795,6 +851,24 @@ class TelemetrySession:
             # tests read it; result files must not balloon.
             if not self._otlp.endpoint and len(self._otlp.exported) <= 4:
                 summary["otlp"]["payloads_captured"] = self._otlp.exported
+            # Trace twin: one final OTLP-shaped span export over the
+            # run's flight records (the trace store), riding the same
+            # dry-run/POST machinery — a run that exported metrics also
+            # ships its span trees, never silently only half the signal.
+            if self._flight is not None:
+                from tpubench.obs.exporters import OTLPTraceExporter
+
+                texp = OTLPTraceExporter(
+                    self._flight.records, endpoint=self.cfg.otlp_endpoint,
+                    resource=self.resource,
+                )
+                try:
+                    texp.export_once()
+                    summary["otlp"]["traces"] = texp.summary()
+                except Exception as e:  # noqa: BLE001 — close() never raises
+                    summary["otlp"]["traces"] = {
+                        "error": f"{type(e).__name__}: {e}",
+                    }
         self._last_summary = summary
         return summary
 

@@ -12,16 +12,110 @@ talks to a tiny ``Tracer`` protocol; implementations:
 
 Beyond the reference: spans get ``first_byte`` and ``stage`` (HBM-landing)
 events — the north-star observability split (SURVEY §5.1).
+
+This module is also the home of the CAUSAL trace plane's context layer
+(PR 9): a thread-local :class:`TraceContext` (``trace_id``/``span_id``/
+per-trace ``sampled`` bit) that the flight recorder, the tail stack's
+helper threads, the coop peer channel and the staging reaper all thread
+through, so every flight record lands with ``trace_id``/``span_id``/
+``parent_id`` and journals become the trace store (assembled by
+:mod:`tpubench.obs.trace` / ``tpubench report trace``). Sampling is
+decided per-TRACE at the root — a child span always inherits its
+parent's decision, so a sampled child can never orphan under an
+unsampled parent.
 """
 
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import random
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Iterator, Optional, Protocol
+
+# ---------------------------------------------------------- trace context ---
+
+_ctx_tls = threading.local()
+_id_tls = threading.local()
+
+
+def _id_rng() -> random.Random:
+    """Per-thread id generator (no lock, no per-op urandom syscall on the
+    hot read path); seeded from the process RNG pool once per thread."""
+    rng = getattr(_id_tls, "rng", None)
+    if rng is None:
+        import os
+
+        rng = _id_tls.rng = random.Random(
+            int.from_bytes(os.urandom(16), "big") ^ threading.get_ident()
+        )
+    return rng
+
+
+def seed_trace_ids(seed: int) -> None:
+    """Deterministic ids for THIS thread (tests/replays only)."""
+    _id_tls.rng = random.Random(seed)
+
+
+def new_trace_id() -> str:
+    return f"{_id_rng().getrandbits(128):032x}"
+
+
+def new_span_id() -> str:
+    return f"{_id_rng().getrandbits(64):016x}"
+
+
+def derive_span_id(parent_span_id: str, name: str) -> str:
+    """Deterministic child-span id for a SYNTHESIZED span (a phase
+    segment of a flight record). Both sides of a cross-host hop can
+    compute it independently — the requester propagates
+    ``derive_span_id(read_span, "peer_request")`` and the merge pass
+    re-derives the same id from the requester's record, which is what
+    stitches the owner's spans under the right parent with no id
+    exchange beyond the context itself."""
+    return hashlib.blake2b(
+        f"{parent_span_id}/{name}".encode(), digest_size=8
+    ).hexdigest()
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """One position in a trace tree: new spans created under this
+    context join ``trace_id`` with ``span_id`` as their parent, and
+    inherit the per-trace ``sampled`` decision."""
+
+    trace_id: str
+    span_id: str
+    sampled: bool = True
+
+
+def current_trace() -> Optional[TraceContext]:
+    return getattr(_ctx_tls, "ctx", None)
+
+
+def adopt_trace(ctx: Optional[TraceContext]) -> None:
+    """Install ``ctx`` as THIS thread's trace position (None clears it)
+    — the helper-thread half of the propagation discipline (hedge
+    producers, the staging reaper, peer serves), mirroring
+    ``flight.adopt_op``."""
+    _ctx_tls.ctx = ctx
+
+
+@contextlib.contextmanager
+def trace_scope(ctx: Optional[TraceContext]) -> Iterator[None]:
+    """Scoped adopt/restore: spans/records created inside parent under
+    ``ctx`` (a None ctx scopes a no-op — callers need no branching)."""
+    if ctx is None:
+        yield
+        return
+    prev = current_trace()
+    _ctx_tls.ctx = ctx
+    try:
+        yield
+    finally:
+        _ctx_tls.ctx = prev
 
 
 class Span(Protocol):
@@ -62,13 +156,23 @@ class RecordedSpan:
     start_ns: int
     end_ns: int = 0
     events: list = field(default_factory=list)
+    trace_id: str = ""
+    span_id: str = ""
+    parent_id: str = ""
 
     def event(self, name: str, **attrs) -> None:
         self.events.append((name, time.perf_counter_ns(), attrs))
 
 
 class RecordingTracer:
-    """Thread-safe in-process tracer; sampling mirrors TraceIDRatioBased."""
+    """Thread-safe in-process tracer; sampling mirrors TraceIDRatioBased.
+
+    Sampling is decided per-TRACE, at the root span: a span opened under
+    an active :class:`TraceContext` inherits the root's decision instead
+    of re-drawing. (The old per-span draw could sample a child whose
+    parent was dropped — an orphan span no tool can ever stitch.) Every
+    span installs its context for its scope, so child spans — and flight
+    records begun inside it — parent under it."""
 
     def __init__(self, sample_rate: float = 1.0, seed: int = 0):
         self.sample_rate = sample_rate
@@ -78,14 +182,30 @@ class RecordingTracer:
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs) -> Iterator[Span]:
-        with self._lock:
-            sampled = self._rng.random() < self.sample_rate
+        parent = current_trace()
+        if parent is not None:
+            # Per-trace decision: inherit the root's draw verbatim.
+            sampled = parent.sampled
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        else:
+            with self._lock:
+                sampled = self._rng.random() < self.sample_rate
+            trace_id, parent_id = new_trace_id(), ""
+        span_id = new_span_id()
+        ctx = TraceContext(trace_id, span_id, sampled)
         if not sampled:
-            yield _NOOP_SPAN
+            # Unsampled root still scopes its (unsampled) context so the
+            # whole tree shares one decision — children skip too.
+            with trace_scope(ctx):
+                yield _NOOP_SPAN
             return
-        sp = RecordedSpan(name=name, attrs=attrs, start_ns=time.perf_counter_ns())
+        sp = RecordedSpan(
+            name=name, attrs=attrs, start_ns=time.perf_counter_ns(),
+            trace_id=trace_id, span_id=span_id, parent_id=parent_id,
+        )
         try:
-            yield sp
+            with trace_scope(ctx):
+                yield sp
         finally:
             sp.end_ns = time.perf_counter_ns()
             with self._lock:
@@ -183,10 +303,61 @@ class OtelTracer:
                 def event(self, ename: str, **eattrs) -> None:
                     otel_span.add_event(ename, eattrs)
 
-            yield _Wrap()
+            # Install this span's position as the thread's TraceContext
+            # (the same contract RecordingTracer honors), so flight ops
+            # begun inside join the SAME trace the SDK exports instead
+            # of rooting their own: with the real SDK the journal
+            # records carry the exported span's exact ids. A double/
+            # older SDK without get_span_context falls back to local
+            # ids — parenting among records stays consistent, and the
+            # read workload's `trace_context` span event remains the
+            # bidirectional handle.
+            parent = current_trace()
+            sc = getattr(otel_span, "get_span_context", lambda: None)()
+            if sc is not None and getattr(sc, "trace_id", 0):
+                trace_id = f"{sc.trace_id:032x}"
+                span_id = f"{sc.span_id:016x}"
+            else:
+                trace_id = parent.trace_id if parent else new_trace_id()
+                span_id = new_span_id()
+            recording = getattr(otel_span, "is_recording", lambda: True)()
+            sampled = bool(recording) and (
+                parent.sampled if parent is not None else True
+            )
+            with trace_scope(TraceContext(trace_id, span_id, sampled)):
+                yield _Wrap()
 
     def shutdown(self) -> None:
-        self._provider.shutdown()
+        # Flush-on-exit must never turn a finished run into a traceback:
+        # an exporter raising inside the SDK's shutdown (endpoint gone,
+        # batch processor already torn down — broken-SDK shapes) degrades
+        # to a one-line warning. The run's RESULTS are already written by
+        # the time any tracer flushes.
+        try:
+            self._provider.shutdown()
+        except Exception as e:  # noqa: BLE001 — see above
+            import warnings
+
+            warnings.warn(
+                f"trace exporter flush failed at shutdown "
+                f"({type(e).__name__}: {e}); spans may be incomplete",
+                stacklevel=2,
+            )
+
+
+@contextlib.contextmanager
+def tracer_session(cfg) -> Iterator[Tracer]:
+    """The ONE flush-on-exit discipline for every subcommand that runs a
+    workload (reference trace_exporter.go:55-60): build the configured
+    tracer, yield it, and shutdown() in the finally — so batched spans
+    (console/cloud_trace exporters) survive chaos/tune/read alike, and a
+    flush error degrades per OtelTracer.shutdown's one-line-warning
+    contract instead of masking the run's real outcome."""
+    tracer = make_tracer(cfg)
+    try:
+        yield tracer
+    finally:
+        tracer.shutdown()
 
 
 def make_tracer(cfg) -> Tracer:

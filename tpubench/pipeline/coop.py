@@ -65,6 +65,13 @@ import numpy as np
 from tpubench.mem.slab import CopyMeter, SlabPool, payload_view, release_payload
 from tpubench.metrics.percentiles import summarize_ns
 from tpubench.obs import flight as _flight
+from tpubench.obs.tracing import (
+    TraceContext,
+    adopt_trace,
+    current_trace,
+    derive_span_id,
+    trace_scope,
+)
 from tpubench.pipeline.cache import ChunkCache, ChunkKey
 from tpubench.storage.base import ObjectMeta, StorageError
 
@@ -456,6 +463,7 @@ class CoopCache:
         demote_interval_s: float = 2.0,
         retry_cfg=None,
         flight_ring=None,
+        flight_recorder=None,
         clock: Callable[[], float] = time.monotonic,
     ):
         self.cache = cache
@@ -471,6 +479,14 @@ class CoopCache:
         self._demote_interval_s = demote_interval_s
         self._clock = clock
         self._flight_ring = flight_ring
+        # THIS host's recorder: serve-side origin fetches record on it
+        # (kind="serve" on a pooled single-appender ring — see
+        # _acquire_serve_ring), so the owner half of a cross-host hop
+        # lands in the OWNER's journal carrying the REQUESTER's
+        # propagated trace context.
+        self._flight_recorder = flight_recorder
+        self._serve_ring_free: list[str] = []
+        self._serve_ring_seq = 0
         self._peer_inner = (
             PeerBackend(channel, ring)
             if channel is not None and not getattr(channel, "lockstep", False)
@@ -580,7 +596,15 @@ class CoopCache:
             self.peer_requests += 1
         t0 = time.perf_counter_ns()
         try:
-            payload = self._receive(key)
+            # Trace propagation: the hop travels as a child of THIS
+            # read's synthesized peer_request segment — the derived id
+            # is recomputable from the requester's record at merge time,
+            # so the owner host's serve span stitches under it with no
+            # extra wire data (loopback/request-reply channels carry the
+            # context thread-locally; a networked channel would marshal
+            # the same two ids).
+            with trace_scope(self._peer_hop_ctx()):
+                payload = self._receive(key)
         except StorageError:
             _flight.note_phase("peer_miss")
             with self._lock:
@@ -596,6 +620,23 @@ class CoopCache:
                  time.perf_counter_ns() - t0)
             )
         return payload
+
+    def _peer_hop_ctx(self) -> Optional[TraceContext]:
+        """The context a peer hop travels under: the current read op's
+        trace with the DERIVED peer_request segment id as parent (so the
+        owner's spans nest under the hop, not the whole read), carrying
+        the read's per-trace sampling decision across the hop. Falls
+        back to the thread's ambient trace context; None when the read
+        is untraced."""
+        op = _flight.current_op()
+        if op is not None:
+            base = op.trace_context()
+            return TraceContext(
+                base.trace_id,
+                derive_span_id(base.span_id, "peer_request"),
+                base.sampled,
+            )
+        return current_trace()
 
     def _receive(self, key: ChunkKey):
         """Stream the peer payload through the composed peer backend
@@ -650,12 +691,28 @@ class CoopCache:
         if owner == self.host_id:
             payload = self._origin(key, owner=True)
             self._channel.broadcast(owner, bytes(payload_view(payload)), key)
+            # A collective cannot parent one remote span under another
+            # (every host enters together; the owner fetched under its
+            # OWN plan-walk span) — instead the followers' contexts ride
+            # the gather's spare slots and land here as TRACE LINKS, the
+            # OTel link shape for causal-but-not-parental edges.
+            links = getattr(self._channel, "last_request_links", lambda: [])()
+            if links:
+                _flight.annotate(
+                    "trace_link",
+                    peers=[
+                        {"trace_id": c.trace_id, "span_id": c.span_id}
+                        for c in links
+                    ],
+                )
             return payload
         _flight.note_phase("peer_request")
         with self._lock:
             self.peer_requests += 1
         t0 = time.perf_counter_ns()
-        data = self._channel.broadcast(owner, None, key)
+        data = self._channel.broadcast(
+            owner, None, key, ctx=self._peer_hop_ctx()
+        )
         _flight.note_phase("peer_hit")
         with self._lock:
             self.peer_hits += 1
@@ -698,9 +755,28 @@ class CoopCache:
         # The serve's backend work must not stamp phases on the
         # REQUESTER's flight op (loopback runs serve on the requester's
         # thread; connect/first_byte stamps here would break the peer
-        # record's phase monotonicity).
+        # record's phase monotonicity) — but the requester's PROPAGATED
+        # trace context is kept: the serve's own record (kind="serve",
+        # on THIS host's recorder) parents under the remote peer_request
+        # segment, which is the cross-host stitch `report trace` merges.
+        peer_ctx = current_trace()
         caller_op = _flight.current_op()
         _flight.adopt_op(None)
+        adopt_trace(peer_ctx)
+        sop = None
+        ring_name = None
+        if self._flight_recorder is not None:
+            # The transport invokes serve on arbitrary threads and a
+            # ring has exactly one appending owner — but keying rings
+            # by thread ident would grow one 1024-slot ring per ident
+            # forever on a per-connection-thread transport. A free-list
+            # bounds the pool at PEAK serve concurrency: a name is held
+            # exclusively for the duration of this serve (single
+            # appender by construction) and recycled after.
+            ring_name = self._acquire_serve_ring()
+            sop = self._flight_recorder.worker(ring_name).begin(
+                key.object, "peer", kind="serve"
+            )
         try:
             payload, source = self.cache.get_or_fetch_info(
                 key, lambda: self._origin(key, owner=True, serving=True),
@@ -709,23 +785,61 @@ class CoopCache:
                 data = bytes(payload_view(payload))
             finally:
                 release_payload(payload)
+            if sop is not None:
+                if source == "hit":
+                    sop.mark("cache_hit")
+                # First-stamp-wins: when the origin path already stamped
+                # body_complete (the composed backend stack does), this
+                # is a no-op — it guarantees the serve SPAN covers the
+                # fetch even over an origin_fetch that stamps nothing,
+                # so the owner side of a slow hop has a duration the
+                # critical-path walk can descend into.
+                sop.mark("body_complete")
+                sop.finish(len(data))
             with self._lock:
                 self.peer_serves += 1
                 self.peer_served_bytes += len(data)
                 if source == "coalesced":
                     self.pod_coalesced += 1
             return data
-        except Exception:  # noqa: BLE001 — shed, requester recovers
+        except Exception as e:  # noqa: BLE001 — shed, requester recovers
             # Exception, not BaseException: loopback runs serve on the
             # REQUESTER's thread — a KeyboardInterrupt here must stop
             # the run, not be counted as a shed.
+            if sop is not None:
+                sop.finish(error=e)
             with self._lock:
                 self.serve_errors += 1
             return None
         finally:
+            if sop is not None:
+                sop.abandon()  # no-op when finished; never leak the op
+            if ring_name is not None:
+                self._release_serve_ring(ring_name)
             _flight.adopt_op(caller_op)
+            # adopt_op set the trace position to the caller op's context
+            # (or cleared it when there is no op) — restore the ACTUAL
+            # entry state: on a loopback serve the requester thread was
+            # inside its hop scope, and anything it begins after this
+            # return (payload streaming in _receive) must parent under
+            # the hop segment, not the whole read or a fresh root.
+            adopt_trace(peer_ctx)
             with self._lock:
                 self._serving_bytes -= n
+
+    def _acquire_serve_ring(self) -> str:
+        """Exclusive serve-ring name: pool bounded by peak concurrency,
+        each name held by exactly one in-flight serve (the ring's one
+        appender), recycled on release."""
+        with self._lock:
+            if self._serve_ring_free:
+                return self._serve_ring_free.pop()
+            self._serve_ring_seq += 1
+            return f"serve-{self._serve_ring_seq}"
+
+    def _release_serve_ring(self, name: str) -> None:
+        with self._lock:
+            self._serve_ring_free.append(name)
 
     # ----------------------------------------------------------- demotion --
     def _slow_hosts_from_rows(self, rows: Sequence[dict]) -> set[int]:
@@ -955,6 +1069,7 @@ def coop_from_config(cfg, cache: ChunkCache, origin_fetch,
         demote_interval_s=cc.demote_interval_s,
         retry_cfg=cfg.transport.retry,
         flight_ring=flight.worker("coop") if flight is not None else None,
+        flight_recorder=flight,
     )
     broker = getattr(channel, "_broker", None)
     if broker is not None:

@@ -305,10 +305,21 @@ class InflightWindow:
 
     def _submit(self, tr: _Transfer) -> None:
         try:
-            s0 = self._clock()
-            tr.handle = self._engine.submit(tr.array, self._device)
-            tr.submit_ns = s0
-            self.submit_ns += self._clock() - s0
+            # Adopt the transfer's op (and with it the read's trace
+            # position) for the submission call — the same discipline
+            # _finalize uses for completion — so submit-side
+            # annotations land on the transfer's record, which is the
+            # read's "staging transfer" child span in the trace tree.
+            if tr.op is not None:
+                _flight.adopt_op(tr.op)
+            try:
+                s0 = self._clock()
+                tr.handle = self._engine.submit(tr.array, self._device)
+                tr.submit_ns = s0
+                self.submit_ns += self._clock() - s0
+            finally:
+                if tr.op is not None:
+                    _flight.adopt_op(None)
         except BaseException as e:  # raised at the producer's next enqueue
             self._fail(tr, e)
             return

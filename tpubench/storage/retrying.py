@@ -111,9 +111,12 @@ class _ResumingReader:
                     self._clock() - self._window_start
                 ) + pause > self._retry.deadline_s:
                     raise
+                # backoff_s rides the note so the trace plane can
+                # synthesize the retry attempt as a child SPAN covering
+                # its pause (obs/trace.py), not just a point event.
                 _flight_annotate(
                     "retry", attempt=self._attempts, reason="resume",
-                    error=type(exc).__name__,
+                    error=type(exc).__name__, backoff_s=round(pause, 6),
                 )
                 self._sleep(pause)
                 self._reopen()

@@ -429,7 +429,7 @@ class _Attempt:
 
     __slots__ = (
         "idx", "open_fn", "out_q", "chunk_bytes", "cancelled", "credits",
-        "bytes", "first_byte_ns", "generation", "op", "thread",
+        "bytes", "first_byte_ns", "generation", "op", "ctx", "thread",
     )
 
     def __init__(self, idx: int, open_fn, out_q: "queue.Queue",
@@ -445,13 +445,19 @@ class _Attempt:
         # Producer-written once post-open, consumer-read post-race
         # (GIL-atomic attribute, same discipline as first_byte_ns).
         self.generation = None
-        # The consumer thread's flight op (captured at launch): the
-        # producer adopts it so backend-level phases/annotations
-        # (connect, first_byte, breaker/retry events) still attribute to
-        # the read's record despite running on a helper thread.
+        # The consumer thread's flight op AND trace position (captured
+        # at launch): the producer adopts both, so backend-level phases/
+        # annotations (connect, first_byte, breaker/retry events) still
+        # attribute to the read's record, and any span the leg's backend
+        # stack opens parents under the read — despite running on a
+        # helper thread. The trace context is captured separately
+        # because a hedge can race a read that has a tracer span but no
+        # flight op (flight recorder off).
         from tpubench.obs.flight import current_op
+        from tpubench.obs.tracing import current_trace
 
         self.op = current_op()
+        self.ctx = current_trace()
         self.thread = threading.Thread(
             target=self._run, daemon=True, name=f"hedge-{idx}"
         )
@@ -459,8 +465,11 @@ class _Attempt:
 
     def _run(self) -> None:
         from tpubench.obs.flight import adopt_op
+        from tpubench.obs.tracing import adopt_trace
 
         adopt_op(self.op)
+        if self.op is None:
+            adopt_trace(self.ctx)
         try:
             reader = self.open_fn()
         except BaseException as e:  # noqa: BLE001 — surfaced to the consumer

@@ -286,6 +286,7 @@ def run_chaos(
     cfg: BenchConfig,
     timeline: Optional[list] = None,
     chaos_workload: str = "read",
+    tracer=None,
 ):
     """Run ``chaos_workload`` under the scheduled fault timeline and
     return its RunResult with ``extra["chaos"]`` (the scorecard) stamped.
@@ -293,7 +294,10 @@ def run_chaos(
     ``timeline`` (``[[t0, t1, {fault fields}], ...]``) overrides
     ``cfg.transport.fault.phases``. The target is hermetic: the fake
     backend for ``--protocol fake``, an in-process fake GCS server for
-    ``http`` (h1.1, or the h2 server with ``--http2``)."""
+    ``http`` (h1.1, or the h2 server with ``--http2``). ``tracer``
+    (owned and flush-on-exit-closed by the CLI's ``tracer_session``)
+    instruments the read workload's spans; train-ingest/pod-ingest
+    trace through their flight ops alone."""
     fc = cfg.transport.fault
     if timeline is not None:
         fc.phases = timeline
@@ -371,7 +375,13 @@ def run_chaos(
         # workloads get the SAME armed plan (via the explicit backend),
         # so phase windows and scorecard segments share one epoch.
         if chaos_workload == "read":
-            from tpubench.workloads.read import run_read as _runner
+            from tpubench.workloads.read import run_read
+
+            def _runner(cfg, backend):
+                # The CLI's tracer_session hands the tracer in; spans
+                # recorded during the fault window are the chaos run's
+                # per-read causal story (report trace on the journal).
+                return run_read(cfg, backend=backend, tracer=tracer)
         elif chaos_workload == "train-ingest":
             # The pipeline smoke path: fault schedules exercise the
             # prefetcher + cache; a blackhole window surfaces as

@@ -39,6 +39,7 @@ from tpubench.obs.flight import (
     host_journal_path,
     transport_label,
 )
+from tpubench.obs.tracing import trace_scope
 from tpubench.storage import open_backend
 from tpubench.storage.base import StorageBackend
 from tpubench.workloads.common import (
@@ -116,33 +117,54 @@ class PodIngestWorkload:
                     )
             tel.start()
 
-        def fetch(k: int, cancel) -> None:
-            op = (
-                flight.worker(f"shard{local_idx[k]}").begin(name, tlabel)
-                if flight is not None else None
-            )
-            try:
-                fetch_shard(self.backend, name, table, local_idx[k], buffers[k])
-            except BaseException as e:
-                if op is not None:
-                    op.finish(error=e)
-                raise
-            if op is not None:
-                op.mark("body_complete")
-                op.finish(table.shard(local_idx[k]).length)
-
+        # install=False: the pod op is a side-channel record — installing
+        # it on this (main) thread would leave the thread's op and trace
+        # position dangling if the run aborts before finish, poisoning
+        # every later trace begun on this thread with a dead parent. The
+        # shard reads parent under it EXPLICITLY via trace_scope instead.
         pod_op = (
-            flight.worker("pod").begin(name, tlabel, kind="object")
+            flight.worker("pod").begin(name, tlabel, kind="object",
+                                       install=False)
             if flight is not None else None
         )
+        pod_ctx = pod_op.trace_context() if pod_op is not None else None
+
+        def fetch(k: int, cancel) -> None:
+            # The shard read joins the object span's trace (the "object →
+            # shard read" tree edge) even though it runs on a worker
+            # thread that inherited no ambient context.
+            with trace_scope(pod_ctx):
+                op = (
+                    flight.worker(f"shard{local_idx[k]}").begin(name, tlabel)
+                    if flight is not None else None
+                )
+                try:
+                    fetch_shard(self.backend, name, table,
+                                local_idx[k], buffers[k])
+                except BaseException as e:
+                    if op is not None:
+                        op.finish(error=e)
+                    raise
+                if op is not None:
+                    op.mark("body_complete")
+                    op.finish(table.shard(local_idx[k]).length)
+
         t0 = time.perf_counter()
-        gres = fetch_shards_mux(
-            self.backend, self.cfg, name, table, local_idx, buffers
-        )
-        if gres is None:
-            gres = WorkerGroup(abort_on_error=w.abort_on_error).run(
-                len(local_idx), fetch, name="fetch"
+        try:
+            gres = fetch_shards_mux(
+                self.backend, self.cfg, name, table, local_idx, buffers
             )
+            if gres is None:
+                gres = WorkerGroup(abort_on_error=w.abort_on_error).run(
+                    len(local_idx), fetch, name="fetch"
+                )
+        except BaseException as e:
+            # An aborting fetch must still close the object record: the
+            # journal keeps the errored span instead of silently losing
+            # the object that died.
+            if pod_op is not None:
+                pod_op.finish(error=e)
+            raise
         t_fetch = time.perf_counter() - t0
         if pod_op is not None:
             pod_op.mark("body_complete")

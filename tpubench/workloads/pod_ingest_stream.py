@@ -43,11 +43,13 @@ from tpubench.obs.exporters import (
     cloud_exporter_from_config,
 )
 from tpubench.obs.flight import (
+    adopt_op,
     flight_from_config,
     host_journal_path,
     transport_label,
 )
 from tpubench.obs.profiling import annotate
+from tpubench.obs.tracing import trace_scope
 from tpubench.storage import open_backend
 from tpubench.storage.base import StorageBackend
 from tpubench.workloads.common import (
@@ -93,7 +95,8 @@ class StreamedPodIngest:
         self._flight = flight_from_config(cfg)
         self._tlabel = transport_label(cfg)
 
-    def _fetch_local(self, plan: _ObjectPlan, buffers: list[np.ndarray], local_idx):
+    def _fetch_local(self, plan: _ObjectPlan, buffers: list[np.ndarray],
+                     local_idx, parent_ctx=None):
         w = self.cfg.workload
         flight = self._flight
 
@@ -101,22 +104,26 @@ class StreamedPodIngest:
             # fetch_shard zeroes the pad tail — essential here because the
             # double-buffer sets are REUSED across objects of differing
             # sizes; stale bytes would otherwise be gathered as padding.
-            op = (
-                flight.worker(f"shard{local_idx[k]}").begin(
-                    plan.name, self._tlabel
+            # parent_ctx (the object span) makes the shard read a child
+            # of its object in the trace tree even though this worker
+            # thread inherited no ambient context.
+            with trace_scope(parent_ctx):
+                op = (
+                    flight.worker(f"shard{local_idx[k]}").begin(
+                        plan.name, self._tlabel
+                    )
+                    if flight is not None else None
                 )
-                if flight is not None else None
-            )
-            try:
-                fetch_shard(self.backend, plan.name, plan.table,
-                            local_idx[k], buffers[k])
-            except BaseException as e:
+                try:
+                    fetch_shard(self.backend, plan.name, plan.table,
+                                local_idx[k], buffers[k])
+                except BaseException as e:
+                    if op is not None:
+                        op.finish(error=e)
+                    raise
                 if op is not None:
-                    op.finish(error=e)
-                raise
-            if op is not None:
-                op.mark("body_complete")
-                op.finish(plan.table.shard(local_idx[k]).length)
+                    op.mark("body_complete")
+                    op.finish(plan.table.shard(local_idx[k]).length)
 
         gres = fetch_shards_mux(
             self.backend, self.cfg, plan.name, plan.table, local_idx, buffers
@@ -333,6 +340,12 @@ class StreamedPodIngest:
                 ).start()
 
             def timed_fetch(k: int):
+                # Pool threads are REUSED across objects while the op is
+                # finished by the MAIN loop (which cannot clear THIS
+                # thread's installed-op slot): clear any stale op/trace
+                # position first, or object k+1's op would parent under
+                # object k's span — every object chained into one trace.
+                adopt_op(None)
                 # Object-level flight op opened HERE (the fetch thread):
                 # the mux fetch path's connect/retry notes attach to it
                 # via the thread-local channel; the main loop stamps the
@@ -353,10 +366,17 @@ class StreamedPodIngest:
                     # in-flight record is dropped, never corrupted (the
                     # per-shard error records from _fetch_local survive).
                     holes = self._fetch_local(
-                        plans[k], buffer_sets[k % 2], local_idx
+                        plans[k], buffer_sets[k % 2], local_idx,
+                        parent_ctx=(
+                            op.trace_context() if op is not None else None
+                        ),
                     )
                 if op is not None:
                     op.mark("body_complete")
+                    # Release this thread's slot NOW (the record stays
+                    # in flight for the main loop's finish): the next
+                    # object on this pool thread starts trace-clean.
+                    adopt_op(None)
                 return time.perf_counter() - t0, holes, op
 
             pending = (

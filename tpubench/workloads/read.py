@@ -228,10 +228,22 @@ class ReadWorkload:
                         "ReadObject", bucket=w.bucket, object=name
                     ) as span:
                         t0 = time.perf_counter_ns()
+                        # The op begins INSIDE the tracer span's scope,
+                        # so its flight record joins the span's trace
+                        # (RecordingTracer/OTel install a TraceContext)
+                        # — the journal and the exported spans tell one
+                        # stitched story per read.
                         op = (
                             wf.begin(name, tlabel, enqueue_ns=t0)
                             if wf is not None else None
                         )
+                        if op is not None:
+                            # Bidirectional handle: the exported span
+                            # carries the journal record's identity.
+                            span.event(
+                                "trace_context", trace_id=op.trace_id,
+                                span_id=op.span_id,
+                            )
                         try:
                             reader = self.backend.open_read(name)
                             if zero_copy:

@@ -364,6 +364,23 @@ def run_timeline(paths: list[str]) -> str:
     return render_timeline(load_journals(paths))
 
 
+def run_trace(paths: list[str], *, slow_fraction: float = 0.1,
+              head_rate: float = 0.05, max_keep: int = 512,
+              show: int = 3) -> str:
+    """``tpubench report trace <journal...>`` — merge per-host flight
+    journals into cross-host span trees (the records' trace_id/span_id/
+    parent_id graph), tail-sample per trace (slowest decile + unbiased
+    head sample), and print the p99 blame table + the slowest trees
+    with per-span critical-path durations."""
+    from tpubench.obs.flight import load_journals
+    from tpubench.obs.trace import render_trace_report
+
+    return render_trace_report(
+        load_journals(paths), slow_fraction=slow_fraction,
+        head_rate=head_rate, max_keep=max_keep, show=show,
+    )
+
+
 def run_report(paths: list[str]) -> str:
     """Load result/sweep/bench JSONs and render the full report."""
     runs: list[dict] = []

@@ -52,6 +52,7 @@ from tpubench.mem.slab import (
 from tpubench.metrics.percentiles import summarize_ns
 from tpubench.metrics.recorder import LatencyRecorder
 from tpubench.metrics.report import RunResult
+from tpubench.obs import tracing as _tracing
 from tpubench.obs.flight import (
     flight_from_config,
     host_journal_path,
@@ -327,6 +328,13 @@ class _TrainIngest:
         )
         t_run0 = time.perf_counter_ns()
         sink_stats: dict = {}
+        # Safety net for the per-step adopt/restore pairs below: any
+        # abort path that escapes a step between its adopt and restore
+        # (a staging error surfacing at enqueue, a stall-guard raise)
+        # must not leave a dead step's trace position installed on this
+        # thread — every later trace in the process would parent under
+        # it (the pod_ingest leak class).
+        run_prev_ctx = _tracing.current_trace()
         try:
             with activation:
                 if p.readahead > 0:
@@ -365,6 +373,17 @@ class _TrainIngest:
                                       install=False, kind="step")
                         if step_wf is not None else None
                     )
+                    # The step is its trace's ROOT: every record the
+                    # consumer begins inside it (cache hits, demand
+                    # misses, peer hops, synchronous stage marks)
+                    # parents under the step span — "workload step →
+                    # demand read" is the tree's first edge. install=
+                    # False keeps the step op out of the phase channel
+                    # (reads own it), so the trace position is adopted
+                    # explicitly and restored when the step ends.
+                    step_prev_ctx = _tracing.current_trace()
+                    if op is not None:
+                        _tracing.adopt_trace(op.trace_context())
                     stall_ns = 0
                     first_block_ns = last_block_ns = None
                     # Chunk payloads: bytes (legacy arm) or SlabLease
@@ -402,6 +421,7 @@ class _TrainIngest:
                                     cop.finish(error=e)
                                 if op is not None:
                                     op.finish(error=e)
+                                _tracing.adopt_trace(step_prev_ctx)
                                 raise
                             t1 = time.perf_counter_ns()
                             if source == "hit":
@@ -517,11 +537,13 @@ class _TrainIngest:
                         time.sleep(compute_s)
                     if op is not None:
                         op.finish(step_bytes)
+                    _tracing.adopt_trace(step_prev_ctx)
                     profiler.on_step_end(step)
                     now = time.perf_counter_ns()
                     step_rec.record_ns(now - step_t0)
                     step_t0 = now
         finally:
+            _tracing.adopt_trace(run_prev_ctx)
             profiler.close()
             if controller is not None:
                 tune_stats = controller.stop()

@@ -183,11 +183,11 @@ def sweep_axes(cfg: BenchConfig, workload: str) -> dict[str, list]:
 # ------------------------------------------------------------ execution ---
 
 
-def _run_target(cfg: BenchConfig, workload: str) -> RunResult:
+def _run_target(cfg: BenchConfig, workload: str, tracer=None) -> RunResult:
     if workload == "read":
         from tpubench.workloads.read import run_read
 
-        return run_read(cfg)
+        return run_read(cfg, tracer=tracer)
     if workload == "train-ingest":
         from tpubench.workloads.train_ingest import run_train_ingest
 
@@ -285,8 +285,15 @@ def run_tune(
     mode: str = "online",
     workload: str = "read",
     profile_path: str = "",
+    tracer=None,
 ) -> RunResult:
-    """The ``tpubench tune`` entry point (module docstring)."""
+    """The ``tpubench tune`` entry point (module docstring).
+
+    ``tracer`` (built and flush-on-exit-closed by the CLI's
+    ``tracer_session``) instruments the ONLINE/adaptive arm — the
+    long-lived run whose spans are worth exporting. Sweep cells stay
+    untraced, the same churn-not-signal policy that disables their
+    telemetry endpoint."""
     validate_tune_config(cfg.tune)
     if mode not in ("sweep", "online", "ab"):
         raise SystemExit(f"tune: unknown mode {mode!r} (sweep|online|ab)")
@@ -325,7 +332,7 @@ def run_tune(
             c = _clone(cfg)
             c.tune.enabled = True
             rearm()
-            adaptive_res = _run_target(c, workload)
+            adaptive_res = _run_target(c, workload, tracer=tracer)
             tune_extra["adaptive"] = adaptive_res.extra.get("tune") or {
                 "enabled": False,
                 "note": "workload had no live-actuatable knobs",
