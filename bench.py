@@ -288,6 +288,54 @@ def _coop_cache_cell() -> dict:
     return out
 
 
+def _serve_knee_cell() -> dict:
+    """Open-loop serve load sweep on the hermetic fake backend
+    (BENCH_r06+): fixed seed, deterministic per-read service latency
+    (scaled, floored so the scale=0 smoke still has a finite service
+    rate), offered load stepped through multipliers of the base rate —
+    the latency-vs-offered-load curve with the saturation knee
+    identified (p99 inflection / goodput saturation). CPU-only and
+    jax-free, so it rides the quiet-CPU segment with the other A/Bs.
+    The smoke guard pins goodput monotone-nondecreasing below the
+    knee."""
+    from tpubench.config import BenchConfig
+    from tpubench.workloads.serve import run_serve_sweep
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 4
+    cfg.workload.object_size = 1 * MB
+    cfg.workload.granule_bytes = 64 * 1024
+    cfg.staging.mode = "none"
+    cfg.obs.export = "none"
+    cfg.pipeline.cache_bytes = 0  # every request pays real service time
+    # Deterministic service floor: capacity ≈ workers / latency, so the
+    # sweep's upper multipliers land past the knee by construction.
+    cfg.transport.fault.per_read_latency_s = max(
+        0.001, 0.004 * _SLEEP_SCALE
+    )
+    cfg.transport.fault.seed = 7
+    cfg.serve.seed = 7
+    cfg.serve.duration_s = max(0.4, 1.0 * _SLEEP_SCALE)
+    cfg.serve.rate_rps = 150.0
+    cfg.serve.tenants = 30
+    cfg.serve.workers = 2
+    cfg.serve.sweep_points = [0.5, 1.0, 2.0, 4.0, 8.0]
+    res = run_serve_sweep(cfg)
+    sweep = res.extra["serve"]["sweep"]
+    return {
+        "points": [
+            {
+                k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in p.items()
+            }
+            for p in sweep["points"]
+        ],
+        "knee": sweep["knee"],
+        "sleep_scale": _SLEEP_SCALE,
+    }
+
+
 def _trace_overhead_cell() -> dict:
     """Tracing-on vs tracing-off goodput on the hermetic fake backend
     (BENCH_r06+): the SAME read config (fixed seed, staging off, flight
@@ -532,6 +580,14 @@ def main() -> int:
         trace_overhead = _trace_overhead_cell()
     except Exception as e:  # noqa: BLE001 — the bench must not die here
         print(f"# trace overhead A/B failed: {e}", file=sys.stderr)
+
+    # Open-loop serve knee: hermetic fake backend, CPU-only and
+    # jax-free — same quiet-CPU segment as the other A/B cells.
+    serve_knee: dict = {}
+    try:
+        serve_knee = _serve_knee_cell()
+    except Exception as e:  # noqa: BLE001 — the bench must not die here
+        print(f"# serve knee sweep failed: {e}", file=sys.stderr)
 
     dev = jax.local_devices()[0]  # first jax touch: AFTER the quiet-CPU A/B
 
@@ -800,6 +856,7 @@ def main() -> int:
                 "tune_ab": tune_ab,
                 "coop_cache": coop_cache,
                 "trace_overhead": trace_overhead,
+                "serve_knee": serve_knee,
                 "shaped_verdict": shaped,
                 "probe_divergence_factor": pdf,
                 "host_cores": _usable_cores(),

@@ -102,6 +102,14 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tracing: causal trace plane (context/stitch/blame)"
     )
+    # Serve-plane tests (tests/test_serve.py) stay in tier-1 — same
+    # policy as the other subsystem markers: the QoS A/B acceptance and
+    # the knee sweep run on every pass; the marker exists for selective
+    # runs (`-m serve`).
+    config.addinivalue_line(
+        "markers", "serve: open-loop multi-tenant serve plane "
+                   "(arrivals/QoS/knee)"
+    )
     # Multihost tests are marker-gated (see tests/test_multihost.py):
     # they need working multi-process jax.distributed, which this
     # container lacks — tier-1 collects clean skips, not failures.

@@ -112,6 +112,17 @@ def test_bench_end_to_end_cpu():
         f"{tov['untraced_gbps']} GB/s) — the trace plane must stay "
         "under 2%"
     )
+    # Serve-knee cell (PR 10): the open-loop load sweep emitted a point
+    # per multiplier and identified the saturation knee, with goodput
+    # monotone-nondecreasing below it (generous tolerance — scale=0
+    # points are tens of ms of wall on a share-capped host).
+    sk = d["serve_knee"]
+    assert len(sk["points"]) == 5
+    assert sk["knee"] is not None
+    for p in sk["points"]:
+        assert p["offered_rps"] > 0
+    below = [p["goodput_gbps"] for p in sk["points"][:sk["knee"]["index"]]]
+    assert all(b >= a * 0.85 for a, b in zip(below, below[1:])), below
     sweep = d["staging_depth_sweep"]
     assert set(sweep) == {"1", "2", "4"}
     assert sweep["1"]["drain"] == "inline"

@@ -440,3 +440,66 @@ def test_cli_chaos_end_to_end(tmp_path, capsys):
     sc = data["extra"]["chaos"]["scorecard"]
     assert sc["failed_reads"] == 0
     assert sc["timeline_covered"]
+
+
+def test_cli_serve_flags_fold_into_config(tmp_path):
+    out = tmp_path / "cfg.json"
+    rc = main([
+        "serve", "--protocol", "fake",
+        "--serve-rate", "123", "--serve-duration", "2.5",
+        "--serve-arrival", "bursty", "--serve-tenants", "9",
+        "--serve-workers", "3", "--no-serve-qos",
+        "--serve-admission-cap", "2", "--serve-queue-limit", "5",
+        "--serve-seed", "11", "--serve-sweep-points", "1,2,3",
+        "--save-config", str(out),
+    ])
+    assert rc == 0
+    with open(out) as f:
+        cfg = json.load(f)
+    sv = cfg["serve"]
+    assert sv["rate_rps"] == 123 and sv["duration_s"] == 2.5
+    assert sv["arrival"] == "bursty" and sv["tenants"] == 9
+    assert sv["workers"] == 3 and sv["qos"] is False
+    assert sv["admission_cap"] == 2 and sv["queue_limit"] == 5
+    assert sv["seed"] == 11 and sv["sweep_points"] == [1.0, 2.0, 3.0]
+
+
+def test_cli_serve_rejects_malformed_classes(tmp_path):
+    with pytest.raises(SystemExit, match="invalid JSON"):
+        main([
+            "serve", "--protocol", "fake",
+            "--serve-classes", "{not json",
+            "--save-config", str(tmp_path / "x.json"),
+        ])
+    with pytest.raises(SystemExit, match="deadline_ms"):
+        main([
+            "serve", "--protocol", "fake",
+            "--serve-classes", '[{"name": "x", "share": 1.0}]',
+            "--save-config", str(tmp_path / "x.json"),
+        ])
+    with pytest.raises(SystemExit, match="arrival=trace requires"):
+        main([
+            "serve", "--protocol", "fake",
+            "--serve-arrival", "trace",
+            "--save-config", str(tmp_path / "x.json"),
+        ])
+
+
+def test_cli_serve_end_to_end(tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0.2")
+    rc = main([
+        "serve", "--protocol", "fake",
+        "--workers", "2", "--object-size", str(256 * 1024),
+        "--serve-rate", "150", "--serve-duration", "1.0",
+        "--serve-tenants", "12", "--serve-workers", "2",
+        "--export", "none", "--results-dir", str(tmp_path),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "serve scorecard" in out and "[gold]" in out
+    files = os.listdir(tmp_path)
+    assert len(files) == 1
+    with open(tmp_path / files[0]) as f:
+        data = json.load(f)
+    assert data["workload"] == "serve"
+    assert data["extra"]["serve"]["arrivals"] > 0

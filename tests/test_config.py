@@ -99,3 +99,32 @@ def test_validate_fault_config_rejects_bad_values():
         with pytest.raises(SystemExit) as ei:
             validate_fault_config(FaultConfig(**kwargs), "fault")
         assert needle in str(ei.value)
+
+
+def test_validate_serve_config_rejects_malformed_specs():
+    # The serve plane's parse-time gate (PR 10): malformed tenant class
+    # specs and arrival params fail as one-line SystemExits at config
+    # load — exhaustive per-field cases live in tests/test_serve.py.
+    import pytest
+
+    from tpubench.config import ServeConfig, validate_serve_config
+
+    validate_serve_config(ServeConfig())  # defaults are valid
+    sc = ServeConfig()
+    sc.classes = [{"name": "x", "share": 0.5, "deadline_ms": -1.0}]
+    with pytest.raises(SystemExit, match="deadline_ms"):
+        validate_serve_config(sc)
+    sc = ServeConfig()
+    sc.arrival = "carrier-pigeon"
+    with pytest.raises(SystemExit, match="arrival"):
+        validate_serve_config(sc)
+
+
+def test_serve_config_json_roundtrip():
+    from tpubench.config import BenchConfig
+
+    cfg = BenchConfig()
+    cfg.serve.qos = False
+    cfg.serve.arrival = "bursty"
+    back = BenchConfig.from_json(cfg.to_json())
+    assert back.serve.qos is False and back.serve.arrival == "bursty"

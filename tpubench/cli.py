@@ -528,6 +528,52 @@ def build_config(args) -> BenchConfig:
     from tpubench.config import validate_coop_config
 
     validate_coop_config(co)
+    sv = cfg.serve
+    for attr, dest in (
+        ("serve_duration", "duration_s"), ("serve_rate", "rate_rps"),
+        ("serve_tenants", "tenants"), ("serve_workers", "workers"),
+        ("serve_admission_cap", "admission_cap"),
+        ("serve_queue_limit", "queue_limit"),
+        ("serve_readahead", "readahead"),
+        ("serve_burst_factor", "burst_factor"),
+        ("serve_burst_fraction", "burst_fraction"),
+        ("serve_seed", "seed"),
+    ):
+        v = getattr(args, attr, None)
+        if v is not None:
+            setattr(sv, dest, v)
+    if getattr(args, "serve_arrival", None):
+        sv.arrival = args.serve_arrival
+    if getattr(args, "serve_trace", None):
+        sv.trace_path = args.serve_trace
+        sv.arrival = "trace"
+    if getattr(args, "no_serve_qos", False):
+        sv.qos = False
+    if getattr(args, "serve_classes", None):
+        raw = args.serve_classes
+        if raw.startswith("@"):
+            with open(raw[1:]) as f:
+                raw = f.read()
+        try:
+            sv.classes = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise SystemExit(
+                f"--serve-classes: invalid JSON: {e}"
+            ) from None
+    if getattr(args, "serve_sweep_points", None):
+        try:
+            sv.sweep_points = [
+                float(x) for x in args.serve_sweep_points.split(",") if x
+            ]
+        except ValueError:
+            raise SystemExit(
+                f"--serve-sweep-points "
+                f"{args.serve_sweep_points!r}: expected a comma list "
+                "of positive numbers"
+            ) from None
+    from tpubench.config import validate_serve_config
+
+    validate_serve_config(sv)
     tn = cfg.tune
     if getattr(args, "tune", False):
         tn.enabled = True
@@ -888,6 +934,72 @@ def main(argv=None) -> int:
                        help="fault window start, seconds from run start")
     chaos.add_argument("--chaos-duration", type=float, default=2.0,
                        help="fault window length in seconds")
+    serve = add("serve", "open-loop multi-tenant traffic plane: arrival "
+                         "processes (poisson/bursty/diurnal/trace) drive "
+                         "thousands of Zipf-hot tenants with per-class "
+                         "QoS — priority admission, weighted cache/"
+                         "prefetch budgets, deadline-aware shedding — "
+                         "through the full backend/cache stack; "
+                         "--serve-sweep steps offered load to the "
+                         "saturation knee")
+    serve.add_argument("--serve-sweep", action="store_true",
+                       help="step offered load through the configured "
+                            "multipliers of --serve-rate and emit the "
+                            "latency-vs-load curve with the knee "
+                            "identified (p99 inflection)")
+    serve.add_argument("--serve-duration", type=float,
+                       help="virtual schedule length in seconds "
+                            "(default 4; wall time scales with "
+                            "TPUBENCH_BENCH_SLEEP_SCALE)")
+    serve.add_argument("--serve-rate", type=float,
+                       help="aggregate offered load, requests/second "
+                            "(default 200)")
+    serve.add_argument("--serve-arrival",
+                       choices=("poisson", "bursty", "diurnal", "trace"),
+                       help="arrival process (default poisson; bursty = "
+                            "two-state MMPP, diurnal = sinusoidal-rate "
+                            "Poisson, trace = replayed timestamps from "
+                            "--serve-trace)")
+    serve.add_argument("--serve-trace",
+                       help="replayed-trace arrivals: JSON list of "
+                            "arrival seconds (implies "
+                            "--serve-arrival trace)")
+    serve.add_argument("--serve-tenants", type=int,
+                       help="synthetic tenant population (default 100), "
+                            "expanded over the class shares")
+    serve.add_argument("--serve-classes",
+                       help="priority-class spec: JSON list of {name, "
+                            "share, weight, deadline_ms, priority} "
+                            "dicts, inline or @path (default "
+                            "gold/silver/best_effort)")
+    serve.add_argument("--serve-workers", type=int,
+                       help="service worker threads (default 8)")
+    serve.add_argument("--no-serve-qos", action="store_true",
+                       help="QoS off: FIFO admission, no shedding, no "
+                            "weighted budgets — the baseline arm of "
+                            "the QoS A/B")
+    serve.add_argument("--serve-admission-cap", type=int,
+                       help="requests in service at once (default = "
+                            "--serve-workers; live-tunable via the "
+                            "workers tune knob)")
+    serve.add_argument("--serve-queue-limit", type=int,
+                       help="queued requests before overload shedding "
+                            "(QoS mode; default 8x workers)")
+    serve.add_argument("--serve-readahead", type=int,
+                       help="readahead depth in chunks over the arrival "
+                            "schedule (0 = demand-only, the default)")
+    serve.add_argument("--serve-burst-factor", type=float,
+                       help="bursty: burst-to-quiet rate ratio "
+                            "(default 4)")
+    serve.add_argument("--serve-burst-fraction", type=float,
+                       help="bursty: fraction of each cycle bursting "
+                            "(default 0.25)")
+    serve.add_argument("--serve-seed", type=int,
+                       help="arrival/popularity seed (identical seeds "
+                            "replay identical schedules)")
+    serve.add_argument("--serve-sweep-points",
+                       help="comma list of offered-load multipliers for "
+                            "--serve-sweep (default 0.25,0.5,1,2,4)")
     tune = add("tune", "adaptive ingest autotuner: offline coordinate "
                        "sweep or online AIMD session over read/"
                        "train-ingest; emits a convergence trace + a "
@@ -1192,6 +1304,20 @@ def main(argv=None) -> int:
                     tracer=tracer,
                 )
             print(format_scorecard(res.extra["chaos"]))
+        elif args.cmd == "serve":
+            from tpubench.obs.tracing import tracer_session
+            from tpubench.workloads.serve import (
+                format_serve_scorecard,
+                run_serve,
+                run_serve_sweep,
+            )
+
+            with tracer_session(cfg) as tracer:
+                if args.serve_sweep:
+                    res = run_serve_sweep(cfg, tracer=tracer)
+                else:
+                    res = run_serve(cfg, tracer=tracer)
+            print(format_serve_scorecard(res.extra["serve"]))
         elif args.cmd == "tune":
             from tpubench.obs.tracing import tracer_session
             from tpubench.workloads.tune_cmd import format_tune_block, run_tune

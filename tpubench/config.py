@@ -437,6 +437,166 @@ def validate_coop_config(cc: "CoopConfig", where: str = "coop") -> None:
         )
 
 
+@dataclass
+class ServeConfig:
+    """Open-loop multi-tenant traffic plane (``tpubench serve``,
+    tpubench/serve/ + workloads/serve.py).
+
+    Every other workload is closed-loop — a fixed pool pulls as fast as
+    it can. ``serve`` drives OPEN-LOOP arrivals (requests land on their
+    own schedule whether or not the system keeps up) from many synthetic
+    tenants in weighted priority classes, through the full
+    open_backend → chunk cache → prefetcher → staging stack, with QoS
+    enforced at the choke points: priority admission with a live cap
+    (the PR-5 runnable-queue admission hook), weighted per-class cache/
+    prefetch byte budgets, and deadline-aware shedding under overload.
+    ``serve-sweep`` steps offered load and emits the latency-vs-load
+    curve to the saturation knee (the Pulsar-study methodology)."""
+
+    # Run length (seconds of VIRTUAL schedule; wall time scales with
+    # TPUBENCH_BENCH_SLEEP_SCALE via the shared parse_sleep_scale).
+    duration_s: float = 4.0
+    # Aggregate offered load, requests/second across all tenants.
+    rate_rps: float = 200.0
+    # Arrival process: poisson | bursty (two-state MMPP) | diurnal
+    # (sinusoidal-rate thinned Poisson) | trace (replayed timestamps).
+    arrival: str = "poisson"
+    burst_factor: float = 4.0  # bursty: burst-to-quiet rate ratio
+    burst_fraction: float = 0.25  # bursty: fraction of each cycle bursting
+    burst_cycle_s: float = 1.0  # bursty: quiet+burst cycle length
+    diurnal_period_s: float = 4.0  # diurnal: one "day" in seconds
+    trace_path: str = ""  # trace: JSON list of arrival seconds
+    # Tenant population: expanded over `classes` by share; each tenant
+    # draws its objects from a Zipf(alpha) popularity law over the
+    # shared object set (workloads/arrivals.zipf_plan).
+    tenants: int = 100
+    alpha: float = 1.2
+    # Priority classes: list of {"name", "share" (of tenants/traffic),
+    # "weight" (cache/prefetch budget split), "deadline_ms" (per-request
+    # SLO), "priority" (lower = served first)} dicts. Validated by
+    # validate_serve_config; malformed specs are a one-line SystemExit.
+    classes: list = field(default_factory=lambda: [
+        {"name": "gold", "share": 0.1, "weight": 4.0,
+         "deadline_ms": 80.0, "priority": 0},
+        {"name": "silver", "share": 0.3, "weight": 2.0,
+         "deadline_ms": 250.0, "priority": 1},
+        {"name": "best_effort", "share": 0.6, "weight": 1.0,
+         "deadline_ms": 1500.0, "priority": 2},
+    ])
+    # Request size: one chunk per request (0 = workload.granule_bytes).
+    chunk_bytes: int = 0
+    # Service worker threads (the concurrency ceiling admission caps).
+    workers: int = 8
+    # QoS master switch: False = FIFO queue, no shedding, no weighted
+    # budgets — the baseline arm of the QoS A/B.
+    qos: bool = True
+    # Admission cap: requests in service at once (0 = workers). Live:
+    # the tune controller actuates it through the "workers" knob.
+    admission_cap: int = 0
+    # Queued-request bound before overload shedding (QoS mode; 0 = a
+    # default of 8x workers). The baseline arm queues unboundedly.
+    queue_limit: int = 0
+    # Readahead over the arrival schedule (serve knows its replayed
+    # trace ahead of time the way train-ingest knows its plan): depth in
+    # chunks; 0 = demand-only.
+    readahead: int = 0
+    # serve-sweep: offered-load multipliers of rate_rps, stepped in
+    # order; per-point run length (0 = duration_s).
+    sweep_points: list = field(default_factory=lambda: [
+        0.25, 0.5, 1.0, 2.0, 4.0,
+    ])
+    sweep_duration_s: float = 0.0
+    seed: int = 0
+
+
+def validate_serve_config(sc: "ServeConfig", where: str = "serve") -> None:
+    """Parse-time sanity for the serve plane (one-line SystemExit at
+    config load — the validate_fault_config style): malformed tenant
+    class specs and arrival parameters fail before a single arrival."""
+    if not (sc.duration_s > 0):  # also rejects NaN
+        raise SystemExit(f"{where}.duration_s={sc.duration_s!r}: must be > 0")
+    if not (sc.rate_rps > 0):
+        raise SystemExit(f"{where}.rate_rps={sc.rate_rps!r}: must be > 0")
+    if sc.arrival not in ("poisson", "bursty", "diurnal", "trace"):
+        raise SystemExit(
+            f"{where}.arrival={sc.arrival!r}: must be "
+            "poisson|bursty|diurnal|trace"
+        )
+    if sc.arrival == "trace" and not sc.trace_path:
+        raise SystemExit(
+            f"{where}.arrival=trace requires {where}.trace_path "
+            "(a JSON list of arrival seconds)"
+        )
+    if not (sc.burst_factor >= 1.0):
+        raise SystemExit(
+            f"{where}.burst_factor={sc.burst_factor!r}: must be >= 1"
+        )
+    if not (0.0 < sc.burst_fraction < 1.0):
+        raise SystemExit(
+            f"{where}.burst_fraction={sc.burst_fraction!r}: must be in (0, 1)"
+        )
+    for name in ("burst_cycle_s", "diurnal_period_s", "alpha"):
+        v = getattr(sc, name)
+        if not (v > 0):
+            raise SystemExit(f"{where}.{name}={v!r}: must be > 0")
+    for name, lo in (("tenants", 1), ("workers", 1), ("chunk_bytes", 0),
+                     ("admission_cap", 0), ("queue_limit", 0),
+                     ("readahead", 0)):
+        v = getattr(sc, name)
+        if v < lo:
+            raise SystemExit(f"{where}.{name}={v!r}: must be >= {lo}")
+    if not (sc.sweep_duration_s >= 0):
+        raise SystemExit(
+            f"{where}.sweep_duration_s={sc.sweep_duration_s!r}: must be >= 0"
+        )
+    if not sc.sweep_points or not all(
+        isinstance(p, (int, float)) and p > 0 for p in sc.sweep_points
+    ):
+        raise SystemExit(
+            f"{where}.sweep_points={sc.sweep_points!r}: must be a non-empty "
+            "list of positive load multipliers"
+        )
+    if not sc.classes or not isinstance(sc.classes, list):
+        raise SystemExit(
+            f"{where}.classes: must be a non-empty list of class dicts"
+        )
+    allowed = {"name", "share", "weight", "deadline_ms", "priority"}
+    seen = set()
+    for i, c in enumerate(sc.classes):
+        label = f"{where}.classes[{i}]"
+        if not isinstance(c, dict):
+            raise SystemExit(f"{label}: expected a dict, got {c!r}")
+        unknown = sorted(set(c) - allowed)
+        if unknown:
+            raise SystemExit(
+                f"{label}: unknown field(s) {unknown}; valid: "
+                f"{sorted(allowed)}"
+            )
+        name = c.get("name")
+        if not name or not isinstance(name, str):
+            raise SystemExit(f"{label}: 'name' must be a non-empty string")
+        if name in seen:
+            raise SystemExit(f"{label}: duplicate class name {name!r}")
+        seen.add(name)
+        for fname, pred, what in (
+            ("share", lambda v: v > 0, "> 0"),
+            ("deadline_ms", lambda v: v > 0, "> 0"),
+            ("weight", lambda v: v > 0, "> 0"),
+        ):
+            v = c.get(fname, 1.0 if fname == "weight" else None)
+            try:
+                ok = v is not None and pred(float(v))
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise SystemExit(f"{label}.{fname}={v!r}: must be {what}")
+        pr = c.get("priority", i)
+        if not isinstance(pr, int) or pr < 0:
+            raise SystemExit(
+                f"{label}.priority={pr!r}: must be an int >= 0"
+            )
+
+
 # Knobs the tune controller may actuate (the canonical name set; the
 # controller's ACTUATED registry maps each to its config field and CLI
 # flag, and tests/test_tune.py pins that the three surfaces never drift).
@@ -822,6 +982,7 @@ class BenchConfig:
     tune: TuneConfig = field(default_factory=TuneConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     coop: CoopConfig = field(default_factory=CoopConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
     # ------------------------------------------------------------------ io --
     def to_dict(self) -> dict[str, Any]:
@@ -860,6 +1021,7 @@ _SUBTYPES = {
     "tune": TuneConfig,
     "telemetry": TelemetryConfig,
     "coop": CoopConfig,
+    "serve": ServeConfig,
     "retry": RetryConfig,
     "fault": FaultConfig,
     "tail": TailConfig,

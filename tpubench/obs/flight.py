@@ -772,6 +772,20 @@ def timeline_summary(records: list[dict]) -> dict:
             )
         ),
     }
+    # Serve-plane attribution (the open-loop traffic workload): every
+    # resolved request notes its outcome (`serve_req`) and every
+    # admission-control drop notes `shed` — the timeline can say how
+    # much offered load the run absorbed vs refused, per journal.
+    serve_notes = [n for n in notes if n.get("kind") == "serve_req"]
+    serve = {
+        "requests": len(serve_notes),
+        "shed": sum(1 for n in notes if n.get("kind") == "shed"),
+        "deadline_misses": sum(
+            1 for n in serve_notes
+            if n.get("outcome") == "completed"
+            and n.get("deadline_met") is False
+        ),
+    }
     return {
         "records": len(records),
         "errors": errors,
@@ -781,6 +795,7 @@ def timeline_summary(records: list[dict]) -> dict:
         "pipeline": pipeline,
         "coop": coop,
         "staging": staging,
+        "serve": serve,
         "goodput": goodput_summary(records),
         "hosts": sorted({r.get("host", 0) for r in records}),
         "phases": _phase_stats(records),
@@ -863,6 +878,12 @@ def render_timeline(docs: list[dict]) -> str:
             f"misses={coop['peer_misses']}) "
             f"owner_fetches={coop['owner_fetches']} "
             f"demotions={coop['demotions']} restores={coop['restores']}"
+        )
+    srv = summ.get("serve", {})
+    if srv.get("requests") or srv.get("shed"):
+        lines.append(
+            f"serve: requests={srv['requests']} shed={srv['shed']} "
+            f"deadline_misses={srv['deadline_misses']}"
         )
     stg = summ.get("staging", {})
     if stg.get("transfers"):

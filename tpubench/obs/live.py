@@ -240,6 +240,12 @@ def render_top(view: dict, color: bool = False) -> str:
         bits.append(
             f"tune={tn['decisions']}d/{tn['accepts']}a/{tn['reverts']}r"
         )
+    srv = summ.get("serve", {})
+    if srv.get("requests") or srv.get("shed"):
+        bits.append(
+            f"serve req={srv['requests']} shed={srv['shed']} "
+            f"dl_miss={srv['deadline_misses']}"
+        )
     if pipe.get("steps"):
         bits.append(
             f"steps={pipe['steps']} "

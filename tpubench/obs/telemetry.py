@@ -378,6 +378,13 @@ COUNTER_METRICS = {
     "tpubench_stage_bytes_total": "bytes staged to HBM",
     "tpubench_stage_overlapped_total":
         "staging transfers completed by the overlapped window",
+    "tpubench_serve_requests_total":
+        "open-loop serve requests resolved (completed or shed)",
+    "tpubench_serve_shed_total":
+        "serve requests shed by admission control "
+        "(queue overload / deadline / drain)",
+    "tpubench_serve_deadline_miss_total":
+        "completed serve requests that missed their tenant deadline",
     "tpubench_journal_flushes_total": "in-run flight-journal stream flushes",
     "tpubench_journal_rotated_records_total":
         "oldest journal records dropped by size-bounded rotation",
@@ -546,6 +553,13 @@ class FlightFeeder:
                     reg.get("tpubench_hedges_total").inc()
                 elif n.get("event") == "win":
                     reg.get("tpubench_hedge_wins_total").inc()
+            elif nk == "serve_req":
+                reg.get("tpubench_serve_requests_total").inc()
+                if (n.get("outcome") == "completed"
+                        and n.get("deadline_met") is False):
+                    reg.get("tpubench_serve_deadline_miss_total").inc()
+            elif nk == "shed":
+                reg.get("tpubench_serve_shed_total").inc()
             elif nk == "stall":
                 reg.get("tpubench_stalls_total").inc()
             elif nk == "breaker":

@@ -58,12 +58,22 @@ class ChunkKey(NamedTuple):
 
 
 class _Entry:
-    __slots__ = ("data", "origin", "used")
+    __slots__ = ("data", "origin", "used", "owner", "pins")
 
-    def __init__(self, data: bytes, origin: str):
+    def __init__(self, data: bytes, origin: str, owner: Optional[str] = None):
         self.data = data
         self.origin = origin
         self.used = False
+        # QoS tagging (the serve plane): which tenant class these bytes
+        # belong to — the weighted-eviction victim-selection key.
+        self.owner = owner
+        # Single-flight waiter pins: consumers registered on the fetch
+        # that produced this entry but not yet woken. A pinned entry is
+        # never an eviction victim — evicting bytes a waiter is about
+        # to consume would turn the single-flight save into an instant
+        # re-fetch (and, on the weighted path, let one class's budget
+        # pressure break another class's in-flight coalesce).
+        self.pins = 0
 
 
 class _Flight:
@@ -90,8 +100,21 @@ class ChunkCache:
     pipeline A/B), and single-flight dedup still applies.
     """
 
-    def __init__(self, capacity_bytes: int, debug: bool = False):
+    def __init__(self, capacity_bytes: int, debug: bool = False,
+                 owner_budgets: Optional[dict] = None):
         self.capacity = max(0, int(capacity_bytes))
+        # Weighted per-owner (tenant-class) byte budgets — the serve
+        # plane's QoS hook. None/empty = classic single-tenant LRU.
+        # With budgets set, an insert first evicts the INSERTING
+        # owner's own least-recent unpinned entries while it is over
+        # its budget (a class pays for its own overrun), and capacity
+        # eviction prefers victims from the most-over-budget owner
+        # before falling back to global LRU. Budgets are soft caps:
+        # when an over-budget owner has only pinned entries the insert
+        # still lands (correctness over strictness) and the overrun is
+        # counted.
+        self.owner_budgets = dict(owner_budgets or {})
+        self.owner_bytes: dict[str, int] = {}
         # debug=True re-derives the byte-accounting invariants after
         # every mutation (O(entries) each — test harnesses only). The
         # live-reclamp path (Prefetcher.reclamp) leans on exactly these:
@@ -125,6 +148,9 @@ class ChunkCache:
         self.prefetch_wasted_bytes = 0  # evicted before any use
         self.prefetch_dropped_bytes = 0  # never cached at all
         self.prefetch_invalidated_bytes = 0  # dropped by a newer generation
+        self.owner_evictions = 0  # evictions charged to an owner budget
+        self.owner_budget_overruns = 0  # soft-cap overruns (pins held)
+        self.pinned_capacity_overruns = 0  # capacity exceeded, all pinned
         # Directly-maintained count of resident prefetched-but-unused
         # bytes: the prefetcher's byte-budget source of truth (O(1),
         # no derived identity to keep consistent across drop reasons).
@@ -151,6 +177,13 @@ class ChunkCache:
         )
         assert 0 <= self.prefetch_resident_unused <= (
             self.prefetch_inserted_bytes
+        )
+        by_owner: dict = {}
+        for e in self._entries.values():
+            if e.owner is not None:
+                by_owner[e.owner] = by_owner.get(e.owner, 0) + len(e.data)
+        assert {k: v for k, v in self.owner_bytes.items() if v} == by_owner, (
+            f"owner_bytes drift: counter={self.owner_bytes} actual={by_owner}"
         )
 
     def _note_generation_locked(self, key: ChunkKey) -> None:
@@ -181,6 +214,12 @@ class ChunkCache:
         e = self._entries.pop(key)
         self.bytes -= len(e.data)
         self.evicted_bytes += len(e.data)
+        if e.owner is not None:
+            left = self.owner_bytes.get(e.owner, 0) - len(e.data)
+            if left > 0:
+                self.owner_bytes[e.owner] = left
+            else:
+                self.owner_bytes.pop(e.owner, None)
         if e.origin == "prefetch" and not e.used:
             self.prefetch_resident_unused -= len(e.data)
             if reason == "invalidate":
@@ -198,7 +237,64 @@ class ChunkCache:
         if self._debug:
             self._assert_invariants_locked()
 
-    def _insert_locked(self, key: ChunkKey, data, origin: str) -> None:
+    def _victim_locked(self, prefer_owner: Optional[str]) -> Optional[ChunkKey]:
+        """Next eviction victim: least-recent UNPINNED entry, preferring
+        ``prefer_owner``'s entries when given, else (with budgets set)
+        the most-over-budget owner's, else global LRU. None when every
+        entry is pinned (single-flight waiters hold them all — the
+        caller overruns rather than break an in-flight coalesce)."""
+        if prefer_owner is None and self.owner_budgets:
+            worst, worst_ratio = None, 1.0
+            for owner, b in self.owner_bytes.items():
+                budget = self.owner_budgets.get(owner)
+                if budget and b > budget and b / budget > worst_ratio:
+                    worst, worst_ratio = owner, b / budget
+            prefer_owner = worst
+        fallback = None
+        for k, e in self._entries.items():  # OrderedDict: LRU first
+            if e.pins:
+                continue
+            if prefer_owner is not None and e.owner == prefer_owner:
+                return k
+            if fallback is None:
+                fallback = k
+        return fallback
+
+    def _evict_to_fit_locked(self, n: int, owner: Optional[str]) -> None:
+        """Make room for an ``n``-byte insert by ``owner``: first charge
+        the inserting owner's own budget (its unpinned LRU entries go
+        while it is over), then global capacity with over-budget-owner
+        preference. Stops (soft overrun, counted) when only pinned
+        entries remain."""
+        budget = self.owner_budgets.get(owner) if owner is not None else None
+        while budget and self.owner_bytes.get(owner, 0) + n > budget:
+            victim = None
+            for k, e in self._entries.items():
+                if e.owner == owner and not e.pins:
+                    victim = k
+                    break
+            if victim is None:
+                if self.owner_bytes.get(owner, 0) + n > budget:
+                    self.owner_budget_overruns += 1
+                break
+            self._drop_locked(victim)
+            self.evictions += 1
+            self.owner_evictions += 1
+        while self.bytes + n > self.capacity:
+            victim = self._victim_locked(None)
+            if victim is None:
+                # Every resident entry is pinned by single-flight
+                # waiters: capacity soft-overruns. Counted separately
+                # from owner_budget_overruns — this fires on a classic
+                # (budget-less) cache too and must not read as phantom
+                # QoS budget pressure.
+                self.pinned_capacity_overruns += 1
+                break
+            self._drop_locked(victim)
+            self.evictions += 1
+
+    def _insert_locked(self, key: ChunkKey, data, origin: str,
+                       owner: Optional[str] = None, pins: int = 0) -> None:
         n = len(data)
         g = self._obj_gen.get((key.bucket, key.object))
         if g is not None and key.generation < g:
@@ -219,15 +315,16 @@ class ChunkCache:
             return
         if key in self._entries:
             return  # racer already inserted the same (immutable) bytes
-        while self.bytes + n > self.capacity:
-            old_key = next(iter(self._entries))
-            self._drop_locked(old_key)
-            self.evictions += 1
+        self._evict_to_fit_locked(n, owner)
         if isinstance(data, SlabLease):
             # The cache's own reference (dropped by _drop_locked). Lock
             # order is cache lock -> pool lock, everywhere.
             data.incref()
-        self._entries[key] = _Entry(data, origin)
+        entry = _Entry(data, origin, owner)
+        entry.pins = pins
+        self._entries[key] = entry
+        if owner is not None:
+            self.owner_bytes[owner] = self.owner_bytes.get(owner, 0) + n
         self.bytes += n
         self.inserted_bytes += n
         if origin == "prefetch":
@@ -260,9 +357,17 @@ class ChunkCache:
         with self._lock:
             return key in self._entries or key in self._inflight
 
+    def set_owner_budgets(self, budgets: dict) -> None:
+        """Live re-split of the per-owner byte budgets (the serve
+        plane's weighted-cache knob); enforcement is lazy — the next
+        insert by an over-budget owner pays."""
+        with self._lock:
+            self.owner_budgets = dict(budgets or {})
+
     def get_or_fetch(
         self, key: ChunkKey, fetch: Callable[[], object],
         origin: str = "demand", consumer: bool = True,
+        owner: Optional[str] = None,
     ):
         """The consumer path: hit → cached bytes; miss → ``fetch()`` once
         per key no matter how many threads ask concurrently (losers wait
@@ -272,11 +377,12 @@ class ChunkCache:
         counts nor marks the entry used (the prefetcher finding its work
         already done is not a consumption), and joining an in-flight
         fetch is not a coalesce save."""
-        return self.get_or_fetch_info(key, fetch, origin, consumer)[0]
+        return self.get_or_fetch_info(key, fetch, origin, consumer, owner)[0]
 
     def get_or_fetch_info(
         self, key: ChunkKey, fetch: Callable[[], object],
         origin: str = "demand", consumer: bool = True,
+        owner: Optional[str] = None,
     ) -> tuple:
         """:meth:`get_or_fetch` plus HOW the bytes arrived — ``"hit"``
         (already cached), ``"fetched"`` (this caller issued the backend
@@ -330,9 +436,14 @@ class ChunkCache:
                 with self._lock:
                     self.coalesced += 1
                     e = self._entries.get(key)
-                    if (e is not None and e.origin == "prefetch"
-                            and not e.used):
-                        self._mark_used_locked(e)
+                    if e is not None:
+                        if e.origin == "prefetch" and not e.used:
+                            self._mark_used_locked(e)
+                        if e.pins > 0:
+                            # This waiter's pin is spent: once every
+                            # registered waiter has woken the entry
+                            # competes for eviction like any other.
+                            e.pins -= 1
                 return fl.data, "coalesced"
             if not consumer:
                 # A prefetch worker joining a failed fetch stays
@@ -359,7 +470,11 @@ class ChunkCache:
         with self._lock:
             fl.data = data
             del self._inflight[key]
-            self._insert_locked(key, data, origin)
+            # Registered waiters pin the entry until each wakes (the
+            # weighted evictor skips pinned entries — see _Entry.pins).
+            self._insert_locked(
+                key, data, origin, owner=owner, pins=fl.consumer_waiters
+            )
             if fl.consumer_waiters:
                 # A consumer is already waiting on these bytes: they ARE
                 # consumed. Mark at insert, not at the waiter's wakeup —
@@ -378,10 +493,11 @@ class ChunkCache:
         fl.event.set()
         return data, "fetched"
 
-    def insert(self, key: ChunkKey, data, origin: str = "demand") -> None:
+    def insert(self, key: ChunkKey, data, origin: str = "demand",
+               owner: Optional[str] = None) -> None:
         with self._lock:
             self._note_generation_locked(key)
-            self._insert_locked(key, _freeze(data), origin)
+            self._insert_locked(key, _freeze(data), origin, owner=owner)
 
     def close(self) -> None:
         """Run teardown: drop every resident entry, releasing the cache's
@@ -424,4 +540,9 @@ class ChunkCache:
                 "prefetch_wasted_bytes": self.prefetch_wasted_bytes,
                 "prefetch_dropped_bytes": self.prefetch_dropped_bytes,
                 "prefetch_invalidated_bytes": self.prefetch_invalidated_bytes,
+                "owner_evictions": self.owner_evictions,
+                "owner_budget_overruns": self.owner_budget_overruns,
+                "pinned_capacity_overruns": self.pinned_capacity_overruns,
+                "owner_bytes": dict(self.owner_bytes),
+                "owner_budgets": dict(self.owner_budgets),
             }

@@ -1080,35 +1080,14 @@ def coop_from_config(cfg, cache: ChunkCache, origin_fetch,
 # ------------------------------------------------------------- simulation ---
 
 
-def zipf_plan(
-    objects: Sequence[ObjectMeta],
-    chunk_bytes: int,
-    n_accesses: int,
-    *,
-    bucket: str = "",
-    alpha: float = 1.2,
-    seed: int = 0,
-) -> list[ChunkKey]:
-    """A Zipf-hot chunk access sequence: chunks ranked across the object
-    set, rank r drawn with probability ∝ 1/r^alpha — the hot-set shape
-    real dataset popularity follows (and the one cooperative caching
-    exists to exploit: most accesses land on a small shared hot set)."""
-    keys: list[ChunkKey] = []
-    for meta in objects:
-        off = 0
-        while off < meta.size:
-            n = min(chunk_bytes, meta.size - off)
-            keys.append(ChunkKey(bucket, meta.name, meta.generation, off, n))
-            off += n
-    if not keys:
-        raise ValueError("zipf_plan: empty object set")
-    weights = np.asarray(
-        [1.0 / ((r + 1) ** alpha) for r in range(len(keys))], dtype=np.float64
-    )
-    weights /= weights.sum()
-    rng = np.random.Generator(np.random.Philox(seed))
-    idx = rng.choice(len(keys), size=n_accesses, p=weights)
-    return [keys[i] for i in idx]
+def zipf_plan(*args, **kwargs):
+    """Promoted to :func:`tpubench.workloads.arrivals.zipf_plan` (the
+    one popularity-law definition serve and the coop sim share); this
+    re-export keeps the coop surface stable. Imported lazily so the
+    pipeline package never depends on workloads at import time."""
+    from tpubench.workloads.arrivals import zipf_plan as _zp
+
+    return _zp(*args, **kwargs)
 
 
 def run_coop_sim(

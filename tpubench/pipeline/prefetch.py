@@ -141,6 +141,8 @@ class Prefetcher:
         meter: Optional[CopyMeter] = None,
         max_workers: int = 0,
         fetch_fn: Optional[Callable[[ChunkKey], object]] = None,
+        owners: Optional[Sequence[Optional[str]]] = None,
+        owner_budgets: Optional[dict] = None,
     ):
         self._backend = backend
         self._cache = cache
@@ -152,6 +154,25 @@ class Prefetcher:
         # the SAME owner-routing/single-flight the demand path uses.
         self._fetch_fn = fetch_fn
         self._plan = list(plan)
+        # QoS (serve plane): owners[i] tags plan[i] with its tenant
+        # class; owner_budgets bounds each class's scheduled+in-flight
+        # prefetch bytes — one greedy class can't monopolize the
+        # readahead window. Over-budget items are SKIPPED (not a window
+        # barrier): other classes' items behind them still schedule.
+        self._owners = list(owners) if owners is not None else None
+        if self._owners is not None and len(self._owners) != len(self._plan):
+            raise ValueError(
+                f"prefetch owners length {len(self._owners)} != plan "
+                f"length {len(self._plan)}"
+            )
+        self._owner_budgets = dict(owner_budgets or {})
+        self._owner_out: dict[str, int] = {}
+        self.owner_budget_skips = 0
+        # Indices already counted as budget-skipped: _fill_locked
+        # re-scans the window on every advance()/completion, so without
+        # this a single persistently-over-budget item would re-count on
+        # every pass (the per-tick re-count bug class).
+        self._owner_skip_seen: set[int] = set()
         self._depth = max(0, depth)
         self._depth_effective = self._depth
         self._budget = max(0, byte_budget)
@@ -216,6 +237,30 @@ class Prefetcher:
             self._fill_locked()
             self._cond.notify_all()
 
+    def _owner_of(self, i: int) -> Optional[str]:
+        return self._owners[i] if self._owners is not None else None
+
+    def _sched_add_locked(self, i: int, key: ChunkKey) -> None:
+        self._scheduled.add(i)
+        o = self._owner_of(i)
+        if o is not None:
+            self._owner_out[o] = self._owner_out.get(o, 0) + key.length
+
+    def _sched_drop_locked(self, i: int) -> None:
+        """A scheduled item left the system (fetched, cancelled, or
+        stale): release its owner's outstanding-byte charge with its
+        scheduled-set slot — the two must move together or a class's
+        budget slowly leaks shut."""
+        if i in self._scheduled:
+            self._scheduled.discard(i)
+            o = self._owner_of(i)
+            if o is not None:
+                left = self._owner_out.get(o, 0) - self._plan[i].length
+                if left > 0:
+                    self._owner_out[o] = left
+                else:
+                    self._owner_out.pop(o, None)
+
     def _fill_locked(self) -> None:
         hi = min(len(self._plan), self._cursor + self._depth_effective)
         for i in range(self._cursor, hi):
@@ -227,8 +272,22 @@ class Prefetcher:
             ):
                 break
             if self._cache.contains(key):
+                # Residency first: an already-cached item was never a
+                # budget casualty and must not count as one.
                 continue
-            self._scheduled.add(i)
+            o = self._owner_of(i)
+            if o is not None:
+                b = self._owner_budgets.get(o)
+                if b and self._owner_out.get(o, 0) + key.length > b:
+                    # Per-class budget: skip, don't break — the window
+                    # keeps filling with OTHER classes' items. Each
+                    # plan item counts as ONE skip no matter how many
+                    # re-scans defer it.
+                    if i not in self._owner_skip_seen:
+                        self._owner_skip_seen.add(i)
+                        self.owner_budget_skips += 1
+                    continue
+            self._sched_add_locked(i, key)
             heapq.heappush(self._heap, (i, key))
 
     def reclamp(self, depth: Optional[int] = None,
@@ -249,7 +308,7 @@ class Prefetcher:
                     keep = [(i, k) for i, k in self._heap if i < hi]
                     for i, _ in self._heap:
                         if i >= hi:
-                            self._scheduled.discard(i)
+                            self._sched_drop_locked(i)
                             self.cancelled += 1
                     self._heap = keep
                     heapq.heapify(self._heap)
@@ -315,12 +374,12 @@ class Prefetcher:
                     # ever consume.
                     while self._heap:
                         i, _ = heapq.heappop(self._heap)
-                        self._scheduled.discard(i)
+                        self._sched_drop_locked(i)
                         self.cancelled += 1
                     return
                 idx, key = heapq.heappop(self._heap)
                 if idx < self._cursor:
-                    self._scheduled.discard(idx)
+                    self._sched_drop_locked(idx)
                     self.cancelled += 1
                     continue
                 self._inflight_bytes += key.length
@@ -350,6 +409,7 @@ class Prefetcher:
                         pool=self._pool, meter=self._meter,
                     ),
                     origin="prefetch", consumer=False,
+                    owner=self._owner_of(idx),
                 )
                 if source == "fetched":
                     nbytes = len(data)
@@ -386,7 +446,7 @@ class Prefetcher:
             finally:
                 with self._cond:
                     self._inflight_bytes -= key.length
-                    self._scheduled.discard(idx)
+                    self._sched_drop_locked(idx)
 
     # --------------------------------------------------------------- stats --
     def stats(self) -> dict:
@@ -414,6 +474,7 @@ class Prefetcher:
             "errors": self.errors,
             "last_error": self.last_error,
             "depth_clamps": self.depth_clamps,
+            "owner_budget_skips": self.owner_budget_skips,
             "prefetched_bytes": self._cache.prefetch_inserted_bytes,
             "used_bytes": used,
             "wasted_bytes": wasted,

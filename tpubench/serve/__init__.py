@@ -1,0 +1,20 @@
+"""QoS serve plane: per-tenant scheduling for the open-loop traffic
+workload (``tpubench serve``).
+
+:mod:`qos` holds the scheduling primitives — tenant population
+expansion, the priority admission queue (the PR-5 runnable-queue
+admission cap generalized with a priority order and deadline-aware
+shedding), and the scorecard math (per-class SLO attainment, Jain
+fairness, knee detection). The workload driver lives in
+:mod:`tpubench.workloads.serve`.
+"""
+
+from tpubench.serve.qos import (  # noqa: F401
+    AdmissionQueue,
+    Request,
+    ShedError,
+    Tenant,
+    build_tenants,
+    find_knee,
+    jain_index,
+)

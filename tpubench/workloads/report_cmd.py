@@ -60,6 +60,13 @@ def _axis(run: dict) -> str:
         copies = (run.get("extra", {}).get("pipeline") or {}).get("copies")
         if copies and copies.get("mode"):
             bits.append(copies["mode"])
+    if run.get("workload") == "serve":
+        # QoS-on vs QoS-off is the serve A/B's axis: the protected run
+        # must not render as a twin of its baseline arm.
+        sv = run.get("extra", {}).get("serve") or {}
+        bits.append("serve " + ("qos" if sv.get("qos") else "qos-off"))
+        if sv.get("sweep"):
+            bits.append("sweep")
     # Adaptive-vs-static is an A/B axis of its own: a run the controller
     # drove must not render as a twin of its static sibling.
     if (run.get("extra", {}).get("tune") or {}).get("enabled") or \
@@ -119,6 +126,14 @@ def summarize_run(run: dict, label: str = "") -> str:
         from tpubench.workloads.train_ingest import format_pipeline_scorecard
 
         lines.append(format_pipeline_scorecard(pipe))
+    sv = extra.get("serve")
+    if sv:
+        # Serve scorecard / load-sweep curve: the same body `tpubench
+        # serve` printed live — per-class SLO attainment, Jain
+        # fairness, shedding, and (sweep runs) the knee.
+        from tpubench.workloads.serve import format_serve_scorecard
+
+        lines.append(format_serve_scorecard(sv))
     tel = extra.get("telemetry")
     if tel:
         # Live-telemetry stamp: where the run was scrapeable and what
@@ -230,6 +245,40 @@ def compare_runs(runs: list[dict]) -> str:
                     f"{cell(bp, '{:.2f}', 'copies', 'copies_per_byte')} "
                     f"({cell(bp, '{}', 'copies', 'mode')})"
                 )
+        osv = other.get("extra", {}).get("serve")
+        bsv = base.get("extra", {}).get("serve")
+        if osv and bsv and not (osv.get("sweep") or bsv.get("sweep")):
+            # The QoS A/B's verdict line: did the protected class keep
+            # its SLO, what did the protection cost in aggregate
+            # goodput, and how fair was each arm (Jain over weight-
+            # normalized per-tenant goodput).
+            def _gold(sv):
+                cl = sv.get("classes") or {}
+                return min(
+                    cl.values(), key=lambda x: x.get("priority", 0)
+                ) if cl else {}
+
+            og, bg_ = _gold(osv), _gold(bsv)
+            bgp = bsv.get("goodput_gbps") or 0.0
+            ogp = osv.get("goodput_gbps") or 0.0
+            retention = (ogp / bgp) if bgp > 0 else None
+            lines.append(
+                "    serve: gold SLO "
+                f"{cell(og, '{:.1%}', 'slo_attainment')} vs "
+                f"{cell(bg_, '{:.1%}', 'slo_attainment')}, "
+                "gold p99 "
+                f"{cell(og, '{:.1f}ms', 'p99_ms')} vs "
+                f"{cell(bg_, '{:.1f}ms', 'p99_ms')}, "
+                "shed "
+                f"{osv.get('shed', 0)} vs {bsv.get('shed', 0)}, "
+                "jain "
+                f"{cell(osv, '{:.3f}', 'jain_fairness')} vs "
+                f"{cell(bsv, '{:.3f}', 'jain_fairness')}"
+                + (
+                    f", goodput retention {retention:.1%}"
+                    if retention is not None else ""
+                )
+            )
         # Tune diff: a static run against its adaptive sibling compares
         # on what the controller exists for — the converged operating
         # point and when it got there — alongside the throughput ratio

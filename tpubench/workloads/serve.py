@@ -1,0 +1,731 @@
+"""``tpubench serve`` — open-loop multi-tenant traffic plane.
+
+Every other tpubench workload is closed-loop: a fixed worker pool pulls
+as fast as it can, so the measured operating point is always "saturated
+by construction" and there is no knee to find. Production ingest is the
+opposite regime — requests arrive from many tenants on their own
+schedule whether or not the system keeps up — and the questions that
+matter are open-loop questions: where is the saturation knee, what does
+p99 do as offered load approaches it, who gets hurt past it, and does
+QoS actually protect the tenants that paid for protection.
+
+Mechanics (the full stack, nothing stubbed):
+
+* a pre-generated **arrival schedule** (``workloads/arrivals``: Poisson,
+  bursty MMPP, diurnal, replayed trace — seeded, replayable) assigns
+  each arrival to one of thousands of synthetic tenants in weighted
+  priority classes, each tenant drawing chunks from a shared Zipf hot
+  set;
+* a **dispatcher** replays the schedule in real time (gaps scaled by the
+  shared ``TPUBENCH_BENCH_SLEEP_SCALE`` contract, floored so bursts stay
+  bursts) into the :class:`~tpubench.serve.qos.AdmissionQueue` —
+  priority admission with a LIVE cap (the PR-5 runnable-queue admission
+  hook, tune-actuatable) and deadline-aware shedding under overload;
+* **service workers** resolve each request through the chunk cache
+  (weighted per-class budgets + single-flight) and the full
+  ``open_backend`` stack (hedge/watchdog/breaker/retry compose under
+  serve exactly as under every other workload), with optional readahead
+  over the schedule (per-class prefetch byte budgets);
+* the **scorecard** (``extra["serve"]``) reports per-class SLO
+  attainment, p50/p99, shed counts by reason, Jain fairness over
+  weight-normalized per-tenant goodput, and goodput-under-overload;
+  ``run_serve_sweep`` steps offered load and locates the knee
+  (``serve.qos.find_knee``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from tpubench.config import (
+    BenchConfig,
+    parse_sleep_scale,
+    validate_serve_config,
+)
+from tpubench.metrics.percentiles import summarize_ns
+from tpubench.metrics.recorder import LatencyRecorder
+from tpubench.metrics.report import RunResult
+from tpubench.obs.flight import (
+    flight_from_config,
+    host_journal_path,
+    transport_label,
+)
+from tpubench.obs.telemetry import telemetry_from_config
+from tpubench.pipeline.cache import ChunkCache
+from tpubench.pipeline.prefetch import Prefetcher, fetch_chunk
+from tpubench.serve.qos import (
+    AdmissionQueue,
+    ClassLedger,
+    Request,
+    build_tenants,
+    class_budget_split,
+    find_knee,
+    jain_index,
+)
+from tpubench.storage import open_backend
+from tpubench.storage.base import StorageBackend
+from tpubench.workloads.arrivals import (
+    load_trace,
+    make_arrivals,
+    scaled_gaps,
+    zipf_keys_weights,
+)
+
+
+def build_schedule(cfg: BenchConfig, backend: StorageBackend,
+                   rate_rps: Optional[float] = None) -> list[Request]:
+    """The run's merged open-loop schedule: arrival timestamps from the
+    configured process, each assigned to a tenant (class-share-weighted)
+    and to one chunk of that tenant's Zipf stream. Deterministic for a
+    given seed — the replayed-trace property every arrival kind gets."""
+    sc = cfg.serve
+    w = cfg.workload
+    chunk = sc.chunk_bytes or w.granule_bytes
+    objects = backend.list(w.object_name_prefix)
+    if not objects:
+        raise SystemExit(
+            f"serve: no objects under prefix {w.object_name_prefix!r} "
+            "(run `tpubench prepare` or use --protocol fake)"
+        )
+    tenants = build_tenants(sc.classes, sc.tenants, seed=sc.seed)
+    times = make_arrivals(
+        sc.arrival, rate_rps if rate_rps is not None else sc.rate_rps,
+        sc.duration_s, seed=sc.seed,
+        burst_factor=sc.burst_factor, burst_fraction=sc.burst_fraction,
+        burst_cycle_s=sc.burst_cycle_s,
+        diurnal_period_s=sc.diurnal_period_s,
+        trace=load_trace(sc.trace_path) if sc.arrival == "trace" else None,
+    )
+    # Tenant assignment: class share split evenly over the class's
+    # tenants (traffic share and population share use the same knob).
+    by_cls: dict[str, list[int]] = {}
+    for i, t in enumerate(tenants):
+        by_cls.setdefault(t.cls, []).append(i)
+    probs = np.zeros(len(tenants), dtype=np.float64)
+    share_total = sum(float(c["share"]) for c in sc.classes)
+    for c in sc.classes:
+        members = by_cls.get(str(c["name"]), [])
+        if not members:
+            continue
+        per = (float(c["share"]) / share_total) / len(members)
+        for i in members:
+            probs[i] = per
+    probs /= probs.sum()
+    rng = np.random.Generator(np.random.Philox(sc.seed + 1))
+    assign = rng.choice(len(tenants), size=len(times), p=probs)
+    # Per-tenant Zipf chunk streams over the SHARED object set: keys
+    # and the weight vector are enumerated ONCE (zipf_keys_weights) and
+    # only the per-tenant rng draws differ — per-tenant zipf_plan calls
+    # would redo O(chunks) setup per tenant for identical data.
+    keys, weights = zipf_keys_weights(
+        objects, chunk, bucket=w.bucket, alpha=sc.alpha
+    )
+    counts = np.bincount(assign, minlength=len(tenants))
+    streams = {}
+    for i, n in enumerate(counts):
+        if n:
+            trng = np.random.Generator(np.random.Philox(tenants[i].seed))
+            streams[i] = iter(
+                trng.choice(len(keys), size=int(n), p=weights)
+            )
+    return [
+        Request(
+            tenant=tenants[ti], key=keys[next(streams[ti])],
+            arrival_s=float(t), index=idx,
+        )
+        for idx, (t, ti) in enumerate(zip(times, assign))
+    ]
+
+
+class _ShedLog:
+    """Serialized flight-note emitter for sheds: shed callbacks fire on
+    whichever thread shed (dispatcher push, worker pop, drain), and a
+    WorkerFlight ring is single-appender by contract — one small lock
+    keeps the breadcrumb path honest."""
+
+    def __init__(self, flight, tlabel: str):
+        self._ring = flight.worker("shed") if flight is not None else None
+        self._tlabel = tlabel
+        self._lock = threading.Lock()
+
+    def __call__(self, req: Request, reason: str) -> None:
+        if self._ring is None:
+            return
+        try:
+            with self._lock:
+                op = self._ring.begin(
+                    req.key.object, self._tlabel, install=False,
+                )
+                op.note(
+                    "shed", cls=req.tenant.cls, reason=reason,
+                )
+                op.note(
+                    "serve_req", cls=req.tenant.cls, outcome="shed",
+                )
+                op.finish(0)
+        except Exception:  # noqa: BLE001 — breadcrumbs must not shed twice
+            pass
+
+
+def run_serve(cfg: BenchConfig, backend: Optional[StorageBackend] = None,
+              rate_rps: Optional[float] = None, tracer=None) -> RunResult:
+    """One open-loop serve run at the configured offered load (or
+    ``rate_rps``, the sweep's per-point override)."""
+    validate_serve_config(cfg.serve)
+    owns_backend = backend is None
+    backend = backend or open_backend(cfg, tracer=tracer)
+    try:
+        return _Serve(cfg, backend, rate_rps).run()
+    finally:
+        if owns_backend:
+            backend.close()
+
+
+class _Serve:
+    def __init__(self, cfg: BenchConfig, backend: StorageBackend,
+                 rate_rps: Optional[float]):
+        self.cfg = cfg
+        self.backend = backend
+        self.rate_rps = rate_rps
+
+    def run(self) -> RunResult:
+        cfg, sc = self.cfg, self.cfg.serve
+        chunk = sc.chunk_bytes or cfg.workload.granule_bytes
+        schedule = build_schedule(cfg, self.backend, self.rate_rps)
+        tlabel = transport_label(cfg)
+        scale = parse_sleep_scale("serve arrival gaps")
+        gaps = scaled_gaps([r.arrival_s for r in schedule], scale)
+
+        qos = sc.qos
+        budgets = class_budget_split(sc.classes, cfg.pipeline.cache_bytes) \
+            if qos else None
+        cache = ChunkCache(cfg.pipeline.cache_bytes, owner_budgets=budgets)
+        flight = flight_from_config(cfg)
+        shed_log = _ShedLog(flight, tlabel)
+        queue = AdmissionQueue(
+            cap=sc.admission_cap or sc.workers, qos=qos,
+            queue_limit=(sc.queue_limit or 8 * sc.workers) if qos else 0,
+            on_shed=shed_log,
+        )
+        worker_flights = [
+            flight.worker(f"serve-{i}") if flight is not None else None
+            for i in range(sc.workers)
+        ]
+
+        # Per-class ledgers + latency recorders; classes sorted by
+        # priority so "the high-priority class" is ledger order 0.
+        classes = sorted(
+            sc.classes, key=lambda c: int(c.get("priority", 0))
+        )
+        ledgers = {str(c["name"]): ClassLedger() for c in classes}
+        recorders = {
+            str(c["name"]): LatencyRecorder(f"request_{c['name']}")
+            for c in classes
+        }
+        agg_rec = LatencyRecorder("request")
+        ledger_lock = threading.Lock()
+        tenant_bytes: dict[str, int] = {}
+        completed_bytes = [0]
+
+        for req in schedule:
+            ledgers[req.tenant.cls].arrivals += 1
+
+        # Readahead over the schedule (serve IS a replayed trace — the
+        # plan is known ahead, train-ingest style), with per-class byte
+        # budgets so one class can't monopolize the window.
+        pf: Optional[Prefetcher] = None
+        if sc.readahead > 0:
+            plan = [r.key for r in schedule]
+            owners = [r.tenant.cls for r in schedule] if qos else None
+            pf_budgets = class_budget_split(
+                sc.classes, sc.readahead * chunk
+            ) if qos else None
+            pf = Prefetcher(
+                self.backend, cache, plan,
+                workers=cfg.pipeline.prefetch_workers,
+                depth=sc.readahead,
+                byte_budget=cfg.pipeline.readahead_bytes,
+                transport=tlabel,
+                owners=owners, owner_budgets=pf_budgets,
+            )
+            pf.advance(0)
+
+        # Live telemetry (read.py wiring): flight tap + journal stream.
+        jpath_stream = None
+        if cfg.obs.flight_journal:
+            jpath_stream = host_journal_path(
+                cfg.obs.flight_journal, cfg.dist.process_id,
+                cfg.dist.num_processes,
+            )
+        tel = telemetry_from_config(cfg)
+        tel_summary = None
+        if tel is not None:
+            tel.resource["workload"] = "serve"
+            if flight is not None:
+                tel.attach_flight(flight)
+                if jpath_stream:
+                    tel.stream_journal(
+                        flight, jpath_stream,
+                        extra_fn=lambda: {"workload": "serve"},
+                        max_bytes=cfg.obs.journal_max_bytes,
+                    )
+            tel.attach_recorders([agg_rec])
+            tel.start()
+
+        def worker(i: int) -> None:
+            wf = worker_flights[i]
+            while True:
+                req = queue.pop()
+                if req is None:
+                    return
+                cls = req.tenant.cls
+                t_pop = time.perf_counter_ns()
+                op = None
+                try:
+                    data = cache.get(req.key)
+                    if data is not None:
+                        source = "hit"
+                        if wf is not None:
+                            op = wf.begin(
+                                req.key.object, tlabel, kind="cache",
+                                enqueue_ns=req.enqueue_ns,
+                            )
+                            op.mark("cache_hit")
+                    else:
+                        if wf is not None:
+                            op = wf.begin(
+                                req.key.object, tlabel,
+                                enqueue_ns=req.enqueue_ns,
+                            )
+                            op.mark("cache_miss", t_pop)
+                        data, source = cache.get_or_fetch_info(
+                            req.key,
+                            lambda k=req.key: fetch_chunk(self.backend, k),
+                            owner=cls if qos else None,
+                        )
+                        if op is not None:
+                            if source == "hit":
+                                # Raced hit: a prefetch (or concurrent
+                                # worker) landed the chunk between the
+                                # get() probe and this call — the
+                                # would-be miss record becomes a cache
+                                # record (train-ingest discipline), so
+                                # the FETCHER's read record stays the
+                                # only byte-carrying one.
+                                op.abandon()
+                                op = wf.begin(
+                                    req.key.object, tlabel, kind="cache",
+                                    enqueue_ns=req.enqueue_ns,
+                                )
+                                op.mark("cache_hit")
+                            else:
+                                op.mark("body_complete")
+                    done_ns = time.perf_counter_ns()
+                    met = done_ns <= req.deadline_ns
+                    nbytes = len(data)
+                    if op is not None:
+                        # Storage-byte credit follows the owner-only
+                        # discipline (goodput_summary sums kind="read"
+                        # bytes; one backend read must count once):
+                        # coalesced waits finish with 0, raced hits are
+                        # cache records, plain hits took the cache
+                        # branch above.
+                        op.note(
+                            "serve_req", cls=cls, outcome="completed",
+                            deadline_met=met,
+                        )
+                        op.finish(
+                            nbytes if source in ("hit", "fetched") else 0
+                        )
+                    lat_ns = done_ns - req.enqueue_ns
+                    with ledger_lock:
+                        led = ledgers[cls]
+                        led.completed += 1
+                        led.bytes += nbytes
+                        if met:
+                            led.deadline_met += 1
+                        tenant_bytes[req.tenant.name] = (
+                            tenant_bytes.get(req.tenant.name, 0) + nbytes
+                        )
+                        completed_bytes[0] += nbytes
+                    recorders[cls].record_ns(lat_ns)
+                    agg_rec.record_ns(lat_ns)
+                except Exception as e:  # noqa: BLE001 — per-request domain
+                    # Open-loop serving has per-request failure domains:
+                    # one tenant's failed fetch (post-retry) is an error
+                    # in its ledger, never a run abort. Exception, NOT
+                    # BaseException (the coop serve() discipline):
+                    # KeyboardInterrupt/SystemExit must stop the worker,
+                    # never count as a tenant error.
+                    if op is not None:
+                        op.finish(error=e)
+                    with ledger_lock:
+                        ledgers[cls].errors += 1
+                finally:
+                    queue.done()
+
+        # Tune controller (the chaos+autotuner composition): the LIVE
+        # admission cap is the "workers" knob, readahead/prefetch ride
+        # their usual knobs, and the p99 guardrail samples the HIGHEST-
+        # priority class's recorder — the controller defends the gold
+        # SLO while chasing aggregate goodput.
+        controller = None
+        tune_stats = None
+        tune_on = getattr(cfg, "tune", None) is not None and cfg.tune.enabled
+        if tune_on:
+            controller = _build_serve_controller(
+                cfg, queue, pf, recorders[str(classes[0]["name"])],
+                lambda: completed_bytes[0], flight,
+            )
+
+        threads = [
+            threading.Thread(target=worker, args=(i,), name=f"serve-{i}",
+                             daemon=True)
+            for i in range(sc.workers)
+        ]
+        activation = flight.activate() if flight is not None else None
+        t0 = time.perf_counter_ns()
+        try:
+            if activation is not None:
+                activation.__enter__()
+            for t in threads:
+                t.start()
+            if controller is not None:
+                controller.start()
+            # ---- the open loop: replay the schedule in real time ----
+            for req, gap in zip(schedule, gaps):
+                if gap > 0:
+                    time.sleep(gap)
+                req.enqueue_ns = time.perf_counter_ns()
+                if pf is not None:
+                    pf.advance(req.index)
+                queue.push(req)  # queue-overload sheds note via on_shed
+            # Grace: let in-flight work drain, bounded — an overloaded
+            # queue must not extend the run forever (that would be
+            # closed-loop completion semantics sneaking back in).
+            grace_s = max(1.0, 2.0 * scale)
+            t_end = time.monotonic() + grace_s
+            while (queue.queued or queue.in_service) \
+                    and time.monotonic() < t_end:
+                time.sleep(0.005)
+        finally:
+            drained = queue.close()  # leftovers shed as "drain"
+            for t in threads:
+                t.join(timeout=5.0)
+            if controller is not None:
+                tune_stats = controller.stop()
+            if pf is not None:
+                pf.close()
+            if activation is not None:
+                activation.__exit__(None, None, None)
+            if tel is not None:
+                tel.set_chips(1)
+                tel_summary = tel.close()
+        wall = (time.perf_counter_ns() - t0) / 1e9
+        cache.close()
+
+        # Merge the queue's shed ledger into the per-class ledgers
+        # (shed-during-drain — `drained` leftovers at the bell — is a
+        # real SLO miss and counts like any other shed).
+        qstats = queue.stats()
+        qstats["drained_at_close"] = drained
+        for reason, by_cls in qstats["shed"].items():
+            for cls, n in by_cls.items():
+                if cls in ledgers:
+                    ledgers[cls].shed += n
+
+        serve_extra = self._scorecard(
+            schedule, ledgers, recorders, tenant_bytes, qstats,
+            wall, completed_bytes[0], classes,
+        )
+        if pf is not None:
+            serve_extra["prefetch"] = pf.stats()
+        serve_extra["cache"] = cache.stats()
+
+        summaries = {}
+        if len(agg_rec):
+            summaries["request"] = summarize_ns(agg_rec.as_ns_array())
+        for cls, rec in recorders.items():
+            if len(rec):
+                summaries[f"request_{cls}"] = summarize_ns(rec.as_ns_array())
+        gbps = (completed_bytes[0] / 1e9) / wall if wall > 0 else 0.0
+        errors = sum(led.errors for led in ledgers.values())
+        res = RunResult(
+            workload="serve",
+            config=cfg.to_dict(),
+            bytes_total=completed_bytes[0],
+            wall_seconds=wall,
+            gbps=gbps,
+            gbps_per_chip=gbps,
+            n_chips=1,
+            summaries=summaries,
+            errors=errors,
+        )
+        res.extra["serve"] = serve_extra
+        if tune_stats is not None:
+            res.extra["tune"] = tune_stats
+        if tel_summary is not None:
+            res.extra["telemetry"] = tel_summary
+        from tpubench.storage.tail import collect_tail_stats
+
+        tail_stats = collect_tail_stats(self.backend)
+        if tail_stats:
+            res.extra["tail"] = tail_stats
+        if flight is not None:
+            res.extra["flight"] = flight.summary()
+            if jpath_stream:
+                res.extra["flight_journal"] = flight.write_journal(
+                    jpath_stream, extra={"workload": "serve", "n_chips": 1},
+                    max_bytes=cfg.obs.journal_max_bytes,
+                )
+        return res
+
+    def _scorecard(self, schedule, ledgers, recorders, tenant_bytes,
+                   qstats, wall, completed_bytes, classes) -> dict:
+        sc = self.cfg.serve
+        per_class = {}
+        for c in classes:
+            cls = str(c["name"])
+            led = ledgers[cls]
+            rec = recorders[cls]
+            arr = rec.as_ns_array()
+            per_class[cls] = {
+                "priority": int(c.get("priority", 0)),
+                "weight": float(c.get("weight", 1.0)),
+                "deadline_ms": float(c["deadline_ms"]),
+                "arrivals": led.arrivals,
+                "completed": led.completed,
+                "deadline_met": led.deadline_met,
+                "shed": led.shed,
+                "errors": led.errors,
+                "bytes": led.bytes,
+                "slo_attainment": led.slo_attainment(),
+                "p50_ms": float(np.percentile(arr, 50) / 1e6)
+                if arr.size else None,
+                "p99_ms": float(np.percentile(arr, 99) / 1e6)
+                if arr.size else None,
+            }
+        # Jain fairness over weight-normalized per-TENANT goodput:
+        # tenants that sent traffic compete; a starved tenant's 0 is a
+        # legitimate unfairness sample (zero-completed ≠ excluded).
+        # Weights come off the schedule's own Request objects — never a
+        # build_tenants re-derivation that must stay bit-identical.
+        weights = {r.tenant.name: r.tenant.weight for r in schedule}
+        norm = [
+            tenant_bytes.get(name, 0) / w
+            for name, w in sorted(weights.items())
+        ]
+        arrivals = len(schedule)
+        completed = sum(led.completed for led in ledgers.values())
+        shed = sum(led.shed for led in ledgers.values())
+        return {
+            "qos": sc.qos,
+            "arrival": sc.arrival,
+            "tenants": sc.tenants,
+            "active_tenants": len(weights),
+            "duration_s": sc.duration_s,
+            "wall_s": wall,
+            "offered_rps": arrivals / wall if wall > 0 else None,
+            "achieved_rps": completed / wall if wall > 0 else None,
+            "arrivals": arrivals,
+            "completed": completed,
+            "shed": shed,
+            "shed_by_reason": qstats["shed"],
+            "goodput_gbps": (completed_bytes / 1e9) / wall
+            if wall > 0 else 0.0,
+            "jain_fairness": jain_index(norm),
+            "queue": {
+                k: qstats[k] for k in (
+                    "cap", "queue_limit", "peak_queue", "peak_in_service",
+                )
+            },
+            "classes": per_class,
+        }
+
+
+def _build_serve_controller(cfg, queue, pf, guard_rec, bytes_fn, flight):
+    """Serve-plane tune controller: admission cap (the "workers" knob —
+    the PR-5 hook, live via AdmissionQueue.set_cap), readahead depth and
+    prefetch fan-out; objective is aggregate goodput, guardrail is the
+    HIGHEST-priority class's p99."""
+    from tpubench.tune.controller import (
+        Knob,
+        RecorderSampler,
+        TuneController,
+        readahead_ceiling,
+    )
+
+    wanted = set(cfg.tune.knobs)
+    knobs = []
+    if "workers" in wanted:
+        knobs.append(Knob(
+            "workers", queue.cap, queue.set_cap,
+            lo=1, hi=max(2, cfg.serve.workers), mode="mul",
+        ))
+    if "readahead" in wanted and pf is not None:
+        knobs.append(Knob(
+            "readahead", cfg.serve.readahead,
+            lambda v: pf.reclamp(depth=v),
+            lo=1, hi=readahead_ceiling(cfg.serve.readahead), mode="mul",
+        ))
+    if "prefetch_workers" in wanted and pf is not None:
+        hi = pf.stats()["workers_max"]
+        if hi > 1:
+            knobs.append(Knob(
+                "prefetch_workers", pf.active_workers, pf.set_workers,
+                lo=1, hi=hi, mode="add",
+            ))
+    if not knobs:
+        return None
+    sampler = RecorderSampler([guard_rec], bytes_fn)
+    ring = flight.worker("tune") if flight is not None else None
+    return TuneController(cfg.tune, knobs, sampler, flight_ring=ring)
+
+
+def run_serve_sweep(cfg: BenchConfig, tracer=None) -> RunResult:
+    """``tpubench serve --serve-sweep``: step offered load through
+    ``serve.sweep_points × rate_rps`` and emit the latency-vs-load curve
+    with the saturation knee identified (p99 inflection / goodput
+    saturation) — the Pulsar-methodology sweep, hermetic on the fake
+    backend."""
+    validate_serve_config(cfg.serve)
+    points = []
+    results = []
+    for mult in cfg.serve.sweep_points:
+        c = BenchConfig.from_dict(cfg.to_dict())
+        if cfg.serve.sweep_duration_s > 0:
+            c.serve.duration_s = cfg.serve.sweep_duration_s
+        # Per-point endpoint churn off (the tune-sweep policy): one
+        # sweep must not bind N telemetry ports.
+        c.telemetry.port = -1
+        c.telemetry.enabled = False
+        c.telemetry.otlp = False
+        if c.obs.flight_journal:
+            # One journal PER POINT (.pt<i> suffix): every point writes
+            # the same configured path otherwise, and the sweep's
+            # journal would silently hold only the heaviest point.
+            c.obs.flight_journal = f"{c.obs.flight_journal}.pt{len(points)}"
+        res = run_serve(
+            c, rate_rps=cfg.serve.rate_rps * mult, tracer=tracer
+        )
+        sv = res.extra["serve"]
+        gold = min(
+            sv["classes"].values(), key=lambda x: x["priority"]
+        ) if sv["classes"] else {}
+        s = res.summaries.get("request")
+        points.append({
+            "multiplier": mult,
+            "offered_rps": sv["offered_rps"],
+            "achieved_rps": sv["achieved_rps"],
+            "goodput_gbps": sv["goodput_gbps"],
+            "p99_ms": s.p99_ms if s is not None else None,
+            "gold_p99_ms": gold.get("p99_ms"),
+            "gold_slo_attainment": gold.get("slo_attainment"),
+            "shed": sv["shed"],
+            "jain_fairness": sv["jain_fairness"],
+        })
+        results.append(res)
+    knee = find_knee(points)
+    # The sweep's RunResult carries the heaviest point's latencies plus
+    # the whole curve; `tpubench report` renders curve + knee.
+    last = results[-1]
+    res = RunResult(
+        workload="serve",
+        config=cfg.to_dict(),
+        bytes_total=sum(r.bytes_total for r in results),
+        wall_seconds=sum(r.wall_seconds for r in results),
+        gbps=last.gbps,
+        gbps_per_chip=last.gbps,
+        n_chips=1,
+        summaries=last.summaries,
+        errors=sum(r.errors for r in results),
+    )
+    res.extra["serve"] = {
+        "qos": cfg.serve.qos,
+        "sweep": {
+            "base_rate_rps": cfg.serve.rate_rps,
+            "points": points,
+            "knee": knee,
+        },
+    }
+    return res
+
+
+# -------------------------------------------------------------- rendering --
+
+
+def format_serve_scorecard(sv: dict) -> str:
+    """Human rendering of ``extra["serve"]`` (CLI + ``tpubench report``)."""
+    sweep = sv.get("sweep")
+    if sweep:
+        lines = ["== serve load sweep =="]
+        lines.append(
+            f"  base rate={sweep.get('base_rate_rps', 0):.0f} rps  "
+            f"qos={'on' if sv.get('qos') else 'off'}"
+        )
+        lines.append(
+            "  offered_rps  achieved_rps  goodput  p99_ms  gold_p99  shed"
+        )
+        for p in sweep.get("points", ()):
+            lines.append(
+                f"  {p.get('offered_rps') or 0:11.1f}"
+                f"  {p.get('achieved_rps') or 0:12.1f}"
+                f"  {p.get('goodput_gbps') or 0:7.4f}"
+                f"  {p.get('p99_ms') or 0:6.1f}"
+                f"  {p.get('gold_p99_ms') or 0:8.1f}"
+                f"  {p.get('shed', 0):4d}"
+            )
+        knee = sweep.get("knee")
+        if knee:
+            lines.append(
+                f"  knee: {knee['offered_rps']:.1f} rps "
+                f"({knee['reason']}, point {knee['index']})"
+            )
+        else:
+            lines.append("  knee: not reached in this sweep")
+        return "\n".join(lines)
+    lines = [
+        "== serve scorecard ==",
+        (
+            f"  qos={'on' if sv.get('qos') else 'off'} "
+            f"arrival={sv.get('arrival', '?')} "
+            f"tenants={sv.get('active_tenants', 0)}"
+            f"/{sv.get('tenants', 0)}  "
+            f"offered={sv.get('offered_rps') or 0:.1f} rps "
+            f"achieved={sv.get('achieved_rps') or 0:.1f} rps "
+            f"goodput={sv.get('goodput_gbps', 0.0):.4f} GB/s"
+        ),
+        (
+            f"  arrivals={sv.get('arrivals', 0)} "
+            f"completed={sv.get('completed', 0)} "
+            f"shed={sv.get('shed', 0)} "
+            + (
+                f"jain={sv['jain_fairness']:.3f}"
+                if sv.get("jain_fairness") is not None else "jain=n/a"
+            )
+        ),
+    ]
+    for cls, st in (sv.get("classes") or {}).items():
+        slo = st.get("slo_attainment")
+        p99 = st.get("p99_ms")
+        lines.append(
+            f"  [{cls}] prio={st.get('priority')} "
+            f"deadline={st.get('deadline_ms', 0):.0f}ms "
+            f"arrivals={st.get('arrivals', 0)} "
+            f"completed={st.get('completed', 0)} "
+            f"shed={st.get('shed', 0)} "
+            f"slo={f'{slo:.1%}' if slo is not None else 'n/a'} "
+            f"p99={f'{p99:.1f}ms' if p99 is not None else 'n/a'}"
+        )
+    q = sv.get("queue")
+    if q:
+        lines.append(
+            f"  queue: cap={q.get('cap')} limit={q.get('queue_limit')} "
+            f"peak={q.get('peak_queue')} "
+            f"peak_in_service={q.get('peak_in_service')}"
+        )
+    return "\n".join(lines)
