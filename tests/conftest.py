@@ -110,6 +110,15 @@ def pytest_configure(config):
         "markers", "serve: open-loop multi-tenant serve plane "
                    "(arrivals/QoS/knee)"
     )
+    # Reactor tests (the epoll-mode native executor: SPSC-ring drains,
+    # doorbell coalescing, destroy ordering, stale-.so degrade) stay in
+    # tier-1 — same policy as the other subsystem markers: not
+    # slow-marked, so the dispatch-path rewrite is exercised on every
+    # pass; the marker exists for selective runs (`-m reactor`).
+    config.addinivalue_line(
+        "markers", "reactor: epoll-mode native executor "
+                   "(event loop/rings/doorbell)"
+    )
     # Multihost tests are marker-gated (see tests/test_multihost.py):
     # they need working multi-process jax.distributed, which this
     # container lacks — tier-1 collects clean skips, not failures.

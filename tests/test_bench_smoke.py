@@ -66,6 +66,33 @@ def test_bench_end_to_end_cpu():
     ab = d["fetch_only_ab"]
     assert ab["native_executor_gbps"] > 0 and ab["python_fetch_gbps"] > 0
     assert ab["source"] == "native_c_server"
+    # Three-arm reactor A/B (ISSUE 11): python / legacy thread pool /
+    # epoll reactor × fan-out {4,16,64} against the C server, with the
+    # guard — reactor goodput at the HIGHEST fan-out stays at or above
+    # the legacy thread pool's (best-of interleaved samples; a 0.85
+    # noise floor for the share-capped CI host — the strict ≥ verdict
+    # plus completions-per-wake p50 > 8 is the BENCH driver's call on
+    # quiet hardware, this guard catches the dispatch path REGRESSING).
+    rab = d["reactor_ab"]
+    assert rab["fanouts"] == [4, 16, 64]
+    assert set(rab["arms"]) == {"python", "threads", "reactor"}
+    for arm, by_fan in rab["arms"].items():
+        for fan, gs in by_fan.items():
+            assert gs and all(g > 0 for g in gs), (arm, fan, gs)
+    assert len(rab["arms"]["reactor"]["64"]) == 2  # best-of at the top
+    assert rab["executor_modes"]["reactor"] == "reactor"
+    assert rab["executor_modes"]["threads"] == "threads"
+    bt = rab["best_at_top"]
+    assert bt["reactor"] >= 0.85 * bt["threads"], (
+        f"reactor {bt['reactor']} GB/s fell below the legacy thread "
+        f"pool {bt['threads']} GB/s at fan-out 64 — the dispatch-path "
+        "rewrite regressed"
+    )
+    # The batched handoff engaged: the reactor hands over more than one
+    # completion per wake at fan-out 64 (p50 > 8 is the quiet-hardware
+    # acceptance; >1 pins the mechanism against per-completion dings).
+    rcpw = rab["completions_per_wake"]["reactor"]
+    assert rcpw["max"] > 1, rcpw
     # The note is assembled from the run's own fields: its shaped claim
     # must match the measured verdict, either way.
     note = d["note"]

@@ -1,0 +1,379 @@
+"""Reactor-mode native executor (ISSUE 11): the epoll event loop behind
+``--fetch-executor native`` — pool roundtrips through the kind-dispatched
+``tb_pool_*`` surface, SPSC-ring batched drains and their counters, the
+destroy-vs-in-flight ordering, the stale-.so degrade ladder, and the
+executor runners end-to-end in every dispatch mode."""
+
+import urllib.parse
+
+import numpy as np
+import pytest
+
+from tpubench.config import MB, BenchConfig
+from tpubench.storage.base import deterministic_bytes
+from tpubench.storage.fake import FakeBackend
+from tpubench.storage.fake_server import FakeGcsServer
+
+
+def _native_available() -> bool:
+    from tpubench.native.engine import get_engine
+
+    return get_engine() is not None
+
+
+pytestmark = [
+    pytest.mark.reactor,
+    pytest.mark.skipif(
+        not _native_available(), reason="native engine unavailable"
+    ),
+]
+
+
+@pytest.fixture(scope="module")
+def engine():
+    from tpubench.native.engine import get_engine
+
+    return get_engine()
+
+
+@pytest.fixture(scope="module")
+def csrv(engine):
+    """All-native C loopback source (1 MB body)."""
+    from tpubench.native.engine import NativeSourceServer
+
+    body = deterministic_bytes("tpubench/file_0", 1 * MB)
+    srv = NativeSourceServer(engine, "tpubench/file_0", body)
+    yield srv, body.tobytes()
+    srv.stop()
+
+
+def test_reactor_symbols_present(engine):
+    """The rebuilt .so exports the reactor API (satellite: rebuild
+    libtpubench.so with the new symbols)."""
+    assert engine._has_pool_create2
+    assert engine._has_pool_ring
+
+
+def test_reactor_pool_roundtrip_and_kind(engine, csrv):
+    srv, body = csrv
+    pool = engine.pool_create(4, 32, mode="reactor")
+    assert pool.mode == "reactor"
+    assert engine.lib.tb_pool_is_reactor(pool._h) == 1
+    try:
+        bufs = {}
+        for i in range(12):
+            b = engine.alloc(1 * MB)
+            bufs[i] = b
+            pool.submit(srv.host, srv.port, "/o?alt=media", b, tag=i)
+        got = 0
+        while got < 12:
+            cs = pool.next_batch(timeout_ms=10_000)
+            assert cs, "reactor drain stalled"
+            for c in cs:
+                assert c["result"] == 1 * MB and c["status"] == 200, c
+                assert bytes(bufs[c["tag"]].array) == body
+                assert c["first_byte_ns"] >= c["start_ns"] > 0
+                assert c["total_ns"] > 0
+            got += len(cs)
+    finally:
+        pool.close()
+        for b in bufs.values():
+            b.free()
+
+
+def test_reactor_ranged_and_discard(engine, csrv):
+    srv, body = csrv
+    pool = engine.pool_create(4, 32, mode="reactor")
+    try:
+        # Ranged GET lands the exact slice; NULL-buffer task discards
+        # through the loop's scratch but still counts body bytes.
+        buf = engine.alloc(65536)
+        start = 123 * 1024
+        pool.submit_to(
+            srv.host, srv.port, "/o?alt=media", buf.address, 65536,
+            headers=f"Range: bytes={start}-{start + 65535}\r\n", tag=1,
+        )
+        pool.submit_to(srv.host, srv.port, "/o?alt=media", 0, 0, tag=2)
+        seen = {}
+        while len(seen) < 2:
+            for c in pool.next_batch(timeout_ms=10_000):
+                seen[c["tag"]] = c
+        assert seen[1]["result"] == 65536 and seen[1]["status"] == 206
+        assert bytes(buf.array) == body[start:start + 65536]
+        assert seen[2]["result"] == 1 * MB and seen[2]["status"] == 200
+    finally:
+        pool.close()
+        buf.free()
+
+
+def test_reactor_single_next_works(engine, csrv):
+    srv, _ = csrv
+    pool = engine.pool_create(2, 8, mode="reactor")
+    try:
+        pool.submit_to(srv.host, srv.port, "/o?alt=media", 0, 0, tag=7)
+        c = pool.next(timeout_ms=10_000)
+        assert c is not None and c["tag"] == 7 and c["result"] == 1 * MB
+        assert pool.next(timeout_ms=0) is None  # empty ring polls clean
+    finally:
+        pool.close()
+
+
+def test_reactor_error_completion_pool_survives(engine, csrv):
+    """A refused connection fails THAT task (transient -errno), and the
+    pool keeps serving later submits — legacy-pool error parity."""
+    srv, _ = csrv
+    from tpubench.native.engine import PERMANENT_CODES
+
+    pool = engine.pool_create(2, 8, mode="reactor")
+    try:
+        # Port 1 on loopback: refused.
+        pool.submit_to("127.0.0.1", 1, "/x", 0, 0, tag=1)
+        c = pool.next(timeout_ms=10_000)
+        assert c is not None and c["tag"] == 1
+        assert c["result"] < 0 and c["result"] not in PERMANENT_CODES
+        pool.submit_to(srv.host, srv.port, "/o?alt=media", 0, 0, tag=2)
+        c2 = pool.next(timeout_ms=10_000)
+        assert c2 is not None and c2["tag"] == 2 and c2["result"] == 1 * MB
+    finally:
+        pool.close()
+
+
+def test_reactor_admission_cap_eagain(engine, csrv):
+    """Submits beyond ``cap`` bounce with -EAGAIN (the runnable-queue
+    admission contract the executor runners rely on)."""
+    import errno as errno_mod
+
+    from tpubench.native.engine import NativeError
+
+    srv, _ = csrv
+    pool = engine.pool_create(1, 2, mode="reactor")
+    try:
+        pool.submit_to(srv.host, srv.port, "/o?alt=media", 0, 0, tag=1)
+        pool.submit_to(srv.host, srv.port, "/o?alt=media", 0, 0, tag=2)
+        with pytest.raises(NativeError) as ei:
+            pool.submit_to(srv.host, srv.port, "/o?alt=media", 0, 0, tag=3)
+        assert ei.value.code == -errno_mod.EAGAIN
+        drained = 0
+        while drained < 2:
+            drained += len(pool.next_batch(timeout_ms=10_000) or [])
+    finally:
+        pool.close()
+
+
+def test_reactor_batched_wake_and_counters(engine, csrv):
+    """Many small completions arrive in batched wakes; the reactor
+    tb_stats counters (loops, events, completions, doorbells, ring
+    depth) all advance — the attribution surface ISSUE 11 names."""
+    srv, _ = csrv
+    stats0 = engine.stats()
+    pool = engine.pool_create(8, 64, mode="reactor")
+    try:
+        n = 48
+        for i in range(n):
+            # 64 KB ranged discards: high completion rate, so the
+            # doorbell coalescing has something to batch.
+            pool.submit_to(
+                srv.host, srv.port, "/o?alt=media", 0, 0,
+                headers="Range: bytes=0-65535\r\n", tag=i,
+            )
+        got = 0
+        batches = []
+        while got < n:
+            cs = pool.next_batch(timeout_ms=10_000)
+            assert cs
+            for c in cs:
+                assert c["result"] == 65536 and c["status"] == 206
+            batches.append(len(cs))
+            got += len(cs)
+    finally:
+        pool.close()
+    delta = {k: v - stats0.get(k, 0) for k, v in engine.stats().items()}
+    assert delta["reactor_completions"] >= n
+    assert delta["reactor_loops"] > 0
+    assert delta["reactor_epoll_events"] > 0
+    assert delta["reactor_doorbell_wakes"] > 0
+    assert delta["reactor_ring_depth_sum"] >= delta["reactor_completions"]
+    assert engine.stats()["reactor_ring_depth_max"] >= 1
+    # Coalescing did its job somewhere in the run: strictly fewer
+    # doorbells than completions (per-completion dings are the failure
+    # mode this design removes).
+    assert delta["reactor_doorbell_wakes"] < delta["reactor_completions"]
+    assert max(batches) > 1
+
+
+def test_reactor_destroy_with_inflight_hammer(engine, csrv):
+    """create → submit (work IN FLIGHT) → close, in a loop: destroy
+    must drain the doorbell/ring and join the loop threads before
+    freeing — no crash, no hang, and it returns promptly (the
+    shutdown-ordering test the thread-per-connection teardown never
+    had)."""
+    import time
+
+    srv, _ = csrv
+    t0 = time.monotonic()
+    for it in range(8):
+        pool = engine.pool_create(4, 16, mode="reactor")
+        assert pool.mode == "reactor"
+        for i in range(6):
+            pool.submit_to(srv.host, srv.port, "/o?alt=media", 0, 0, tag=i)
+        if it % 2:
+            pool.next(timeout_ms=20)  # settle some, cancel the rest
+        pool.close()
+    assert time.monotonic() - t0 < 30
+
+
+def test_reactor_stale_so_degrade_ladder(engine, csrv, monkeypatch):
+    """Stale-.so contract: without tb_pool_create2 the reactor request
+    degrades to the legacy pool (mode says so); without tb_pool_ring_*
+    next_batch degrades to tb_pool_next_batch; without that too it
+    degrades to a tb_pool_next drain loop — never a crash (satellite:
+    old binaries stay loadable)."""
+    srv, _ = csrv
+
+    def roundtrip(pool, n=6):
+        try:
+            for i in range(n):
+                pool.submit_to(srv.host, srv.port, "/o?alt=media", 0, 0,
+                               tag=i)
+            got = 0
+            while got < n:
+                cs = pool.next_batch(timeout_ms=10_000)
+                assert cs
+                for c in cs:
+                    assert c["result"] == 1 * MB and c["status"] == 200
+                got += len(cs)
+        finally:
+            pool.close()
+
+    # Rung 1: no create2 symbol — reactor request lands on legacy.
+    monkeypatch.setattr(engine, "_has_pool_create2", False)
+    pool = engine.pool_create(2, 16, mode="reactor")
+    assert pool.mode == "threads"
+    roundtrip(pool)
+    # Rung 2: no ring symbol — batch drain uses tb_pool_next_batch.
+    monkeypatch.setattr(engine, "_has_pool_ring", False)
+    roundtrip(engine.pool_create(2, 16, mode="reactor"))
+    # Rung 3: no batch symbol either — the next() drain loop.
+    monkeypatch.setattr(engine, "_has_pool_batch", False)
+    roundtrip(engine.pool_create(2, 16, mode="reactor"))
+
+
+def test_ring_drain_works_on_legacy_pool(engine, csrv):
+    """tb_pool_ring_next_batch on a LEGACY pool delegates to the batch
+    drain — either drain symbol serves either handle kind."""
+    srv, _ = csrv
+    pool = engine.pool_create(2, 16, mode="threads")
+    assert pool.mode == "threads"
+    assert engine.lib.tb_pool_is_reactor(pool._h) == 0
+    try:
+        for i in range(4):
+            pool.submit_to(srv.host, srv.port, "/o?alt=media", 0, 0, tag=i)
+        got = 0
+        while got < 4:
+            cs = pool.next_batch(timeout_ms=10_000)  # ring symbol path
+            assert cs
+            got += len(cs)
+    finally:
+        pool.close()
+
+
+# ------------------------------------------------- executor end-to-end ----
+
+
+@pytest.fixture(scope="module")
+def pysrv():
+    be = FakeBackend.prepopulated("bench/file_", count=4, size=500_000)
+    with FakeGcsServer(be) as srv:
+        yield srv
+
+
+def _cfg(server, executor: str, workers: int = 4) -> BenchConfig:
+    cfg = BenchConfig()
+    cfg.transport.protocol = "http"
+    cfg.transport.endpoint = server.endpoint
+    cfg.workload.bucket = "testbucket"
+    cfg.workload.object_name_prefix = "bench/file_"
+    cfg.workload.fetch_executor = executor
+    cfg.workload.workers = workers
+    cfg.workload.read_calls_per_worker = 3
+    cfg.staging.mode = "none"
+    return cfg
+
+
+@pytest.mark.parametrize("executor,want_mode", [
+    ("native", "reactor"),          # the post-BENCH_r05 default shape
+    ("native-reactor", "reactor"),
+    ("native-threads", "threads"),
+])
+def test_read_workload_executor_modes(pysrv, executor, want_mode):
+    """run_read dispatches every native-* value to the executor runner,
+    the engaged dispatch shape is stamped honestly, and the goodput
+    accounting holds in all three."""
+    from tpubench.workloads.read import run_read
+
+    res = run_read(_cfg(pysrv, executor))
+    assert res.errors == 0
+    assert res.extra["fetch_executor"] == executor
+    assert res.extra["executor_mode"] == want_mode
+    assert res.bytes_total == 4 * 3 * 500_000
+    assert res.extra["completions_per_wake"]["wakes"] > 0
+
+
+def test_staged_executor_reactor_checksummed(pysrv):
+    """The staged runner (slot-range GETs landing in staging-slot
+    buffers) rides the reactor with the on-device checksum green —
+    socket → slot memory integrity across the new receive path."""
+    from tpubench.workloads.read import run_read
+
+    cfg = _cfg(pysrv, "native-reactor", workers=2)
+    cfg.workload.read_calls_per_worker = 2
+    cfg.staging.mode = "device_put"
+    cfg.staging.slot_bytes = 128 * 1024
+    cfg.staging.depth = 3
+    cfg.staging.validate_checksum = True
+    res = run_read(cfg)
+    assert res.errors == 0
+    assert res.extra["executor_mode"] == "reactor"
+    assert res.extra["checksum_ok"] is True
+    assert res.extra["staged_bytes"] == 2 * 2 * 500_000
+
+
+def test_reactor_executor_retries_injected_503s():
+    """The gax retry ladder over completions survives the dispatch-path
+    rewrite: injected 503s classify transient and retry to success."""
+    from tpubench.storage.fake import FaultPlan
+    from tpubench.workloads.read import run_read
+
+    be = FakeBackend.prepopulated("bench/file_", count=2, size=200_000)
+    be.fault = FaultPlan(error_rate=0.3, seed=7)
+    srv = FakeGcsServer(be)
+    srv.start()
+    try:
+        cfg = _cfg(srv, "native-reactor", workers=2)
+        cfg.workload.read_calls_per_worker = 4
+        cfg.transport.retry.initial_backoff_s = 0.005
+        cfg.transport.retry.max_backoff_s = 0.02
+        res = run_read(cfg)
+    finally:
+        srv.stop()
+    assert res.errors == 0
+    assert res.bytes_total == 2 * 4 * 200_000
+    assert res.extra["retries"] > 0  # the fault plan really fired
+    assert res.extra["executor_mode"] == "reactor"
+
+
+def test_reactor_executor_tune_admission_cap_survives(pysrv):
+    """The PR-5 live actuation contract: the tune controller's
+    runnable-queue admission cap still bounds and completes the run on
+    the reactor (no reads lost at shrunken concurrency)."""
+    from tpubench.workloads.read import run_read
+
+    cfg = _cfg(pysrv, "native-reactor", workers=4)
+    cfg.workload.read_calls_per_worker = 4
+    cfg.tune.enabled = True
+    cfg.tune.knobs = ["workers"]
+    cfg.tune.window_s = 0.05
+    res = run_read(cfg)
+    assert res.errors == 0
+    assert res.bytes_total == 4 * 4 * 500_000
+    assert "tune" in res.extra

@@ -163,6 +163,54 @@ def test_metric_drift_guard_registry_readme_and_phases():
         assert isinstance(m, Histogram), p
 
 
+def test_native_counter_drift_guard_engine_catalog_and_readme():
+    """Same drift discipline for the NATIVE counters (the `counter=`
+    label values of tpubench_native_transport_total): the tb_stats names
+    the engine exports, the telemetry catalog
+    (NATIVE_TRANSPORT_COUNTERS) and the README native-counter table must
+    agree exactly — a reactor counter added to engine.cc without docs,
+    or documented but dropped from the build, fails here instead of
+    silently vanishing from dashboards."""
+    from tpubench.obs.telemetry import NATIVE_TRANSPORT_COUNTERS
+
+    assert all(NATIVE_TRANSPORT_COUNTERS.values())  # helps non-empty
+    # Catalog <-> engine stats() keys (the engine is the source of
+    # truth: stats() builds its dict from tb_stats_name).
+    from tpubench.native.engine import get_engine
+
+    eng = get_engine()
+    if eng is None:
+        pytest.skip("native toolchain unavailable")
+    stats = eng.stats()
+    assert stats, "tb_stats_* missing from the freshly built engine"
+    assert set(stats) == set(NATIVE_TRANSPORT_COUNTERS), (
+        "engine tb_stats names and NATIVE_TRANSPORT_COUNTERS drifted: "
+        f"engine-only={sorted(set(stats) - set(NATIVE_TRANSPORT_COUNTERS))} "
+        f"catalog-only={sorted(set(NATIVE_TRANSPORT_COUNTERS) - set(stats))}"
+    )
+    # The reactor's own counters are present (ISSUE 11 acceptance: the
+    # win must be attributable, not asserted).
+    for name in (
+        "reactor_loops", "reactor_epoll_events", "reactor_completions",
+        "reactor_doorbell_wakes", "reactor_ring_depth_sum",
+        "reactor_ring_depth_max",
+    ):
+        assert name in stats, name
+    # Catalog <-> README native counter table.
+    with open(os.path.join(REPO, "README.md")) as f:
+        readme = f.read()
+    m = re.search(
+        r"<!-- native-counters -->(.*?)<!-- /native-counters -->",
+        readme, re.S,
+    )
+    assert m, "README native-counter table (native-counters markers) missing"
+    documented = set(re.findall(r"`([a-z0-9_]+)`", m.group(1)))
+    missing = set(NATIVE_TRANSPORT_COUNTERS) - documented
+    assert not missing, f"native counters missing from README: {sorted(missing)}"
+    stale = documented - set(NATIVE_TRANSPORT_COUNTERS)
+    assert not stale, f"README documents dropped native counters: {sorted(stale)}"
+
+
 # ----------------------------------------------------------- flight tap ----
 
 

@@ -136,7 +136,8 @@ def build_note(f: dict) -> str:
     nexec_median (float|None), sync_median (float|None),
     nexec_deconfounded (bool); optional: overlap_best (float|None),
     sync_best (float|None), overlap_put_submit_frac (float|None),
-    fetch_ab (dict with native_executor_gbps/python_fetch_gbps)."""
+    fetch_ab (dict with native_executor_gbps/python_fetch_gbps),
+    reactor_ab (dict with best_at_top/completions_per_wake/fanouts)."""
     parts: list[str] = []
     if f.get("shaped_verdict"):
         parts.append(
@@ -265,6 +266,27 @@ def build_note(f: dict) -> str:
                 else "."
             )
         )
+    rab = f.get("reactor_ab") or {}
+    bt = rab.get("best_at_top") or {}
+    if bt.get("reactor") and bt.get("threads"):
+        fan = (rab.get("fanouts") or ["?"])[-1]
+        rcpw = (rab.get("completions_per_wake") or {}).get("reactor") or {}
+        rel = "ahead of" if bt["reactor"] >= bt["threads"] else "behind"
+        s = (
+            f"reactor three-arm A/B at fan-out {fan} (best-of, quiet "
+            f"CPU, C server source): reactor {bt['reactor']} vs "
+            f"thread-pool {bt['threads']} vs python {bt.get('python')} "
+            f"GB/s — the epoll loop + SPSC-ring handoff measures {rel} "
+            "the legacy executor"
+        )
+        if rcpw.get("p50") is not None:
+            s += (
+                f", handing over {rcpw['p50']} completions per wake at "
+                "p50 (the legacy per-completion handoff delivers ~1)."
+            )
+        else:
+            s += "."
+        parts.append(s)
     parts.append(
         "vs_baseline divides by an in-process host-RAM memcpy fetch "
         "(~7 GB/s) no NIC-attached client reaches; vs_tunnel_ceiling is "

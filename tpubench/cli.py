@@ -300,10 +300,15 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="media GETs over the native HTTP/2 client (the "
                         "reference's ForceAttemptHTTP2 branch, "
                         "main.go:76-80); h2c on http, TLS+ALPN on https")
-    p.add_argument("--fetch-executor", choices=("python", "native"),
+    p.add_argument("--fetch-executor",
+                   choices=("python", "native", "native-reactor",
+                            "native-threads"),
                    help="read fan-out runtime: python worker threads, or "
-                        "the C++ fetch executor (pthreads + completion "
-                        "queue; plain-http endpoints, staging none)")
+                        "the C++ fetch executor — 'native' runs its epoll "
+                        "reactor (event loop + lock-free completion "
+                        "rings); 'native-threads' pins the legacy "
+                        "thread-per-connection pool; 'native-reactor' "
+                        "pins the reactor (plain-http endpoints)")
     p.add_argument("--no-direct", action="store_true", help="skip O_DIRECT")
     p.add_argument("--mount-cmd",
                    help="shell template run before FS workloads; {dir} "

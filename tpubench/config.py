@@ -847,10 +847,14 @@ class WorkloadConfig:
     abort_on_error: bool = True
     # Fan-out runtime for the read workload: "python" = worker threads
     # (each GIL-releasing I/O call native); "native" = the C++ fetch
-    # executor (tb_pool_*) — N pthreads with per-thread keep-alive
-    # connections and a completion queue, so the per-request hot path
-    # never enters the interpreter. Native scope: plain-http endpoints,
-    # staging "none".
+    # executor (tb_pool_*) in its REACTOR shape — an epoll event loop
+    # owning all connections with completions delivered over lock-free
+    # SPSC rings (one wake drains the backlog; the per-completion
+    # lock/condvar handoff BENCH_r05 blamed is gone). "native-threads"
+    # pins the legacy thread-per-connection pool (the TLS path and the
+    # A/B comparator); "native-reactor" pins the reactor explicitly.
+    # Native scope: plain-http endpoints (reactor: plaintext; TLS falls
+    # back to the thread pool), staging "none" or "device_put".
     fetch_executor: str = "python"
 
 

@@ -278,6 +278,32 @@ class NativeEngine:
             self._has_pool_batch = True
         except AttributeError:
             self._has_pool_batch = False
+        # Reactor-mode executor (tb_pool_create2 + the SPSC ring drain):
+        # bound defensively so a stale .so predating the reactor degrades
+        # to the legacy thread pool (pool_create falls back, mode label
+        # says so) and the ring drain degrades to tb_pool_next_batch —
+        # old binaries stay loadable, nothing crashes.
+        try:
+            lib.tb_pool_create2.restype = c.c_int64
+            lib.tb_pool_create2.argtypes = [
+                c.c_int, c.c_int, c.c_int, c.c_char_p, c.c_int, c.c_int,
+            ]
+            lib.tb_pool_is_reactor.restype = c.c_int
+            lib.tb_pool_is_reactor.argtypes = [c.c_int64]
+            self._has_pool_create2 = True
+        except AttributeError:
+            self._has_pool_create2 = False
+        try:
+            lib.tb_pool_ring_next_batch.restype = c.c_int
+            lib.tb_pool_ring_next_batch.argtypes = [
+                c.c_int64, c.c_int, c.c_int, c.POINTER(c.c_uint64),
+                c.POINTER(c.c_int64), c.POINTER(c.c_int),
+                c.POINTER(c.c_int64), c.POINTER(c.c_int64),
+                c.POINTER(c.c_int64),
+            ]
+            self._has_pool_ring = True
+        except AttributeError:
+            self._has_pool_ring = False
         lib.tb_grpc_read.restype = c.c_int64
         lib.tb_grpc_read.argtypes = [
             c.c_int64, c.c_char_p, c.c_char_p, c.c_char_p, c.c_char_p,
@@ -690,13 +716,35 @@ class NativeEngine:
         tls: bool = False,
         cafile: str = "",
         insecure: bool = False,
+        mode: str = "threads",
+        loops: int = 0,
     ) -> "NativeFetchPool":
-        """Native fetch executor (the errgroup analog in C++): ``threads``
-        workers run HTTP GETs into caller buffers over per-thread
-        keep-alive connections — plaintext or TLS (verified against
-        ``cafile``/system store, task host as SNI); completions drain
-        through :meth:`NativeFetchPool.next`. The per-request hot path
-        never enters the Python interpreter."""
+        """Native fetch executor. Two dispatch shapes behind one handle:
+
+        ``mode="threads"`` (legacy): ``threads`` worker pthreads, one
+        keep-alive connection each, completions through a mutex/condvar
+        queue — plaintext or TLS.
+
+        ``mode="reactor"``: epoll event loop(s) owning ALL connections
+        (``threads`` becomes the CONNECTION budget; in-flight GETs
+        beyond it queue per target and share keep-alive sockets),
+        completions delivered over lock-free SPSC rings with an eventfd
+        doorbell — zero lock crossings on the steady-state hot path
+        (the BENCH_r05 handoff tax, removed). ``loops`` sets the
+        event-loop thread count (0 = one). Plaintext only: TLS requests
+        and stale ``.so``s without the reactor symbols fall back to the
+        legacy pool — check :attr:`NativeFetchPool.mode` for what
+        actually engaged (A/Bs must label arms honestly).
+        """
+        want_reactor = mode == "reactor"
+        if want_reactor and self._has_pool_create2 and not tls:
+            h = self.lib.tb_pool_create2(
+                threads, cap, 0, cafile.encode(), 1 if insecure else 0,
+                1 | (max(0, min(loops, 16)) << 8),
+            )
+            if h != 0:
+                return NativeFetchPool(self, h, mode="reactor")
+            # Reactor creation failed (fd limits?): legacy still serves.
         h = self.lib.tb_pool_create(
             threads, cap, 1 if tls else 0, cafile.encode(),
             1 if insecure else 0,
@@ -707,7 +755,7 @@ class NativeEngine:
                 + (" (TLS requested but OpenSSL unavailable?)" if tls else ""),
                 code=-12,
             )
-        return NativeFetchPool(self, h)
+        return NativeFetchPool(self, h, mode="threads")
 
     def grpc_submit(
         self,
@@ -877,12 +925,18 @@ class NativeFetchPool:
 
     Contract: a buffer passed to :meth:`submit` is OWNED BY THE POOL until
     its completion comes back from :meth:`next` (identified by ``tag``).
-    ``close()`` joins the workers after queued tasks finish.
+    ``close()`` joins the workers after queued tasks finish (legacy mode)
+    or cancels outstanding work after joining the event loop (reactor
+    mode) — either way, after close() returns nothing writes into caller
+    buffers. Reactor completions ride an SPSC ring: drain from ONE thread
+    at a time (the executor runners already do).
     """
 
-    def __init__(self, engine: NativeEngine, handle: int):
+    def __init__(self, engine: NativeEngine, handle: int,
+                 mode: str = "threads"):
         self._engine = engine
         self._h = handle
+        self.mode = mode  # "threads" | "reactor" — what actually engaged
 
     def submit(
         self,
@@ -945,16 +999,17 @@ class NativeFetchPool:
         }
 
     def next_batch(self, timeout_ms: int = -1, max_n: int = 64) -> list[dict]:
-        """Drain up to ``max_n`` completions in ONE native lock crossing
-        (tb_pool_next_batch): under fan-out, completions queue up while
-        the consumer processes the previous one — batching the handoff
-        amortizes the mutex/condvar cost across the backlog instead of
-        paying it per completion. Returns ``[]`` on timeout. Falls back
-        to a drain loop over :meth:`next` on a stale .so (one blocking
-        wait, then zero-timeout polls — same observable behavior, minus
-        the single-crossing economy)."""
+        """Drain up to ``max_n`` completions in ONE handoff: the SPSC
+        ring drain (tb_pool_ring_next_batch — zero lock crossings on a
+        reactor pool, delegating to the batched mutex drain on a legacy
+        one) when the .so has it, else tb_pool_next_batch (one native
+        lock crossing for the whole backlog), else a drain loop over
+        :meth:`next` (one blocking wait, then zero-timeout polls — same
+        observable behavior, minus the single-crossing economy). Returns
+        ``[]`` on timeout. The two-stage degrade is the stale-.so
+        contract: old binaries stay loadable, never crash."""
         max_n = max(1, int(max_n))
-        if not self._engine._has_pool_batch:
+        if not self._engine._has_pool_ring and not self._engine._has_pool_batch:
             first = self.next(timeout_ms=timeout_ms)
             if first is None:
                 return []
@@ -972,7 +1027,12 @@ class NativeFetchPool:
         fbs = (ctypes.c_int64 * n)()
         totals = (ctypes.c_int64 * n)()
         starts = (ctypes.c_int64 * n)()
-        rc = self._engine.lib.tb_pool_next_batch(
+        drain = (
+            self._engine.lib.tb_pool_ring_next_batch
+            if self._engine._has_pool_ring
+            else self._engine.lib.tb_pool_next_batch
+        )
+        rc = drain(
             self._h, timeout_ms, n, tags, results, statuses, fbs, totals,
             starts,
         )

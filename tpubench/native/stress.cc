@@ -42,6 +42,12 @@ void* tb_dlpack_create(void* data, int64_t rows, int64_t cols, void* deleter);
 void tb_dlpack_free(void* managed);
 int64_t tb_pool_create(int threads, int cap, int tls,
                        const char* cafile, int insecure);
+int64_t tb_pool_create2(int threads, int cap, int tls,
+                        const char* cafile, int insecure, int mode);
+int tb_pool_is_reactor(int64_t h);
+int tb_pool_ring_next_batch(int64_t h, int timeout_ms, int max_n,
+                            uint64_t* tags, int64_t* results, int* statuses,
+                            int64_t* fbs, int64_t* totals, int64_t* starts);
 int tb_pool_submit(int64_t h, const char* host, int port, const char* path,
                    const char* headers, void* buf, int64_t buf_len,
                    uint64_t tag);
@@ -306,6 +312,322 @@ static int stress_srv_and_discard() {
   return bad ? 20 : 0;
 }
 
+// Abrupt keep-alive server: serves 2 responses per connection WITHOUT a
+// "Connection: close" announcement, then closes — the peer sees a bare
+// FIN on a conn it believed reusable. This is the stale-keep-alive
+// shape that triggers (a) the batch-edge deferred conn free (a FIN
+// event and a reuse race in one epoll batch) and (b) the fresh-socket
+// retransmit contract.
+static int g_srv2_fd = -1;
+
+static void handle_conn_abrupt(int c) {
+  for (int served = 0; served < 2; served++) {
+    char req[2048];
+    ssize_t n = 0, got = 0;
+    bool have = false;
+    while (got < static_cast<ssize_t>(sizeof req) &&
+           (n = recv(c, req + got, sizeof req - got, 0)) > 0) {
+      got += n;
+      if (memmem(req, got, "\r\n\r\n", 4)) {
+        have = true;
+        break;
+      }
+    }
+    if (!have) break;
+    const char* resp =
+        "HTTP/1.1 200 OK\r\nContent-Length: 16\r\n\r\n0123456789abcdef";
+    send(c, resp, strlen(resp), 0);
+  }
+  close(c);  // unannounced: keep-alive peers must survive the bare FIN
+}
+
+static void serve_loop_abrupt() {
+  std::vector<std::thread> handlers;
+  for (;;) {
+    int c = accept(g_srv2_fd, nullptr, nullptr);
+    if (c < 0) break;
+    handlers.emplace_back(handle_conn_abrupt, c);
+  }
+  for (auto& h : handlers) h.join();
+}
+
+// Reactor vs the abrupt server: single-threaded submit/drain interleave
+// (the ring cap forces -EAGAIN backpressure) with every completion
+// REQUIRED to succeed — a stale FIN racing connection reuse must end in
+// a fresh-socket retransmit, never a surfaced error — and exactly-once
+// delivery asserted. The FIN-vs-reuse races also hammer the batch-edge
+// deferred conn free under TSAN.
+static int stress_reactor_stale_churn() {
+  g_srv2_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (g_srv2_fd < 0) return 1;
+  int one = 1;
+  setsockopt(g_srv2_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in a;
+  memset(&a, 0, sizeof a);
+  a.sin_family = AF_INET;
+  a.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  a.sin_port = 0;
+  if (bind(g_srv2_fd, reinterpret_cast<struct sockaddr*>(&a), sizeof a) != 0) {
+    close(g_srv2_fd);
+    return 2;
+  }
+  socklen_t alen = sizeof a;
+  getsockname(g_srv2_fd, reinterpret_cast<struct sockaddr*>(&a), &alen);
+  int port = ntohs(a.sin_port);
+  listen(g_srv2_fd, 16);
+  std::thread srv(serve_loop_abrupt);
+
+  const int kTasks = 96;
+  int64_t pool = tb_pool_create2(3, 24, 0, "", 0, 1);
+  int bad = 0;
+  std::vector<int> seen(kTasks, 0);
+  std::vector<void*> bufs(kTasks, nullptr);
+  if (!pool) {
+    bad = 100;
+  } else {
+    int next = 0, drained = 0;
+    auto drain_some = [&](int timeout_ms) {
+      uint64_t tags[8];
+      int64_t results[8], fbs[8], totals[8], starts[8];
+      int statuses[8];
+      int n = tb_pool_ring_next_batch(pool, timeout_ms, 8, tags, results,
+                                      statuses, fbs, totals, starts);
+      for (int i = 0; i < n; i++) {
+        int t = static_cast<int>(tags[i]);
+        if (t < 0 || t >= kTasks || seen[t]++) {
+          bad++;
+          continue;
+        }
+        // Success REQUIRED: stale FINs must be absorbed by the
+        // fresh-socket retransmit, not surfaced.
+        if (results[i] != 16 || statuses[i] != 200) bad++;
+      }
+      return n;
+    };
+    while (drained < kTasks) {
+      while (next < kTasks) {
+        void* b = tb_alloc_aligned(4096, 4096);
+        if (!b) {
+          bad++;
+          break;
+        }
+        int rc = tb_pool_submit(pool, "127.0.0.1", port, "/x", "", b, 4096,
+                                next);
+        if (rc == -EAGAIN) {
+          tb_free_aligned(b);
+          break;  // backpressure: drain below
+        }
+        if (rc != 0) {
+          tb_free_aligned(b);
+          bad++;
+          break;
+        }
+        bufs[next++] = b;
+      }
+      int n = drain_some(30000);
+      if (n <= 0) {
+        bad++;  // stall: bail instead of hanging
+        break;
+      }
+      drained += n;
+    }
+    tb_pool_destroy(pool);
+  }
+  shutdown(g_srv2_fd, SHUT_RDWR);
+  close(g_srv2_fd);
+  srv.join();
+  for (auto b : bufs)
+    if (b) tb_free_aligned(b);
+  return bad ? 50 : 0;
+}
+
+// Reactor stress: 2 submitter threads race a mixed task set (landed +
+// content-checked ranges, discard, metadata) into the epoll reactor
+// against the all-native loopback server while the main thread drains
+// through MIXED single/batched paths (tb_pool_next, tb_pool_next_batch,
+// tb_pool_ring_next_batch) — the SPSC ring handoff, the doorbell
+// eventfd, the submit inbox and the loop's connection state machines
+// all race under TSAN, and EXACTLY-ONCE delivery is asserted on a tag
+// bitmap (a duplicated or lost completion is a correctness bug, not
+// just a race).
+static int stress_reactor() {
+  const int64_t kBody = 1 << 20;
+  uint8_t* body = static_cast<uint8_t*>(tb_alloc_aligned(kBody, 4096));
+  if (!body) return 1;
+  tb_fill_random(body, kBody, 99);
+  int port = 0;
+  void* srv = tb_srv_start(body, kBody, "{\"size\": \"1048576\"}", &port);
+  if (!srv) {
+    tb_free_aligned(body);
+    return 2;
+  }
+  const int kTasks = 64;
+  // 2 event loops, 6-connection budget, cap 32 < kTasks so the -EAGAIN
+  // admission path races the drain too.
+  int64_t pool = tb_pool_create2(6, 32, 0, "", 0, 1 | (2 << 8));
+  int bad = 0;
+  if (!pool || !tb_pool_is_reactor(pool)) {
+    tb_srv_stop(srv);
+    tb_free_aligned(body);
+    return 3;
+  }
+  std::vector<void*> bufs(kTasks, nullptr);
+  std::vector<int> starts(kTasks, 0);
+  const char* media = "/storage/v1/b/b/o/x?alt=media";
+  std::atomic<int> submitted{0};
+  std::atomic<int> done_submitters{0};
+  std::atomic<bool> submit_failed{false};
+  std::vector<std::thread> submitters;
+  for (int si = 0; si < 2; si++) {
+    submitters.emplace_back([&, si]() {
+      for (int i = si; i < kTasks; i += 2) {
+        int rc;
+        if (i % 3 == 1) {
+          bufs[i] = tb_alloc_aligned(65536, 4096);
+          if (!bufs[i]) {
+            submit_failed.store(true);
+            continue;
+          }
+          starts[i] = (i * 4096) % (1 << 19);
+        } else if (i % 3 == 2) {
+          bufs[i] = tb_alloc_aligned(4096, 4096);
+          if (!bufs[i]) {
+            submit_failed.store(true);
+            continue;
+          }
+        }
+        for (;;) {
+          if (i % 3 == 0) {
+            rc = tb_pool_submit(pool, "127.0.0.1", port, media, "", nullptr,
+                                0, i);
+          } else if (i % 3 == 1) {
+            char hdrs[64];
+            snprintf(hdrs, sizeof hdrs, "Range: bytes=%d-%d\r\n", starts[i],
+                     starts[i] + 65535);
+            rc = tb_pool_submit(pool, "127.0.0.1", port, media, hdrs,
+                                bufs[i], 65536, i);
+          } else {
+            rc = tb_pool_submit(pool, "127.0.0.1", port,
+                                "/storage/v1/b/b/o/x", "", bufs[i], 4096, i);
+          }
+          if (rc == 0) break;
+          if (rc == -EAGAIN) {
+            sched_yield();  // main thread drains concurrently
+            continue;
+          }
+          submit_failed.store(true);
+          break;
+        }
+        if (rc == 0) submitted.fetch_add(1);
+      }
+      done_submitters.fetch_add(1);
+    });
+  }
+  // Exactly-once ledger: each tag must come back exactly once.
+  std::vector<int> seen(kTasks, 0);
+  int drained = 0;
+  int which = 0;
+  for (;;) {
+    if (done_submitters.load() == 2 && drained >= submitted.load()) break;
+    uint64_t tags[8];
+    int64_t results[8], fbs[8], totals[8], st_ns[8];
+    int statuses[8];
+    int n;
+    if (which == 0) {
+      int rc = tb_pool_next(pool, 30000, &tags[0], &results[0], &statuses[0],
+                            &fbs[0], &totals[0], &st_ns[0]);
+      n = rc == 1 ? 1 : rc;
+    } else if (which == 1) {
+      n = tb_pool_next_batch(pool, 30000, 8, tags, results, statuses, fbs,
+                             totals, st_ns);
+    } else {
+      n = tb_pool_ring_next_batch(pool, 30000, 8, tags, results, statuses,
+                                  fbs, totals, st_ns);
+    }
+    which = (which + 1) % 3;
+    if (n <= 0) {
+      if (done_submitters.load() == 2 && drained >= submitted.load()) break;
+      bad++;  // stall: bail instead of hanging
+      break;
+    }
+    for (int i = 0; i < n; i++) {
+      int t = static_cast<int>(tags[i]);
+      if (t < 0 || t >= kTasks || seen[t]++) {
+        bad++;  // duplicate or junk tag: delivery not exactly-once
+        continue;
+      }
+      if (t % 3 == 0) {
+        if (results[i] != kBody || statuses[i] != 200) bad++;
+      } else if (t % 3 == 1) {
+        if (results[i] != 65536 || statuses[i] != 206 ||
+            memcmp(bufs[t], body + starts[t], 65536) != 0)
+          bad++;
+      } else {
+        if (results[i] <= 0 || statuses[i] != 200) bad++;
+      }
+    }
+    drained += n;
+  }
+  for (auto& t : submitters) t.join();
+  if (submit_failed.load()) bad++;
+  for (int t = 0; t < kTasks; t++)
+    if (seen[t] > 1) bad++;  // belt+braces: ledger re-check after joins
+  tb_pool_destroy(pool);
+  int leaked = tb_srv_stop(srv);
+  for (auto b : bufs)
+    if (b) tb_free_aligned(b);
+  if (!leaked) tb_free_aligned(body);
+  return bad ? 30 : 0;
+}
+
+// Destroy-ordering hammer: create → submit (leaving work IN FLIGHT) →
+// destroy, in a tight loop. tb_pool_destroy must drain the doorbell and
+// rings and join every loop thread BEFORE freeing — the shutdown
+// ordering the thread-per-connection teardown never had a test for. A
+// use-after-free here is a TSAN/ASAN report or a crash; a missed join
+// is a leaked-thread wreck on iteration 2.
+static int stress_reactor_destroy_hammer() {
+  const int64_t kBody = 512 * 1024;
+  uint8_t* body = static_cast<uint8_t*>(tb_alloc_aligned(kBody, 4096));
+  if (!body) return 1;
+  tb_fill_random(body, kBody, 123);
+  int port = 0;
+  void* srv = tb_srv_start(body, kBody, "{\"size\": \"524288\"}", &port);
+  if (!srv) {
+    tb_free_aligned(body);
+    return 2;
+  }
+  const char* media = "/storage/v1/b/b/o/x?alt=media";
+  int bad = 0;
+  for (int it = 0; it < 12; it++) {
+    int64_t pool = tb_pool_create2(4, 16, 0, "", 0, 1 | ((it % 2 + 1) << 8));
+    if (!pool) {
+      bad++;
+      continue;
+    }
+    for (int i = 0; i < 8; i++)
+      tb_pool_submit(pool, "127.0.0.1", port, media, "", nullptr, 0, i);
+    // Vary how much settles before the teardown races the in-flight
+    // wakes: drain nothing / one / a small batch.
+    if (it % 3 == 1) {
+      uint64_t tag;
+      int64_t result, fb, total, start;
+      int status;
+      tb_pool_next(pool, 50, &tag, &result, &status, &fb, &total, &start);
+    } else if (it % 3 == 2) {
+      uint64_t tags[4];
+      int64_t results[4], fbs[4], totals[4], starts2[4];
+      int statuses[4];
+      tb_pool_ring_next_batch(pool, 50, 4, tags, results, statuses, fbs,
+                              totals, starts2);
+    }
+    if (tb_pool_destroy(pool) != 0) bad++;
+  }
+  int leaked = tb_srv_stop(srv);
+  if (!leaked) tb_free_aligned(body);  // leak contract: keep body pinned
+  return bad ? 40 : 0;
+}
+
 int main(int argc, char** argv) {
   if (argc < 2) {
     std::fprintf(stderr, "usage: %s <scratch-dir>\n", argv[0]);
@@ -362,6 +684,12 @@ int main(int argc, char** argv) {
   if (prc) { std::fprintf(stderr, "fetch-pool stress failed rc=%d\n", prc); return 1; }
   int src = stress_srv_and_discard();
   if (src) { std::fprintf(stderr, "srv/discard stress failed rc=%d\n", src); return 1; }
+  int rrc = stress_reactor();
+  if (rrc) { std::fprintf(stderr, "reactor stress failed rc=%d\n", rrc); return 1; }
+  int crc = stress_reactor_stale_churn();
+  if (crc) { std::fprintf(stderr, "reactor stale-churn stress failed rc=%d\n", crc); return 1; }
+  int hrc = stress_reactor_destroy_hammer();
+  if (hrc) { std::fprintf(stderr, "reactor destroy hammer failed rc=%d\n", hrc); return 1; }
   std::puts("stress ok");
   return 0;
 }

@@ -400,6 +400,38 @@ LABELED_COUNTER_METRICS = {
     ),
 }
 
+# The native tb_stats_* counter catalog — the `counter=` label values of
+# tpubench_native_transport_total, pinned here so the three surfaces that
+# carry them (engine.py stats() keys, this catalog, the README native
+# counter table) cannot drift apart silently (the drift guard in
+# tests/test_telemetry.py walks all three). Adding a counter to
+# engine.cc's tb_stats enum REQUIRES a row here and in the README.
+NATIVE_TRANSPORT_COUNTERS = {
+    "bytes_tx": "payload bytes handed to send/SSL_write",
+    "bytes_rx": "payload bytes returned by recv/SSL_read",
+    "recv_wait_ns": "wall time blocked inside recv/SSL_read",
+    "connects": "TCP connects (tb_http_connect + reactor sockets)",
+    "tls_handshakes": "completed TLS handshakes",
+    "conn_closes": "connection handles closed",
+    "h2_frames_rx": "h2 frames consumed by the poll loop",
+    "h2_data_bytes_rx": "h2 DATA frame payload bytes (incl. padding)",
+    "h2_window_updates_tx": "h2 flow-control credit frames sent",
+    "h2_streams_opened": "h2 streams submitted (gRPC + raw GET)",
+    "h2_rst_rx": "RST_STREAM frames received",
+    "h2_goaway_rx": "GOAWAY frames received",
+    "pool_wakes": "executor consumer wakes returning >=1 completion",
+    "pool_completions": "executor completions across all wakes",
+    "pool_batched_wakes": "wakes that drained >1 completion in one handoff",
+    "reactor_loops": "reactor epoll_wait iterations",
+    "reactor_epoll_events": "epoll events delivered to the reactor",
+    "reactor_completions": "completions enqueued to reactor SPSC rings",
+    "reactor_doorbell_wakes":
+        "eventfd doorbells rung (coalesced: batch threshold or loop edge)",
+    "reactor_ring_depth_sum":
+        "ring depth observed at each enqueue, summed (mean = sum/completions)",
+    "reactor_ring_depth_max": "max reactor ring depth observed",
+}
+
 GAUGE_METRICS = {
     "tpubench_up": "1 while the telemetry session is live",
     "tpubench_run_seconds": "wall seconds since the session started",

@@ -4,6 +4,16 @@ client-level retry policy (``main.go:179-184``) applied to completions and,
 in staged mode, the flagship GCS→HBM pipeline fed directly from the
 executor.
 
+Executor dispatch shape (``--fetch-executor``): ``native`` runs the epoll
+REACTOR (one event loop owning all connections, completions over lock-free
+SPSC rings — the post-BENCH_r05 default; that bench measured the legacy
+thread-per-connection pool LOSING to the Python hot loop because every
+completion paid a mutex/condvar crossing); ``native-threads`` pins the
+legacy pool (still the TLS path and the A/B comparator);
+``native-reactor`` pins the reactor explicitly. The runnable-queue
+admission cap, the live tune knobs (``workers`` actuation) and the retry
+scheduler are pool-shape-agnostic and survive either dispatch.
+
 Two runners:
 
 * :func:`run_read_native_executor` — staging "none": measures pure fetch
@@ -172,7 +182,31 @@ def _require_native_http(cfg: BenchConfig, backend: StorageBackend):
     return engine, inner
 
 
-def _make_pool(engine, inner, threads: int, cap: int):
+def executor_mode(fetch_executor: str) -> str:
+    """Requested pool dispatch shape for a ``fetch_executor`` config value:
+    "native" prefers the reactor (the post-BENCH_r05 default — the epoll
+    loop + SPSC-ring handoff), "native-reactor"/"native-threads" pin it
+    explicitly. What actually engaged is ``NativeFetchPool.mode`` (TLS
+    endpoints and stale .so builds fall back to the thread pool)."""
+    return "threads" if fetch_executor == "native-threads" else "reactor"
+
+
+def _reactor_loops() -> int:
+    """Event-loop thread count for reactor pools: one loop per ~2 usable
+    cores, capped small — on the share-capped 1-core hosts BENCH_r05 ran
+    on, ONE loop (plus the draining consumer) is exactly the shape that
+    beats 48 pthreads fighting over the core."""
+    import os
+
+    cores = (
+        len(os.sched_getaffinity(0))
+        if hasattr(os, "sched_getaffinity")
+        else os.cpu_count() or 1
+    )
+    return max(1, min(4, cores // 2))
+
+
+def _make_pool(engine, inner, threads: int, cap: int, mode: str = "reactor"):
     """Executor pool matching the backend's endpoint transport."""
     t = inner.transport
     return engine.pool_create(
@@ -181,6 +215,8 @@ def _make_pool(engine, inner, threads: int, cap: int):
         tls=inner.scheme == "https",
         cafile=t.tls_ca_file,
         insecure=t.tls_insecure_skip_verify,
+        mode=mode,
+        loops=_reactor_loops(),
     )
 
 
@@ -191,6 +227,22 @@ def _stamp_native_delta(res: RunResult, engine, stats0: dict) -> None:
     delta = {k: v - stats0.get(k, 0) for k, v in engine.stats().items()}
     if any(delta.values()):
         res.extra["native_transport"] = delta
+
+
+def _wake_batch_stats(batches: list) -> Optional[dict]:
+    """Per-wake completion batch sizes → the distribution the reactor
+    acceptance gates on (completions-per-wake p50 > 8 at high fan-out vs
+    ~1 on the legacy per-completion handoff)."""
+    import statistics
+
+    if not batches:
+        return None
+    return {
+        "wakes": len(batches),
+        "p50": statistics.median(batches),
+        "max": max(batches),
+        "mean": round(sum(batches) / len(batches), 3),
+    }
 
 
 def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunResult:
@@ -221,14 +273,16 @@ def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunRe
     total_reads = w.workers * reads_per
     if total_reads <= 0:
         res = RunResult(workload="read", config=cfg.to_dict(), summaries={})
-        res.extra["fetch_executor"] = "native"
+        res.extra["fetch_executor"] = w.fetch_executor
         return res
-    pool = _make_pool(engine, inner, w.workers, max(4, 2 * w.workers))
+    pool = _make_pool(engine, inner, w.workers, max(4, 2 * w.workers),
+                      mode=executor_mode(w.fetch_executor))
     native_stats0 = engine.stats()
     retry = RetryScheduler(cfg.transport.retry)
     bytes_total = 0
     errors = 0
     first_error = ""
+    wake_batches: list = []
 
     # Discard mode (NULL buffer): pool workers stream each body through a
     # per-thread hot granule-sized scratch and drop it — exact io.Discard
@@ -375,6 +429,7 @@ def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunRe
                     raise RuntimeError("native fetch executor stalled (120s)")
                 continue
             last_completion = time.monotonic()
+            wake_batches.append(len(cs))
             for c in cs:
                 handle(c)
     finally:
@@ -399,8 +454,12 @@ def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunRe
         summaries=metrics.summaries(),
         errors=errors,
     )
-    res.extra["fetch_executor"] = "native"
+    res.extra["fetch_executor"] = w.fetch_executor
+    res.extra["executor_mode"] = pool.mode
     res.extra["executor_threads"] = w.workers
+    bs = _wake_batch_stats(wake_batches)
+    if bs is not None:
+        res.extra["completions_per_wake"] = bs
     _stamp_native_delta(res, engine, native_stats0)
     res.extra["client_retry"] = (
         f"gax policy over completions (policy={cfg.transport.retry.policy}, "
@@ -538,7 +597,7 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
     recorders = [metrics.new_worker(f"w{i}") for i in range(w.workers)]
     if total_reads <= 0 or sum(sizes) == 0:
         res = RunResult(workload="read", config=cfg.to_dict(), summaries={})
-        res.extra["fetch_executor"] = "native"
+        res.extra["fetch_executor"] = w.fetch_executor
         return res
 
     devices = jax.local_devices()
@@ -573,9 +632,11 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
             completed_upfront += reads_per
         ws.append(st)
 
-    pool = _make_pool(engine, inner, w.workers, max(8, 2 * w.workers * depth))
+    pool = _make_pool(engine, inner, w.workers, max(8, 2 * w.workers * depth),
+                      mode=executor_mode(w.fetch_executor))
     native_stats0 = engine.stats()
     retry = RetryScheduler(cfg.transport.retry)
+    wake_batches: list = []
     inflight: dict[int, tuple] = {}  # tag -> (wid, slot, start, length)
     # PER-WORKER transfer FIFOs: completion order is FIFO per device, not
     # globally (workers round-robin across devices) — one global queue
@@ -764,6 +825,7 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
             if not cs:
                 continue
             last_progress = time.monotonic()
+            wake_batches.append(len(cs))
             for c in cs:
                 _handle_staged_completion(c)
         # All fetches done; drain remaining transfers into the timed window
@@ -809,8 +871,12 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
         summaries=metrics.summaries(),
         errors=errors,
     )
-    res.extra["fetch_executor"] = "native"
+    res.extra["fetch_executor"] = w.fetch_executor
+    res.extra["executor_mode"] = pool.mode
     res.extra["executor_threads"] = w.workers
+    bs = _wake_batch_stats(wake_batches)
+    if bs is not None:
+        res.extra["completions_per_wake"] = bs
     _stamp_native_delta(res, engine, native_stats0)
     res.extra["staging_zero_copy"] = True
     res.extra["staged_bytes"] = staged

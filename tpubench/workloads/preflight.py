@@ -187,7 +187,7 @@ def _engine_check(cfg: BenchConfig) -> dict:
     need = (
         cfg.transport.native_receive
         or cfg.transport.http2
-        or cfg.workload.fetch_executor == "native"
+        or cfg.workload.fetch_executor.startswith("native")
     )
     err = ""
     try:

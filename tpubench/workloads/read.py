@@ -482,7 +482,7 @@ def run_read(
     # the workload's ReadObject spans (OC-bridge analog).
     backend = backend or open_backend(cfg, tracer=tracer)
     try:
-        if cfg.workload.fetch_executor == "native":
+        if cfg.workload.fetch_executor.startswith("native"):
             from tpubench.workloads.fetch_executor import (
                 run_read_native_executor,
                 run_read_native_staged,
