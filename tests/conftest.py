@@ -119,6 +119,15 @@ def pytest_configure(config):
         "markers", "reactor: epoll-mode native executor "
                    "(event loop/rings/doorbell)"
     )
+    # Analysis tests (the invariant-analysis plane: `tpubench check`
+    # passes, allowlist policy, drift registry, lock-order graph) stay
+    # in tier-1 — the tree-is-clean gate is the whole point: a new
+    # lifecycle/hygiene/bounds/drift violation fails CI, not review.
+    # The marker exists for selective runs (`-m analysis`).
+    config.addinivalue_line(
+        "markers", "analysis: invariant-analysis plane "
+                   "(tpubench check / drift registry / lock graph)"
+    )
     # Multihost tests are marker-gated (see tests/test_multihost.py):
     # they need working multi-process jax.distributed, which this
     # container lacks — tier-1 collects clean skips, not failures.

@@ -1,0 +1,665 @@
+"""Invariant-analysis plane (`tpubench check`): per-pass fixtures
+(violating + clean + allowlisted), the --json schema contract, the
+exit-code contract, lock-graph cycle detection on a synthetic cycle,
+allowlist policy (justifications required, stale entries rejected) —
+and the tier-1 gate: the real tree runs clean."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpubench.analysis import (
+    CheckConfigError,
+    DriftSkip,
+    SCHEMA,
+    SourceFile,
+    load_allowlist,
+    run_check,
+    run_drift_guard,
+)
+from tpubench.analysis.determinism import DETERMINISM_PASS
+from tpubench.analysis.lifecycle import FLIGHT_PASS, RESOURCE_PASS
+from tpubench.analysis.lockorder import (
+    LOCK_ORDER_PASS,
+    build_lock_graph,
+    find_cycles,
+)
+from tpubench.analysis.threads import THREAD_PASS
+
+pytestmark = pytest.mark.analysis
+
+
+def _sf(path: str, src: str) -> SourceFile:
+    return SourceFile.parse(path, src)
+
+
+def _codes(findings) -> list[str]:
+    return [f.code for f in findings]
+
+
+# ------------------------------------------------------ flight-op pass ---
+
+def test_flight_op_leak_and_clean_variants():
+    leak = _sf("a.py", """
+def f(wf):
+    op = wf.begin("obj", "t")
+    do_work()
+""")
+    assert _codes(FLIGHT_PASS.run([leak])) == ["op-leak:op"]
+
+    # Error path: an except handler that re-raises without finishing
+    # leaks the op on the unwind (the ring never gets the record).
+    errpath = _sf("b.py", """
+def f(wf):
+    op = wf.begin("obj", "t")
+    try:
+        work()
+    except Exception:
+        raise
+    op.finish(1)
+""")
+    assert _codes(FLIGHT_PASS.run([errpath])) == ["op-error-path:op"]
+
+    clean = _sf("c.py", """
+def f(wf):
+    op = wf.begin("obj", "t")
+    try:
+        work()
+    except Exception as e:
+        op.finish(error=e)
+        raise
+    op.finish(10)
+
+def g(wf):
+    with wf.begin("obj", "t") as op:
+        work()
+
+def h(wf):
+    op = wf.begin("obj", "t")
+    if claimed():
+        op.abandon()
+        return
+    op.finish(1)
+""")
+    assert FLIGHT_PASS.run([clean]) == []
+
+    dropped = _sf("d.py", """
+def f(wf):
+    wf.begin("obj", "t")
+""")
+    assert _codes(FLIGHT_PASS.run([dropped])) == ["op-dropped"]
+
+
+def test_flight_op_conditional_close_shapes():
+    """The happy-path-only leak class: a close guarded by a condition
+    unrelated to the handle, or reachable only in an error handler,
+    fires; None-guards, both-branch closes, and acquire-and-close
+    inside one shared guard stay clean."""
+    leak = _sf("cc.py", """
+def f(wf, ok):
+    op = wf.begin("o", "t")
+    if ok:
+        op.finish(1)
+""")
+    assert _codes(FLIGHT_PASS.run([leak])) == ["op-conditional-close:op"]
+
+    handler_only = _sf("cc2.py", """
+def f(wf):
+    op = wf.begin("o", "t")
+    try:
+        work()
+    except Exception as e:
+        op.finish(error=e)
+        raise
+""")
+    assert _codes(FLIGHT_PASS.run([handler_only])) == [
+        "op-conditional-close:op"
+    ]
+
+    clean = _sf("cc3.py", """
+def none_guard(wf):
+    op = wf.begin("o", "t") if active() else None
+    work()
+    if op is not None:
+        op.finish(1)
+
+def both_branches(wf):
+    op = wf.begin("o", "t")
+    if claimed():
+        op.abandon()
+    else:
+        op.finish(1)
+
+def shared_guard(self):
+    if self._flight is not None:
+        op = self._flight.begin("s", "d")
+        op.finish(3)
+
+def loop_pair(wf, keys):
+    for k in keys:
+        op = wf.begin(k, "t")
+        op.finish(1)
+""")
+    assert FLIGHT_PASS.run([clean]) == []
+
+
+def test_flight_op_annotated_and_walrus_bindings():
+    """A type annotation or walrus binding must not hide a leak."""
+    ann = _sf("ab.py", """
+def f(wf):
+    op: FlightOp = wf.begin("o", "t")
+""")
+    assert _codes(FLIGHT_PASS.run([ann])) == ["op-leak:op"]
+
+    walrus = _sf("wb.py", """
+def f(wf):
+    if (op := wf.begin("o", "t")):
+        op.finish(1)
+""")
+    assert FLIGHT_PASS.run([walrus]) == []
+
+
+def test_flight_op_escape_transfers_obligation():
+    # Handing the op to a queue/callee transfers the close obligation.
+    escape = _sf("e.py", """
+def f(wf, q):
+    op = wf.begin("obj", "t")
+    q.put((3, op))
+""")
+    assert FLIGHT_PASS.run([escape]) == []
+
+
+def test_flight_stamp_without_adopt_in_thread_target():
+    bad = _sf("t.py", """
+import threading
+from tpubench.obs import flight as _flight
+
+def spawn():
+    def helper():
+        _flight.note_phase("first_byte")
+    threading.Thread(target=helper, name="h").start()
+""")
+    assert "stamp-without-adopt" in _codes(FLIGHT_PASS.run([bad]))
+
+    good = _sf("t2.py", """
+import threading
+from tpubench.obs import flight as _flight
+
+def spawn(op):
+    def helper():
+        _flight.adopt_op(op)
+        _flight.note_phase("first_byte")
+    threading.Thread(target=helper, name="h").start()
+""")
+    assert FLIGHT_PASS.run([good]) == []
+
+
+# ------------------------------------------------------- resource pass ---
+
+def test_lease_lifecycle_fixtures():
+    leak = _sf("l.py", """
+def f(pool):
+    lease = pool.lease(10)
+    fill(lease.view())
+""")
+    assert _codes(RESOURCE_PASS.run([leak])) == ["lease-leak:lease"]
+
+    # The canonical fetch_chunk shape: release-on-error then ownership
+    # escapes to the caller/cache.
+    clean = _sf("l2.py", """
+def f(pool, cache, key):
+    lease = pool.lease(10)
+    try:
+        fill(lease.view())
+    except BaseException:
+        lease.release()
+        raise
+    cache.put(key, lease)
+
+def g(pool):
+    lease = pool.lease(10)
+    try:
+        fill(lease.view())
+    finally:
+        lease.release()
+""")
+    assert RESOURCE_PASS.run([clean]) == []
+
+    # A derived value (lease.view()) is NOT an ownership escape.
+    derived = _sf("l3.py", """
+def f(pool):
+    lease = pool.lease(10)
+    stream_into(lease.view())
+""")
+    assert _codes(RESOURCE_PASS.run([derived])) == ["lease-leak:lease"]
+
+
+# --------------------------------------------------------- thread pass ---
+
+def test_thread_hygiene_fixtures():
+    bad = _sf("th.py", """
+import threading
+
+def f():
+    threading.Thread(target=f, daemon=True).start()
+
+def g():
+    try:
+        work()
+    except BaseException:
+        pass
+
+def h():
+    try:
+        work()
+    except:
+        log()
+""")
+    codes = _codes(THREAD_PASS.run([bad]))
+    assert codes.count("baseexception-swallow") == 2
+    assert codes.count("unnamed-thread") == 1
+
+    # Aliased imports must not hide an unnamed thread from the gate.
+    aliased = _sf("th3.py", """
+import threading as _threading
+
+def f():
+    _threading.Thread(target=f, daemon=True).start()
+""")
+    assert _codes(THREAD_PASS.run([aliased])) == ["unnamed-thread"]
+
+    # A raise inside a nested def registered as a callback is NOT a
+    # re-raise on the handler's unwind path.
+    nested = _sf("th4.py", """
+def f(register):
+    try:
+        work()
+    except BaseException:
+        def cb():
+            raise ValueError()
+        register(cb)
+""")
+    assert _codes(THREAD_PASS.run([nested])) == ["baseexception-swallow"]
+
+    clean = _sf("th2.py", """
+import threading
+
+def f():
+    threading.Thread(target=f, name="worker-0", daemon=True).start()
+
+def g(lease):
+    try:
+        work()
+    except BaseException:
+        lease.release()
+        raise
+
+def h():
+    try:
+        work()
+    except Exception as e:
+        record(e)
+""")
+    assert THREAD_PASS.run([clean]) == []
+
+
+# ---------------------------------------------------- determinism pass ---
+
+def test_determinism_clock_and_rng_fixtures():
+    # Only designated controller/sampler modules are checked.
+    bad = _sf("tpubench/serve/qos.py", """
+import time, random
+
+def decide():
+    return time.monotonic() + random.random()
+""")
+    codes = _codes(DETERMINISM_PASS.run([bad]))
+    assert "naked-clock:time.monotonic" in codes
+    assert "naked-rng:random.random" in codes
+
+    elsewhere = _sf("tpubench/workloads/read.py", """
+import time
+
+def run():
+    return time.time()
+""")
+    assert DETERMINISM_PASS.run([elsewhere]) == []
+
+    seeded = _sf("tpubench/workloads/arrivals.py", """
+import random
+import numpy as np
+
+def make(seed):
+    return random.Random(seed), np.random.Generator(np.random.Philox(seed))
+
+def make_kw(seed):
+    return np.random.default_rng(seed=seed)
+""")
+    assert DETERMINISM_PASS.run([seeded]) == []
+
+
+def test_determinism_bounds_fixtures():
+    bad = _sf("tpubench/obs/widget.py", """
+from collections import deque
+
+class Sampler:
+    def __init__(self):
+        self.samples = []
+        self.q = deque()
+
+    def observe(self, v):
+        self.samples.append(v)
+""")
+    codes = _codes(DETERMINISM_PASS.run([bad]))
+    assert "unbounded-deque" in codes
+    assert "unbounded-accumulator:samples" in codes
+
+    clean = _sf("tpubench/obs/widget2.py", """
+from collections import deque
+
+CAP = 512
+
+class Sampler:
+    def __init__(self):
+        self.samples = []
+        self.q = deque(maxlen=64)
+
+    def observe(self, v):
+        self.samples.append(v)
+        if len(self.samples) >= CAP:
+            del self.samples[::2]
+""")
+    assert DETERMINISM_PASS.run([clean]) == []
+
+    # Two uncapped deques in one file get DISTINCT keys (vetting one
+    # must never suppress the other)...
+    two = _sf("tpubench/obs/widget3.py", """
+from collections import deque
+
+class A:
+    def __init__(self):
+        self.q = deque()
+
+class B:
+    def __init__(self):
+        self.q = deque()
+""")
+    keys = {f.key for f in DETERMINISM_PASS.run([two])}
+    assert len(keys) == 2
+    # ...and a branchy __init__ is still only initialization, not a
+    # trim/reset path (re-assignment evidence must be OUTSIDE __init__).
+    branchy = _sf("tpubench/obs/widget4.py", """
+class S:
+    def __init__(self, big):
+        if big:
+            self.samples = []
+        else:
+            self.samples = [0]
+
+    def observe(self, v):
+        self.samples.append(v)
+""")
+    assert _codes(DETERMINISM_PASS.run([branchy])) == [
+        "unbounded-accumulator:samples"
+    ]
+
+
+# ----------------------------------------------------- lock-order pass ---
+
+_CYCLE_SRC = """
+import threading
+
+class Cache:
+    def __init__(self, coop: "Coop"):
+        self._lock = threading.Lock()
+        self.coop = coop
+
+    def get(self):
+        with self._lock:
+            self.coop.serve()
+
+class Coop:
+    def __init__(self, cache: Cache):
+        self._lock = threading.Lock()
+        self.cache = cache
+
+    def serve(self):
+        with self._lock:
+            self.cache.get()
+"""
+
+
+def test_lock_graph_cycle_detection_synthetic():
+    sf = _sf("tpubench/pipeline/cache.py", _CYCLE_SRC)
+    findings = LOCK_ORDER_PASS.run([sf])
+    cycles = [f for f in findings if f.code.startswith("cycle:")]
+    assert len(cycles) == 1
+    assert "Cache._lock" in cycles[0].message
+    assert "Coop._lock" in cycles[0].message
+    # The mutual recursion ALSO re-acquires each plain Lock while held
+    # (transitively through the other class) — both self-deadlocks are
+    # reported alongside the ordering cycle.
+    assert {f.code for f in findings if f.code.startswith("self-")} == {
+        "self-deadlock:Cache._lock", "self-deadlock:Coop._lock",
+    }
+
+    g = build_lock_graph([sf])
+    assert g.edges["Cache._lock"] == {"Coop._lock"}
+    assert g.edges["Coop._lock"] == {"Cache._lock"}
+    assert len(find_cycles(g)) == 1
+
+
+def test_lock_graph_multi_item_with_and_context_expr_calls():
+    """`with self._a, self.helper():` — the helper call runs while _a
+    is already held, so a lock it (transitively) takes is an
+    acquired-while-held edge."""
+    sf = _sf("tpubench/pipeline/cache.py", """
+import threading
+
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def helper(self):
+        with self._b:
+            pass
+        return open("/dev/null")
+
+    def m(self):
+        with self._a, self.helper():
+            pass
+""")
+    g = build_lock_graph([sf])
+    assert g.edges.get("C._a") == {"C._b"}
+
+
+def test_lock_graph_self_deadlock_on_nonreentrant_lock():
+    """Re-acquiring a plain threading.Lock while held (here through a
+    callee) deadlocks unconditionally — flagged; the same shape on an
+    RLock is legal re-entrancy."""
+    plain = _sf("tpubench/pipeline/cache.py", """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def helper(self):
+        with self._lock:
+            pass
+
+    def m(self):
+        with self._lock:
+            self.helper()
+""")
+    assert _codes(LOCK_ORDER_PASS.run([plain])) == ["self-deadlock:C._lock"]
+    assert LOCK_ORDER_PASS.run([
+        _sf("tpubench/pipeline/cache.py",
+            plain.text.replace("threading.Lock()", "threading.RLock()"))
+    ]) == []
+
+
+def test_lock_graph_condition_aliases_and_nesting():
+    sf = _sf("tpubench/staging/executor.py", """
+import threading
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._other = threading.Lock()
+
+    def a(self):
+        with self._cond:
+            with self._other:
+                pass
+
+    def b(self):
+        with self._other:
+            pass
+""")
+    g = build_lock_graph([sf])
+    # Condition(self._lock) aliases _lock; consistent one-way nesting
+    # is an edge, not a cycle.
+    assert g.edges == {"W._lock": {"W._other"}}
+    assert find_cycles(g) == []
+    assert LOCK_ORDER_PASS.run([sf]) == []
+
+
+# ------------------------------------------------------ drift registry ---
+
+def test_drift_registry_guards_run_clean():
+    for name in ("metrics", "spans", "tune-knobs"):
+        assert run_drift_guard(name) == [], name
+    try:
+        assert run_drift_guard("native-counters") == []
+    except DriftSkip as e:
+        pytest.skip(str(e))
+
+
+def test_drift_guard_unknown_name_raises():
+    with pytest.raises(KeyError):
+        run_drift_guard("nonsense")
+
+
+# -------------------------------------------- allowlist & exit contract ---
+
+def test_allowlist_requires_justification(tmp_path):
+    p = tmp_path / "al.json"
+    p.write_text(json.dumps({
+        "schema": "tpubench-check-allowlist/1",
+        "entries": [{"key": "thread:x.py:f:baseexception-swallow",
+                     "justification": ""}],
+    }))
+    with pytest.raises(CheckConfigError, match="justification"):
+        load_allowlist(str(p))
+    p.write_text(json.dumps({"schema": "nope", "entries": []}))
+    with pytest.raises(CheckConfigError, match="schema"):
+        load_allowlist(str(p))
+    # A typo'd EXPLICIT allowlist path is a config error (exit 2), not
+    # "every vetting suddenly surfaces as findings" (exit 1).
+    with pytest.raises(CheckConfigError, match="not found"):
+        load_allowlist(str(tmp_path / "no-such-allowlist.json"))
+
+
+def test_allowlisted_finding_suppresses_and_stale_entry_fails():
+    bad = _sf("x.py", """
+def f():
+    try:
+        work()
+    except BaseException:
+        pass
+""")
+    key = "thread:x.py:f:baseexception-swallow"
+    rep = run_check(files=[bad], allowlist={key: "vetted: test"},
+                    with_drift=False)
+    assert rep.clean and rep.exit_code == 0
+    assert [f.key for f in rep.suppressed] == [key]
+
+    rep = run_check(files=[bad], allowlist={}, with_drift=False)
+    assert not rep.clean and rep.exit_code == 1
+
+    # A stale entry (its file was scanned, nothing matched) is itself
+    # a failure: the allowlist can only shrink back, never rot.
+    rep = run_check(files=[_sf("x.py", "x = 1\n")],
+                    allowlist={key: "vetted: test"}, with_drift=False)
+    assert rep.stale_allowlist == [key]
+    assert rep.exit_code == 1
+
+    # But a path-restricted run (pre-commit over changed files) must
+    # NOT declare out-of-scope entries stale: scanning only y.py says
+    # nothing about the x.py entry.
+    rep = run_check(files=[_sf("y.py", "x = 1\n")],
+                    allowlist={key: "vetted: test"}, with_drift=False)
+    assert rep.stale_allowlist == [] and rep.clean
+
+    # Same for the PASS dimension: a --no-drift run must not declare a
+    # drift-pass vetting stale just because its file was scanned.
+    drift_key = "drift:x.py:metrics:drift:metrics"
+    rep = run_check(files=[_sf("x.py", "x = 1\n")],
+                    allowlist={drift_key: "vetted: test"},
+                    with_drift=False)
+    assert rep.stale_allowlist == [] and rep.clean
+
+
+def test_json_schema_stability():
+    bad = _sf("x.py", """
+def f():
+    try:
+        work()
+    except BaseException:
+        pass
+""")
+    doc = run_check(files=[bad], allowlist={}, with_drift=False).to_dict()
+    assert doc["schema"] == SCHEMA == "tpubench-check/1"
+    assert set(doc) == {"schema", "passes", "files_scanned", "findings",
+                        "stale_allowlist", "skipped", "summary"}
+    (f,) = doc["findings"]
+    assert set(f) == {"pass", "path", "line", "symbol", "code",
+                      "message", "key", "allowlisted"}
+    assert f["allowlisted"] is False
+    assert doc["summary"] == {
+        "findings": 1, "allowlisted": 0, "stale_allowlist": 0,
+        "clean": False,
+    }
+    assert doc["passes"] == [
+        "flight-op", "thread", "resource", "determinism", "lock-order",
+    ]
+
+
+# ------------------------------------------------------ the tier-1 gate ---
+
+def test_tree_is_clean_under_tpubench_check():
+    """THE gate: the whole tree passes every static pass and every
+    drift guard, modulo the vetted allowlist — and every allowlist
+    entry still matches a real finding. A new violation anywhere in
+    tpubench/ fails tier-1 here, not in review."""
+    rep = run_check()
+    assert rep.clean, "\n" + rep.render()
+    # Allowlist hygiene rides along: every entry carries a reason.
+    for key, just in rep.allowlist.items():
+        assert just.strip(), key
+
+
+def test_check_counts_real_violation_classes():
+    """Regression teeth: the passes that justified this plane still
+    fire on the exact shapes the reviews kept catching (so a refactor
+    of the analyzer cannot silently lobotomize it)."""
+    shapes = {
+        "op-leak:op": "def f(wf):\n    op = wf.begin('o', 't')\n",
+        "lease-leak:lease":
+            "def f(pool):\n    lease = pool.lease(1)\n    use(lease.view())\n",
+        "baseexception-swallow":
+            "def f():\n    try:\n        w()\n"
+            "    except BaseException:\n        pass\n",
+        "unnamed-thread":
+            "import threading\n"
+            "def f():\n    threading.Thread(target=f).start()\n",
+    }
+    for code, src in shapes.items():
+        rep = run_check(files=[_sf("fixture.py", src)], allowlist={},
+                        with_drift=False)
+        assert code in [f.code for f in rep.findings], code

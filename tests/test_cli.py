@@ -28,6 +28,51 @@ def test_cli_read_smoke(tmp_path, capsys):
     assert data["workload"] == "read" and data["errors"] == 0
 
 
+def test_cli_check_smoke(capsys):
+    """`tpubench check` over the real tree: exits 0, human summary,
+    and the --json schema contract (the CI invocation surface)."""
+    rc = main(["check", "--no-drift"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "tpubench check: 0 findings" in out
+
+    rc = main(["check", "--no-drift", "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema"] == "tpubench-check/1"
+    assert doc["summary"]["clean"] is True
+    assert doc["summary"]["findings"] == 0
+    assert doc["passes"] == [
+        "flight-op", "thread", "resource", "determinism", "lock-order",
+    ]
+
+
+def test_cli_check_finds_violations_and_exits_nonzero(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def f():\n    try:\n        w()\n"
+        "    except BaseException:\n        pass\n"
+    )
+    empty = tmp_path / "al.json"
+    empty.write_text(json.dumps(
+        {"schema": "tpubench-check-allowlist/1", "entries": []}
+    ))
+    rc = main(["check", "--no-drift", "--allowlist", str(empty), str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "baseexception-swallow" in out
+
+    # Analyzer misconfiguration (justification-less allowlist) is exit
+    # 2, distinct from findings.
+    lawless = tmp_path / "al2.json"
+    lawless.write_text(json.dumps({
+        "schema": "tpubench-check-allowlist/1",
+        "entries": [{"key": "k", "justification": ""}],
+    }))
+    rc = main(["check", "--no-drift", "--allowlist", str(lawless)])
+    assert rc == 2
+
+
 def test_cli_fs_workloads(tmp_path, capsys):
     d = tmp_path / "data"
     rc = main(

@@ -162,6 +162,18 @@ def test_admission_queue_priority_order_and_fifo_baseline():
     fifo.close()
 
 
+def test_admission_queue_pop_timeout_with_stalled_virtual_clock():
+    """pop(timeout=) must terminate even when the injected clock_ns
+    never advances (the virtual-time test/replay scenario): the wait
+    budget runs on the virtual clock, but a real-time wait expiry with
+    zero virtual progress honors the timeout instead of spinning."""
+    q = AdmissionQueue(cap=1, qos=True, clock_ns=lambda: 0)
+    t0 = time.monotonic()
+    assert q.pop(timeout=0.1) is None
+    assert time.monotonic() - t0 < 5.0  # returned, didn't spin forever
+    q.close()
+
+
 def test_admission_queue_cap_blocks_and_live_grows():
     t = _tenant()
     q = AdmissionQueue(cap=1, qos=True)

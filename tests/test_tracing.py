@@ -248,3 +248,23 @@ def test_failed_grpc_stream_closes_span_with_error():
         c.close()
     spans = [s for s in tracer.spans if s.name == "gcs_grpc.read_object"]
     assert len(spans) == 1 and spans[0].end_ns > 0
+
+
+def test_recording_tracer_span_cap_and_drop_warning():
+    """The EXACT_SAMPLE_CAP discipline (enforced tree-wide by `tpubench
+    check`): the in-process span buffer is bounded, keeps the run's
+    FIRST spans, counts the cut, and shutdown() refuses to let a
+    truncated set look complete."""
+    tr = RecordingTracer(sample_rate=1.0, max_spans=2)
+    for i in range(4):
+        with tr.span(f"s{i}"):
+            pass
+    assert [s.name for s in tr.spans] == ["s0", "s1"]  # keep-first
+    assert tr.dropped_spans == 2
+    with pytest.warns(UserWarning, match="dropped 2 spans"):
+        tr.shutdown()
+    # Under the cap: no spurious warning at shutdown.
+    quiet = RecordingTracer(sample_rate=1.0)
+    with quiet.span("only"):
+        pass
+    quiet.shutdown()

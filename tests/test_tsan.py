@@ -1,6 +1,13 @@
-"""ThreadSanitizer hygiene for the native engine (SURVEY §4 prescription:
-the reference shipped a real latency-slice data race, ssd_test/main.go:80;
-the engine's per-thread-array contract is verified under TSAN here)."""
+"""Sanitizer matrix for the native engine (SURVEY §4 prescription: the
+reference shipped a real latency-slice data race, ssd_test/main.go:80).
+
+One stress binary (engine.cc + stress.cc: per-thread arrays, fetch
+pool, srv/discard, reactor exactly-once, stale churn, destroy hammer)
+built three ways — TSAN (races), ASAN with leak checking (heap errors;
+the destroy-hammer phase is where an engine-teardown leak would hide),
+UBSAN non-recovering (UB traps) — via the matrix in
+``tpubench.native.build``. A compiler lacking a sanitizer runtime
+skips that cell; a finding in any cell is a hard failure."""
 
 import os
 import shutil
@@ -8,38 +15,39 @@ import subprocess
 
 import pytest
 
-HERE = os.path.dirname(__file__)
-NATIVE = os.path.join(HERE, "..", "tpubench", "native")
+from tpubench.native.build import (
+    SANITIZER_FINDING_MARKERS,
+    SANITIZERS,
+    SanitizerUnavailable,
+    build_stress,
+    sanitizer_env,
+)
 
 
 @pytest.mark.slow
-def test_engine_clean_under_tsan(tmp_path):
-    gxx = shutil.which("g++")
-    if not gxx:
+@pytest.mark.parametrize("sanitizer", sorted(SANITIZERS))
+def test_engine_clean_under_sanitizer(tmp_path, sanitizer):
+    if not shutil.which("g++"):
         pytest.skip("g++ unavailable")
-    binary = str(tmp_path / "stress_tsan")
-    compile_cmd = [
-        gxx, "-O1", "-g", "-fsanitize=thread", "-std=c++17",
-        os.path.join(NATIVE, "engine.cc"),
-        os.path.join(NATIVE, "stress.cc"),
-        # -ldl matches build.py: engine.cc dlopens OpenSSL at first use.
-        "-o", binary, "-lpthread", "-ldl",
-    ]
-    cp = subprocess.run(compile_cmd, capture_output=True, text=True)
-    if cp.returncode != 0:
-        if "tsan" in (cp.stderr or "").lower():
-            pytest.skip(f"TSAN runtime unavailable: {cp.stderr[-200:]}")
-        raise AssertionError(f"stress build failed: {cp.stderr}")
+    binary = str(tmp_path / f"stress_{sanitizer}")
+    try:
+        build_stress(sanitizer, binary)
+    except SanitizerUnavailable as e:
+        pytest.skip(f"sanitizer runtime unavailable: {e}")
 
     scratch = tmp_path / "scratch"
     scratch.mkdir()
     run = subprocess.run(
         [binary, str(scratch)],
-        capture_output=True, text=True, timeout=120,
-        env={**os.environ, "TSAN_OPTIONS": "halt_on_error=1 exitcode=66"},
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, **sanitizer_env(sanitizer)},
     )
     assert run.returncode == 0, (
-        f"TSAN stress failed (rc={run.returncode}):\n{run.stdout}\n{run.stderr}"
+        f"{sanitizer} stress failed (rc={run.returncode}):\n"
+        f"{run.stdout}\n{run.stderr}"
     )
-    assert "WARNING: ThreadSanitizer" not in run.stderr
+    for marker in SANITIZER_FINDING_MARKERS:
+        assert marker not in run.stderr, (
+            f"{sanitizer} finding:\n{run.stderr}"
+        )
     assert "stress ok" in run.stdout

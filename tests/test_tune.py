@@ -4,8 +4,6 @@ config+CLI wiring, the knob-drift guard, and the hermetic
 static-vs-adaptive acceptance A/B against the fake h2 server under a
 shaped straggler fault plan."""
 
-import argparse
-import dataclasses
 import json
 import threading
 import time
@@ -498,26 +496,13 @@ def test_cli_rejects_bad_tune_values():
 def test_knob_drift_guard():
     """CI satellite: every TuneConfig-actuated knob must (a) be in the
     canonical TUNE_KNOBS set, (b) resolve to a real dataclass field in
-    tpubench.config, and (c) have a CLI flag — so the controller, the
-    config surface and the CLI can never silently diverge."""
-    from tpubench import cli
+    tpubench.config, and (c) have a CLI flag. The comparison now lives
+    in the declarative drift registry (tpubench.analysis.drift, one
+    mechanism for all catalogs) and also runs in `tpubench check`."""
+    from tpubench.analysis.drift import run_drift_guard
 
+    assert run_drift_guard("tune-knobs") == []
     assert set(ACTUATED) == set(TUNE_KNOBS)
-    cfg = BenchConfig()
-    parser = argparse.ArgumentParser()
-    cli._add_common(parser)
-    dests = {a.dest for a in parser._actions}
-    for name, spec in ACTUATED.items():
-        obj = cfg
-        *parents, leaf = spec["config"]
-        for part in parents:
-            obj = getattr(obj, part)
-        assert any(f.name == leaf for f in dataclasses.fields(obj)), (
-            f"knob {name}: config field {'.'.join(spec['config'])} missing"
-        )
-        assert spec["cli"] in dests, (
-            f"knob {name}: CLI flag dest {spec['cli']!r} missing"
-        )
 
 
 def test_tune_profile_roundtrip_and_apply(tmp_path):

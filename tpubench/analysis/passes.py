@@ -1,0 +1,26 @@
+"""Pass registry: the five invariant planes behind ``tpubench check``."""
+
+from __future__ import annotations
+
+from tpubench.analysis.core import REPO_ROOT, AnalysisPass
+from tpubench.analysis.determinism import DETERMINISM_PASS
+from tpubench.analysis.drift import make_drift_pass
+from tpubench.analysis.lifecycle import FLIGHT_PASS, RESOURCE_PASS
+from tpubench.analysis.lockorder import LOCK_ORDER_PASS
+from tpubench.analysis.threads import THREAD_PASS
+
+STATIC_PASSES: tuple[AnalysisPass, ...] = (
+    FLIGHT_PASS,
+    THREAD_PASS,
+    RESOURCE_PASS,
+    DETERMINISM_PASS,
+    LOCK_ORDER_PASS,
+)
+
+
+def all_passes(with_drift: bool = True,
+               repo_root: str = REPO_ROOT) -> list[AnalysisPass]:
+    passes = list(STATIC_PASSES)
+    if with_drift:
+        passes.append(make_drift_pass(repo_root))
+    return passes

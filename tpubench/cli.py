@@ -1071,6 +1071,27 @@ def main(argv=None) -> int:
     topp.add_argument("--frames", type=int,
                       help="exit after N refreshes (default: run until "
                            "Ctrl-C)")
+    chk = sub.add_parser(
+        "check",
+        help="invariant-analysis plane: AST passes mechanizing the "
+             "recurring review findings (flight-op lifecycle, thread "
+             "hygiene, slab-lease balance, determinism & bounds, "
+             "catalog-drift guards, lock-order graph); nonzero exit "
+             "on findings; vetted allowlist entries require "
+             "justifications (see README 'Static analysis & "
+             "sanitizers')",
+    )
+    chk.add_argument("--json", action="store_true",
+                     help="machine output (tpubench-check/1 schema)")
+    chk.add_argument("--allowlist",
+                     help="override the checked-in allowlist path "
+                          "(tpubench/analysis/allowlist.json)")
+    chk.add_argument("--no-drift", action="store_true",
+                     help="skip the runtime catalog-drift guards (pure "
+                          "AST passes only — faster, no engine probe)")
+    chk.add_argument("paths", nargs="*",
+                     help="restrict analysis to these files (default: "
+                          "the whole tpubench tree)")
     rep = sub.add_parser(
         "report",
         help="summarize/compare result JSONs (percentile blocks, A/B "
@@ -1099,6 +1120,16 @@ def main(argv=None) -> int:
                           "print in full (default 3)")
 
     args = top.parse_args(argv)
+    if args.cmd == "check":
+        # Static analysis: jax-free, device-free — runnable on any CI
+        # box or coordinator VM, same policy as report/top.
+        from tpubench.analysis import run_cli_check
+
+        return run_cli_check(
+            json_out=args.json, paths=args.paths or None,
+            allowlist_path=args.allowlist,
+            with_drift=not args.no_drift,
+        )
     if args.cmd == "top":
         # Live dashboard: jax-free, no common config (like report) —
         # runnable on a coordinator VM that never touches a device.

@@ -1,4 +1,14 @@
-"""Compile the native engine, cached by source mtime."""
+"""Compile the native engine, cached by source mtime — plus the
+sanitizer matrix for the stress harness.
+
+The engine's only correctness net used to be TSAN; the matrix adds
+ASAN (heap errors + leak checking on the destroy-hammer path) and
+UBSAN (UB trapped, not recovered) builds of engine.cc+stress.cc, all
+driven by the same stress phases (per-thread arrays, fetch pool,
+srv/discard, reactor exactly-once, stale churn, destroy hammer).
+``build_stress`` raises :class:`SanitizerUnavailable` when the
+compiler lacks a sanitizer runtime so CI skips gracefully instead of
+failing the build."""
 
 from __future__ import annotations
 
@@ -8,8 +18,76 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "engine.cc")
+_STRESS = os.path.join(_DIR, "stress.cc")
 _LIB = os.path.join(_DIR, "libtpubench.so")
 _lock = threading.Lock()
+
+# sanitizer name -> (compile flags, runtime env). halt_on_error +
+# exitcode=66 everywhere: a finding is a hard failure, never a warning
+# scrolled past. ASAN runs with leak detection ON — the destroy-hammer
+# phase is exactly where an engine teardown leak would hide; UBSAN
+# compiles with -fno-sanitize-recover so UB traps instead of logging.
+SANITIZERS: dict[str, tuple[list[str], dict[str, str]]] = {
+    "thread": (
+        ["-fsanitize=thread"],
+        {"TSAN_OPTIONS": "halt_on_error=1 exitcode=66"},
+    ),
+    "address": (
+        ["-fsanitize=address", "-fno-omit-frame-pointer"],
+        {"ASAN_OPTIONS": "detect_leaks=1:halt_on_error=1:exitcode=66"},
+    ),
+    "undefined": (
+        ["-fsanitize=undefined", "-fno-sanitize-recover=all"],
+        {"UBSAN_OPTIONS": "halt_on_error=1:print_stacktrace=1:"
+                          "exitcode=66"},
+    ),
+}
+
+# stderr markers that mean "a sanitizer spoke" — asserted absent even
+# when the exit code lies (forked children, _exit paths).
+SANITIZER_FINDING_MARKERS = (
+    "WARNING: ThreadSanitizer",
+    "ERROR: AddressSanitizer",
+    "ERROR: LeakSanitizer",
+    "runtime error:",
+)
+
+
+class SanitizerUnavailable(RuntimeError):
+    """The toolchain cannot build/link this sanitizer — a skip, not a
+    failure (containers often ship g++ without every libsan)."""
+
+
+def sanitizer_env(sanitizer: str) -> dict[str, str]:
+    return dict(SANITIZERS[sanitizer][1])
+
+
+def build_stress(sanitizer: str, out_path: str) -> str:
+    """Build engine.cc+stress.cc under ``sanitizer`` at ``out_path``.
+
+    Raises :class:`SanitizerUnavailable` when the compile/link failure
+    names the sanitizer runtime, ``CalledProcessError`` on a genuine
+    source build break (that one must fail the test)."""
+    flags, _env = SANITIZERS[sanitizer]
+    cmd = [
+        "g++", "-O1", "-g", "-std=c++17", *flags,
+        _SRC, _STRESS,
+        # -ldl matches build_library: engine.cc dlopens OpenSSL at
+        # first use.
+        "-o", out_path, "-lpthread", "-ldl",
+    ]
+    cp = subprocess.run(cmd, capture_output=True, text=True)
+    if cp.returncode != 0:
+        err = (cp.stderr or "").lower()
+        if any(tok in err for tok in ("sanitize", "asan", "tsan", "ubsan",
+                                      "libtsan", "libasan", "libubsan")):
+            raise SanitizerUnavailable(
+                f"{sanitizer}: {cp.stderr.strip()[-200:]}"
+            )
+        raise subprocess.CalledProcessError(
+            cp.returncode, cmd, cp.stdout, cp.stderr
+        )
+    return out_path
 
 
 def library_path() -> str:

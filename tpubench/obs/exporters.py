@@ -204,7 +204,9 @@ class PeriodicExporter:
         self._fn = fn
         self._interval = interval_s
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = threading.Thread(
+            target=self._loop, name="periodic-exporter", daemon=True
+        )
         self._flush_lock = threading.Lock()
         self.flush_count = 0
         self.error_count = 0

@@ -32,6 +32,7 @@ import hashlib
 import random
 import threading
 import time
+import warnings
 from dataclasses import dataclass, field
 from typing import Iterator, Optional, Protocol
 
@@ -174,9 +175,17 @@ class RecordingTracer:
     span installs its context for its scope, so child spans — and flight
     records begun inside it — parent under it."""
 
-    def __init__(self, sample_rate: float = 1.0, seed: int = 0):
+    def __init__(self, sample_rate: float = 1.0, seed: int = 0,
+                 max_spans: int = 65536):
         self.sample_rate = sample_rate
+        # Bounded (the EXACT_SAMPLE_CAP discipline, enforced by
+        # `tpubench check`): an open-loop serve run is unbounded in
+        # time, and journals — not this in-process buffer — are the
+        # durable trace store. Keep-first + a drop counter: the run
+        # report can say how much was cut.
         self.spans: list[RecordedSpan] = []
+        self.max_spans = max(1, int(max_spans))
+        self.dropped_spans = 0
         self._lock = threading.Lock()
         self._rng = random.Random(seed)
 
@@ -209,10 +218,21 @@ class RecordingTracer:
         finally:
             sp.end_ns = time.perf_counter_ns()
             with self._lock:
-                self.spans.append(sp)
+                if len(self.spans) < self.max_spans:
+                    self.spans.append(sp)
+                else:
+                    self.dropped_spans += 1
 
     def shutdown(self) -> None:
-        pass
+        # Same one-line-warning discipline as OtelTracer.shutdown: a
+        # truncated span set must not LOOK complete.
+        if self.dropped_spans:
+            warnings.warn(
+                f"RecordingTracer dropped {self.dropped_spans} spans "
+                f"past the max_spans={self.max_spans} cap — the kept "
+                "set is the run's FIRST spans, not all of them",
+                stacklevel=2,
+            )
 
 
 class SpanCarrier:
@@ -336,8 +356,6 @@ class OtelTracer:
         try:
             self._provider.shutdown()
         except Exception as e:  # noqa: BLE001 — see above
-            import warnings
-
             warnings.warn(
                 f"trace exporter flush failed at shutdown "
                 f"({type(e).__name__}: {e}); spans may be incomplete",

@@ -1175,7 +1175,10 @@ def run_coop_sim(
                     key, lambda k=key: cc.fetch(k)
                 )
                 release_payload(payload)
-        except BaseException as exc:  # noqa: BLE001 — surfaced below
+        except Exception as exc:  # noqa: BLE001 — surfaced below
+            # Exception, not BaseException (the Ctrl-C rule): a
+            # KeyboardInterrupt on a sim host thread should unwind the
+            # sim, not masquerade as a per-host fetch error.
             entry["error"] = f"{type(exc).__name__}: {exc}"
 
     threads = [

@@ -434,7 +434,7 @@ class Prefetcher:
                         self.skipped += 1
                     if op is not None:
                         op.abandon()
-            except BaseException as exc:  # noqa: BLE001 — best-effort layer
+            except Exception as exc:  # noqa: BLE001 — best-effort layer
                 # Prefetch is advisory: the error is recorded, the chunk
                 # stays uncached, and the demand path (with its own retry
                 # stack) surfaces any real failure to the workload.

@@ -276,9 +276,15 @@ class AdmissionQueue:
         whose deadline already passed at pop time (the work is doomed;
         serving it would only delay requests that can still make
         theirs). Returns None on close-and-empty or timeout."""
-        deadline = (
-            None if timeout is None else time.monotonic() + timeout
+        # Injected-clock discipline (the tune-controller rule, enforced
+        # by `tpubench check`): the wait budget runs on the same
+        # clock_ns= the deadline decisions use, so tests/replay can
+        # drive both with virtual time.
+        deadline_ns = (
+            None if timeout is None
+            else self._clock_ns() + int(timeout * 1e9)
         )
+        stalled_waits = 0
         with self._cond:
             while True:
                 while self._heap and self._in_service < self._cap:
@@ -294,11 +300,27 @@ class AdmissionQueue:
                     return req
                 if self._closed:
                     return None
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
+                if deadline_ns is not None:
+                    remaining = (deadline_ns - self._clock_ns()) / 1e9
                     if remaining <= 0:
                         return None
-                    self._cond.wait(remaining)
+                    before_ns = self._clock_ns()
+                    notified = self._cond.wait(remaining)
+                    if notified or self._clock_ns() > before_ns:
+                        stalled_waits = 0
+                        continue
+                    # Condition.wait expires on REAL time; with a
+                    # stalled virtual clock_ns= the remaining budget
+                    # would never shrink and pop would spin forever.
+                    # One zero-progress expiry loops back (a push's
+                    # notify can race the expiry, and a coarse-stepped
+                    # replay clock may advance just late) — the heap is
+                    # re-examined at the loop top; a second consecutive
+                    # one means nobody is driving the clock: honor the
+                    # timeout.
+                    stalled_waits += 1
+                    if stalled_waits >= 2:
+                        return None
                 else:
                     self._cond.wait()
 

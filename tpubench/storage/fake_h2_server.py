@@ -217,14 +217,15 @@ class _Conn:
                         t = threading.Thread(
                             target=self._handle,
                             args=(stream, headers_by_stream.pop(stream)),
-                            daemon=True,
+                            name=f"h2-stream-{stream}", daemon=True,
                         )
                         t.start()
                 elif ftype == 0:  # DATA (request bodies: ignored)
                     if fflags & 0x1 and stream in headers_by_stream:
                         h = headers_by_stream.pop(stream)
                         threading.Thread(
-                            target=self._handle, args=(stream, h), daemon=True
+                            target=self._handle, args=(stream, h),
+                            name=f"h2-stream-{stream}", daemon=True,
                         ).start()
                 elif ftype == 7:  # GOAWAY
                     return
@@ -552,11 +553,13 @@ class FakeH2Server:
                     send_interim_1xx=self.send_interim_1xx,
                     interim_end_stream=self.interim_end_stream,
                 ).serve,
-                daemon=True,
+                name="h2-conn", daemon=True,
             ).start()
 
     def start(self) -> "FakeH2Server":
-        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="h2-accept", daemon=True
+        )
         self._thread.start()
         return self
 

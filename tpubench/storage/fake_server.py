@@ -241,7 +241,10 @@ class FakeGcsServer:
         return f"{scheme}://{host}:{port}"
 
     def start(self) -> "FakeGcsServer":
-        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fake-gcs-http",
+            daemon=True,
+        )
         self._thread.start()
         return self
 
