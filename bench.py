@@ -423,6 +423,78 @@ def _serve_knee_cell() -> dict:
     }
 
 
+def _elastic_resize_cell() -> dict:
+    """Cooperative-leave vs killed-host resize A/B on the hermetic
+    elastic serve pod (BENCH_r06+): two identical 4-host pods replay the
+    SAME seeded open-loop schedule; mid-run one arm's host 1 leaves
+    cooperatively (warm handoff drains its hot set to the chunks' new
+    owners over the peer channel) while the other arm's host 1 is
+    killed at the same virtual instant (no goodbye — peers fall back to
+    origin). The delta IS the handoff protocol: the cooperative arm
+    must move bytes by handoff and pay no more resize-window origin
+    bytes than the kill arm (the smoke guard in test_bench_smoke).
+    CPU-only and jax-free — quiet-CPU segment with the other A/Bs."""
+    from tpubench.config import BenchConfig
+    from tpubench.workloads.serve import run_serve
+
+    def _arm(action: str) -> dict:
+        cfg = BenchConfig()
+        cfg.transport.protocol = "fake"
+        cfg.workload.workers = 4
+        cfg.workload.object_size = 2 * MB
+        cfg.workload.granule_bytes = 128 * 1024
+        cfg.staging.mode = "none"
+        cfg.obs.export = "none"
+        cfg.pipeline.cache_bytes = 64 * MB
+        sv = cfg.serve
+        sv.seed = 11
+        sv.duration_s = 2.0  # virtual; wall scales with the sleep scale
+        sv.rate_rps = 200.0
+        sv.tenants = 24
+        sv.workers = 4
+        sv.hosts = 4
+        sv.resize_window_s = 0.6
+        t_event = 0.9
+        sv.membership_timeline = [[t_event, t_event, {action: 1}]]
+        res = run_serve(cfg)
+        mb = res.extra["membership"]
+        gold_resize = next(
+            iter((mb["slo"].get("resize") or {}).values()), None
+        )
+        ev = mb["events"][0] if mb["events"] else {}
+        return {
+            "action": action,
+            "epoch": mb["epoch"],
+            "handoff_out_bytes": mb["handoff"]["out_bytes"],
+            "handoff_in_bytes": mb["handoff"]["in_bytes"],
+            "resize_window_origin_bytes": (
+                mb["origin_bytes"]["resize_windows"]
+            ),
+            "steady_origin_bytes": mb["origin_bytes"]["steady"],
+            "remap_fraction": round(ev.get("remap_fraction", 0.0), 4),
+            "time_to_rewarm_s": ev.get("time_to_rewarm_s"),
+            "gold_resize_slo": (
+                round(gold_resize, 4) if gold_resize is not None else None
+            ),
+            "failovers": mb["failovers"],
+            "pool_leaked_slabs": mb["pool_leaked_slabs"],
+            "completed": res.extra["serve"]["completed"],
+            "errors": res.errors,
+        }
+
+    coop = _arm("leave_host")
+    kill = _arm("kill_host")
+    return {
+        "cooperative": coop,
+        "killed": kill,
+        "origin_bytes_saved_in_window": (
+            kill["resize_window_origin_bytes"]
+            - coop["resize_window_origin_bytes"]
+        ),
+        "sleep_scale": _SLEEP_SCALE,
+    }
+
+
 def _trace_overhead_cell() -> dict:
     """Tracing-on vs tracing-off goodput on the hermetic fake backend
     (BENCH_r06+): the SAME read config (fixed seed, staging off, flight
@@ -685,6 +757,14 @@ def main() -> int:
         serve_knee = _serve_knee_cell()
     except Exception as e:  # noqa: BLE001 — the bench must not die here
         print(f"# serve knee sweep failed: {e}", file=sys.stderr)
+
+    # Elastic-membership resize A/B (cooperative leave vs kill on a
+    # 4-host pod): hermetic, CPU-only, jax-free — quiet-CPU segment.
+    elastic_resize: dict = {}
+    try:
+        elastic_resize = _elastic_resize_cell()
+    except Exception as e:  # noqa: BLE001 — the bench must not die here
+        print(f"# elastic resize A/B failed: {e}", file=sys.stderr)
 
     dev = jax.local_devices()[0]  # first jax touch: AFTER the quiet-CPU A/B
 
@@ -956,6 +1036,7 @@ def main() -> int:
                 "coop_cache": coop_cache,
                 "trace_overhead": trace_overhead,
                 "serve_knee": serve_knee,
+                "elastic_resize": elastic_resize,
                 "shaped_verdict": shaped,
                 "probe_divergence_factor": pdf,
                 "host_cores": _usable_cores(),

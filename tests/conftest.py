@@ -128,6 +128,15 @@ def pytest_configure(config):
         "markers", "analysis: invariant-analysis plane "
                    "(tpubench check / drift registry / lock graph)"
     )
+    # Membership tests (elastic pod membership: state machine, warm
+    # handoff, killed-owner degradation, the 4-host elastic acceptance)
+    # stay in tier-1 — same policy as the other subsystem markers: the
+    # resize acceptance runs on every pass; the marker exists for
+    # selective runs (`-m membership`).
+    config.addinivalue_line(
+        "markers", "membership: elastic pod membership "
+                   "(state machine/handoff/resize scorecard)"
+    )
     # Multihost tests are marker-gated (see tests/test_multihost.py):
     # they need working multi-process jax.distributed, which this
     # container lacks — tier-1 collects clean skips, not failures.

@@ -150,6 +150,22 @@ def test_bench_end_to_end_cpu():
         assert p["offered_rps"] > 0
     below = [p["goodput_gbps"] for p in sk["points"][:sk["knee"]["index"]]]
     assert all(b >= a * 0.85 for a, b in zip(below, below[1:])), below
+    # Elastic-resize A/B cell (PR 14): cooperative-leave vs killed-host
+    # on a 4-host pod, identical seeded schedule — the regression
+    # guards: the cooperative arm actually moved bytes by warm handoff,
+    # paid no MORE resize-window origin bytes than the kill arm (the
+    # handoff replaced the re-fetch), and neither arm leaked a slab
+    # lease or wedged the admission queue (errors == 0).
+    er = d["elastic_resize"]
+    coop_arm, kill_arm = er["cooperative"], er["killed"]
+    assert coop_arm["handoff_out_bytes"] > 0
+    assert kill_arm["handoff_out_bytes"] == 0
+    assert (coop_arm["resize_window_origin_bytes"]
+            <= kill_arm["resize_window_origin_bytes"]), er
+    for arm in (coop_arm, kill_arm):
+        assert arm["pool_leaked_slabs"] == 0
+        assert arm["errors"] == 0
+        assert arm["epoch"] == 1
     sweep = d["staging_depth_sweep"]
     assert set(sweep) == {"1", "2", "4"}
     assert sweep["1"]["drain"] == "inline"

@@ -741,26 +741,26 @@ def test_coop_from_config_off_and_degenerate():
     coop.close()
 
 
-def test_coop_from_config_multiprocess_loopback_collapses(capsys):
+def test_coop_from_config_multiprocess_loopback_is_hard_error():
     """A PRIVATE loopback broker spans one process: building a
     multi-host ring over it would route most misses at peers that can
-    never answer. The membership collapses to this host (zero routing)
-    with a one-line warning pointing at the ici channel."""
+    never answer. Since elastic membership (PR 13) this is a hard
+    SystemExit — a silent single-host collapse would let an "N-host"
+    elastic run measure a pod of one — unless a membership-aware fabric
+    registered a SHARED broker for the process (the real-fabric path,
+    covered in tests/test_membership.py)."""
     from tpubench.pipeline.coop import coop_from_config
 
     cfg = BenchConfig()
     cfg.coop.enabled = True
     cfg.dist.num_processes = 4
     cfg.dist.process_id = 2
-    coop = coop_from_config(cfg, ChunkCache(MB), lambda k: b"y" * 8)
-    assert coop.host_id == 2
-    assert coop.ring.hosts == {2}  # nothing routes, nothing hangs
-    assert coop.fetch(key(length=8)) == b"y" * 8
-    assert coop.stats()["peer_requests"] == 0
-    err = capsys.readouterr().err
-    assert "loopback channel cannot reach" in err
-    assert "--coop-channel ici" in err
-    coop.close()
+    with pytest.raises(SystemExit) as ei:
+        coop_from_config(cfg, ChunkCache(MB), lambda k: b"y" * 8)
+    msg = str(ei.value)
+    assert "loopback channel cannot reach" in msg
+    assert "--coop-channel ici" in msg
+    assert "shared pod fabric" in msg
 
 
 def test_train_ingest_rejects_lockstep_with_async_consumers(

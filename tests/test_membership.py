@@ -1,0 +1,650 @@
+"""Elastic pod membership (tpubench/dist/membership.py): the state
+machine, ownership remap bounds, the warm-handoff protocol, killed-owner
+degradation, clean rejoin, and the hermetic 4-host elastic serve
+acceptance (marker: ``membership``)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpubench.config import BenchConfig
+from tpubench.dist.membership import (
+    ElasticFabric,
+    Membership,
+    MembershipError,
+    remap_stats,
+)
+from tpubench.mem.slab import SlabPool, release_payload
+from tpubench.obs.flight import (
+    FlightRecorder,
+    render_timeline,
+    timeline_summary,
+)
+from tpubench.pipeline.cache import ChunkCache, ChunkKey
+from tpubench.pipeline.coop import (
+    CoopCache,
+    HashRing,
+    LoopbackChannel,
+    coop_from_config,
+    register_shared_broker,
+)
+from tpubench.pipeline.prefetch import fetch_chunk
+from tpubench.storage.fake import FakeBackend
+
+pytestmark = pytest.mark.membership
+
+MB = 1 << 20
+CHUNK = 64 * 1024
+
+
+def key(i: int = 0, length: int = CHUNK, obj: str = "obj") -> ChunkKey:
+    return ChunkKey("b", f"{obj}{i}", 1, 0, length)
+
+
+# ------------------------------------------------------- state machine ----
+
+
+def test_membership_transitions_epoch_monotonic_deterministic_clock():
+    clock = [10.0]
+    m = Membership(range(4), clock=lambda: clock[0])
+    assert m.epoch == 0
+    assert m.live_hosts() == {0, 1, 2, 3}
+    assert m.ring_hosts() == {0, 1, 2, 3}
+
+    clock[0] = 11.0
+    ev = m.fail(2)
+    assert (ev.epoch, ev.action, ev.host, ev.t_s) == (1, "fail", 2, 11.0)
+    assert m.state(2) == "down"
+    assert m.live_hosts() == {0, 1, 3}
+
+    # Invalid transitions refuse WITHOUT bumping the epoch.
+    for bad in (lambda: m.fail(2), lambda: m.leave(2),
+                lambda: m.pause(2), lambda: m.resume(0),
+                lambda: m.join(0)):
+        with pytest.raises(MembershipError):
+            bad()
+    assert m.epoch == 1
+
+    clock[0] = 12.0
+    assert m.join(2).epoch == 2  # rejoin: down -> up
+    assert m.pause(1).epoch == 3
+    assert m.state(1) == "paused"
+    # Paused hosts keep their ring points but are not dispatchable.
+    assert m.live_hosts() == {0, 2, 3}
+    assert m.ring_hosts() == {0, 1, 2, 3}
+    assert m.resume(1).epoch == 4
+    assert m.leave(3).epoch == 5
+    # A brand-new host id may join an existing pod.
+    assert m.join(9).epoch == 6
+    assert 9 in m.live_hosts()
+
+    epochs = [e.epoch for e in m.events()]
+    assert epochs == sorted(epochs)  # monotone, gap-free by construction
+    assert epochs == list(range(1, 7))
+
+
+def test_membership_transitions_journal_as_member_records():
+    rec = FlightRecorder(capacity_per_worker=64)
+    m = Membership(range(2), clock=lambda: 0.0,
+                   flight_ring=rec.worker("member"))
+    m.fail(1)
+    m.join(1)
+    m.note_event("handoff", 1, {"handoff_chunks": 3,
+                                "handoff_bytes": 3 * CHUNK})
+    records = rec.records()
+    assert [r["kind"] for r in records] == ["member"] * 3
+    notes = [r["notes"][0] for r in records]
+    assert notes[0]["action"] == "fail" and notes[0]["epoch"] == 1
+    assert notes[1]["action"] == "join" and notes[1]["epoch"] == 2
+    assert notes[2]["action"] == "handoff"
+    assert notes[2]["handoff_bytes"] == 3 * CHUNK
+    # The journal rolls them up for `report timeline` / `tpubench top`.
+    summ = timeline_summary(records)["membership"]
+    assert summ["events"] == 2
+    assert summ["by_action"] == {"fail": 1, "join": 1}
+    assert summ["handoff_bytes"] == 3 * CHUNK
+    assert summ["last_epoch"] == 2
+    out = render_timeline([{"records": records}])
+    assert "membership: events=2" in out
+
+
+def test_remap_is_consistent_hash_minimal_per_event():
+    keys = [key(i % 40, obj=f"o{i // 40}_") for i in range(2000)]
+    ring = HashRing(range(4), vnodes=64)
+    before = {k: ring.owner(k) for k in keys}
+    ring.remove_host(1)
+    after = {k: ring.owner(k) for k in keys}
+    rs = remap_stats(keys, before, after)
+    # Removing 1 of 4 hosts must move ~1/4 of the keys — never the
+    # wholesale reshuffle a naive mod-N placement would produce.
+    assert 0.10 <= rs["remap_fraction"] <= 0.45
+    assert rs["remap_bytes"] == rs["remapped_keys"] * CHUNK
+    # A key that moved must have been owned by the removed host, OR
+    # kept its owner: survivors' keys never shuffle among themselves.
+    for k in keys:
+        if before[k] != after[k]:
+            assert before[k] == 1
+    ring.add_host(1)
+    restored = {k: ring.owner(k) for k in keys}
+    assert restored == before  # join is remap-minimal AND exact
+
+
+# --------------------------------------------------------- warm handoff ----
+
+
+def _fabric_pod(n_hosts: int, *, pools: bool = False, cache_bytes=64 * MB):
+    """N coop hosts over one fabric + shared fake origin; returns
+    (fabric, hosts, backend, fetch_count) where fetch_count is the
+    per-chunk origin-fetch ledger."""
+    backend = FakeBackend.prepopulated(prefix="em/f_", count=4, size=MB)
+    fetches = {"n": 0, "bytes": 0}
+    fab = ElasticFabric(n_hosts, clock=lambda: 0.0)
+    hosts = {}
+    for h in range(n_hosts):
+        pool = SlabPool(CHUNK, 64, use_native=False) if pools else None
+        cache = ChunkCache(cache_bytes)
+
+        def origin_fetch(k, _pool=pool):
+            fetches["n"] += 1
+            fetches["bytes"] += k.length
+            return fetch_chunk(backend, k, pool=_pool)
+
+        coop = CoopCache(
+            cache, host_id=h, ring=fab.ring,
+            channel=LoopbackChannel(fab.broker, h),
+            origin_fetch=origin_fetch, pool=pool, enabled=True,
+        )
+        fab.add_host(coop)
+        hosts[h] = {"coop": coop, "cache": cache, "pool": pool}
+    objs = backend.list("em/f_")
+    keys = [
+        ChunkKey("tpubench-fake", o.name, o.generation, s, CHUNK)
+        for o in objs for s in range(0, MB, CHUNK)
+    ]
+    return fab, hosts, keys, fetches
+
+
+def _teardown_pod(fab, hosts):
+    fab.close()
+    leaked = 0
+    for e in hosts.values():
+        e["cache"].close()
+        if e["pool"] is not None:
+            leaked += e["pool"].close()["leaked_slabs"]
+    return leaked
+
+
+def test_warm_handoff_drains_hot_set_to_new_owners():
+    fab, hosts, keys, fetches = _fabric_pod(3)
+    c0 = hosts[0]["coop"]
+    warmed = []
+    for k in keys:
+        # Host 0 resolves everything once: peers' chunks via the peer
+        # channel, own chunks from origin — its cache is the hot set.
+        data = hosts[0]["cache"].get_or_fetch(k, lambda kk=k: c0.fetch(kk))
+        release_payload(data)
+        warmed.append(k)
+    resident_before = hosts[0]["cache"].stats()["entries"]
+    assert resident_before == len(keys)
+    origin_before = dict(fetches)
+
+    st = fab.leave_host(0)
+    # Everything host 0 held now belongs to a peer (it owns nothing
+    # post-leave), so the whole hot set drains, byte-accounted both
+    # sides.
+    assert st["chunks"] == len(keys) and st["rejected"] == 0
+    assert st["bytes"] == len(keys) * CHUNK
+    agg = fab.aggregate()
+    assert agg["handoff_out_bytes"] == st["bytes"]
+    assert agg["handoff_in_bytes"] == st["bytes"]
+    # The handoff event rode the journal-free membership log.
+    actions = [e.action for e in fab.membership.events()]
+    assert actions == ["leave", "handoff"]
+
+    # Surviving hosts can now serve every handed-off chunk WITHOUT new
+    # origin fetches: the warm handoff replaced the re-fetch.
+    for k in keys:
+        owner = fab.ring.owner(k)
+        entry = hosts[owner]
+        data = entry["cache"].get_or_fetch(
+            k, lambda kk=k, c=entry["coop"]: c.fetch(kk)
+        )
+        assert len(data) == CHUNK
+        release_payload(data)
+    assert fetches["n"] == origin_before["n"], (
+        "post-handoff lookups re-fetched from origin"
+    )
+    assert _teardown_pod(fab, hosts) == 0
+
+
+def test_handoff_preserves_qos_owner_tags():
+    """Per-class cache byte budgets must survive the hop: a handed-off
+    entry lands on its new owner under the SAME owner tag it carried on
+    the departing host, so weighted eviction keeps charging the right
+    class after a cooperative departure."""
+    fab, hosts, keys, fetches = _fabric_pod(2)
+    c0 = hosts[0]["coop"]
+    tagged = keys[:6]
+    for k in tagged:
+        data = hosts[0]["cache"].get_or_fetch(
+            k, lambda kk=k: c0.fetch(kk), owner="gold",
+        )
+        release_payload(data)
+    assert hosts[0]["cache"].stats()["owner_bytes"] == {
+        "gold": len(tagged) * CHUNK
+    }
+    # Chunks host 1 already holds (it served them as owner, untagged)
+    # keep their resident entry — insert no-ops on a present key — so
+    # the tag can only land on the chunks the handoff brings FRESH.
+    fresh = [
+        k for k in tagged if not hosts[1]["cache"].contains(k)
+    ]
+    assert fresh  # the drain must actually move something taggable
+    fab.leave_host(0)
+    assert hosts[1]["cache"].stats()["owner_bytes"].get("gold", 0) == (
+        len(fresh) * CHUNK
+    )
+    _teardown_pod(fab, hosts)
+
+
+def test_killed_owner_falls_back_to_origin_without_leaking_leases():
+    fab, hosts, keys, fetches = _fabric_pod(3, pools=True)
+    victim = 1
+    victim_keys = [k for k in keys if fab.ring.owner(k) == victim]
+    assert victim_keys
+    # Warm the victim through peer traffic from host 0.
+    c0 = hosts[0]["coop"]
+    for k in victim_keys:
+        data = hosts[0]["cache"].get_or_fetch(
+            k, lambda kk=k: c0.fetch(kk)
+        )
+        release_payload(data)
+    assert c0.stats()["peer_hits"] == len(victim_keys)
+
+    assert fab.kill_host(victim)
+    assert not fab.kill_host(victim)  # double-kill refused, no epoch
+    assert fab.membership.epoch == 1
+    assert victim not in fab.ring.hosts
+
+    # The dead host's chunks resolve from the reshaped ring — a new
+    # owner (origin fetch) or a survivor's cache — and never hang.
+    before = fetches["n"]
+    c2 = hosts[2]["coop"]
+    for k in victim_keys:
+        data = hosts[2]["cache"].get_or_fetch(
+            k, lambda kk=k: c2.fetch(kk)
+        )
+        assert len(data) == CHUNK
+        release_payload(data)
+    assert fetches["n"] >= before  # degradation path: origin re-fetches
+    # No handoff happened — that is the point of the kill arm.
+    assert fab.aggregate()["handoff_out_bytes"] == 0
+    # The dead host's RAM died with it: a later rejoin starts COLD
+    # (its pre-death cache must not resurrect into the scorecard).
+    assert hosts[victim]["cache"].stats()["entries"] == 0
+    assert fab.rejoin_host(victim)
+    assert hosts[victim]["cache"].stats()["entries"] == 0
+    # And absolutely no slab lease leaked across the death.
+    assert _teardown_pod(fab, hosts) == 0
+
+
+def test_paused_owner_is_transient_then_origin_fallback():
+    fab, hosts, keys, fetches = _fabric_pod(2)
+    victim_keys = [k for k in keys if fab.ring.owner(k) == 1][:4]
+    assert victim_keys
+    fab.pause_host(1)
+    assert fab.membership.state(1) == "paused"
+    assert 1 in fab.ring.hosts  # paused owners keep their ring points
+    c0 = hosts[0]["coop"]
+    for k in victim_keys:
+        data = hosts[0]["cache"].get_or_fetch(
+            k, lambda kk=k: c0.fetch(kk)
+        )
+        assert len(data) == CHUNK
+        release_payload(data)
+    s = c0.stats()
+    # Every routed miss degraded through the bounded transient-retry
+    # path to origin — a miss, never a hang, never an error.
+    assert s["peer_misses"] == len(victim_keys)
+    assert s["peer_hits"] == 0
+    fab.resume_host(1)
+    k = victim_keys[0]
+    hosts[0]["cache"].close()  # forget local copies; re-route to owner
+    hosts[0]["cache"] = ChunkCache(64 * MB)
+    data = c0.fetch(k)
+    release_payload(data)
+    assert c0.stats()["peer_hits"] == 1  # the owner answers again
+    _teardown_pod(fab, hosts)
+
+
+def test_leave_purges_demotion_and_rejoin_starts_clean():
+    fab, hosts, keys, fetches = _fabric_pod(3)
+    # Host 1 is demoted (straggler) and carries stale transfer samples
+    # on its peers' books.
+    fab.ring.demote(1)
+    assert 1 in fab.ring.demoted
+    hosts[0]["coop"]._transfer_ns.append((1, 10_000_000))
+    hosts[2]["coop"]._transfer_ns.append((1, 12_000_000))
+    hosts[1]["coop"]._transfer_ns.append((0, 9_000_000))
+
+    fab.leave_host(1)
+    # Epoch bumped; peers forgot the departed host's samples.
+    assert all(
+        s[0] != 1
+        for h in (0, 2) for s in hosts[h]["coop"]._transfer_ns
+    )
+
+    assert fab.rejoin_host(1)
+    # A host that left demoted must NOT re-enter pre-demoted, and its
+    # own stale samples must not survive the epoch bump.
+    assert 1 in fab.ring.hosts
+    assert 1 not in fab.ring.demoted
+    assert len(hosts[1]["coop"]._transfer_ns) == 0
+    # And it serves again: a peer-routed miss lands on it.
+    served = [k for k in keys if fab.ring.owner(k) == 1]
+    assert served
+    c0 = hosts[0]["coop"]
+    data = c0.fetch(served[0])
+    release_payload(data)
+    assert c0.stats()["peer_hits"] == 1
+    _teardown_pod(fab, hosts)
+
+
+# ---------------------------------------------- coop_from_config fabric ----
+
+
+def test_coop_from_config_multihost_loopback_is_hard_error():
+    """The PR-8 warning-and-collapse is gone: with elastic membership a
+    silent single-host degrade is a measurement lie. No shared fabric
+    registered => SystemExit pointing at the fix."""
+    cfg = BenchConfig()
+    cfg.coop.enabled = True
+    cfg.dist.num_processes = 4
+    cfg.dist.process_id = 2
+    with pytest.raises(SystemExit, match="shared pod fabric"):
+        coop_from_config(cfg, ChunkCache(MB), lambda k: b"y" * 8)
+
+
+def test_coop_from_config_multihost_uses_registered_shared_broker():
+    fab = ElasticFabric(4, clock=lambda: 0.0)
+    register_shared_broker(fab.broker)
+    try:
+        cfg = BenchConfig()
+        cfg.coop.enabled = True
+        cfg.dist.num_processes = 4
+        cfg.dist.process_id = 2
+        coop = coop_from_config(cfg, ChunkCache(MB), lambda k: b"y" * 8)
+        # A REAL 4-host membership, wired to the shared fabric's broker.
+        assert coop.ring.hosts == {0, 1, 2, 3}
+        assert coop._channel._broker is fab.broker
+        coop.close()
+    finally:
+        register_shared_broker(None)
+
+
+# --------------------------------------------------- config/CLI surface ----
+
+
+@pytest.mark.parametrize("mutate,frag", [
+    (lambda sc: setattr(sc, "hosts", 0), "hosts"),
+    (lambda sc: setattr(sc, "resize_window_s", 0.0), "resize_window_s"),
+    (lambda sc: setattr(sc, "membership_timeline",
+                        [[0.5, 0.5, {"kill_host": 1}]]),
+     "hosts >= 2"),
+    (lambda sc: (setattr(sc, "hosts", 4),
+                 setattr(sc, "membership_timeline", [[0.5, 0.5]])),
+     "expected"),
+    (lambda sc: (setattr(sc, "hosts", 4),
+                 setattr(sc, "membership_timeline",
+                         [[0.5, 0.2, {"kill_host": 1}]])),
+     "t0 <= t1"),
+    (lambda sc: (setattr(sc, "hosts", 4),
+                 setattr(sc, "membership_timeline",
+                         [[0.5, 0.5, {"explode_host": 1}]])),
+     "unknown membership action"),
+    (lambda sc: (setattr(sc, "hosts", 4),
+                 setattr(sc, "membership_timeline",
+                         [[0.5, 0.5, {"kill_host": 7}]])),
+     "host must be an int"),
+    (lambda sc: (setattr(sc, "hosts", 4), setattr(sc, "readahead", 2),
+                 setattr(sc, "membership_timeline",
+                         [[0.5, 0.5, {"kill_host": 1}]])),
+     "readahead"),
+])
+def test_membership_timeline_validation(mutate, frag):
+    from tpubench.config import validate_serve_config
+
+    cfg = BenchConfig()
+    mutate(cfg.serve)
+    with pytest.raises(SystemExit, match=frag):
+        validate_serve_config(cfg.serve)
+
+
+def test_cli_elastic_flags_fold_into_config(tmp_path):
+    from tpubench.cli import main
+
+    out = tmp_path / "cfg.json"
+    tl = tmp_path / "timeline.json"
+    tl.write_text(json.dumps([[0.5, 0.5, {"leave_host": 1}]]))
+    rc = main([
+        "serve", "--protocol", "fake",
+        "--serve-hosts", "4", "--resize-window", "0.75",
+        "--membership-timeline", f"@{tl}",
+        "--save-config", str(out),
+    ])
+    assert rc == 0
+    with open(out) as f:
+        sv = json.load(f)["serve"]
+    assert sv["hosts"] == 4
+    assert sv["resize_window_s"] == 0.75
+    assert sv["membership_timeline"] == [[0.5, 0.5, {"leave_host": 1}]]
+
+
+def test_telemetry_feeder_counts_member_notes():
+    from tpubench.obs.telemetry import FlightFeeder, build_registry
+
+    reg = build_registry()
+    feeder = FlightFeeder(reg)
+    feeder({"kind": "member", "phases": {}, "bytes": 0, "notes": [
+        {"kind": "member", "action": "fail", "host": 1, "epoch": 3},
+    ]})
+    feeder({"kind": "member", "phases": {}, "bytes": 0, "notes": [
+        {"kind": "member", "action": "handoff", "host": 1, "epoch": 3,
+         "handoff_chunks": 2, "handoff_bytes": 2 * CHUNK},
+    ]})
+    assert reg.get("tpubench_membership_events_total").value == 1
+    assert reg.get("tpubench_membership_epoch").value == 3
+    assert reg.get(
+        "tpubench_membership_handoff_chunks_total"
+    ).value == 2
+    assert reg.get(
+        "tpubench_membership_handoff_bytes_total"
+    ).value == 2 * CHUNK
+
+
+# ------------------------------------------------- elastic serve plane ----
+
+
+def _elastic_cfg(action: str, *, hosts=4, duration=1.2, rate=250.0,
+                 seed=11) -> BenchConfig:
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 4
+    cfg.workload.object_size = MB
+    cfg.workload.granule_bytes = CHUNK
+    cfg.staging.mode = "none"
+    cfg.obs.export = "none"
+    cfg.pipeline.cache_bytes = 64 * MB
+    sv = cfg.serve
+    sv.seed = seed
+    sv.duration_s = duration
+    sv.rate_rps = rate
+    sv.tenants = 24
+    sv.workers = 4
+    sv.hosts = hosts
+    sv.resize_window_s = 0.4
+    t = duration * 0.45
+    sv.membership_timeline = [[t, t, {action: 1}]]
+    return cfg
+
+
+def test_elastic_acceptance_4host_cooperative_vs_killed(tmp_path):
+    """The hermetic 4-host elastic acceptance: under open-loop serve
+    traffic, a cooperative leave keeps gold-class SLO through the
+    resize window with handoff bytes replacing origin re-fetches
+    (measurably fewer resize-window origin bytes than the killed arm),
+    a killed host degrades gracefully (no hung admission queue, no
+    leaked leases, the pod recovers), and `tpubench report` renders the
+    resize scorecard with the cooperative-vs-killed diff."""
+    from tpubench.workloads.report_cmd import compare_runs, summarize_run
+    from tpubench.workloads.serve import run_serve
+
+    arms = {}
+    for action in ("leave_host", "kill_host"):
+        res = run_serve(_elastic_cfg(action))
+        arms[action] = res
+        mb = res.extra["membership"]
+        ev = mb["events"][0]
+        assert ev["applied"] and mb["epoch"] == 1
+        # Consistent-hash minimality held under live traffic.
+        assert 0.05 <= ev["remap_fraction"] <= 0.5
+        # The degradation path never wedged the admission queue: every
+        # arrival resolved (completed or shed), none leaked.
+        sv = res.extra["serve"]
+        assert sv["arrivals"] == sv["completed"] + sv["shed"] + sum(
+            c["errors"] for c in sv["classes"].values()
+        )
+        # Zero leaked slab leases across the resize.
+        assert mb["pool_leaked_slabs"] == 0
+        # The pod recovered: the post-event windowed peer-hit ratio
+        # came back to >= 90% of the pre-event ratio within the run.
+        assert ev["time_to_rewarm_s"] is not None
+
+    coop_mb = arms["leave_host"].extra["membership"]
+    kill_mb = arms["kill_host"].extra["membership"]
+    # Identical schedule both arms (the A/B is the event, not the load).
+    assert (arms["leave_host"].extra["serve"]["arrivals"]
+            == arms["kill_host"].extra["serve"]["arrivals"])
+    # Warm handoff moved the hot set...
+    assert coop_mb["handoff"]["out_bytes"] > 0
+    assert coop_mb["handoff"]["in_bytes"] == coop_mb["handoff"]["out_bytes"]
+    assert kill_mb["handoff"]["out_bytes"] == 0
+    # ...replacing origin re-fetches during the resize window.
+    assert (coop_mb["origin_bytes"]["resize_windows"]
+            <= kill_mb["origin_bytes"]["resize_windows"])
+    # Gold-class SLO survived the cooperative resize window.
+    gold_resize = next(iter(coop_mb["slo"]["resize"].values()))
+    assert gold_resize is not None and gold_resize >= 0.9
+
+    # Rendering: the scorecard and the cooperative-vs-killed diff.
+    coop_run = json.loads(json.dumps(arms["leave_host"].to_dict()))
+    kill_run = json.loads(json.dumps(arms["kill_host"].to_dict()))
+    out = summarize_run(coop_run)
+    assert "membership resize scorecard" in out
+    assert "leave_host host 1" in out
+    assert "handoff" in out
+    diff = compare_runs([coop_run, kill_run])
+    assert "membership:" in diff
+    assert "resize-window origin" in diff
+
+
+def test_elastic_serve_pause_window_degrades_and_recovers():
+    cfg = _elastic_cfg("pause_host", duration=1.2)
+    t0, t1 = 0.4, 0.8
+    cfg.serve.membership_timeline = [[t0, t1, {"pause_host": 1}]]
+    from tpubench.workloads.serve import run_serve
+
+    res = run_serve(cfg)
+    mb = res.extra["membership"]
+    actions = [e["action"] for e in mb["events"]]
+    assert actions == ["pause_host", "resume_host"]
+    assert mb["epoch"] == 2
+    # The pause window brackets [t0, t1 + resize_window).
+    (w0, w1), = mb["windows_s"]
+    assert w0 == t0 and w1 == pytest.approx(t1 + 0.4)
+    # Paused-owner misses fell to origin (transient -> bounded retry ->
+    # origin), so the run completed without errors.
+    assert res.errors == 0
+    sv = res.extra["serve"]
+    assert sv["completed"] > 0
+
+
+def test_elastic_serve_rejoin_after_kill_restores_the_pod():
+    cfg = _elastic_cfg("kill_host", duration=1.6)
+    cfg.serve.membership_timeline = [
+        [0.4, 0.4, {"kill_host": 1}],
+        [0.9, 0.9, {"rejoin_host": 1}],
+    ]
+    from tpubench.workloads.serve import run_serve
+
+    res = run_serve(cfg)
+    mb = res.extra["membership"]
+    assert [e["action"] for e in mb["events"]] == [
+        "kill_host", "rejoin_host",
+    ]
+    assert mb["epoch"] == 2
+    # After rejoin the host owns keys again (remap on the way back in).
+    assert mb["events"][1]["remapped_keys"] > 0
+    assert res.errors == 0
+    assert mb["pool_leaked_slabs"] == 0
+
+
+def test_chaos_serve_composes_host_and_byte_faults(monkeypatch):
+    monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0.5")
+    from tpubench.workloads.chaos import run_chaos
+
+    cfg = _elastic_cfg("kill_host", hosts=3, duration=1.2, rate=150.0)
+    cfg.serve.membership_timeline = []  # events ride the chaos timeline
+    res = run_chaos(cfg, timeline=[
+        [0.5, 0.5, {"kill_host": 2}],
+        [0.2, 0.9, {"error_rate": 0.02}],
+    ], chaos_workload="serve")
+    ch = res.extra["chaos"]
+    assert ch["member_timeline"] == [[0.5, 0.5, {"kill_host": 2}]]
+    assert ch["timeline"] and "error_rate" in ch["timeline"][0][2]
+    mb = res.extra["membership"]
+    assert mb["events"][0]["action"] == "kill_host"
+    assert mb["events"][0]["applied"]
+    assert "scorecard" in ch
+
+
+def test_chaos_serve_restores_caller_config(monkeypatch):
+    """run_chaos splits host events out of the timeline — but the
+    caller's cfg must come back untouched: a second run on the same cfg
+    must not inherit the first run's kill event or stripped phases."""
+    monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0.3")
+    from tpubench.workloads.chaos import run_chaos
+
+    cfg = _elastic_cfg("kill_host", hosts=2, duration=0.8, rate=80.0)
+    cfg.serve.membership_timeline = []
+    run_chaos(cfg, timeline=[
+        [0.3, 0.3, {"kill_host": 1}],
+        [0.1, 0.5, {"error_rate": 0.01}],
+    ], chaos_workload="serve")
+    assert cfg.serve.membership_timeline == []
+    assert cfg.transport.fault.phases == [
+        [0.3, 0.3, {"kill_host": 1}],
+        [0.1, 0.5, {"error_rate": 0.01}],
+    ]
+
+
+def test_chaos_member_phase_window_must_be_numeric():
+    from tpubench.workloads.chaos import run_chaos
+
+    cfg = _elastic_cfg("kill_host", hosts=2)
+    cfg.serve.membership_timeline = []
+    with pytest.raises(SystemExit, match="must be numeric"):
+        run_chaos(cfg, timeline=[[["x"], 1.0, {"kill_host": 1}]],
+                  chaos_workload="serve")
+
+
+def test_chaos_host_faults_require_serve_workload():
+    from tpubench.workloads.chaos import run_chaos
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    with pytest.raises(SystemExit, match="elastic serve pod"):
+        run_chaos(cfg, timeline=[[0.1, 0.1, {"kill_host": 1}]],
+                  chaos_workload="read")

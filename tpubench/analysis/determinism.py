@@ -38,6 +38,10 @@ CLOCK_MODULES = (
     "tpubench/serve/qos.py",
     "tpubench/workloads/arrivals.py",
     "tpubench/obs/trace.py",
+    # Elastic membership: event stamps must ride the injected clock so
+    # the serve harness can drive them with virtual schedule time and
+    # the state-machine tests replay deterministically.
+    "tpubench/dist/membership.py",
 )
 
 # Paths whose classes must bound every accumulator (obs/serve planes

@@ -40,6 +40,10 @@ LOCK_ORDER_FILES = (
     "tpubench/pipeline/coop.py",
     "tpubench/staging/executor.py",
     "tpubench/serve/qos.py",
+    # Elastic membership composes over the coop broker/ring and the
+    # serve admission queue — its lock must stay a leaf (listeners and
+    # journal writes run OUTSIDE it).
+    "tpubench/dist/membership.py",
 )
 
 _LOCK_CTORS = {"Lock", "RLock", "Condition"}

@@ -543,10 +543,23 @@ def build_config(args) -> BenchConfig:
         ("serve_burst_factor", "burst_factor"),
         ("serve_burst_fraction", "burst_fraction"),
         ("serve_seed", "seed"),
+        ("serve_hosts", "hosts"),
+        ("resize_window", "resize_window_s"),
     ):
         v = getattr(args, attr, None)
         if v is not None:
             setattr(sv, dest, v)
+    if getattr(args, "membership_timeline", None):
+        raw = args.membership_timeline
+        if raw.startswith("@"):
+            with open(raw[1:]) as f:
+                raw = f.read()
+        try:
+            sv.membership_timeline = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise SystemExit(
+                f"--membership-timeline: invalid JSON: {e}"
+            ) from None
     if getattr(args, "serve_arrival", None):
         sv.arrival = args.serve_arrival
     if getattr(args, "serve_trace", None):
@@ -919,12 +932,17 @@ def main(argv=None) -> int:
                          "(hermetic: fake backend or in-process fake "
                          "server; see --chaos-*)")
     chaos.add_argument("--chaos-workload",
-                       choices=("read", "pod-ingest", "train-ingest"),
+                       choices=("read", "pod-ingest", "train-ingest",
+                                "serve"),
                        default="read",
                        help="workload the fault timeline runs against "
                             "(train-ingest: the fault schedule exercises "
                             "the prefetcher — a blackhole shows up as "
-                            "data-stall time, never a hang)")
+                            "data-stall time, never a hang; serve: the "
+                            "open-loop plane — with --serve-hosts >= 2 "
+                            "the timeline may also carry host-level "
+                            "kill_host/leave_host/pause_host/rejoin_host "
+                            "entries that resize the pod under load)")
     chaos.add_argument("--chaos-timeline",
                        help="JSON [[t0,t1,{fault fields}],...] (seconds "
                             "from run start), or @path to a JSON file; "
@@ -939,6 +957,17 @@ def main(argv=None) -> int:
                        help="fault window start, seconds from run start")
     chaos.add_argument("--chaos-duration", type=float, default=2.0,
                        help="fault window length in seconds")
+    # Elastic-pod knobs for chaos serve runs (--chaos-workload serve):
+    # the host-level kill/leave/pause/rejoin entries ride
+    # --chaos-timeline; these size the pod they act on.
+    for flag, kw in (
+        ("--serve-hosts", dict(type=int, dest="serve_hosts")),
+        ("--serve-duration", dict(type=float, dest="serve_duration")),
+        ("--serve-rate", dict(type=float, dest="serve_rate")),
+        ("--serve-workers", dict(type=int, dest="serve_workers")),
+        ("--resize-window", dict(type=float, dest="resize_window")),
+    ):
+        chaos.add_argument(flag, help=argparse.SUPPRESS, **kw)
     serve = add("serve", "open-loop multi-tenant traffic plane: arrival "
                          "processes (poisson/bursty/diurnal/trace) drive "
                          "thousands of Zipf-hot tenants with per-class "
@@ -1005,6 +1034,22 @@ def main(argv=None) -> int:
     serve.add_argument("--serve-sweep-points",
                        help="comma list of offered-load multipliers for "
                             "--serve-sweep (default 0.25,0.5,1,2,4)")
+    serve.add_argument("--serve-hosts", type=int,
+                       help="elastic pod: fan the serve plane across N "
+                            "hermetic threaded hosts whose misses route "
+                            "through coop-cache consistent-hash "
+                            "ownership (default 1 = single-host plane)")
+    serve.add_argument("--membership-timeline",
+                       help="elastic membership events: JSON list of "
+                            "[t0, t1, {action: host}] entries (inline "
+                            "or @path) in virtual schedule seconds — "
+                            "actions kill_host / leave_host (warm "
+                            "handoff) / pause_host (resumes at t1) / "
+                            "rejoin_host")
+    serve.add_argument("--resize-window", type=float,
+                       help="virtual seconds of resize window the "
+                            "scorecard brackets each membership event "
+                            "with (default 1.0)")
     tune = add("tune", "adaptive ingest autotuner: offline coordinate "
                        "sweep or online AIMD session over read/"
                        "train-ingest; emits a convergence trace + a "
@@ -1340,9 +1385,16 @@ def main(argv=None) -> int:
                     tracer=tracer,
                 )
             print(format_scorecard(res.extra["chaos"]))
+            if res.extra.get("membership"):
+                from tpubench.workloads.serve import (
+                    format_membership_scorecard,
+                )
+
+                print(format_membership_scorecard(res.extra["membership"]))
         elif args.cmd == "serve":
             from tpubench.obs.tracing import tracer_session
             from tpubench.workloads.serve import (
+                format_membership_scorecard,
                 format_serve_scorecard,
                 run_serve,
                 run_serve_sweep,
@@ -1354,6 +1406,8 @@ def main(argv=None) -> int:
                 else:
                     res = run_serve(cfg, tracer=tracer)
             print(format_serve_scorecard(res.extra["serve"]))
+            if res.extra.get("membership"):
+                print(format_membership_scorecard(res.extra["membership"]))
         elif args.cmd == "tune":
             from tpubench.obs.tracing import tracer_session
             from tpubench.workloads.tune_cmd import format_tune_block, run_tune

@@ -507,6 +507,19 @@ class ServeConfig:
     ])
     sweep_duration_s: float = 0.0
     seed: int = 0
+    # Elastic pod membership (tpubench/dist/membership.py): hosts > 1
+    # fans the serve plane across an N-host hermetic threaded pod whose
+    # misses route through coop-cache consistent-hash ownership, and
+    # membership_timeline changes the pod's shape UNDER load —
+    # ``[t0, t1, {action: host}]`` entries in virtual schedule seconds
+    # (the arrival clock), actions kill_host (die, no handoff),
+    # leave_host (cooperative warm handoff), pause_host (unresponsive
+    # during [t0, t1], resumes at t1) and rejoin_host (clean re-entry).
+    # The resize scorecard brackets each event with resize_window_s of
+    # virtual time (SLO-during-resize vs steady state).
+    hosts: int = 1
+    membership_timeline: list = field(default_factory=list)
+    resize_window_s: float = 1.0
 
 
 def validate_serve_config(sc: "ServeConfig", where: str = "serve") -> None:
@@ -595,6 +608,82 @@ def validate_serve_config(sc: "ServeConfig", where: str = "serve") -> None:
             raise SystemExit(
                 f"{label}.priority={pr!r}: must be an int >= 0"
             )
+    if sc.hosts < 1:
+        raise SystemExit(f"{where}.hosts={sc.hosts!r}: must be >= 1")
+    if not (sc.resize_window_s > 0):  # also rejects NaN
+        raise SystemExit(
+            f"{where}.resize_window_s={sc.resize_window_s!r}: must be > 0"
+        )
+    if sc.hosts > 1 and sc.readahead > 0:
+        # The elastic pod is demand-path only: schedule readahead is a
+        # single-host plane feature, and silently no-opping the knob
+        # would hand an A/B user bit-identical arms.
+        raise SystemExit(
+            f"{where}.readahead={sc.readahead} is a single-host plane "
+            f"feature — the elastic pod ({where}.hosts={sc.hosts}) "
+            "resolves misses through coop ownership only; set "
+            f"{where}.readahead=0"
+        )
+    validate_membership_timeline(sc, where)
+
+
+def validate_membership_timeline(sc: "ServeConfig",
+                                 where: str = "serve") -> None:
+    """Parse-time sanity for the elastic-membership timeline (the
+    validate_fault_config phase style): entry shape, numeric windows,
+    exactly one known host action per entry, host ids inside the pod.
+    A timeline over a single-host pod is refused loudly — there is no
+    membership to change."""
+    tl = sc.membership_timeline
+    if not tl:
+        return
+    if sc.hosts < 2:
+        raise SystemExit(
+            f"{where}.membership_timeline needs {where}.hosts >= 2 "
+            f"(got {sc.hosts}): a pod of one has no membership to change"
+        )
+    for i, ph in enumerate(tl):
+        label = f"{where}.membership_timeline[{i}]"
+        if not isinstance(ph, (list, tuple)) or len(ph) != 3:
+            raise SystemExit(
+                f"{label}: expected [t0, t1, {{action: host}}], got {ph!r}"
+            )
+        t0, t1, spec = ph
+        try:
+            t0, t1 = float(t0), float(t1)
+        except (TypeError, ValueError):
+            raise SystemExit(
+                f"{label}: window [{ph[0]!r}, {ph[1]!r}] must be numeric"
+            ) from None
+        if t0 < 0 or t1 < t0:
+            raise SystemExit(
+                f"{label}: window [{t0}, {t1}] must satisfy 0 <= t0 <= t1"
+            )
+        if not isinstance(spec, dict) or len(spec) != 1:
+            raise SystemExit(
+                f"{label}: third element must be one {{action: host}} "
+                f"dict, got {spec!r}"
+            )
+        (action, host), = spec.items()
+        if action not in MEMBER_TIMELINE_ACTIONS:
+            raise SystemExit(
+                f"{label}: unknown membership action {action!r}; valid: "
+                f"{sorted(MEMBER_TIMELINE_ACTIONS)}"
+            )
+        if not isinstance(host, int) or not (0 <= host < sc.hosts):
+            raise SystemExit(
+                f"{label}.{action}={host!r}: host must be an int in "
+                f"[0, {sc.hosts})"
+            )
+
+
+# Host-level membership actions a chaos/serve timeline may carry (the
+# single source dist/membership.py, the chaos splitter and the timeline
+# validator all read). pause_host resumes at its window's t1; the
+# others fire at t0.
+MEMBER_TIMELINE_ACTIONS = (
+    "kill_host", "leave_host", "pause_host", "rejoin_host",
+)
 
 
 # Knobs the tune controller may actuate (the canonical name set; the

@@ -9,12 +9,32 @@ Here the fan-out axes are first-class:
                   (the CP-analog: one logical object split across the pod);
 * ``reassemble``— ICI all-gather of byte-range shards under ``shard_map``
                   (XLA-native and explicit ppermute-ring variants), the
-                  TPU-native replacement for a NCCL/MPI backend.
+                  TPU-native replacement for a NCCL/MPI backend;
+* ``peer``      — lockstep ICI peer-transfer channel for the coop cache;
+* ``membership``— elastic pod membership (epoch-numbered views, warm
+                  handoff, the hermetic elastic fabric) — jax-free.
+
+Package attributes resolve lazily (PEP 562): ``shard``/``reassemble``
+import jax, and the jax-free planes (membership, serve, report, check)
+must be able to import their dist submodules without paying — or
+requiring — a jax import.
 """
 
-from tpubench.dist.shard import ShardTable, worker_object_index  # noqa: F401
-from tpubench.dist.reassemble import (  # noqa: F401
-    make_mesh,
-    make_reassemble,
-    make_ring_reassemble,
-)
+_LAZY = {
+    "ShardTable": "tpubench.dist.shard",
+    "worker_object_index": "tpubench.dist.shard",
+    "make_mesh": "tpubench.dist.reassemble",
+    "make_reassemble": "tpubench.dist.reassemble",
+    "make_ring_reassemble": "tpubench.dist.reassemble",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

@@ -776,6 +776,33 @@ def timeline_summary(records: list[dict]) -> dict:
     # resolved request notes its outcome (`serve_req`) and every
     # admission-control drop notes `shed` — the timeline can say how
     # much offered load the run absorbed vs refused, per journal.
+    # Elastic-membership attribution: every pod-view transition is a
+    # kind="member" record carrying a member note (epoch, action, host;
+    # the cooperative handoff's byte accounting rides an action=handoff
+    # note under the same kind) — the timeline can say when, and how
+    # violently, the pod changed shape.
+    member_notes = [n for n in notes if n.get("kind") == "member"]
+    membership = {
+        "events": sum(
+            1 for n in member_notes if n.get("action") != "handoff"
+        ),
+        "by_action": {},
+        "handoff_chunks": sum(
+            n.get("handoff_chunks", 0) for n in member_notes
+        ),
+        "handoff_bytes": sum(
+            n.get("handoff_bytes", 0) for n in member_notes
+        ),
+        "last_epoch": max(
+            (n.get("epoch", 0) for n in member_notes), default=0
+        ),
+    }
+    for n in member_notes:
+        a = n.get("action")
+        if a and a != "handoff":
+            membership["by_action"][a] = (
+                membership["by_action"].get(a, 0) + 1
+            )
     serve_notes = [n for n in notes if n.get("kind") == "serve_req"]
     serve = {
         "requests": len(serve_notes),
@@ -794,6 +821,7 @@ def timeline_summary(records: list[dict]) -> dict:
         "tune": tune,
         "pipeline": pipeline,
         "coop": coop,
+        "membership": membership,
         "staging": staging,
         "serve": serve,
         "goodput": goodput_summary(records),
@@ -878,6 +906,17 @@ def render_timeline(docs: list[dict]) -> str:
             f"misses={coop['peer_misses']}) "
             f"owner_fetches={coop['owner_fetches']} "
             f"demotions={coop['demotions']} restores={coop['restores']}"
+        )
+    mem = summ.get("membership", {})
+    if mem.get("events"):
+        by = " ".join(
+            f"{a}={n}" for a, n in sorted(mem["by_action"].items())
+        )
+        lines.append(
+            f"membership: events={mem['events']} ({by}) "
+            f"epoch={mem['last_epoch']} "
+            f"handoff={mem['handoff_chunks']} chunks/"
+            f"{mem['handoff_bytes']}B"
         )
     srv = summ.get("serve", {})
     if srv.get("requests") or srv.get("shed"):

@@ -240,6 +240,12 @@ def render_top(view: dict, color: bool = False) -> str:
         bits.append(
             f"tune={tn['decisions']}d/{tn['accepts']}a/{tn['reverts']}r"
         )
+    mem = summ.get("membership", {})
+    if mem.get("events"):
+        bits.append(
+            f"membership ev={mem['events']} epoch={mem['last_epoch']} "
+            f"handoff={mem['handoff_bytes']}B"
+        )
     srv = summ.get("serve", {})
     if srv.get("requests") or srv.get("shed"):
         bits.append(

@@ -373,6 +373,12 @@ COUNTER_METRICS = {
         "straggler owners demoted off the ownership ring",
     "tpubench_coop_restores_total":
         "demoted owners restored to the ownership ring",
+    "tpubench_membership_events_total":
+        "elastic-membership transitions (join/leave/fail/pause/resume)",
+    "tpubench_membership_handoff_chunks_total":
+        "chunks drained to new owners by cooperative warm handoff",
+    "tpubench_membership_handoff_bytes_total":
+        "bytes drained to new owners by cooperative warm handoff",
     "tpubench_slab_overflows_total": "slab-pool overflow leases",
     "tpubench_stage_transfers_total": "host-to-HBM staging transfers",
     "tpubench_stage_bytes_total": "bytes staged to HBM",
@@ -444,6 +450,9 @@ GAUGE_METRICS = {
         "peer hits / peer requests, record-derived (coop cache)",
     "tpubench_staging_efficiency":
         "fraction of transfer flight time hidden from the fetch threads",
+    "tpubench_membership_epoch":
+        "current elastic-membership view epoch (bumps on every "
+        "join/leave/fail/pause/resume)",
 }
 
 HISTOGRAM_METRICS = {
@@ -610,6 +619,20 @@ class FlightFeeder:
                     reg.get("tpubench_coop_demotions_total").inc()
                 elif n.get("event") == "restore":
                     reg.get("tpubench_coop_restores_total").inc()
+            elif nk == "member":
+                action = n.get("action")
+                if action == "handoff":
+                    reg.get(
+                        "tpubench_membership_handoff_chunks_total"
+                    ).inc(n.get("handoff_chunks", 0))
+                    reg.get(
+                        "tpubench_membership_handoff_bytes_total"
+                    ).inc(n.get("handoff_bytes", 0))
+                else:
+                    reg.get("tpubench_membership_events_total").inc()
+                epoch = n.get("epoch")
+                if epoch is not None:
+                    reg.get("tpubench_membership_epoch").set(epoch)
             elif nk == "stage" and n.get("event") == "overlap":
                 reg.get("tpubench_stage_overlapped_total").inc()
 

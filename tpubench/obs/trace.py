@@ -58,6 +58,9 @@ SPAN_KINDS = {
     "serve": "an origin fetch made to answer a peer's request "
              "(owner side of a cross-host coop hop)",
     "coop": "a cooperative-cache ring decision (demote/restore)",
+    "member": "an elastic-membership transition (join/leave/fail/"
+              "pause/resume — epoch-numbered pod view changes) or its "
+              "warm-handoff byte accounting",
     "tune": "one autotuner decision window",
 }
 

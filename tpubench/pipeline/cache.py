@@ -512,6 +512,40 @@ class ChunkCache:
             if isinstance(e.data, SlabLease):
                 e.data.release()
 
+    def export_manifest(self, max_bytes: int = 0) -> list:
+        """MRU-first snapshot of resident entry identities as ``(key,
+        owner)`` pairs — the cooperative-departure **hot set** a leaving
+        owner drains to its chunks' new owners (the owner tag travels
+        too, so QoS byte-budget accounting survives the hop). Read-only:
+        no counters move, no LRU order changes, no payload bytes are
+        copied here — the drain copies one entry at a time through
+        :meth:`peek_bytes`, so a whole-cache drain never transiently
+        doubles the host's cache footprint. A byte budget
+        (``max_bytes``; 0 = everything) bounds the manifest to the
+        hottest entries."""
+        out: list = []
+        total = 0
+        with self._lock:
+            for k in reversed(self._entries):  # OrderedDict: MRU first
+                n = len(self._entries[k].data)
+                if max_bytes and total + n > max_bytes:
+                    break
+                out.append((k, self._entries[k].owner))
+                total += n
+        return out
+
+    def peek_bytes(self, key: ChunkKey):
+        """One entry's payload as immutable bytes, or None when it is
+        no longer resident. No counters move, no LRU reorder, no
+        payload reference taken — the copy happens under the cache
+        lock, so a concurrent eviction can never retire a slab
+        mid-read."""
+        from tpubench.mem.slab import payload_view
+
+        with self._lock:
+            e = self._entries.get(key)
+            return bytes(payload_view(e.data)) if e is not None else None
+
     def unused_prefetched_bytes(self) -> int:
         """Prefetched entries still waiting for their first use — at end
         of run these are waste (the prefetcher folds them in)."""

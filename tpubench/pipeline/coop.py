@@ -239,41 +239,86 @@ class LoopbackBroker:
     for the network — multi-"host" tests register N CoopCaches here and
     exercise the identical routing/dedup/demotion logic real pods run.
     ``delay_s`` injects per-host serve latency (straggler shaping for
-    the demotion tests/bench)."""
+    the demotion tests/bench). ``accept`` is the host's warm-handoff
+    landing callable (:meth:`CoopCache.accept_handoff`) — a departing
+    owner :meth:`push`\\ es its hot set there. A **paused** host (the
+    elastic fabric's stalled-but-not-dead state) raises *transient*
+    errors instead of serving: the requester's bounded peer-tier retry
+    re-asks, then falls through to origin."""
 
     def __init__(self):
         self._serves: dict[int, Callable[[ChunkKey], Optional[bytes]]] = {}
+        self._accepts: dict[int, Callable[[ChunkKey, bytes], bool]] = {}
         self._delay: dict[int, float] = {}
+        self._paused: set[int] = set()
         self._lock = threading.Lock()
 
     def register(self, host_id: int,
                  serve: Callable[[ChunkKey], Optional[bytes]],
-                 delay_s: float = 0.0) -> None:
+                 delay_s: float = 0.0,
+                 accept: Optional[Callable[[ChunkKey, bytes], bool]] = None,
+                 ) -> None:
         with self._lock:
             self._serves[int(host_id)] = serve
             if delay_s:
                 self._delay[int(host_id)] = delay_s
+            if accept is not None:
+                self._accepts[int(host_id)] = accept
 
     def unregister(self, host_id: int) -> None:
         with self._lock:
             self._serves.pop(int(host_id), None)
+            self._accepts.pop(int(host_id), None)
             self._delay.pop(int(host_id), None)
+
+    def pause(self, host_id: int) -> None:
+        """Make ``host_id`` unresponsive without removing it: requests
+        raise transient 503s (the retry stack's domain), pushes bounce."""
+        with self._lock:
+            self._paused.add(int(host_id))
+
+    def resume(self, host_id: int) -> None:
+        with self._lock:
+            self._paused.discard(int(host_id))
 
     def request(self, src: int, owner: int, key: ChunkKey) -> bytes:
         with self._lock:
             serve = self._serves.get(int(owner))
             delay = self._delay.get(int(owner), 0.0)
+            paused = int(owner) in self._paused
         if serve is None:
             # Definitive, not transient: a host this broker has never
             # seen will not appear by retrying (loopback brokers span
             # one process). The follower's remedy is its origin fetch.
             raise PeerMissError(f"peer host {owner} not registered")
+        if paused:
+            # Transient on purpose: a paused host may come back, so the
+            # peer-tier retry gets its (bounded, fast-backoff) say —
+            # after which the requester falls through to origin.
+            raise StorageError(
+                f"peer host {owner} is paused", transient=True, code=503,
+            )
         if delay:
             time.sleep(delay)
         data = serve(key)
         if data is None:
             raise PeerMissError(f"host {owner} shed {key.object} chunk")
         return data
+
+    def push(self, src: int, dst: int, key: ChunkKey, data: bytes,
+             owner: Optional[str] = None) -> bool:
+        """Warm-handoff delivery: ``src``'s departing owner hands one
+        hot chunk to ``dst`` (its new owner), QoS owner tag riding
+        along. Returns False when the destination cannot take it
+        (unregistered, paused, or its accept refused) — the pusher
+        counts the reject and moves on; the pod re-fetches that chunk
+        from origin like the killed-host arm."""
+        with self._lock:
+            accept = self._accepts.get(int(dst))
+            paused = int(dst) in self._paused
+        if accept is None or paused:
+            return False
+        return bool(accept(key, data, owner))
 
 
 class LoopbackChannel:
@@ -522,6 +567,16 @@ class CoopCache:
         self.serve_origin_bytes = 0
         self.demotions = 0
         self.restores = 0
+        # Warm-handoff accounting (elastic membership): chunks this host
+        # DRAINED to new owners at cooperative departure (out) and
+        # chunks it RECEIVED from a departing owner (in). The
+        # cooperative-vs-killed resize A/B is exactly out+in vs the
+        # origin re-fetch bytes the killed arm pays instead.
+        self.handoff_out_chunks = 0
+        self.handoff_out_bytes = 0
+        self.handoff_in_chunks = 0
+        self.handoff_in_bytes = 0
+        self.handoff_rejects = 0  # pushes the destination refused
         # Recent (owner, round-trip ns) peer transfer samples — the
         # stats percentiles AND the local demotion signal's source.
         self._transfer_ns: deque = deque(maxlen=TRANSFER_SAMPLE_CAP)
@@ -827,6 +882,90 @@ class CoopCache:
             with self._lock:
                 self._serving_bytes -= n
 
+    # ------------------------------------------------------ warm handoff --
+    def accept_handoff(self, key: ChunkKey, data: bytes,
+                       owner: Optional[str] = None) -> bool:
+        """Land one hot chunk a departing owner drained to this host
+        (invoked by the fabric's push, on the departing host's thread).
+        The payload takes the ordinary landing path — a leased slab when
+        the pool is on — and inserts as a demand entry under the SAME
+        QoS owner tag it carried on the departing host (per-class cache
+        budgets must survive the hop, or every cooperative departure
+        would dilute the weighted-eviction guarantee with untagged
+        bytes). The next miss for the key is a local hit instead of an
+        origin fetch. Returns False when this host cannot take it
+        (closed/disabled, or the bytes don't match the key)."""
+        if self._closed or not self._enabled:
+            return False
+        if len(data) != key.length:
+            return False
+        payload = self._land(data, key)
+        try:
+            self.cache.insert(key, payload, owner=owner)
+        finally:
+            release_payload(payload)  # the cache holds its own reference
+        with self._lock:
+            self.handoff_in_chunks += 1
+            self.handoff_in_bytes += key.length
+        return True
+
+    def drain_hot_set(self, push: Callable[..., bool],
+                      owner_for: Callable[[ChunkKey], Optional[int]],
+                      max_bytes: int = 0) -> dict:
+        """Cooperative departure: hand this host's resident hot set to
+        each chunk's NEW owner (``owner_for`` resolves against the
+        post-departure ring) over ``push(owner_host, key, data,
+        owner_tag)``. MRU-first, so a byte budget (``max_bytes``; 0 =
+        everything) drains the hottest chunks first. Chunks whose new
+        owner is this host or nobody are skipped; refused pushes are
+        counted and abandoned (the pod re-fetches those from origin —
+        strictly no worse than a kill)."""
+        chunks = nbytes = rejected = skipped = 0
+        for key, tag in self.cache.export_manifest(max_bytes=max_bytes):
+            owner = owner_for(key)
+            if owner is None or owner == self.host_id:
+                skipped += 1
+                continue
+            # One entry at a time (manifest first, bytes per push): a
+            # whole-cache drain must not transiently double the host's
+            # cache footprint at the exact moment the pod is resizing.
+            data = self.cache.peek_bytes(key)
+            if data is None:
+                skipped += 1  # evicted since the manifest snapshot
+                continue
+            if push(owner, key, data, tag):
+                chunks += 1
+                nbytes += len(data)
+            else:
+                rejected += 1
+        with self._lock:
+            self.handoff_out_chunks += chunks
+            self.handoff_out_bytes += nbytes
+            self.handoff_rejects += rejected
+        return {
+            "chunks": chunks, "bytes": nbytes,
+            "rejected": rejected, "skipped": skipped,
+        }
+
+    def purge_host_samples(self, host: int) -> None:
+        """Forget peer-transfer samples attributed to ``host`` (called
+        on every membership epoch that removes it): straggler evidence
+        about a departed owner must not survive the view change — a
+        rejoining host starts from a clean slate, and the demotion scan
+        must never act on rounds served by a host that is gone."""
+        with self._lock:
+            kept = [s for s in self._transfer_ns if s[0] != int(host)]
+            self._transfer_ns.clear()
+            self._transfer_ns.extend(kept)
+
+    def reset_member_state(self) -> None:
+        """Clean-rejoin reset for THIS host: drop every peer-transfer
+        sample (they were measured under a dead epoch's view). Ring
+        demotion state needs no reset here — ``HashRing.remove_host``
+        already forgot it when the host left."""
+        with self._lock:
+            self._transfer_ns.clear()
+
     def _acquire_serve_ring(self) -> str:
         """Exclusive serve-ring name: pool bounded by peak concurrency,
         each name held by exactly one in-flight serve (the ring's one
@@ -1008,9 +1147,33 @@ class CoopCache:
                 ),
                 "demotions": self.demotions,
                 "restores": self.restores,
+                "handoff_out_chunks": self.handoff_out_chunks,
+                "handoff_out_bytes": self.handoff_out_bytes,
+                "handoff_in_chunks": self.handoff_in_chunks,
+                "handoff_in_bytes": self.handoff_in_bytes,
+                "handoff_rejects": self.handoff_rejects,
                 "transfer_p50_ms": transfer.p50_ms if transfer else None,
                 "transfer_p99_ms": transfer.p99_ms if transfer else None,
             }
+
+
+# Shared-fabric broker slot: a membership-aware fabric (the elastic
+# serve harness, an embedding test pod) registers its broker here so
+# coop_from_config can build a MULTI-host loopback membership whose
+# peers are actually reachable. One process, one pod fabric — a module
+# slot, not a registry keyed by name.
+_SHARED_BROKER: list = []
+
+
+def register_shared_broker(broker: Optional[LoopbackBroker]) -> None:
+    """Install (or, with None, clear) the process's shared pod broker.
+    While installed, loopback multi-host memberships in
+    :func:`coop_from_config` attach to it instead of failing."""
+    _SHARED_BROKER[:] = [] if broker is None else [broker]
+
+
+def shared_broker() -> Optional[LoopbackBroker]:
+    return _SHARED_BROKER[0] if _SHARED_BROKER else None
 
 
 def coop_from_config(cfg, cache: ChunkCache, origin_fetch,
@@ -1032,25 +1195,25 @@ def coop_from_config(cfg, cache: ChunkCache, origin_fetch,
 
             channel = IciPeerChannel(host_id=host_id)
         else:
-            # Loopback: a PRIVATE broker spans exactly this process, so
-            # a multi-host membership would route most misses at peers
-            # that can never answer (every routed read pays a failed
-            # lookup before its origin fallback). Collapse the ring to
-            # this host — the degenerate zero-routing pod — and say so;
-            # real pods use channel="ici", embedding harnesses inject a
-            # shared channel.
-            if n_hosts > 1:
-                import sys
-
-                print(
+            # Loopback + multi-host: only legal over a SHARED broker (a
+            # membership-aware fabric registered one for this process).
+            # A private broker spans exactly this process, so an N-host
+            # membership over it would route most misses at peers that
+            # can never answer — with elastic membership in the picture
+            # that silent degrade is a measurement lie (the run claims
+            # an N-host pod and measures a pod of one), so it is now a
+            # hard error instead of a warning-and-collapse.
+            broker = shared_broker()
+            if n_hosts > 1 and broker is None:
+                raise SystemExit(
                     f"coop: loopback channel cannot reach the other "
-                    f"{n_hosts - 1} host(s) from process {host_id}; "
-                    "running with a single-host ring (use "
-                    "--coop-channel ici on a real pod)",
-                    file=sys.stderr,
+                    f"{n_hosts - 1} host(s) from process {host_id} — a "
+                    "multi-host loopback membership needs a shared pod "
+                    "fabric (register_shared_broker / the elastic serve "
+                    "harness); on a real pod use --coop-channel ici"
                 )
-                n_hosts = 0  # membership = {host_id} below
-            broker = LoopbackBroker()
+            if broker is None:
+                broker = LoopbackBroker()
             channel = LoopbackChannel(broker, host_id)
     ring = HashRing(
         range(n_hosts) if n_hosts >= 1 else [host_id], vnodes=cc.vnodes
@@ -1073,7 +1236,7 @@ def coop_from_config(cfg, cache: ChunkCache, origin_fetch,
     )
     broker = getattr(channel, "_broker", None)
     if broker is not None:
-        broker.register(host_id, coop.serve)
+        broker.register(host_id, coop.serve, accept=coop.accept_handoff)
     return coop
 
 

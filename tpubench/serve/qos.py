@@ -134,6 +134,10 @@ class Request:
     enqueue_ns: int = 0  # real clock at push (deadline anchor)
     seq: int = 0
     index: int = 0  # position in the merged schedule (prefetch cursor)
+    # Elastic pod: the front-end host this arrival was dispatched to
+    # (-1 = single-host plane / no live host at dispatch time). A
+    # worker that finds the host dead at pop time fails over.
+    host: int = -1
 
     @property
     def deadline_ns(self) -> int:

@@ -301,8 +301,47 @@ def run_chaos(
     fc = cfg.transport.fault
     if timeline is not None:
         fc.phases = timeline
+    # Restored on exit (the caller may reuse cfg; a second run must not
+    # inherit this run's stripped byte phases or its host events).
+    restore_phases = list(fc.phases or ())
+    restore_member_tl = list(cfg.serve.membership_timeline)
+    # Host-level membership faults (kill_host / leave_host / pause_host
+    # / rejoin_host) ride the SAME timeline as the byte-level fault
+    # plan and compose with it — split them out before fault validation
+    # (they are membership-plane events, not FaultPlan fields). They
+    # are only meaningful under the elastic serve pod.
+    from tpubench.config import MEMBER_TIMELINE_ACTIONS
+
+    member_phases = []
+    byte_phases = []
+    for i, ph in enumerate(fc.phases or ()):
+        if (isinstance(ph, (list, tuple)) and len(ph) == 3
+                and isinstance(ph[2], dict)
+                and set(ph[2]) & set(MEMBER_TIMELINE_ACTIONS)):
+            # Numeric window check HERE: member phases skip the byte-
+            # level validate_fault_config below, and the full timeline
+            # validator only runs later inside run_serve — a malformed
+            # stamp must still die as a one-line SystemExit, never a
+            # TypeError in the scaling arithmetic.
+            try:
+                t0, t1 = float(ph[0]), float(ph[1])
+            except (TypeError, ValueError):
+                raise SystemExit(
+                    f"chaos: timeline[{i}]: host-fault window "
+                    f"[{ph[0]!r}, {ph[1]!r}] must be numeric"
+                ) from None
+            member_phases.append([t0, t1, dict(ph[2])])
+        else:
+            byte_phases.append(ph)
+    fc.phases = byte_phases
+    if member_phases and chaos_workload != "serve":
+        raise SystemExit(
+            "chaos: host-level faults (kill_host/leave_host/pause_host/"
+            "rejoin_host) compose with the elastic serve pod only — use "
+            "--chaos-workload serve with --serve-hosts >= 2"
+        )
     validate_fault_config(fc, "transport.fault")
-    if not fc.phases:
+    if not fc.phases and not member_phases:
         raise SystemExit(
             "chaos: no fault timeline — pass --chaos-timeline or the "
             "--chaos-fault/--chaos-start/--chaos-duration trio "
@@ -328,6 +367,16 @@ def run_chaos(
     for f in _TIME_FIELDS:
         if fdict.get(f):
             fdict[f] = fdict[f] * scale
+    # The serve plane scales its own (virtual) clock, so the membership
+    # timeline passes through UNSCALED; the resilience scorecard maps
+    # real record stamps onto scaled seconds, so its fault-window
+    # bounding box takes the SCALED twin of each member window.
+    score_phases = phases + [
+        [t0 * scale, t1 * scale, dict(spec)]
+        for t0, t1, spec in member_phases
+    ]
+    if member_phases:
+        cfg.serve.membership_timeline = member_phases
 
     # Flight recorder is the scorecard's raw material: force it on, sized
     # to hold every read, journaled to disk (a temp path unless the run
@@ -351,6 +400,8 @@ def run_chaos(
     if chaos_workload == "train-ingest":
         pl = cfg.pipeline
         reads_expected = pl.steps * pl.epochs * pl.batch_shards
+    elif chaos_workload == "serve":
+        reads_expected = int(cfg.serve.rate_rps * cfg.serve.duration_s)
     cfg.obs.flight_records = max(
         cfg.obs.flight_records, reads_expected * 2 + 64
     )
@@ -397,10 +448,19 @@ def run_chaos(
 
             def _runner(cfg, backend):
                 return run_pod_ingest(cfg, backend=backend)
+        elif chaos_workload == "serve":
+            # The open-loop (optionally elastic) serve plane: byte-level
+            # faults hit the shared origin through the fault plan while
+            # host-level member_phases change the pod's shape — the
+            # "pod that changes shape under live faulty traffic" cell.
+            from tpubench.workloads.serve import run_serve
+
+            def _runner(cfg, backend):
+                return run_serve(cfg, backend=backend, tracer=tracer)
         else:
             raise SystemExit(
                 f"chaos: unknown workload {chaos_workload!r} "
-                "(read|pod-ingest|train-ingest)"
+                "(read|pod-ingest|train-ingest|serve)"
             )
         from tpubench.storage import open_backend
 
@@ -442,9 +502,10 @@ def run_chaos(
         res.extra["chaos"] = {
             "workload": chaos_workload,
             "timeline": phases,
+            "member_timeline": member_phases,
             "sleep_scale": scale,
             "scorecard": resilience_scorecard(
-                records, phases, epoch_ns,
+                records, score_phases, epoch_ns,
                 tail_stats=res.extra.get("tail"),
             ),
         }
@@ -463,3 +524,5 @@ def run_chaos(
         cfg.obs.flight_records = cfg_restore["flight_records"]
         cfg.obs.flight_journal = cfg_restore["flight_journal"]
         cfg.obs.journal_max_bytes = cfg_restore["journal_max_bytes"]
+        cfg.transport.fault.phases = restore_phases
+        cfg.serve.membership_timeline = restore_member_tl

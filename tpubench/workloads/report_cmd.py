@@ -67,6 +67,17 @@ def _axis(run: dict) -> str:
         bits.append("serve " + ("qos" if sv.get("qos") else "qos-off"))
         if sv.get("sweep"):
             bits.append("sweep")
+    mb = run.get("extra", {}).get("membership")
+    if mb:
+        # Elastic-pod runs carry their own A/B axis: the cooperative-
+        # leave arm (handoff bytes flowed) vs the killed-host arm must
+        # not render as twins.
+        bits.append(f"elastic {mb.get('hosts', 0)}h")
+        actions = {e.get("action") for e in mb.get("events", ())}
+        if "leave_host" in actions:
+            bits.append("coop-leave")
+        if "kill_host" in actions:
+            bits.append("killed")
     # Adaptive-vs-static is an A/B axis of its own: a run the controller
     # drove must not render as a twin of its static sibling.
     if (run.get("extra", {}).get("tune") or {}).get("enabled") or \
@@ -134,6 +145,14 @@ def summarize_run(run: dict, label: str = "") -> str:
         from tpubench.workloads.serve import format_serve_scorecard
 
         lines.append(format_serve_scorecard(sv))
+    mb = extra.get("membership")
+    if mb:
+        # Elastic-membership resize scorecard: events with remap/handoff
+        # accounting, SLO during resize windows vs steady state,
+        # origin-byte split, time-to-rewarm.
+        from tpubench.workloads.serve import format_membership_scorecard
+
+        lines.append(format_membership_scorecard(mb))
     tel = extra.get("telemetry")
     if tel:
         # Live-telemetry stamp: where the run was scrapeable and what
@@ -278,6 +297,37 @@ def compare_runs(runs: list[dict]) -> str:
                     f", goodput retention {retention:.1%}"
                     if retention is not None else ""
                 )
+            )
+        # Membership diff: the cooperative-leave arm against the
+        # killed-host arm compares on what elastic membership exists
+        # for — did the warm handoff replace origin re-fetches during
+        # the resize window, and did the protected class's SLO survive
+        # the reshape.
+        omb = other.get("extra", {}).get("membership")
+        bmb = base.get("extra", {}).get("membership")
+        if omb and bmb:
+            def _gold_resize(mb):
+                # "gold" = the first entry: the scorecard writes classes
+                # in priority order, so insertion order IS rank.
+                slo = (mb.get("slo") or {}).get("resize") or {}
+                for v in slo.values():
+                    return v
+                return None
+
+            og_, bg2 = _gold_resize(omb), _gold_resize(bmb)
+            lines.append(
+                "    membership: handoff "
+                f"{(omb.get('handoff') or {}).get('out_bytes', 0)}B vs "
+                f"{(bmb.get('handoff') or {}).get('out_bytes', 0)}B, "
+                "resize-window origin "
+                f"{(omb.get('origin_bytes') or {}).get('resize_windows', 0)}B vs "
+                f"{(bmb.get('origin_bytes') or {}).get('resize_windows', 0)}B, "
+                "gold SLO during resize "
+                + (f"{og_:.1%}" if og_ is not None else "n/a")
+                + " vs "
+                + (f"{bg2:.1%}" if bg2 is not None else "n/a")
+                + ", failovers "
+                f"{omb.get('failovers', 0)} vs {bmb.get('failovers', 0)}"
             )
         # Tune diff: a static run against its adaptive sibling compares
         # on what the controller exists for — the converged operating

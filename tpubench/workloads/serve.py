@@ -174,11 +174,15 @@ class _ShedLog:
 def run_serve(cfg: BenchConfig, backend: Optional[StorageBackend] = None,
               rate_rps: Optional[float] = None, tracer=None) -> RunResult:
     """One open-loop serve run at the configured offered load (or
-    ``rate_rps``, the sweep's per-point override)."""
+    ``rate_rps``, the sweep's per-point override). ``serve.hosts > 1``
+    fans the same schedule across an N-host elastic pod
+    (:class:`_ElasticServe`) whose membership may change mid-run."""
     validate_serve_config(cfg.serve)
     owns_backend = backend is None
     backend = backend or open_backend(cfg, tracer=tracer)
     try:
+        if cfg.serve.hosts > 1:
+            return _ElasticServe(cfg, backend, rate_rps).run()
         return _Serve(cfg, backend, rate_rps).run()
     finally:
         if owns_backend:
@@ -486,64 +490,665 @@ class _Serve:
 
     def _scorecard(self, schedule, ledgers, recorders, tenant_bytes,
                    qstats, wall, completed_bytes, classes) -> dict:
+        return serve_scorecard(
+            self.cfg.serve, schedule, ledgers, recorders, tenant_bytes,
+            qstats, wall, completed_bytes, classes,
+        )
+
+
+def serve_scorecard(sc, schedule, ledgers, recorders, tenant_bytes,
+                    qstats, wall, completed_bytes, classes) -> dict:
+    """The per-class serve scorecard (``extra["serve"]``), shared by the
+    single-host and elastic-pod planes — the A/B between them must never
+    come from scorecard-math drift."""
+    per_class = {}
+    for c in classes:
+        cls = str(c["name"])
+        led = ledgers[cls]
+        rec = recorders[cls]
+        arr = rec.as_ns_array()
+        per_class[cls] = {
+            "priority": int(c.get("priority", 0)),
+            "weight": float(c.get("weight", 1.0)),
+            "deadline_ms": float(c["deadline_ms"]),
+            "arrivals": led.arrivals,
+            "completed": led.completed,
+            "deadline_met": led.deadline_met,
+            "shed": led.shed,
+            "errors": led.errors,
+            "bytes": led.bytes,
+            "slo_attainment": led.slo_attainment(),
+            "p50_ms": float(np.percentile(arr, 50) / 1e6)
+            if arr.size else None,
+            "p99_ms": float(np.percentile(arr, 99) / 1e6)
+            if arr.size else None,
+        }
+    # Jain fairness over weight-normalized per-TENANT goodput:
+    # tenants that sent traffic compete; a starved tenant's 0 is a
+    # legitimate unfairness sample (zero-completed ≠ excluded).
+    # Weights come off the schedule's own Request objects — never a
+    # build_tenants re-derivation that must stay bit-identical.
+    weights = {r.tenant.name: r.tenant.weight for r in schedule}
+    norm = [
+        tenant_bytes.get(name, 0) / w
+        for name, w in sorted(weights.items())
+    ]
+    arrivals = len(schedule)
+    completed = sum(led.completed for led in ledgers.values())
+    shed = sum(led.shed for led in ledgers.values())
+    return {
+        "qos": sc.qos,
+        "arrival": sc.arrival,
+        "tenants": sc.tenants,
+        "active_tenants": len(weights),
+        "duration_s": sc.duration_s,
+        "wall_s": wall,
+        "offered_rps": arrivals / wall if wall > 0 else None,
+        "achieved_rps": completed / wall if wall > 0 else None,
+        "arrivals": arrivals,
+        "completed": completed,
+        "shed": shed,
+        "shed_by_reason": qstats["shed"],
+        "goodput_gbps": (completed_bytes / 1e9) / wall
+        if wall > 0 else 0.0,
+        "jain_fairness": jain_index(norm),
+        "queue": {
+            k: qstats[k] for k in (
+                "cap", "queue_limit", "peak_queue", "peak_in_service",
+            )
+        },
+        "classes": per_class,
+    }
+
+
+def _merge_windows(windows: list) -> list:
+    """Merge overlapping [t0, t1] intervals (the resize windows the
+    scorecard brackets events with)."""
+    out: list = []
+    for w0, w1 in sorted(windows):
+        if out and w0 <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], w1)
+        else:
+            out.append([w0, w1])
+    return out
+
+
+def _in_windows(t: float, windows: list) -> bool:
+    return any(w0 <= t < w1 for w0, w1 in windows)
+
+
+class _ElasticServe:
+    """The serve plane fanned across an N-host hermetic threaded pod
+    with ELASTIC membership: every miss routes through coop-cache
+    consistent-hash ownership over a shared loopback fabric, and the
+    ``serve.membership_timeline`` changes the pod's shape mid-run —
+    hosts die (``kill_host``: no goodbye, peers fall back to origin
+    through the PeerMissError/retry composition), leave cooperatively
+    (``leave_host``: the warm-handoff protocol drains the departing
+    owner's hot set to the chunks' new owners over the peer channel),
+    stall (``pause_host``: transient peer errors for the window) and
+    come back clean (``rejoin_host``). Membership events ride the
+    dispatcher's own schedule walk (virtual time), so runs replay
+    bit-identically for a seed; the resize scorecard lands in
+    ``extra["membership"]``.
+
+    In-flight requests against a dead host FAIL OVER at pop time (the
+    worker re-targets a live host) — the admission queue never wedges
+    on a death. Dispatch targets only live hosts; the pod completing
+    with zero live hosts is the (counted) degenerate error case."""
+
+    def __init__(self, cfg: BenchConfig, backend: StorageBackend,
+                 rate_rps: Optional[float]):
+        self.cfg = cfg
+        self.backend = backend
+        self.rate_rps = rate_rps
+
+    def run(self) -> RunResult:
+        # Lazy elastic-plane imports: the single-host serve path (and
+        # `tpubench report`, which imports this module for rendering)
+        # must not pay for them.
+        from tpubench.dist.membership import ElasticFabric, remap_stats
+        from tpubench.mem.slab import (
+            CopyMeter,
+            SlabPool,
+            release_payload,
+        )
+        from tpubench.pipeline.coop import CoopCache, LoopbackChannel
+        from tpubench.storage.base import StorageError
+
+        cfg, sc = self.cfg, self.cfg.serve
+        if getattr(cfg, "tune", None) is not None and cfg.tune.enabled:
+            # Loud, not silent: the serve tune controller actuates the
+            # single-host plane's knobs — running it disconnected would
+            # hand a tuning user arms that never moved.
+            raise SystemExit(
+                "serve: --tune does not compose with the elastic pod "
+                "(serve.hosts > 1) yet — run the autotuner on the "
+                "single-host plane"
+            )
+        chunk = sc.chunk_bytes or cfg.workload.granule_bytes
+        schedule = build_schedule(cfg, self.backend, self.rate_rps)
+        tlabel = transport_label(cfg)
+        scale = parse_sleep_scale("serve arrival gaps")
+        gaps = scaled_gaps([r.arrival_s for r in schedule], scale)
+
+        qos = sc.qos
+        budgets = class_budget_split(sc.classes, cfg.pipeline.cache_bytes) \
+            if qos else None
+        flight = flight_from_config(cfg)
+        shed_log = _ShedLog(flight, tlabel)
+
+        # Per-request SLO outcome, indexed by schedule position: True =
+        # completed within deadline, False = late/shed/error, None =
+        # never resolved (counts as a miss). The resize-vs-steady SLO
+        # split is computed from this against the event windows.
+        outcome: list = [None] * len(schedule)
+
+        def on_shed(req: Request, reason: str) -> None:
+            outcome[req.index] = False
+            shed_log(req, reason)
+
+        queue = AdmissionQueue(
+            cap=sc.admission_cap or sc.workers, qos=qos,
+            queue_limit=(sc.queue_limit or 8 * sc.workers) if qos else 0,
+            on_shed=on_shed,
+        )
+        worker_flights = [
+            flight.worker(f"serve-{i}") if flight is not None else None
+            for i in range(sc.workers)
+        ]
+
+        # ---- the pod: N hosts over one membership-aware fabric ------
+        vnow = [0.0]  # virtual schedule time, driven by the dispatcher
+        fabric = ElasticFabric(
+            sc.hosts, vnodes=cfg.coop.vnodes, clock=lambda: vnow[0],
+            flight_ring=(
+                flight.worker("member") if flight is not None else None
+            ),
+        )
+        pc = cfg.pipeline
+        use_pool = pc.slab_pool and chunk > 0
+        slab_bytes = max(chunk, pc.slab_bytes)
+        pool_slabs = pc.pool_slabs or 64
+        hosts: dict[int, dict] = {}
+        for h in range(sc.hosts):
+            pool = (
+                SlabPool(slab_bytes, pool_slabs, use_native=False)
+                if use_pool else None
+            )
+            meter = CopyMeter()
+            cache = ChunkCache(pc.cache_bytes, owner_budgets=budgets)
+
+            def origin_fetch(key, _pool=pool, _meter=meter):
+                return fetch_chunk(
+                    self.backend, key, pool=_pool, meter=_meter
+                )
+
+            coop = CoopCache(
+                cache,
+                host_id=h,
+                ring=fabric.ring,
+                channel=LoopbackChannel(fabric.broker, h),
+                origin_fetch=origin_fetch,
+                pool=pool,
+                meter=meter,
+                enabled=True,
+                peer_budget_bytes=cfg.coop.peer_budget_bytes,
+                retry_cfg=cfg.transport.retry,
+                flight_recorder=flight,
+            )
+            fabric.add_host(coop)
+            hosts[h] = {"coop": coop, "cache": cache, "pool": pool,
+                        "meter": meter}
+
+        # ---- membership plan + resize windows (virtual seconds) -----
+        member_plan: list = []  # (t, action, host)
+        windows: list = []
+        for t0, t1, spec in sc.membership_timeline:
+            (action, host), = spec.items()
+            t0, t1 = float(t0), float(t1)
+            if action == "pause_host":
+                member_plan.append((t0, "pause_host", int(host)))
+                member_plan.append((t1, "resume_host", int(host)))
+                windows.append([t0, t1 + sc.resize_window_s])
+            else:
+                member_plan.append((t0, action, int(host)))
+                windows.append([t0, t0 + sc.resize_window_s])
+        member_plan.sort(key=lambda e: e[0])
+        windows = _merge_windows(windows)
+
+        uniq_keys = list({r.key for r in schedule})
+        events_out: list = []
+        snapshots: list = []  # (t_virtual, aggregate-counter dict)
+
+        classes = sorted(
+            sc.classes, key=lambda c: int(c.get("priority", 0))
+        )
+        ledgers = {str(c["name"]): ClassLedger() for c in classes}
+        recorders = {
+            str(c["name"]): LatencyRecorder(f"request_{c['name']}")
+            for c in classes
+        }
+        agg_rec = LatencyRecorder("request")
+        ledger_lock = threading.Lock()
+        tenant_bytes: dict[str, int] = {}
+        completed_bytes = [0]
+        failovers = [0]
+        no_live_host_errors = [0]
+
+        for req in schedule:
+            ledgers[req.tenant.cls].arrivals += 1
+
+        def take_snapshot(t: float) -> None:
+            agg = fabric.aggregate()
+            with ledger_lock:
+                agg["completed"] = sum(
+                    led.completed for led in ledgers.values()
+                )
+            snapshots.append((t, agg))
+
+        def apply_event(t: float, action: str, host: int) -> None:
+            vnow[0] = max(vnow[0], t)
+            before = fabric.owners_of(uniq_keys)
+            handoff = None
+            if action == "kill_host":
+                ok = fabric.kill_host(host)
+            elif action == "leave_host":
+                handoff = fabric.leave_host(host)
+                ok = handoff is not None
+            elif action == "pause_host":
+                ok = fabric.pause_host(host)
+            elif action == "resume_host":
+                ok = fabric.resume_host(host)
+            elif action == "rejoin_host":
+                ok = fabric.rejoin_host(host)
+            else:  # unreachable under validate_membership_timeline
+                ok = False
+            ev = {
+                "t_s": t, "action": action, "host": host, "applied": ok,
+                "epoch": fabric.membership.epoch,
+            }
+            ev.update(remap_stats(
+                uniq_keys, before, fabric.owners_of(uniq_keys)
+            ))
+            if handoff is not None:
+                ev["handoff"] = handoff
+            events_out.append(ev)
+            take_snapshot(t)
+
+        # ---- telemetry (the single-host wiring) ---------------------
+        jpath_stream = None
+        if cfg.obs.flight_journal:
+            jpath_stream = host_journal_path(
+                cfg.obs.flight_journal, cfg.dist.process_id,
+                cfg.dist.num_processes,
+            )
+        tel = telemetry_from_config(cfg)
+        tel_summary = None
+        if tel is not None:
+            tel.resource["workload"] = "serve"
+            if flight is not None:
+                tel.attach_flight(flight)
+                if jpath_stream:
+                    tel.stream_journal(
+                        flight, jpath_stream,
+                        extra_fn=lambda: {"workload": "serve"},
+                        max_bytes=cfg.obs.journal_max_bytes,
+                    )
+            tel.attach_recorders([agg_rec])
+            tel.start()
+
+        def worker(i: int) -> None:
+            wf = worker_flights[i]
+            while True:
+                req = queue.pop()
+                if req is None:
+                    return
+                cls = req.tenant.cls
+                t_pop = time.perf_counter_ns()
+                op = None
+                try:
+                    host = req.host
+                    if not fabric.is_dispatchable(host):
+                        # The assigned front end died/paused while this
+                        # request sat queued: fail over to a live host
+                        # instead of wedging or erroring — exactly what
+                        # a pod front door does.
+                        live = sorted(fabric.live_hosts())
+                        if not live:
+                            with ledger_lock:
+                                no_live_host_errors[0] += 1
+                            raise StorageError(
+                                "no live hosts in the pod",
+                                transient=False,
+                            )
+                        host = live[req.index % len(live)]
+                        with ledger_lock:
+                            failovers[0] += 1
+                    entry = hosts[host]
+                    cache, coop = entry["cache"], entry["coop"]
+                    data = cache.get(req.key)
+                    if data is not None:
+                        source = "hit"
+                        if wf is not None:
+                            op = wf.begin(
+                                req.key.object, tlabel, kind="cache",
+                                enqueue_ns=req.enqueue_ns,
+                            )
+                            op.mark("cache_hit")
+                    else:
+                        if wf is not None:
+                            op = wf.begin(
+                                req.key.object, tlabel,
+                                enqueue_ns=req.enqueue_ns,
+                            )
+                            op.mark("cache_miss", t_pop)
+                        data, source = cache.get_or_fetch_info(
+                            req.key,
+                            lambda k=req.key, c=coop: c.fetch(k),
+                            owner=cls if qos else None,
+                        )
+                        if op is not None:
+                            if source == "hit":
+                                # Raced hit (the single-host plane's
+                                # discipline): the would-be miss record
+                                # becomes a cache record so the fetcher
+                                # stays the only byte-carrying one.
+                                op.abandon()
+                                op = wf.begin(
+                                    req.key.object, tlabel, kind="cache",
+                                    enqueue_ns=req.enqueue_ns,
+                                )
+                                op.mark("cache_hit")
+                            else:
+                                op.mark("body_complete")
+                    done_ns = time.perf_counter_ns()
+                    met = done_ns <= req.deadline_ns
+                    nbytes = len(data)
+                    release_payload(data)  # consumer lease ref, if any
+                    if op is not None:
+                        op.note(
+                            "serve_req", cls=cls, outcome="completed",
+                            deadline_met=met, host=host,
+                        )
+                        op.finish(
+                            nbytes if source in ("hit", "fetched") else 0
+                        )
+                    lat_ns = done_ns - req.enqueue_ns
+                    with ledger_lock:
+                        led = ledgers[cls]
+                        led.completed += 1
+                        led.bytes += nbytes
+                        if met:
+                            led.deadline_met += 1
+                        tenant_bytes[req.tenant.name] = (
+                            tenant_bytes.get(req.tenant.name, 0) + nbytes
+                        )
+                        completed_bytes[0] += nbytes
+                    outcome[req.index] = bool(met)
+                    recorders[cls].record_ns(lat_ns)
+                    agg_rec.record_ns(lat_ns)
+                except Exception as e:  # noqa: BLE001 — per-request domain
+                    # The single-host plane's rule: one tenant's failed
+                    # fetch is its ledger's error, never a run abort;
+                    # KeyboardInterrupt/SystemExit still stop the run.
+                    if op is not None:
+                        op.finish(error=e)
+                    outcome[req.index] = False
+                    with ledger_lock:
+                        ledgers[req.tenant.cls].errors += 1
+                finally:
+                    queue.done()
+
+        threads = [
+            threading.Thread(target=worker, args=(i,),
+                             name=f"serve-{i}", daemon=True)
+            for i in range(sc.workers)
+        ]
+        activation = flight.activate() if flight is not None else None
+        t0 = time.perf_counter_ns()
+        try:
+            if activation is not None:
+                activation.__enter__()
+            for t in threads:
+                t.start()
+            take_snapshot(0.0)
+            # ---- the open loop, with membership events interleaved --
+            mp_i = 0
+            snap_every = max(1, len(schedule) // 64)
+            rr = 0
+            for req, gap in zip(schedule, gaps):
+                while (mp_i < len(member_plan)
+                       and member_plan[mp_i][0] <= req.arrival_s):
+                    apply_event(*member_plan[mp_i])
+                    mp_i += 1
+                if gap > 0:
+                    time.sleep(gap)
+                vnow[0] = max(vnow[0], req.arrival_s)
+                live = sorted(fabric.live_hosts())
+                req.host = live[rr % len(live)] if live else -1
+                rr += 1
+                req.enqueue_ns = time.perf_counter_ns()
+                queue.push(req)
+                if rr % snap_every == 0:
+                    take_snapshot(req.arrival_s)
+            while mp_i < len(member_plan):  # events past the last arrival
+                apply_event(*member_plan[mp_i])
+                mp_i += 1
+            grace_s = max(1.0, 2.0 * scale)
+            t_end = time.monotonic() + grace_s
+            while (queue.queued or queue.in_service) \
+                    and time.monotonic() < t_end:
+                time.sleep(0.005)
+        finally:
+            drained = queue.close()
+            for t in threads:
+                t.join(timeout=5.0)
+            take_snapshot(max(vnow[0], sc.duration_s))
+            if activation is not None:
+                activation.__exit__(None, None, None)
+            if tel is not None:
+                tel.set_chips(1)
+                tel_summary = tel.close()
+        wall = (time.perf_counter_ns() - t0) / 1e9
+
+        # ---- teardown: coops, caches, pools (leak detection) --------
+        per_host = []
+        pool_leaks = 0
+        fabric.close()
+        for h, entry in sorted(hosts.items()):
+            stats = {"host": h, "coop": entry["coop"].stats(),
+                     "cache": entry["cache"].stats(),
+                     "copies": entry["meter"].stats()}
+            entry["cache"].close()
+            if entry["pool"] is not None:
+                ps = entry["pool"].close()
+                pool_leaks += ps.get("leaked_slabs", 0)
+                stats["pool"] = ps
+            per_host.append(stats)
+
+        qstats = queue.stats()
+        qstats["drained_at_close"] = drained
+        for reason, by_cls in qstats["shed"].items():
+            for cls, n in by_cls.items():
+                if cls in ledgers:
+                    ledgers[cls].shed += n
+
+        serve_extra = serve_scorecard(
+            sc, schedule, ledgers, recorders, tenant_bytes, qstats,
+            wall, completed_bytes[0], classes,
+        )
+        membership = self._membership_scorecard(
+            schedule, outcome, events_out, windows, snapshots, per_host,
+            failovers[0], no_live_host_errors[0], pool_leaks, classes,
+            fabric,
+        )
+
+        summaries = {}
+        if len(agg_rec):
+            summaries["request"] = summarize_ns(agg_rec.as_ns_array())
+        for cls, rec in recorders.items():
+            if len(rec):
+                summaries[f"request_{cls}"] = summarize_ns(
+                    rec.as_ns_array()
+                )
+        gbps = (completed_bytes[0] / 1e9) / wall if wall > 0 else 0.0
+        errors = sum(led.errors for led in ledgers.values())
+        res = RunResult(
+            workload="serve",
+            config=cfg.to_dict(),
+            bytes_total=completed_bytes[0],
+            wall_seconds=wall,
+            gbps=gbps,
+            gbps_per_chip=gbps,
+            n_chips=1,
+            summaries=summaries,
+            errors=errors,
+        )
+        res.extra["serve"] = serve_extra
+        res.extra["membership"] = membership
+        if tel_summary is not None:
+            res.extra["telemetry"] = tel_summary
+        from tpubench.storage.tail import collect_tail_stats
+
+        tail_stats = collect_tail_stats(self.backend)
+        if tail_stats:
+            res.extra["tail"] = tail_stats
+        if flight is not None:
+            res.extra["flight"] = flight.summary()
+            if jpath_stream:
+                res.extra["flight_journal"] = flight.write_journal(
+                    jpath_stream,
+                    extra={"workload": "serve", "n_chips": 1},
+                    max_bytes=cfg.obs.journal_max_bytes,
+                )
+        return res
+
+    # ----------------------------------------------------- scorecard --
+    def _membership_scorecard(self, schedule, outcome, events_out,
+                              windows, snapshots, per_host, failovers,
+                              no_live_host_errors, pool_leaks, classes,
+                              fabric) -> dict:
         sc = self.cfg.serve
-        per_class = {}
+
+        # Per-class SLO, resize windows vs steady state — by ARRIVAL
+        # time (the open-loop convention: the system owns everything
+        # that arrived in the window, including what it shed).
+        split: dict = {"resize": {}, "steady": {}}
+        counts = {"resize": 0, "steady": 0}
+        tally: dict = {}
+        for req in schedule:
+            seg = "resize" if _in_windows(req.arrival_s, windows) \
+                else "steady"
+            counts[seg] += 1
+            met, tot = tally.get((seg, req.tenant.cls), (0, 0))
+            tally[(seg, req.tenant.cls)] = (
+                met + (1 if outcome[req.index] else 0), tot + 1
+            )
         for c in classes:
             cls = str(c["name"])
-            led = ledgers[cls]
-            rec = recorders[cls]
-            arr = rec.as_ns_array()
-            per_class[cls] = {
-                "priority": int(c.get("priority", 0)),
-                "weight": float(c.get("weight", 1.0)),
-                "deadline_ms": float(c["deadline_ms"]),
-                "arrivals": led.arrivals,
-                "completed": led.completed,
-                "deadline_met": led.deadline_met,
-                "shed": led.shed,
-                "errors": led.errors,
-                "bytes": led.bytes,
-                "slo_attainment": led.slo_attainment(),
-                "p50_ms": float(np.percentile(arr, 50) / 1e6)
-                if arr.size else None,
-                "p99_ms": float(np.percentile(arr, 99) / 1e6)
-                if arr.size else None,
-            }
-        # Jain fairness over weight-normalized per-TENANT goodput:
-        # tenants that sent traffic compete; a starved tenant's 0 is a
-        # legitimate unfairness sample (zero-completed ≠ excluded).
-        # Weights come off the schedule's own Request objects — never a
-        # build_tenants re-derivation that must stay bit-identical.
-        weights = {r.tenant.name: r.tenant.weight for r in schedule}
-        norm = [
-            tenant_bytes.get(name, 0) / w
-            for name, w in sorted(weights.items())
+            for seg in ("resize", "steady"):
+                met, tot = tally.get((seg, cls), (0, 0))
+                split[seg][cls] = (met / tot) if tot else None
+
+        # Counter series helpers over the (virtual-time, aggregate)
+        # snapshots: value at t = the last snapshot at or before t.
+        def value_at(t: float, key: str) -> int:
+            v = 0
+            for st, agg in snapshots:
+                if st <= t:
+                    v = agg.get(key, 0)
+                else:
+                    break
+            return v
+
+        total_origin = snapshots[-1][1].get("origin_bytes", 0) \
+            if snapshots else 0
+        # Clip windows to the run's virtual span for the byte/length
+        # split: an event near the bell opens a window that extends
+        # past end-of-run, and charging that phantom tail would both
+        # shrink steady_len and inflate steady_rate_bps — exactly the
+        # comparison this block exists to keep honest.
+        clipped = [
+            (min(w0, sc.duration_s), min(w1, sc.duration_s))
+            for w0, w1 in windows
         ]
-        arrivals = len(schedule)
-        completed = sum(led.completed for led in ledgers.values())
-        shed = sum(led.shed for led in ledgers.values())
+        window_origin = sum(
+            value_at(w1, "origin_bytes") - value_at(w0, "origin_bytes")
+            for w0, w1 in clipped
+        )
+        window_len = sum(w1 - w0 for w0, w1 in clipped)
+        steady_len = max(0.0, sc.duration_s - window_len)
+        steady_origin = max(0, total_origin - window_origin)
+        steady_rate = steady_origin / steady_len if steady_len > 0 \
+            else None
+
+        # Time-to-rewarm per view-changing event: first post-event
+        # snapshot window whose peer-hit ratio is back to >= 90% of the
+        # cumulative pre-event ratio.
+        def ratio(agg: dict) -> Optional[float]:
+            req = agg.get("peer_requests", 0)
+            return agg.get("peer_hits", 0) / req if req else None
+
+        for ev in events_out:
+            if ev["action"] not in (
+                "kill_host", "leave_host", "pause_host",
+            ):
+                continue
+            te = ev["t_s"]
+            pre = None
+            for st, agg in snapshots:
+                if st <= te:
+                    pre = ratio(agg)
+                else:
+                    break
+            ev["pre_event_peer_hit_ratio"] = pre
+            rewarm = None
+            if pre:
+                prev = None
+                for st, agg in snapshots:
+                    if st < te:
+                        continue
+                    if prev is not None:
+                        dreq = (agg.get("peer_requests", 0)
+                                - prev[1].get("peer_requests", 0))
+                        dhit = (agg.get("peer_hits", 0)
+                                - prev[1].get("peer_hits", 0))
+                        if dreq > 0 and dhit / dreq >= 0.9 * pre:
+                            rewarm = max(0.0, st - te)
+                            break
+                    prev = (st, agg)
+            ev["time_to_rewarm_s"] = rewarm
+
+        agg = fabric.aggregate()
+        final_ratio = ratio(agg)
         return {
-            "qos": sc.qos,
-            "arrival": sc.arrival,
-            "tenants": sc.tenants,
-            "active_tenants": len(weights),
-            "duration_s": sc.duration_s,
-            "wall_s": wall,
-            "offered_rps": arrivals / wall if wall > 0 else None,
-            "achieved_rps": completed / wall if wall > 0 else None,
-            "arrivals": arrivals,
-            "completed": completed,
-            "shed": shed,
-            "shed_by_reason": qstats["shed"],
-            "goodput_gbps": (completed_bytes / 1e9) / wall
-            if wall > 0 else 0.0,
-            "jain_fairness": jain_index(norm),
-            "queue": {
-                k: qstats[k] for k in (
-                    "cap", "queue_limit", "peak_queue", "peak_in_service",
-                )
+            "hosts": sc.hosts,
+            "epoch": agg["epoch"],
+            "resize_window_s": sc.resize_window_s,
+            "events": events_out,
+            "windows_s": [list(w) for w in windows],
+            "slo": split,
+            "arrivals": counts,
+            "origin_bytes": {
+                "total": total_origin,
+                "resize_windows": window_origin,
+                "steady": steady_origin,
+                "steady_rate_bps": steady_rate,
             },
-            "classes": per_class,
+            "handoff": {
+                "out_chunks": agg["handoff_out_chunks"],
+                "out_bytes": agg["handoff_out_bytes"],
+                "in_chunks": agg["handoff_in_chunks"],
+                "in_bytes": agg["handoff_in_bytes"],
+                "rejects": agg["handoff_rejects"],
+            },
+            "peer_hit_ratio": final_ratio,
+            "pod_coalesced": agg["pod_coalesced"],
+            "failovers": failovers,
+            "no_live_host_errors": no_live_host_errors,
+            "pool_leaked_slabs": pool_leaks,
+            "per_host": per_host,
         }
 
 
@@ -728,4 +1333,62 @@ def format_serve_scorecard(sv: dict) -> str:
             f"peak={q.get('peak_queue')} "
             f"peak_in_service={q.get('peak_in_service')}"
         )
+    return "\n".join(lines)
+
+
+def format_membership_scorecard(mb: dict) -> str:
+    """Human rendering of ``extra["membership"]`` — the resize scorecard
+    (CLI + ``tpubench report``)."""
+    lines = [
+        "== membership resize scorecard ==",
+        (
+            f"  pod: {mb.get('hosts', 0)} hosts  "
+            f"final epoch={mb.get('epoch', 0)}  "
+            f"failovers={mb.get('failovers', 0)}  "
+            f"leaked_slabs={mb.get('pool_leaked_slabs', 0)}"
+        ),
+    ]
+    for ev in mb.get("events", ()):
+        extra = ""
+        ho = ev.get("handoff")
+        if ho:
+            extra = (
+                f"  handoff={ho.get('chunks', 0)} chunks/"
+                f"{ho.get('bytes', 0)}B"
+            )
+        rw = ev.get("time_to_rewarm_s")
+        if rw is not None:
+            extra += f"  rewarm={rw:.2f}s"
+        lines.append(
+            f"  [t={ev.get('t_s', 0.0):.2f}s] {ev.get('action')} "
+            f"host {ev.get('host')} -> epoch {ev.get('epoch')} "
+            f"(remap {ev.get('remap_fraction', 0.0):.1%} = "
+            f"{ev.get('remap_bytes', 0)}B){extra}"
+        )
+    slo = mb.get("slo") or {}
+    for seg in ("resize", "steady"):
+        cells = []
+        for cls, v in (slo.get(seg) or {}).items():
+            cells.append(
+                f"{cls}={v:.1%}" if v is not None else f"{cls}=n/a"
+            )
+        arr = (mb.get("arrivals") or {}).get(seg, 0)
+        lines.append(
+            f"  SLO {seg:<6} ({arr} arrivals): " + " ".join(cells)
+        )
+    ob = mb.get("origin_bytes") or {}
+    lines.append(
+        f"  origin bytes: resize_windows={ob.get('resize_windows', 0)} "
+        f"steady={ob.get('steady', 0)} total={ob.get('total', 0)}"
+    )
+    ho = mb.get("handoff") or {}
+    phr = mb.get("peer_hit_ratio")
+    lines.append(
+        f"  handoff: out={ho.get('out_chunks', 0)} chunks/"
+        f"{ho.get('out_bytes', 0)}B in={ho.get('in_chunks', 0)} chunks/"
+        f"{ho.get('in_bytes', 0)}B rejects={ho.get('rejects', 0)}  "
+        + (
+            f"peer_hit={phr:.1%}" if phr is not None else "peer_hit=n/a"
+        )
+    )
     return "\n".join(lines)
