@@ -423,6 +423,127 @@ def _serve_knee_cell() -> dict:
     }
 
 
+def _ckpt_roundtrip_cell() -> dict:
+    """Storage-lifecycle roundtrip on the hermetic fake backend
+    (BENCH_r06+): a sharded checkpoint saved through resumable
+    multi-part uploads UNDER an upload fault (every session commits a
+    prefix of one part and the connection dies — the mid-part reset
+    shape), then restored and byte-verified, plus a plain read workload
+    over the same byte volume as the honest goodput comparator. Fixed
+    seed, jax-free (host-RAM restore), so it rides the quiet-CPU segment
+    with the other A/B cells. Smoke guards: resumed uploads NEVER
+    finalize corrupt bytes, and restore goodput stays within 20% of the
+    read workload's."""
+    from tpubench.config import BenchConfig
+    from tpubench.workloads.ckpt import run_ckpt_restore, run_ckpt_save
+    from tpubench.workloads.read import run_read
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.granule_bytes = 256 * 1024
+    # Keep the prepopulated read-store tiny: the checkpoint objects are
+    # written by the save itself.
+    cfg.workload.workers = 2
+    cfg.workload.threads = 2
+    cfg.workload.object_size = 1 * MB
+    cfg.obs.export = "none"
+    cfg.lifecycle.objects = 4
+    cfg.lifecycle.object_bytes = 2 * MB
+    cfg.lifecycle.part_bytes = 512 * 1024
+    cfg.lifecycle.writers = 2
+    cfg.lifecycle.readers = 2
+    cfg.lifecycle.restore_device = False  # quiet-CPU segment stays jax-free
+    # Mid-part truncate-then-reset: each upload session dies once with a
+    # partial part committed; one scaled stall rides along. Retry pacing
+    # shrunk to bench scale (the gax 1 s initial would dominate).
+    cfg.transport.fault.upload_reset_after_bytes = 1 * MB + 128 * 1024
+    cfg.transport.fault.upload_stall_s = min(0.02, 0.02 * _SLEEP_SCALE)
+    cfg.transport.fault.seed = 7
+    cfg.transport.retry.initial_backoff_s = 0.005
+    cfg.transport.retry.max_backoff_s = 0.02
+    from tpubench.storage import open_backend
+
+    backend = open_backend(cfg)
+    try:
+        save = run_ckpt_save(cfg, backend=backend)
+        restore = run_ckpt_restore(cfg, backend=backend)
+        # Best-of-2 (the fake store persists for the backend's lifetime;
+        # millisecond walls on a share-capped host are scheduler noise).
+        restore_b = run_ckpt_restore(cfg, backend=backend)
+    finally:
+        backend.close()
+    slc = save.extra["lifecycle"]
+    rlc = restore.extra["lifecycle"]
+    if slc["corrupt_finalizes"] or save.errors or restore.errors:
+        raise RuntimeError(
+            f"ckpt roundtrip corrupt/errored: save={slc}, "
+            f"restore_errors={restore.errors}"
+        )
+    # Honest comparator: the read workload over the SAME byte volume and
+    # fan-out shape, MATERIALIZING bytes once into distinct destination
+    # memory via a zero-copy sink — a restore must land every byte, so
+    # comparing it against the reference's io.Discard read (zero
+    # destination writes, cache-hot reused granule) would fail by memcpy
+    # physics on this hermetic backend, not by regression. Both arms
+    # take best-of-2 — millisecond walls on a share-capped host are
+    # scheduler noise.
+    import numpy as np
+
+    class _ZcSink:
+        def __init__(self, total: int, granule: int):
+            self.buf = np.empty(total, np.uint8)
+            self.buf.fill(0)  # prefault (restore's buffer-prep parity)
+            self.mv = memoryview(self.buf)
+            self.off = 0
+            self.granule = granule
+
+        def acquire(self):
+            if self.off + self.granule > len(self.buf):
+                self.off = 0
+            return self.mv[self.off:self.off + self.granule]
+
+        def commit(self, n: int) -> None:
+            self.off += n
+
+        def submit(self, mv) -> None:  # protocol completeness
+            pass
+
+        def finish(self) -> dict:
+            return {}
+
+    rcfg = BenchConfig()
+    rcfg.transport.protocol = "fake"
+    rcfg.workload.workers = 2
+    rcfg.workload.threads = 2
+    rcfg.workload.read_calls_per_worker = 2
+    rcfg.workload.object_size = 2 * MB
+    rcfg.workload.granule_bytes = 256 * 1024
+    rcfg.staging.mode = "none"
+    rcfg.obs.export = "none"
+    read_gbps = max(
+        run_read(
+            rcfg, sink_factory=lambda i: _ZcSink(4 * MB, 256 * 1024)
+        ).gbps
+        for _ in range(2)
+    )
+    restore_gbps = max(
+        rlc["goodput_gbps"], restore_b.extra["lifecycle"]["goodput_gbps"]
+    )
+    return {
+        "save_gbps": round(slc["goodput_gbps"], 4),
+        "restore_gbps": round(restore_gbps, 4),
+        "read_gbps": round(read_gbps, 4),
+        "parts": slc["parts"],
+        "resumed_parts": slc["resumed_parts"],
+        "corrupt_finalizes": slc["corrupt_finalizes"],
+        "verified_save": slc["verified"],
+        "verified_restore": rlc["verified"],
+        "time_to_restore_s": round(rlc["time_to_restore_s"], 4),
+        "guard_restore_ge_read": restore_gbps >= 0.8 * read_gbps,
+        "sleep_scale": _SLEEP_SCALE,
+    }
+
+
 def _elastic_resize_cell() -> dict:
     """Cooperative-leave vs killed-host resize A/B on the hermetic
     elastic serve pod (BENCH_r06+): two identical 4-host pods replay the
@@ -766,6 +887,14 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001 — the bench must not die here
         print(f"# elastic resize A/B failed: {e}", file=sys.stderr)
 
+    # Storage-lifecycle roundtrip (save-under-faults → verified restore
+    # vs the read comparator): hermetic, jax-free — quiet-CPU segment.
+    ckpt_roundtrip: dict = {}
+    try:
+        ckpt_roundtrip = _ckpt_roundtrip_cell()
+    except Exception as e:  # noqa: BLE001 — the bench must not die here
+        print(f"# ckpt roundtrip failed: {e}", file=sys.stderr)
+
     dev = jax.local_devices()[0]  # first jax touch: AFTER the quiet-CPU A/B
 
     # Compile the pallas landing kernel at the pair slot shape BEFORE the
@@ -1037,6 +1166,7 @@ def main() -> int:
                 "trace_overhead": trace_overhead,
                 "serve_knee": serve_knee,
                 "elastic_resize": elastic_resize,
+                "ckpt_roundtrip": ckpt_roundtrip,
                 "shaped_verdict": shaped,
                 "probe_divergence_factor": pdf,
                 "host_cores": _usable_cores(),

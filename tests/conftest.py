@@ -137,6 +137,16 @@ def pytest_configure(config):
         "markers", "membership: elastic pod membership "
                    "(state machine/handoff/resize scorecard)"
     )
+    # Lifecycle tests (storage-lifecycle plane: resumable uploads +
+    # preconditions + pagination, ckpt save/restore roundtrip under
+    # fault timelines, meta-storm knee) stay in tier-1 — same policy as
+    # the other subsystem markers: the zero-corrupt-finalizes roundtrip
+    # acceptance runs on every pass; the marker exists for selective
+    # runs (`-m lifecycle`).
+    config.addinivalue_line(
+        "markers", "lifecycle: storage-lifecycle plane "
+                   "(resumable uploads/ckpt roundtrip/meta storm)"
+    )
     # Multihost tests are marker-gated (see tests/test_multihost.py):
     # they need working multi-process jax.distributed, which this
     # container lacks — tier-1 collects clean skips, not failures.

@@ -166,6 +166,20 @@ def test_bench_end_to_end_cpu():
         assert arm["pool_leaked_slabs"] == 0
         assert arm["errors"] == 0
         assert arm["epoch"] == 1
+    # Ckpt-roundtrip cell (PR 15): save-under-upload-faults → verified
+    # restore, with the regression guards — resumed uploads NEVER
+    # finalize corrupt bytes (every session hit a mid-part reset, every
+    # object readback-crc-matched the manifest), and restore goodput
+    # stays within 20% of the materializing read comparator.
+    cr = d["ckpt_roundtrip"]
+    assert cr["resumed_parts"] > 0, cr
+    assert cr["corrupt_finalizes"] == 0, cr
+    assert cr["verified_save"] and cr["verified_restore"], cr
+    assert cr["save_gbps"] > 0 and cr["restore_gbps"] > 0
+    assert cr["guard_restore_ge_read"], (
+        f"restore {cr['restore_gbps']} GB/s fell below 80% of the "
+        f"materializing read comparator {cr['read_gbps']} GB/s"
+    )
     sweep = d["staging_depth_sweep"]
     assert set(sweep) == {"1", "2", "4"}
     assert sweep["1"]["drain"] == "inline"

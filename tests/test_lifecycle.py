@@ -1,0 +1,840 @@
+"""Storage-lifecycle plane tests (PR 15).
+
+Covers the write path (resumable multi-part uploads: session offsets,
+308-with-Range resume, ifGenerationMatch preconditions, idempotent
+finalize, upload-side faults through the retry stack — in-process AND
+over both fake servers' wires), list pagination, local_fs parity, the
+ckpt-save / ckpt-restore / meta-storm workloads, the coop-accelerated
+overlapping-shards restore, CLI folding/validation, and the hermetic
+save→restore roundtrip acceptance under a mid-part reset/stall fault
+timeline rendered by ``tpubench report``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from tpubench.config import (
+    MB,
+    BenchConfig,
+    RetryConfig,
+    parse_meta_mix,
+    validate_lifecycle_config,
+)
+from tpubench.storage.base import StorageError, deterministic_bytes
+from tpubench.storage.fake import FakeBackend, FaultPlan
+from tpubench.storage.fake_h2_server import FakeH2Server
+from tpubench.storage.fake_server import FakeGcsServer, parse_content_range
+from tpubench.storage.gcs_http import GcsHttpBackend
+from tpubench.storage.local_fs import LocalFsBackend
+from tpubench.storage.retrying import RetryingBackend
+
+pytestmark = pytest.mark.lifecycle
+
+FAST_RETRY = RetryConfig(initial_backoff_s=0.001, max_backoff_s=0.002)
+
+
+def _read_all(backend, name: str) -> bytes:
+    r = backend.open_read(name)
+    out = bytearray()
+    buf = memoryview(bytearray(1 << 16))
+    while True:
+        n = r.readinto(buf)
+        if n <= 0:
+            break
+        out += buf[:n]
+    r.close()
+    return bytes(out)
+
+
+# --------------------------------------------------------- session store ----
+
+
+class TestResumableSessions:
+    def test_fake_writer_roundtrip_and_generation(self):
+        be = FakeBackend()
+        w = be.open_write("a/b", if_generation_match=0)
+        assert w.write(b"hello ") == 6
+        assert w.write(b"world") == 11
+        meta = w.finalize()
+        assert (meta.size, meta.generation) == (11, 1)
+        assert _read_all(be, "a/b") == b"hello world"
+        # Idempotent finalize: a replayed completion returns the SAME
+        # committed meta — never a double generation bump.
+        assert w.finalize().generation == 1
+
+    def test_offset_behind_watermark_is_idempotent_resend(self):
+        be = FakeBackend()
+        uid = be.begin_upload("x")
+        be.upload_append(uid, 0, b"abcdef")
+        # Replay of the same part (response was lost): overlap skipped.
+        assert be.upload_append(uid, 0, b"abcdef") == 6
+        assert be.upload_append(uid, 3, b"defGHI") == 9
+        meta = be.finalize_upload(uid, total=9)
+        assert _read_all(be, "x") == b"abcdefGHI"
+        assert meta.size == 9
+
+    def test_offset_ahead_of_watermark_rejected(self):
+        be = FakeBackend()
+        uid = be.begin_upload("x")
+        with pytest.raises(StorageError) as ei:
+            be.upload_append(uid, 10, b"zz")
+        assert ei.value.code == 400 and not ei.value.transient
+
+    def test_finalize_precondition_412_nontransient(self):
+        be = FakeBackend()
+        be.write("x", b"v1")  # generation 1
+        uid = be.begin_upload("x", if_generation_match=0)
+        be.upload_append(uid, 0, b"v2")
+        with pytest.raises(StorageError) as ei:
+            be.finalize_upload(uid)
+        assert ei.value.code == 412 and not ei.value.transient
+        # The object is untouched by the failed finalize.
+        assert _read_all(be, "x") == b"v1"
+
+    def test_media_write_precondition_both_directions(self):
+        be = FakeBackend()
+        be.write("m", b"v1", if_generation_match=0)  # create-only: ok
+        with pytest.raises(StorageError) as ei:
+            be.write("m", b"v2", if_generation_match=0)  # exists now
+        assert ei.value.code == 412
+        be.write("m", b"v2", if_generation_match=1)  # CAS on gen: ok
+        assert be.stat("m").generation == 2
+
+    def test_upload_reset_fault_commits_prefix_one_shot(self):
+        be = FakeBackend(fault=FaultPlan(upload_reset_after_bytes=4))
+        uid = be.begin_upload("x")
+        with pytest.raises(StorageError) as ei:
+            be.upload_append(uid, 0, b"0123456789")
+        assert ei.value.transient
+        assert be.upload_committed(uid) == 4  # prefix persisted
+        # One-shot: the resumed tail goes through.
+        assert be.upload_append(uid, 4, b"456789") == 10
+        be.finalize_upload(uid, total=10)
+        assert _read_all(be, "x") == b"0123456789"
+
+    def test_upload_error_rate_is_transient_503(self):
+        be = FakeBackend(fault=FaultPlan(upload_error_rate=1.0))
+        uid = be.begin_upload("x")
+        with pytest.raises(StorageError) as ei:
+            be.upload_append(uid, 0, b"zz")
+        assert ei.value.code == 503 and ei.value.transient
+
+
+class TestResumingWriter:
+    def test_resume_through_retry_stack(self):
+        be = FakeBackend(fault=FaultPlan(upload_reset_after_bytes=4))
+        rb = RetryingBackend(be, FAST_RETRY)
+        w = rb.open_write("c")
+        w.write(b"0123456789")
+        meta = w.finalize()
+        assert meta.size == 10
+        assert w.resumed_parts == 1
+        assert _read_all(be, "c") == b"0123456789"
+
+    def test_412_never_retried(self):
+        be = FakeBackend()
+        be.write("c", b"v1")
+        rb = RetryingBackend(be, FAST_RETRY)
+        w = rb.open_write("c", if_generation_match=0)
+        w.write(b"v2")
+        with pytest.raises(StorageError) as ei:
+            w.finalize()
+        assert ei.value.code == 412
+
+    def test_attempt_budget_resets_on_progress(self):
+        # Two sequential one-shot resets (via phased plans) with
+        # max_attempts=2: each fault recovers with progress between, so
+        # the write must succeed — a shared budget would exhaust.
+        be = FakeBackend(fault=FaultPlan(upload_reset_after_bytes=4))
+        retry = RetryConfig(initial_backoff_s=0.001, max_backoff_s=0.002,
+                            max_attempts=2)
+        rb = RetryingBackend(be, retry)
+        w = rb.open_write("c")
+        w.write(b"0123456789")
+        # Arm a second one-shot fault window for the next part by
+        # swapping the plan (sessions carry their own one-shot flags).
+        be.fault.upload_reset_after_bytes = 14
+        for s in be._uploads.values():
+            s.reset_done = False
+        w.write(b"ABCDEFGHIJ")
+        meta = w.finalize()
+        assert meta.size == 20
+        assert w.resumed_parts == 2
+        assert _read_all(be, "c") == b"0123456789ABCDEFGHIJ"
+
+
+# ------------------------------------------------------------- wire paths ---
+
+
+class TestWireUploads:
+    def _client(self, endpoint: str, retry=None) -> RetryingBackend:
+        cfg = BenchConfig()
+        cfg.transport.endpoint = endpoint
+        return RetryingBackend(
+            GcsHttpBackend("B", cfg.transport), retry or FAST_RETRY
+        )
+
+    def test_h1_resumable_roundtrip_with_mid_part_reset(self):
+        fp = FaultPlan(upload_reset_after_bytes=700)
+        with FakeGcsServer(backend=FakeBackend(fault=fp)) as srv:
+            rb = self._client(srv.endpoint)
+            w = rb.open_write("big")
+            data = bytes(range(256)) * 8
+            w.write(data[:1024])
+            w.write(data[1024:])
+            meta = w.finalize()
+            assert meta.size == 2048
+            assert w.resumed_parts >= 1
+            assert _read_all(rb, "big") == data  # byte-identical
+
+    def test_h1_media_upload_precondition_412(self):
+        with FakeGcsServer(backend=FakeBackend()) as srv:
+            rb = self._client(srv.endpoint)
+            rb.write("m", b"v1", if_generation_match=0)
+            with pytest.raises(StorageError) as ei:
+                rb.write("m", b"v2", if_generation_match=0)
+            assert ei.value.code == 412
+
+    def test_h1_resumable_finalize_precondition_412(self):
+        with FakeGcsServer(backend=FakeBackend()) as srv:
+            rb = self._client(srv.endpoint)
+            rb.write("m", b"v1")
+            w = rb.open_write("m", if_generation_match=0)
+            w.write(b"v2")
+            with pytest.raises(StorageError) as ei:
+                w.finalize()
+            assert ei.value.code == 412
+            assert _read_all(rb, "m") == b"v1"
+
+    def test_h2_server_h11_side_uploads_and_412(self):
+        # The h2 fake's HTTP/1.1 side carries the write surface (an
+        # http2=True client's writes ride the h1.1 pool) — both fakes
+        # share one resumable semantics.
+        with FakeH2Server(backend=FakeBackend()) as srv:
+            rb = self._client(srv.endpoint)
+            w = rb.open_write("x/y", if_generation_match=0)
+            w.write(b"q" * 300)
+            assert w.finalize().size == 300
+            with pytest.raises(StorageError) as ei:
+                rb.write("x/y", b"zz", if_generation_match=0)
+            assert ei.value.code == 412
+
+    def test_resume_probe_bytes_star_star(self):
+        with FakeGcsServer(backend=FakeBackend()) as srv:
+            rb = self._client(srv.endpoint)
+            w = rb.open_write("p")
+            w.write(b"a" * 100)
+            assert w.committed() == 100
+            w.write(b"b" * 50)
+            assert w.committed() == 150
+
+    def test_content_range_parser(self):
+        assert parse_content_range("bytes 0-9/20") == (0, 20)
+        assert parse_content_range("bytes 10-19/*") == (10, None)
+        assert parse_content_range("bytes */40") == (None, 40)
+        assert parse_content_range("bytes */*") == (None, None)
+        with pytest.raises(ValueError):
+            parse_content_range("chunks 0-9/20")
+
+
+class TestListPagination:
+    def _fill(self, be: FakeBackend, n: int = 7):
+        for i in range(n):
+            be.write(f"p/{i:03d}", b"z" * 8)
+
+    def test_h1_server_pages_and_client_drains(self):
+        be = FakeBackend()
+        self._fill(be)
+        with FakeGcsServer(backend=be) as srv:
+            cfg = BenchConfig()
+            cfg.transport.endpoint = srv.endpoint
+            hb = GcsHttpBackend("B", cfg.transport)
+            # The client follows nextPageToken to a complete listing.
+            items = hb.list("p/", page_size=3)
+            assert [m.name for m in items] == [f"p/{i:03d}" for i in range(7)]
+            # Page shape on the wire: maxResults bounds each page and
+            # nextPageToken cursors strictly past the last name.
+            import urllib.request
+
+            doc = json.loads(urllib.request.urlopen(
+                f"{srv.endpoint}/storage/v1/b/B/o?prefix=p/&maxResults=3"
+            ).read())
+            assert len(doc["items"]) == 3
+            assert doc["nextPageToken"] == "p/002"
+            doc2 = json.loads(urllib.request.urlopen(
+                f"{srv.endpoint}/storage/v1/b/B/o?prefix=p/&maxResults=3"
+                "&pageToken=p/002"
+            ).read())
+            assert [i["name"] for i in doc2["items"]] == [
+                "p/003", "p/004", "p/005"
+            ]
+            # Final page carries no token.
+            doc3 = json.loads(urllib.request.urlopen(
+                f"{srv.endpoint}/storage/v1/b/B/o?prefix=p/&maxResults=3"
+                "&pageToken=p/005"
+            ).read())
+            assert [i["name"] for i in doc3["items"]] == ["p/006"]
+            assert "nextPageToken" not in doc3
+
+    def test_h2_server_h11_list_pages(self):
+        be = FakeBackend()
+        self._fill(be, 5)
+        with FakeH2Server(backend=be) as srv:
+            cfg = BenchConfig()
+            cfg.transport.endpoint = srv.endpoint
+            hb = GcsHttpBackend("B", cfg.transport)
+            items = hb.list("p/", page_size=2)
+            assert [m.name for m in items] == [f"p/{i:03d}" for i in range(5)]
+
+
+# ------------------------------------------------------------ local_fs ------
+
+
+class TestLocalFsParity:
+    """The FS-path backend (the reference's gcsfuse-path analogue) works
+    for all three lifecycle workloads: write/open_write/list/stat parity
+    with the fakes."""
+
+    def test_write_list_stat_delete_parity(self, tmp_path):
+        fs = LocalFsBackend(str(tmp_path))
+        fake = FakeBackend()
+        for be in (fs, fake):
+            be.write("d/one", b"11")
+            be.write("d/two", b"2222")
+        assert (
+            [(m.name, m.size) for m in fs.list("d/")]
+            == [(m.name, m.size) for m in fake.list("d/")]
+            == [("d/one", 2), ("d/two", 4)]
+        )
+        assert fs.stat("d/one").size == fake.stat("d/one").size == 2
+        for be in (fs, fake):
+            be.delete("d/one")
+            with pytest.raises(StorageError):
+                be.stat("d/one")
+
+    def test_open_write_resumable_and_part_invisible(self, tmp_path):
+        fs = LocalFsBackend(str(tmp_path))
+        w = fs.open_write("ck/a", if_generation_match=0)
+        w.write(b"part1-")
+        # In-flight sessions are invisible to list/stat (the .part file
+        # is a hidden staging sibling).
+        assert fs.list("ck/") == []
+        assert w.committed() == 6
+        w.write(b"part2")
+        meta = w.finalize()
+        assert meta.size == 11
+        assert _read_all(fs, "ck/a") == b"part1-part2"
+
+    def test_create_only_precondition(self, tmp_path):
+        fs = LocalFsBackend(str(tmp_path))
+        fs.write("x", b"v1", if_generation_match=0)
+        with pytest.raises(StorageError) as ei:
+            fs.write("x", b"v2", if_generation_match=0)
+        assert ei.value.code == 412
+        w = fs.open_write("x", if_generation_match=0)
+        w.write(b"v2")
+        with pytest.raises(StorageError) as ei:
+            w.finalize()
+        assert ei.value.code == 412
+
+    def test_all_three_workloads_over_local_fs(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+        from tpubench.workloads.ckpt import run_ckpt_restore, run_ckpt_save
+        from tpubench.workloads.meta_storm import run_meta_storm
+
+        cfg = BenchConfig()
+        cfg.transport.protocol = "local"
+        cfg.workload.dir = str(tmp_path)
+        cfg.lifecycle.objects = 2
+        cfg.lifecycle.object_bytes = 96 * 1024
+        cfg.lifecycle.part_bytes = 32 * 1024
+        cfg.lifecycle.restore_device = False
+        cfg.lifecycle.meta_objects = 6
+        cfg.lifecycle.meta_object_bytes = 256
+        cfg.lifecycle.meta_rate_rps = 300
+        cfg.lifecycle.meta_duration_s = 0.2
+        save = run_ckpt_save(cfg)
+        assert save.errors == 0
+        assert save.extra["lifecycle"]["corrupt_finalizes"] == 0
+        restore = run_ckpt_restore(cfg)
+        assert restore.errors == 0
+        assert restore.extra["lifecycle"]["verified"] is True
+        storm = run_meta_storm(cfg)
+        assert storm.errors == 0
+        assert storm.extra["lifecycle"]["completed"] > 0
+
+
+# ------------------------------------------------------------- workloads ----
+
+
+def _hermetic_cfg(**lc) -> BenchConfig:
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 2
+    cfg.workload.threads = 2
+    cfg.workload.object_size = 256 * 1024
+    defaults = dict(
+        objects=3, object_bytes=256 * 1024, part_bytes=64 * 1024,
+        writers=2, readers=2,
+    )
+    defaults.update(lc)
+    for k, v in defaults.items():
+        setattr(cfg.lifecycle, k, v)
+    return cfg
+
+
+class TestCkptWorkloads:
+    def test_save_scorecard_and_flight(self, tmp_path):
+        from tpubench.storage import open_backend
+        from tpubench.workloads.ckpt import run_ckpt_save
+
+        cfg = _hermetic_cfg()
+        cfg.obs.flight_journal = str(tmp_path / "save.json")
+        be = open_backend(cfg)
+        try:
+            res = run_ckpt_save(cfg, backend=be)
+        finally:
+            be.close()
+        lc = res.extra["lifecycle"]
+        assert lc["op"] == "save"
+        assert lc["objects"] == 3 and lc["parts"] == 3 * 4
+        assert lc["corrupt_finalizes"] == 0 and lc["verified"] is True
+        assert res.summaries["part"].count == 12
+        # The journal carries kind="upload" records with the lifecycle
+        # phases in monotone order.
+        doc = json.loads((tmp_path / "save.json").read_text())
+        ups = [r for r in doc["records"] if r.get("kind") == "upload"]
+        assert len(ups) == 3
+        from tpubench.obs.flight import monotone
+
+        for r in ups:
+            ph = r["phases"]
+            assert {"upload_open", "part_sent", "upload_complete"} <= set(ph)
+            assert monotone(r), ph
+            assert r["bytes"] == 256 * 1024
+            assert len([n for n in r["notes"] if n["kind"] == "part"]) == 4
+
+    def test_restore_device_path_stages_sharded_arrays(self):
+        from tpubench.storage import open_backend
+        from tpubench.workloads.ckpt import run_ckpt_restore, run_ckpt_save
+
+        cfg = _hermetic_cfg(objects=2)
+        be = open_backend(cfg)
+        try:
+            run_ckpt_save(cfg, backend=be)
+            res = run_ckpt_restore(cfg, backend=be)
+        finally:
+            be.close()
+        lc = res.extra["lifecycle"]
+        assert lc["op"] == "restore"
+        assert lc["staged"] is True
+        assert lc["shards_per_object"] == 8  # the simulated 8-chip mesh
+        assert lc["verified"] is True
+        assert res.n_chips == 8
+        assert lc["time_to_restore_s"] > 0
+
+    def test_failed_save_never_publishes_manifest(self):
+        # The manifest is the restore-readiness marker: under
+        # abort_on_error=False a save whose uploads exhausted their
+        # retry budget must NOT publish one.
+        from tpubench.storage import open_backend
+        from tpubench.workloads.ckpt import run_ckpt_save
+
+        cfg = _hermetic_cfg(objects=2, verify=False)
+        cfg.workload.abort_on_error = False
+        cfg.transport.fault.upload_error_rate = 1.0
+        cfg.transport.retry.max_attempts = 2
+        cfg.transport.retry.initial_backoff_s = 0.001
+        cfg.transport.retry.max_backoff_s = 0.002
+        be = open_backend(cfg)
+        try:
+            res = run_ckpt_save(cfg, backend=be)
+            assert res.errors > 0
+            with pytest.raises(StorageError):
+                be.stat("ckpt/MANIFEST.json")
+        finally:
+            be.close()
+
+    def test_restore_detects_corruption(self):
+        from tpubench.storage import open_backend
+        from tpubench.workloads.ckpt import run_ckpt_restore, run_ckpt_save
+
+        cfg = _hermetic_cfg(objects=2, restore_device=False)
+        be = open_backend(cfg)
+        try:
+            run_ckpt_save(cfg, backend=be)
+            # Corrupt one stored shard behind the manifest's back.
+            inner = be
+            while hasattr(inner, "inner"):
+                inner = inner.inner
+            inner.write("ckpt/shard_00001", b"\x00" * (256 * 1024))
+            res = run_ckpt_restore(cfg, backend=be)
+        finally:
+            be.close()
+        assert res.extra["lifecycle"]["verified"] is False
+        assert res.errors >= 1
+
+    def test_coop_accelerates_overlapping_shard_restore(self):
+        # N hosts restoring the SAME checkpoint: with cooperation the
+        # pod fetches each chunk from origin ~once; per-host caches pay
+        # ~N×. The N-hosts-read-overlapping-shards case, hermetically.
+        from tpubench.pipeline.coop import run_coop_sim
+        from tpubench.workloads.arrivals import zipf_keys_weights
+
+        n_hosts = 4
+        kw = dict(
+            n_hosts=n_hosts, n_objects=3, object_bytes=512 * 1024,
+            chunk_bytes=128 * 1024, seed=5,
+        )
+        # The shared restore plan: every chunk of the checkpoint, once,
+        # in order, on EVERY host.
+        be = FakeBackend.prepopulated(
+            prefix="coop/file_", count=3, size=512 * 1024
+        )
+        keys, _ = zipf_keys_weights(be.list("coop/file_"), 128 * 1024)
+        coop = run_coop_sim(coop=True, plan=keys, **kw)
+        base = run_coop_sim(coop=False, plan=keys, **kw)
+        assert not coop["errors"] and not base["errors"]
+        ckpt_bytes = 3 * 512 * 1024
+        assert base["origin_bytes_per_pod"] == n_hosts * ckpt_bytes
+        assert coop["origin_bytes_per_pod"] == ckpt_bytes
+        assert coop["max_origin_fetches_per_chunk"] == 1
+
+
+class TestMetaStorm:
+    def test_schedule_deterministic_and_mixed(self):
+        from tpubench.lifecycle.storm import build_storm_schedule
+
+        names = [f"m/{i}" for i in range(8)]
+        kw = dict(kind="poisson", rate_rps=500, duration_s=1.0,
+                  mix="list:1,stat:2,open:2", prefix="m/", seed=3)
+        a = build_storm_schedule(names, **kw)
+        b = build_storm_schedule(names, **kw)
+        assert a == b  # same seed -> identical storm
+        kinds = {op.kind for op in a}
+        assert kinds == {"list", "stat", "open"}
+        counts = {k: sum(1 for op in a if op.kind == k) for k in kinds}
+        # stat+open are weighted 2:1 over list.
+        assert counts["stat"] > counts["list"]
+        assert counts["open"] > counts["list"]
+        assert all(op.obj == "m/" for op in a if op.kind == "list")
+        # A different seed is a different storm.
+        c = build_storm_schedule(names, **{**kw, "seed": 4})
+        assert c != a
+
+    def test_storm_run_counts_and_flight(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+        from tpubench.storage import open_backend
+        from tpubench.workloads.meta_storm import run_meta_storm
+
+        cfg = _hermetic_cfg()
+        cfg.lifecycle.meta_objects = 10
+        cfg.lifecycle.meta_object_bytes = 512
+        cfg.lifecycle.meta_rate_rps = 500
+        cfg.lifecycle.meta_duration_s = 0.3
+        cfg.obs.flight_journal = str(tmp_path / "storm.json")
+        be = open_backend(cfg)
+        try:
+            res = run_meta_storm(cfg, backend=be)
+        finally:
+            be.close()
+        lc = res.extra["lifecycle"]
+        assert lc["op"] == "meta_storm"
+        assert lc["completed"] == lc["ops"] and lc["errors"] == 0
+        assert set(lc["by_kind"]) <= {"list", "stat", "open"}
+        assert lc["p99_ms"] is not None
+        doc = json.loads((tmp_path / "storm.json").read_text())
+        metas = [r for r in doc["records"] if r.get("kind") == "meta"]
+        assert len(metas) == lc["completed"]
+        assert all("meta_op" in r["phases"] for r in metas)
+
+    def test_storm_errors_counted_not_raised(self, monkeypatch):
+        monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+        from tpubench.lifecycle.storm import MetaOp, run_storm
+
+        be = FakeBackend()
+        be.write("exists", b"x" * 64)
+        schedule = [
+            MetaOp(0.0, "stat", "exists"),
+            MetaOp(0.001, "stat", "missing"),  # 404 -> error, not raise
+            MetaOp(0.002, "open", "exists"),
+        ]
+        out = run_storm(be, schedule, workers=2)
+        assert out["completed"] == 2 and out["errors"] == 1
+        assert out["by_kind_errors"] == {"stat": 1}
+
+    def test_sweep_finds_knee_under_load(self, monkeypatch):
+        # Real gaps (scale=1), tiny duration: a slow store (injected
+        # per-open latency) against 2 workers saturates at the upper
+        # multipliers — the knee must be identified.
+        monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "1")
+        from tpubench.storage import open_backend
+        from tpubench.workloads.meta_storm import run_meta_storm
+
+        cfg = _hermetic_cfg()
+        cfg.transport.fault.latency_s = 0.01  # per-open service floor
+        cfg.transport.fault.seed = 7
+        cfg.lifecycle.meta_objects = 8
+        cfg.lifecycle.meta_object_bytes = 256
+        cfg.lifecycle.meta_mix = "open:1"  # every op pays the floor
+        cfg.lifecycle.meta_workers = 2  # capacity ~200 ops/s
+        cfg.lifecycle.meta_rate_rps = 100.0
+        cfg.lifecycle.meta_duration_s = 0.6
+        cfg.lifecycle.sweep_points = [0.5, 1.0, 4.0, 8.0]
+        be = open_backend(cfg)
+        try:
+            res = run_meta_storm(cfg, backend=be, sweep=True)
+        finally:
+            be.close()
+        sweep = res.extra["lifecycle"]["sweep"]
+        assert len(sweep["points"]) == 4
+        assert sweep["knee"] is not None, sweep["points"]
+        # Offered load really stepped up across the sweep.
+        offered = [p["offered_rps"] for p in sweep["points"]]
+        assert offered[-1] > 2 * offered[0], offered
+
+
+# ------------------------------------------------------------- acceptance ---
+
+
+class TestRoundtripAcceptance:
+    def test_save_restore_roundtrip_under_fault_timeline(
+        self, tmp_path, monkeypatch
+    ):
+        """The PR's acceptance: a sharded checkpoint written through
+        ckpt-save OVER THE WIRE under a mid-part reset/stall fault
+        timeline resumes (resumed-part count > 0), finalizes
+        byte-identical objects, ckpt-restore rebuilds the exact shards
+        with a time-to-restore scorecard, and ``tpubench report``
+        renders both scorecards plus the A/B diff."""
+        monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+        fault = FaultPlan(
+            upload_reset_after_bytes=96 * 1024,  # mid part 2 of each object
+            upload_stall_s=0.01, upload_stall_rate=0.5, seed=11,
+        )
+        store = FakeBackend(fault=fault)
+        with FakeGcsServer(backend=store) as srv:
+            cfg = _hermetic_cfg(objects=3, object_bytes=192 * 1024,
+                                part_bytes=64 * 1024)
+            cfg.transport.protocol = "http"
+            cfg.transport.endpoint = srv.endpoint
+            cfg.workload.bucket = "B"
+            cfg.transport.retry = RetryConfig(
+                initial_backoff_s=0.002, max_backoff_s=0.01
+            )
+            from tpubench.workloads.ckpt import (
+                run_ckpt_restore,
+                run_ckpt_save,
+            )
+
+            save = run_ckpt_save(cfg)
+            slc = save.extra["lifecycle"]
+            assert slc["resumed_parts"] > 0, slc
+            assert slc["corrupt_finalizes"] == 0
+            assert slc["verified"] is True and save.errors == 0
+            # Byte identity straight against the store, independent of
+            # the workload's own verifier.
+            for i in range(3):
+                name = f"ckpt/shard_{i:05d}"
+                assert (
+                    _read_all(store, name)
+                    == deterministic_bytes(name, 192 * 1024).tobytes()
+                )
+            restore = run_ckpt_restore(cfg)
+            rlc = restore.extra["lifecycle"]
+            assert rlc["verified"] is True and restore.errors == 0
+            assert rlc["staged"] is True and rlc["shards_per_object"] == 8
+            assert rlc["time_to_restore_s"] > 0
+
+        # `tpubench report` renders both scorecards + the lifecycle A/B.
+        from tpubench.metrics.report import write_result
+        from tpubench.workloads.report_cmd import run_report
+
+        p1 = write_result(save, str(tmp_path), tag="a")
+        p2 = write_result(restore, str(tmp_path), tag="b")
+        out = run_report([p1, p2])
+        assert "lifecycle [save]" in out
+        assert "resumed_parts=" in out and "corrupt_finalizes=0" in out
+        assert "lifecycle [restore]" in out
+        assert "time-to-restore=" in out
+        # Two saves diff on the write path's own axes.
+        out2 = run_report([p1, p1])
+        assert "ckpt-save:" in out2 and "resumed" in out2
+
+
+# ---------------------------------------------------------------- config ----
+
+
+class TestConfigAndCli:
+    def test_lifecycle_config_roundtrip(self):
+        cfg = BenchConfig()
+        cfg.lifecycle.objects = 9
+        cfg.lifecycle.meta_mix = "stat:3,open:1"
+        cfg.lifecycle.sweep_points = [1.0, 3.0]
+        back = BenchConfig.from_json(cfg.to_json())
+        assert back.lifecycle.objects == 9
+        assert back.lifecycle.meta_mix == "stat:3,open:1"
+        assert back.lifecycle.sweep_points == [1.0, 3.0]
+
+    def test_parse_meta_mix(self):
+        assert parse_meta_mix("list:1,stat:1") == {"list": 0.5, "stat": 0.5}
+        assert parse_meta_mix("open") == {"open": 1.0}
+        with pytest.raises(SystemExit):
+            parse_meta_mix("delete:1")
+        with pytest.raises(SystemExit):
+            parse_meta_mix("stat:-1")
+        with pytest.raises(SystemExit):
+            parse_meta_mix("")
+
+    @pytest.mark.parametrize("field,value", [
+        ("objects", 0), ("part_bytes", 0), ("meta_rate_rps", 0.0),
+        ("meta_duration_s", float("nan")), ("meta_arrival", "trace"),
+        ("sweep_points", []), ("sweep_points", [0.5, -1]),
+        ("prefix", ""),
+    ])
+    def test_validate_rejects(self, field, value):
+        cfg = BenchConfig()
+        setattr(cfg.lifecycle, field, value)
+        with pytest.raises(SystemExit):
+            validate_lifecycle_config(cfg.lifecycle)
+
+    def test_upload_fault_validation(self):
+        from tpubench.config import validate_fault_config
+
+        cfg = BenchConfig()
+        cfg.transport.fault.upload_error_rate = 1.5
+        with pytest.raises(SystemExit):
+            validate_fault_config(cfg.transport.fault)
+        cfg2 = BenchConfig()
+        cfg2.transport.fault.upload_reset_after_bytes = -1
+        with pytest.raises(SystemExit):
+            validate_fault_config(cfg2.transport.fault)
+        # Upload fields are legal phase fields.
+        cfg3 = BenchConfig()
+        cfg3.transport.fault.phases = [
+            [0, 1, {"upload_reset_after_bytes": 100}]
+        ]
+        validate_fault_config(cfg3.transport.fault)
+
+    def test_cli_flag_folding(self):
+        from tpubench.cli import main
+
+        captured = {}
+
+        def fake_run(cfg, backend=None, manifest=None):
+            captured["cfg"] = cfg
+            from tpubench.metrics.report import RunResult
+
+            r = RunResult(workload="ckpt_save", config=cfg.to_dict())
+            r.extra["lifecycle"] = {"op": "save"}
+            return r
+
+        import tpubench.workloads.ckpt as ckpt_mod
+
+        orig = ckpt_mod.run_ckpt_save
+        ckpt_mod.run_ckpt_save = fake_run
+        try:
+            rc = main([
+                "ckpt-save", "--protocol", "fake",
+                "--ckpt-objects", "7", "--ckpt-part-bytes", "4096",
+                "--ckpt-prefix", "mdl/", "--no-ckpt-verify",
+                "--meta-mix", "stat:1", "--lifecycle-seed", "42",
+                "--results-dir", "/tmp/_lc_cli",
+            ])
+        finally:
+            ckpt_mod.run_ckpt_save = orig
+        assert rc == 0
+        lc = captured["cfg"].lifecycle
+        assert lc.objects == 7 and lc.part_bytes == 4096
+        assert lc.prefix == "mdl/" and lc.verify is False
+        assert lc.meta_mix == "stat:1" and lc.seed == 42
+
+    def test_cli_rejects_bad_mix(self):
+        from tpubench.cli import main
+
+        with pytest.raises(SystemExit):
+            main(["meta-storm", "--protocol", "fake",
+                  "--meta-mix", "chmod:1"])
+
+    def test_cli_e2e_roundtrip_over_local(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+        from tpubench.cli import main
+
+        data_dir = tmp_path / "store"
+        data_dir.mkdir()
+        common = [
+            "--protocol", "local", "--dir", str(data_dir),
+            "--ckpt-objects", "2", "--ckpt-object-bytes", "65536",
+            "--ckpt-part-bytes", "16384", "--no-restore-device",
+            "--results-dir", str(tmp_path / "res"),
+        ]
+        assert main(["ckpt-save"] + common) == 0
+        assert (data_dir / "ckpt" / "MANIFEST.json").exists()
+        assert main(["ckpt-restore"] + common) == 0
+        assert main(["meta-storm"] + common + [
+            "--meta-objects", "4", "--meta-object-bytes", "128",
+            "--meta-rate", "300", "--meta-duration", "0.2",
+        ]) == 0
+
+
+# -------------------------------------------------------------- telemetry ---
+
+
+class TestLifecycleTelemetry:
+    def test_feeder_counts_upload_and_meta_records(self):
+        from tpubench.obs.telemetry import FlightFeeder, build_registry
+
+        reg = build_registry()
+        feeder = FlightFeeder(reg)
+        feeder({
+            "kind": "upload", "bytes": 2048,
+            "phases": {"enqueue": 1, "upload_open": 2, "part_sent": 3,
+                       "upload_complete": 9},
+            "notes": [
+                {"kind": "part", "bytes": 1024},
+                {"kind": "part", "bytes": 1024},
+                {"kind": "retry", "reason": "upload_resume"},
+            ],
+        })
+        feeder({
+            "kind": "meta", "bytes": 0,
+            "phases": {"enqueue": 1, "meta_op": 5},
+            "notes": [],
+        })
+        feeder({
+            "kind": "meta", "bytes": 0, "error": "StorageError",
+            "phases": {"enqueue": 1},
+            "notes": [],
+        })
+        get = lambda n: reg.get(n).value  # noqa: E731
+        assert get("tpubench_upload_sessions_total") == 1
+        assert get("tpubench_upload_bytes_total") == 2048
+        assert get("tpubench_upload_parts_total") == 2
+        assert get("tpubench_upload_resumed_parts_total") == 1
+        assert get("tpubench_meta_ops_total") == 2
+        assert get("tpubench_meta_errors_total") == 1
+
+    def test_manifest_roundtrip_and_crc(self):
+        from tpubench.lifecycle.manifest import (
+            CkptManifest,
+            build_manifest,
+            shard_content,
+        )
+
+        m = build_manifest("ck/", 3, 4096)
+        back = CkptManifest.from_json(m.to_json())
+        assert back == m
+        assert back.total_bytes == 3 * 4096
+        for spec in back.objects:
+            assert spec.crc32 == (
+                zlib.crc32(shard_content(spec.name, spec.size).tobytes())
+                & 0xFFFFFFFF
+            )
+        with pytest.raises(ValueError):
+            CkptManifest.from_json(json.dumps({"format": "nope"}))

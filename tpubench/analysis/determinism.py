@@ -42,6 +42,10 @@ CLOCK_MODULES = (
     # the serve harness can drive them with virtual schedule time and
     # the state-machine tests replay deterministically.
     "tpubench/dist/membership.py",
+    # Storage-lifecycle metadata storm: the open-loop dispatcher's
+    # arrival stamps and per-op latencies must ride an injectable clock
+    # so seeded storms replay deterministically in tests.
+    "tpubench/lifecycle/storm.py",
 )
 
 # Paths whose classes must bound every accumulator (obs/serve planes

@@ -44,6 +44,9 @@ LOCK_ORDER_FILES = (
     # serve admission queue — its lock must stay a leaf (listeners and
     # journal writes run OUTSIDE it).
     "tpubench/dist/membership.py",
+    # Storage-lifecycle storm ledger: its lock stays a leaf (backend
+    # calls and flight appends run OUTSIDE it).
+    "tpubench/lifecycle/storm.py",
 )
 
 _LOCK_CTORS = {"Lock", "RLock", "Condition"}

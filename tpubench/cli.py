@@ -331,6 +331,68 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--save-config", help="write effective config JSON and exit")
 
 
+def _add_lifecycle_flags(p: argparse.ArgumentParser) -> None:
+    """Flags for the storage-lifecycle subcommands (ckpt-save /
+    ckpt-restore / meta-storm) — kept off the common surface; only these
+    parsers carry them."""
+    p.add_argument("--ckpt-objects", type=int,
+                   help="checkpoint shard-objects in the manifest "
+                        "(default 4; one object per parameter shard)")
+    p.add_argument("--ckpt-object-bytes", type=int,
+                   help="bytes per shard-object (default 8 MiB)")
+    p.add_argument("--ckpt-part-bytes", type=int,
+                   help="resumable-upload part size (each part is one "
+                        "content-range PUT; default 1 MiB)")
+    p.add_argument("--ckpt-writers", type=int,
+                   help="concurrent object uploads during ckpt-save "
+                        "(default 4)")
+    p.add_argument("--ckpt-readers", type=int,
+                   help="concurrent shard fetches during ckpt-restore "
+                        "(default 4)")
+    p.add_argument("--ckpt-prefix",
+                   help="object-name prefix; the manifest lands at "
+                        "<prefix>MANIFEST.json (default ckpt/)")
+    p.add_argument("--no-ckpt-verify", action="store_true",
+                   help="skip the readback crc32 verification pass "
+                        "(save) / shard byte-identity check (restore)")
+    p.add_argument("--no-restore-device", action="store_true",
+                   help="ckpt-restore: host-RAM restore only — skip "
+                        "staging shards into device arrays across the "
+                        "mesh")
+    p.add_argument("--meta-objects", type=int,
+                   help="meta-storm: small-object population size "
+                        "(default 64)")
+    p.add_argument("--meta-object-bytes", type=int,
+                   help="meta-storm: bytes per small object (default 4 KiB)")
+    p.add_argument("--meta-rate", type=float, dest="meta_rate",
+                   help="meta-storm: offered metadata ops/second "
+                        "(default 200)")
+    p.add_argument("--meta-duration", type=float, dest="meta_duration",
+                   help="meta-storm: virtual schedule seconds (default 2; "
+                        "wall time scales with TPUBENCH_BENCH_SLEEP_SCALE)")
+    p.add_argument("--meta-arrival", choices=("poisson", "bursty", "diurnal"),
+                   help="meta-storm: arrival process (default poisson)")
+    p.add_argument("--meta-mix",
+                   help="meta-storm: op mix as kind:weight pairs over "
+                        "list/stat/open (default list:1,stat:2,open:2)")
+    p.add_argument("--meta-page-size", type=int,
+                   help="meta-storm: maxResults page bound for list ops "
+                        "(multi-page listings; default 16, 0 = one page)")
+    p.add_argument("--meta-workers", type=int,
+                   help="meta-storm: service worker threads the knee "
+                        "saturates (default 8)")
+    p.add_argument("--meta-sweep", action="store_true",
+                   help="meta-storm: step offered load through the "
+                        "multipliers and identify the saturation knee "
+                        "(the --serve-sweep of metadata)")
+    p.add_argument("--meta-sweep-points",
+                   help="comma list of offered-load multipliers for "
+                        "--meta-sweep (default 0.5,1,2,4)")
+    p.add_argument("--lifecycle-seed", type=int,
+                   help="arrival/mix seed (identical seeds replay "
+                        "identical storms)")
+
+
 def build_config(args) -> BenchConfig:
     if args.config:
         with open(args.config) as f:
@@ -592,6 +654,44 @@ def build_config(args) -> BenchConfig:
     from tpubench.config import validate_serve_config
 
     validate_serve_config(sv)
+    lc = cfg.lifecycle
+    for attr, dest in (
+        ("ckpt_objects", "objects"), ("ckpt_object_bytes", "object_bytes"),
+        ("ckpt_part_bytes", "part_bytes"), ("ckpt_writers", "writers"),
+        ("ckpt_readers", "readers"),
+        ("meta_objects", "meta_objects"),
+        ("meta_object_bytes", "meta_object_bytes"),
+        ("meta_rate", "meta_rate_rps"), ("meta_duration", "meta_duration_s"),
+        ("meta_page_size", "meta_page_size"),
+        ("meta_workers", "meta_workers"),
+        ("lifecycle_seed", "seed"),
+    ):
+        v = getattr(args, attr, None)
+        if v is not None:
+            setattr(lc, dest, v)
+    if getattr(args, "ckpt_prefix", None):
+        lc.prefix = args.ckpt_prefix
+    if getattr(args, "no_ckpt_verify", False):
+        lc.verify = False
+    if getattr(args, "no_restore_device", False):
+        lc.restore_device = False
+    if getattr(args, "meta_arrival", None):
+        lc.meta_arrival = args.meta_arrival
+    if getattr(args, "meta_mix", None):
+        lc.meta_mix = args.meta_mix
+    if getattr(args, "meta_sweep_points", None):
+        try:
+            lc.sweep_points = [
+                float(x) for x in args.meta_sweep_points.split(",") if x
+            ]
+        except ValueError:
+            raise SystemExit(
+                f"--meta-sweep-points {args.meta_sweep_points!r}: "
+                "expected a comma list of positive numbers"
+            ) from None
+    from tpubench.config import validate_lifecycle_config
+
+    validate_lifecycle_config(lc)
     tn = cfg.tune
     if getattr(args, "tune", False):
         tn.enabled = True
@@ -1050,6 +1150,25 @@ def main(argv=None) -> int:
                        help="virtual seconds of resize window the "
                             "scorecard brackets each membership event "
                             "with (default 1.0)")
+    for name, help_ in (
+        ("ckpt-save", "storage lifecycle: save a sharded checkpoint "
+                      "through resumable multi-part uploads (session -> "
+                      "content-range parts -> finalize, part-level "
+                      "retry/resume through the fault plane); scorecard: "
+                      "save goodput, part p50/p99, resumed parts, zero "
+                      "corrupt finalizes"),
+        ("ckpt-restore", "storage lifecycle: restore the saved manifest "
+                         "into sharded device arrays across the mesh "
+                         "(per-host shard ranges via dist.shard); "
+                         "time-to-restore is the headline metric, bytes "
+                         "verified against the manifest crc32s"),
+        ("meta-storm", "storage lifecycle: open-loop list/stat/open "
+                       "storms over many small objects, driven by the "
+                       "arrivals plane (poisson/bursty/diurnal); "
+                       "--meta-sweep steps offered load to the "
+                       "saturation knee"),
+    ):
+        _add_lifecycle_flags(add(name, help_))
     tune = add("tune", "adaptive ingest autotuner: offline coordinate "
                        "sweep or online AIMD session over read/"
                        "train-ingest; emits a convergence trace + a "
@@ -1421,6 +1540,24 @@ def main(argv=None) -> int:
                     tracer=tracer,
                 )
             print(format_tune_block(res.extra["tune"]))
+        elif args.cmd in ("ckpt-save", "ckpt-restore"):
+            from tpubench.lifecycle import format_lifecycle_scorecard
+            from tpubench.workloads.ckpt import (
+                run_ckpt_restore,
+                run_ckpt_save,
+            )
+
+            runner = (
+                run_ckpt_save if args.cmd == "ckpt-save" else run_ckpt_restore
+            )
+            res = runner(cfg)
+            print(format_lifecycle_scorecard(res.extra["lifecycle"]))
+        elif args.cmd == "meta-storm":
+            from tpubench.lifecycle import format_lifecycle_scorecard
+            from tpubench.workloads.meta_storm import run_meta_storm
+
+            res = run_meta_storm(cfg, sweep=args.meta_sweep)
+            print(format_lifecycle_scorecard(res.extra["lifecycle"]))
         elif args.cmd == "probe":
             from tpubench.workloads.probe import run_probe
 

@@ -96,6 +96,18 @@ class FaultConfig:
     # Connection reset: the stream dies abruptly after this many bytes
     # (transient error / RST / closed socket depending on the surface).
     reset_after_bytes: int = 0
+    # --- upload-side faults (the ckpt-save chaos surface) ---
+    # P(a resumable-upload part append fails with a transient 503).
+    upload_error_rate: float = 0.0
+    # One mid-upload pause per session (upload_stall_rate = P(a given
+    # session stalls at all) — the upload twin of the read straggler).
+    upload_stall_s: float = 0.0
+    upload_stall_rate: float = 1.0
+    # Truncate-then-reset: once a session has committed this many bytes,
+    # the in-flight part commits only a PREFIX and the connection dies —
+    # the mid-part shape resumable uploads exist to survive (one-shot
+    # per session, so a resumed upload makes progress past it). 0 = off.
+    upload_reset_after_bytes: int = 0
     # Time-phased schedule: [[t0, t1, {fault fields}], ...] — see class doc.
     phases: list = field(default_factory=list)
 
@@ -110,6 +122,9 @@ class FaultConfig:
             or self.drip_bps
             or self.truncate_after_bytes
             or self.reset_after_bytes
+            or self.upload_error_rate
+            or self.upload_stall_s
+            or self.upload_reset_after_bytes
             or self.phases
         )
 
@@ -120,6 +135,8 @@ _FAULT_PHASE_FIELDS = (
     "error_rate", "read_error_rate", "latency_s", "per_read_latency_s",
     "seed", "stall_after_bytes", "stall_s", "stall_rate", "drip_bps",
     "truncate_after_bytes", "reset_after_bytes",
+    "upload_error_rate", "upload_stall_s", "upload_stall_rate",
+    "upload_reset_after_bytes",
 )
 
 
@@ -139,7 +156,8 @@ def validate_fault_config(fc: "FaultConfig", where: str = "fault") -> None:
             ) from None
 
     def _check_fields(d: dict, label: str) -> None:
-        for name in ("error_rate", "read_error_rate", "stall_rate"):
+        for name in ("error_rate", "read_error_rate", "stall_rate",
+                     "upload_error_rate", "upload_stall_rate"):
             v = d.get(name)
             if v is not None and not (0.0 <= _num(label, name, v) <= 1.0):
                 raise SystemExit(
@@ -148,6 +166,7 @@ def validate_fault_config(fc: "FaultConfig", where: str = "fault") -> None:
         for name in (
             "latency_s", "per_read_latency_s", "stall_s", "drip_bps",
             "stall_after_bytes", "truncate_after_bytes", "reset_after_bytes",
+            "upload_stall_s", "upload_reset_after_bytes",
         ):
             v = d.get(name)
             if v is not None and _num(label, name, v) < 0:
@@ -686,6 +705,128 @@ MEMBER_TIMELINE_ACTIONS = (
 )
 
 
+@dataclass
+class LifecycleConfig:
+    """Storage-lifecycle plane (tpubench/lifecycle/ + the ``ckpt-save``/
+    ``ckpt-restore``/``meta-storm`` workloads).
+
+    Every prior workload READS; this is the other half of the reference
+    (``benchmark-script/``'s write/list/open binaries): a checkpoint-
+    shaped write path over resumable multi-part uploads, a sharded
+    restore with time-to-restore as the headline, and open-loop
+    list/stat/open metadata storms driven by the arrivals plane so
+    metadata ops get a knee curve too."""
+
+    # --- checkpoint shape (save + restore) ---
+    # The manifest: `objects` shard-objects of `object_bytes` each (a
+    # sharded model layout — one object per parameter shard).
+    objects: int = 4
+    object_bytes: int = 8 * MB
+    # Resumable-upload part size (each part is one content-range PUT).
+    part_bytes: int = 1 * MB
+    # Concurrent object uploads (save) / shard fetches (restore).
+    writers: int = 4
+    readers: int = 4
+    # Object-name prefix; the manifest lands at <prefix>MANIFEST.json.
+    prefix: str = "ckpt/"
+    # Readback-verify every finalized object's crc32 against the
+    # manifest (save) / verify fetched shard bytes (restore): the
+    # zero-corrupt-finalizes check. Costs one extra read pass on save.
+    verify: bool = True
+    # Restore stages each object's per-host shard ranges into a SHARDED
+    # device array across the mesh (dist.shard/reassemble path); False
+    # = host-RAM restore only (jax-free).
+    restore_device: bool = True
+    # --- metadata storm ---
+    meta_objects: int = 64  # many small objects (the pathology)
+    meta_object_bytes: int = 4 * KB
+    meta_rate_rps: float = 200.0  # offered metadata ops/second
+    meta_duration_s: float = 2.0  # virtual schedule seconds
+    meta_arrival: str = "poisson"  # poisson | bursty | diurnal
+    # Op mix "kind:weight,..." over list/stat/open (open = open_read of
+    # the object head, the reference's open_file analogue).
+    meta_mix: str = "list:1,stat:2,open:2"
+    # Wire page bound for list ops (maxResults; multi-page listings).
+    meta_page_size: int = 16
+    # Bytes an `open` op reads from the object head before closing.
+    meta_read_bytes: int = 4 * KB
+    # Storm service worker threads (the concurrency the knee saturates).
+    meta_workers: int = 8
+    # --serve-sweep-style offered-load multipliers for the knee curve.
+    sweep_points: list = field(default_factory=lambda: [0.5, 1.0, 2.0, 4.0])
+    seed: int = 0
+
+
+def validate_lifecycle_config(lc: "LifecycleConfig",
+                              where: str = "lifecycle") -> None:
+    """Parse-time sanity for the lifecycle knobs (one-line SystemExit at
+    config load — the validate_fault_config style)."""
+    for name, lo in (
+        ("objects", 1), ("object_bytes", 1), ("part_bytes", 1),
+        ("writers", 1), ("readers", 1), ("meta_objects", 1),
+        ("meta_object_bytes", 0), ("meta_page_size", 0),
+        ("meta_read_bytes", 0), ("meta_workers", 1),
+    ):
+        v = getattr(lc, name)
+        if v < lo:
+            raise SystemExit(f"{where}.{name}={v!r}: must be >= {lo}")
+    for name in ("meta_rate_rps", "meta_duration_s"):
+        v = getattr(lc, name)
+        if not (v > 0):  # also rejects NaN
+            raise SystemExit(f"{where}.{name}={v!r}: must be > 0")
+    if not lc.prefix:
+        raise SystemExit(f"{where}.prefix: must be non-empty")
+    if lc.meta_arrival not in ("poisson", "bursty", "diurnal"):
+        raise SystemExit(
+            f"{where}.meta_arrival={lc.meta_arrival!r}: must be "
+            "poisson|bursty|diurnal"
+        )
+    parse_meta_mix(lc.meta_mix, where=where)
+    if not lc.sweep_points or not all(
+        isinstance(p, (int, float)) and p > 0 for p in lc.sweep_points
+    ):
+        raise SystemExit(
+            f"{where}.sweep_points={lc.sweep_points!r}: must be a "
+            "non-empty list of positive load multipliers"
+        )
+
+
+META_OP_KINDS = ("list", "stat", "open")
+
+
+def parse_meta_mix(spec: str, where: str = "lifecycle") -> dict[str, float]:
+    """``"list:1,stat:2,open:2"`` → normalized weight dict. Unknown op
+    kinds, malformed entries and non-positive weights are one-line
+    SystemExits at config load."""
+    out: dict[str, float] = {}
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        kind, _, w_s = entry.partition(":")
+        kind = kind.strip()
+        if kind not in META_OP_KINDS:
+            raise SystemExit(
+                f"{where}.meta_mix: unknown op kind {kind!r}; valid: "
+                f"{'/'.join(META_OP_KINDS)}"
+            )
+        try:
+            w = float(w_s) if w_s else 1.0
+        except ValueError:
+            raise SystemExit(
+                f"{where}.meta_mix: bad weight {w_s!r} for {kind!r}"
+            ) from None
+        if not (w > 0):
+            raise SystemExit(
+                f"{where}.meta_mix: weight for {kind!r} must be > 0"
+            )
+        out[kind] = out.get(kind, 0.0) + w
+    if not out:
+        raise SystemExit(f"{where}.meta_mix={spec!r}: no ops configured")
+    total = sum(out.values())
+    return {k: v / total for k, v in out.items()}
+
+
 # Knobs the tune controller may actuate (the canonical name set; the
 # controller's ACTUATED registry maps each to its config field and CLI
 # flag, and tests/test_tune.py pins that the three surfaces never drift).
@@ -1076,6 +1217,7 @@ class BenchConfig:
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     coop: CoopConfig = field(default_factory=CoopConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    lifecycle: LifecycleConfig = field(default_factory=LifecycleConfig)
 
     # ------------------------------------------------------------------ io --
     def to_dict(self) -> dict[str, Any]:
@@ -1115,6 +1257,7 @@ _SUBTYPES = {
     "telemetry": TelemetryConfig,
     "coop": CoopConfig,
     "serve": ServeConfig,
+    "lifecycle": LifecycleConfig,
     "retry": RetryConfig,
     "fault": FaultConfig,
     "tail": TailConfig,

@@ -70,6 +70,12 @@ JOURNAL_FORMAT = "tpubench-flight-v1"
 # stage_complete segment IS the transfer's flight time, and with
 # out-of-order completion it is the honest per-transfer quantity a
 # submit-time stamp would have corrupted.
+# Lifecycle phases (PR 15): a resumable upload stamps upload_open when
+# its session opens (before any connection work), part_sent at its first
+# committed part (per-part detail rides "part" notes and the part
+# latency recorder) and upload_complete at finalize; meta_op stamps an
+# open-loop metadata operation's completion (the meta_op segment IS its
+# service time, queue wait included).
 # Coop phases (PR 8): a miss routed to a peer owner stamps peer_request
 # when the ask leaves, then peer_hit (the owner served — the peer_hit
 # segment IS the peer transfer round-trip) or peer_miss (the owner shed;
@@ -85,10 +91,14 @@ PHASES = (
     "peer_hit",
     "peer_miss",
     "owner_fetch",
+    "upload_open",
     "connect",
     "stream_open",
     "first_byte",
     "body_complete",
+    "meta_op",
+    "part_sent",
+    "upload_complete",
     "stall_begin",
     "stall_end",
     "stage_submit",

@@ -391,6 +391,18 @@ COUNTER_METRICS = {
         "(queue overload / deadline / drain)",
     "tpubench_serve_deadline_miss_total":
         "completed serve requests that missed their tenant deadline",
+    "tpubench_upload_sessions_total":
+        "resumable upload sessions completed (one per ckpt-save object)",
+    "tpubench_upload_parts_total":
+        "upload parts committed (content-range PUTs)",
+    "tpubench_upload_resumed_parts_total":
+        "upload parts resumed after a mid-part fault "
+        "(committed offset re-probed, tail resent)",
+    "tpubench_upload_bytes_total":
+        "bytes finalized through resumable uploads",
+    "tpubench_meta_ops_total":
+        "open-loop metadata ops completed (meta-storm list/stat/open)",
+    "tpubench_meta_errors_total": "metadata ops that failed",
     "tpubench_journal_flushes_total": "in-run flight-journal stream flushes",
     "tpubench_journal_rotated_records_total":
         "oldest journal records dropped by size-bounded rotation",
@@ -569,6 +581,17 @@ class FlightFeeder:
         elif kind == "stage":
             reg.get("tpubench_stage_transfers_total").inc()
             reg.get("tpubench_stage_bytes_total").inc(nbytes)
+        elif kind == "upload":
+            if not rec.get("error"):
+                # "Sessions COMPLETED" by its help text: an errored
+                # upload record (e.g. a 412 after session open) must
+                # not count.
+                reg.get("tpubench_upload_sessions_total").inc()
+                reg.get("tpubench_upload_bytes_total").inc(nbytes)
+        elif kind == "meta":
+            reg.get("tpubench_meta_ops_total").inc()
+            if rec.get("error"):
+                reg.get("tpubench_meta_errors_total").inc()
         if "cache_hit" in phases:
             reg.get("tpubench_cache_hits_total").inc()
         if "cache_miss" in phases:
@@ -589,6 +612,10 @@ class FlightFeeder:
             nk = n.get("kind")
             if nk == "retry":
                 reg.get("tpubench_retries_total").inc()
+                if n.get("reason") == "upload_resume":
+                    reg.get("tpubench_upload_resumed_parts_total").inc()
+            elif nk == "part":
+                reg.get("tpubench_upload_parts_total").inc()
             elif nk == "hedge":
                 if n.get("event") == "launch":
                     reg.get("tpubench_hedges_total").inc()

@@ -62,6 +62,10 @@ SPAN_KINDS = {
               "pause/resume — epoch-numbered pod view changes) or its "
               "warm-handoff byte accounting",
     "tune": "one autotuner decision window",
+    "upload": "one resumable object upload (ckpt-save: session open "
+              "to finalize; per-part detail rides its notes)",
+    "meta": "one open-loop metadata operation (meta-storm "
+            "list/stat/open)",
 }
 
 # Annotation kinds synthesized into child spans (notes with a duration
@@ -81,10 +85,14 @@ _PHASE_HELP = {
     "peer_hit": "owner served the chunk (peer round-trip)",
     "peer_miss": "owner shed; the read fell through to origin",
     "owner_fetch": "origin read made as the chunk's ring owner",
+    "upload_open": "resumable upload session opened",
     "connect": "connection establishment",
     "stream_open": "request stream opened",
     "first_byte": "time to first payload byte",
     "body_complete": "payload fully delivered",
+    "meta_op": "metadata operation completed (service time incl. queue)",
+    "part_sent": "first upload part committed",
+    "upload_complete": "resumable upload finalized",
     "stall_begin": "train-ingest step began waiting for data",
     "stall_end": "train-ingest step's data wait ended",
     "stage_submit": "host-to-HBM transfer left the reaper",

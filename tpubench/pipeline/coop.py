@@ -419,10 +419,14 @@ class PeerBackend:
         return PeerReader(data, time.perf_counter_ns(), key.generation)
 
     # StorageBackend protocol completeness (the peer tier is read-only).
-    def write(self, name: str, data: bytes) -> ObjectMeta:
+    def write(self, name: str, data: bytes,
+              if_generation_match=None) -> ObjectMeta:
         raise StorageError("peer backend is read-only", transient=False)
 
-    def list(self, prefix: str = "") -> list:
+    def open_write(self, name: str, if_generation_match=None):
+        raise StorageError("peer backend is read-only", transient=False)
+
+    def list(self, prefix: str = "", page_size: int = 0) -> list:
         return []
 
     def stat(self, name: str) -> ObjectMeta:
@@ -1267,6 +1271,7 @@ def run_coop_sim(
     slab_pool: bool = False,
     peer_budget_bytes: int = 0,
     host_delay_s: Optional[dict] = None,
+    plan: Optional[list] = None,
 ) -> dict:
     """Hermetic multi-"host" pod simulation: N threaded hosts over one
     shared fake origin and a loopback peer transport, each walking its
@@ -1275,6 +1280,11 @@ def run_coop_sim(
     bench's ``coop_cache`` cell — ``coop=False`` runs the identical
     machinery with routing disabled (the per-host-cache baseline), so
     the delta is the cooperation, not incidental code differences.
+
+    ``plan`` overrides the per-host Zipf sequences with ONE shared
+    access sequence every host walks — the N-hosts-read-overlapping-
+    shards shape of a replicated checkpoint restore, where cooperation
+    turns N× origin traffic into ~1×.
 
     Returns the pod scorecard: ``origin_bytes_per_pod``, per-chunk
     origin fetch counts (the pod-wide single-flight proof), pod/peer
@@ -1321,13 +1331,13 @@ def run_coop_sim(
             h, cc.serve,
             delay_s=(host_delay_s or {}).get(h, 0.0),
         )
-        plan = zipf_plan(
+        host_plan = list(plan) if plan is not None else zipf_plan(
             objects, chunk_bytes, accesses_per_host,
             alpha=alpha, seed=seed * 1000 + h,
         )
         hosts.append({
             "coop": cc, "cache": cache, "pool": pool, "meter": meter,
-            "plan": plan, "error": None,
+            "plan": host_plan, "error": None,
         })
 
     def run_host(entry: dict) -> None:

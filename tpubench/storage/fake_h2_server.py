@@ -238,9 +238,12 @@ class _Conn:
                 pass
 
     def _serve_h11(self, initial: bytes) -> None:
-        """Keep-alive HTTP/1.1 side: object metadata, media (with Range)
-        and list — enough for an ``http2=True`` client whose metadata
-        requests ride the HTTP/1.1 pool."""
+        """Keep-alive HTTP/1.1 side: object metadata, media (with Range),
+        list — and the UPLOAD surface (media + resumable sessions), since
+        an ``http2=True`` client's writes ride the HTTP/1.1 pool (the
+        native h2 client is GET-only). Upload semantics shared with the
+        h1.1 fake via handle_upload_request — one definition, two
+        framings."""
         import json
 
         buf = initial
@@ -273,6 +276,58 @@ class _Conn:
             parsed = urllib.parse.urlsplit(path)
             query = urllib.parse.parse_qs(parsed.query)
             parts = parsed.path.split("/")
+            clen = int(hdrs.get("content-length", "0") or 0)
+            if clen:
+                while len(buf) < clen:
+                    chunk = self.sock.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+            req_body, buf = buf[:clen], buf[clen:]
+            if len(parts) >= 2 and parts[1] == "upload":
+                from tpubench.storage.fake_server import (
+                    RESET_CONNECTION,
+                    handle_upload_request,
+                )
+
+                resp = handle_upload_request(
+                    self.backend, method, parts, query,
+                    {"Content-Range": hdrs.get("content-range", "")},
+                    bytes(req_body), host=hdrs.get("host", "127.0.0.1"),
+                )
+                if resp == RESET_CONNECTION:
+                    try:
+                        self.sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    return
+                status, extra_headers, body_doc = resp
+                extra = "".join(
+                    f"{k}: {v}\r\n" for k, v in extra_headers.items()
+                )
+                send(status, json.dumps(body_doc).encode(),
+                     "application/json", extra)
+                continue
+            if (
+                method == "GET"
+                and len(parts) >= 6
+                and parts[1] == "storage"
+                and parts[5] == "o"
+                and not "/".join(parts[6:])
+            ):
+                # List with maxResults/pageToken pagination (parity with
+                # the h1.1 fake's page surface).
+                from tpubench.storage.fake_server import paginate_listing
+
+                prefix = query.get("prefix", [""])[0]
+                send(
+                    200,
+                    json.dumps(
+                        paginate_listing(self.backend.list(prefix), query)
+                    ).encode(),
+                    "application/json",
+                )
+                continue
             if (
                 method != "GET"
                 or len(parts) < 7
@@ -390,19 +445,15 @@ class _Conn:
             return self._respond_error(stream, 404, f"no route: {path}")
         if len(parts) == 6 or not "/".join(parts[6:]):
             # List route over h2 (`.../o?prefix=`): the whole-client
-            # http2 mode sends list requests here too.
+            # http2 mode sends list requests here too — same
+            # maxResults/pageToken page surface as the h1.1 fake.
             import json
 
-            from tpubench.storage.base import object_meta_dict
+            from tpubench.storage.fake_server import paginate_listing
 
             prefix = query.get("prefix", [""])[0]
             body = json.dumps(
-                {
-                    "kind": "storage#objects",
-                    "items": [
-                        object_meta_dict(m) for m in self.backend.list(prefix)
-                    ],
-                }
+                paginate_listing(self.backend.list(prefix), query)
             ).encode()
             return self._respond_body(stream, 200, body)
         name = urllib.parse.unquote("/".join(parts[6:]))

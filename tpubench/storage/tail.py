@@ -80,11 +80,21 @@ class _WrapperBackend:
     def __init__(self, inner: StorageBackend):
         self.inner = inner
 
-    def write(self, name: str, data: bytes):
-        return self.inner.write(name, data)
+    def write(self, name: str, data: bytes, if_generation_match=None):
+        return self.inner.write(
+            name, data, if_generation_match=if_generation_match
+        )
 
-    def list(self, prefix: str = ""):
-        return self.inner.list(prefix)
+    def open_write(self, name: str, if_generation_match=None):
+        # The tail layers shape READS (hedge/watchdog race byte streams);
+        # the write path passes through and composes with the retry
+        # decorator's resuming writer above this stack.
+        return self.inner.open_write(
+            name, if_generation_match=if_generation_match
+        )
+
+    def list(self, prefix: str = "", page_size: int = 0):
+        return self.inner.list(prefix, page_size=page_size)
 
     def stat(self, name: str):
         return self.inner.stat(name)

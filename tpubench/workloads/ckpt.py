@@ -1,0 +1,323 @@
+"""Checkpoint save/restore workloads (``tpubench ckpt-save`` /
+``tpubench ckpt-restore``).
+
+The storage-lifecycle pair (ROADMAP item: checkpoint restore/save):
+
+* **ckpt-save** — the first WRITE path: a sharded-model manifest of
+  ``lifecycle.objects`` shard-objects streamed out through resumable
+  multi-part uploads (session → content-range parts → finalize), with
+  part-level retry/resume riding the backend stack's resuming writer so
+  breaker/retry compose under upload faults exactly like they do under
+  read faults. Scorecard: save goodput, part p50/p99, resumed-part
+  count, and ZERO corrupt finalizes (readback crc32 vs the manifest).
+* **ckpt-restore** — the manifest read back into per-host shard ranges
+  (dist.shard's lane-aligned decomposition) and staged into SHARDED
+  device arrays across the mesh, with **time-to-restore** as the
+  headline metric and byte-identity verified against the manifest crcs.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from typing import Optional
+
+from tpubench.config import BenchConfig
+from tpubench.lifecycle import format_lifecycle_scorecard  # noqa: F401 (CLI re-export)
+from tpubench.lifecycle.manifest import (
+    CkptManifest,
+    build_manifest,
+    manifest_name,
+    read_manifest,
+    shard_content,
+)
+from tpubench.lifecycle.upload import readback_crc32, upload_object
+from tpubench.metrics import LatencyRecorder, merge_recorders
+from tpubench.metrics.percentiles import summarize_ns
+from tpubench.metrics.report import RunResult
+from tpubench.obs.flight import (
+    flight_from_config,
+    host_journal_path,
+    transport_label,
+)
+from tpubench.storage import open_backend
+from tpubench.workloads.common import WorkerGroup
+
+
+def _flight_finish(cfg: BenchConfig, flight, res: RunResult,
+                   workload: str) -> None:
+    """Shared journal/summary stamping tail (read.py discipline)."""
+    if flight is None:
+        return
+    res.extra["flight"] = flight.summary()
+    if cfg.obs.flight_journal:
+        d = cfg.dist
+        jpath = host_journal_path(
+            cfg.obs.flight_journal, d.process_id, d.num_processes
+        )
+        res.extra["flight_journal"] = flight.write_journal(
+            jpath, extra={"workload": workload},
+            max_bytes=cfg.obs.journal_max_bytes,
+        )
+
+
+def run_ckpt_save(
+    cfg: BenchConfig, backend=None, manifest: Optional[CkptManifest] = None,
+) -> RunResult:
+    lc = cfg.lifecycle
+    owns = backend is None
+    backend = backend or open_backend(cfg)
+    flight = flight_from_config(cfg)
+    tlabel = transport_label(cfg)
+    manifest = manifest or build_manifest(lc.prefix, lc.objects,
+                                          lc.object_bytes)
+    n_workers = min(lc.writers, len(manifest.objects))
+    part_recs = [LatencyRecorder(f"part{i}") for i in range(n_workers)]
+    obj_recs = [LatencyRecorder(f"obj{i}") for i in range(n_workers)]
+    parts = [0] * n_workers
+    resumed = [0] * n_workers
+    uploaded = [0] * n_workers
+    corrupt = [0] * n_workers
+
+    def worker(i: int, cancel) -> None:
+        ring = flight.worker(f"save{i}") if flight is not None else None
+        for spec in manifest.objects[i::n_workers]:
+            if cancel.is_set():
+                break
+            data = shard_content(spec.name, spec.size)
+            t0 = time.perf_counter_ns()
+            op = (
+                ring.begin(spec.name, tlabel, enqueue_ns=t0, kind="upload")
+                if ring is not None else None
+            )
+            try:
+                _meta, stats = upload_object(
+                    backend, spec.name, data.data, lc.part_bytes,
+                    part_recorder=part_recs[i],
+                )
+            except BaseException as e:
+                if op is not None:
+                    op.finish(error=e)
+                raise
+            obj_recs[i].record_ns(time.perf_counter_ns() - t0)
+            if op is not None:
+                op.finish(stats["bytes"])
+            parts[i] += stats["parts"]
+            resumed[i] += stats["resumed_parts"]
+            uploaded[i] += stats["bytes"]
+            if lc.verify and readback_crc32(
+                backend, spec.name, spec.size
+            ) != spec.crc32:
+                # A finalize that committed wrong bytes is the one
+                # failure a resumable upload may NEVER have.
+                corrupt[i] += 1
+
+    t0 = time.perf_counter()
+    try:
+        import contextlib
+
+        with (flight.activate() if flight is not None
+              else contextlib.nullcontext()):
+            gres = WorkerGroup(
+                abort_on_error=cfg.workload.abort_on_error
+            ).run(n_workers, worker, name="ckpt-save")
+        # The manifest lands LAST (restore's readiness marker), through
+        # the one-shot media path — both write surfaces exercised. It is
+        # the READINESS marker: under abort_on_error=False a failed or
+        # corrupt shard means the checkpoint is NOT restorable, so no
+        # manifest may be published.
+        if gres.error_count == 0 and sum(corrupt) == 0:
+            backend.write(
+                manifest_name(lc.prefix), manifest.to_json().encode()
+            )
+        wall = time.perf_counter() - t0
+    finally:
+        if owns:
+            backend.close()
+    total = sum(uploaded)
+    part_all = merge_recorders(part_recs)
+    res = RunResult(
+        workload="ckpt_save",
+        config=cfg.to_dict(),
+        bytes_total=total,
+        wall_seconds=wall,
+        gbps=(total / 1e9) / wall if wall > 0 else 0.0,
+        gbps_per_chip=(total / 1e9) / wall if wall > 0 else 0.0,
+        summaries={
+            "part": summarize_ns(part_all),
+            "object_upload": summarize_ns(merge_recorders(obj_recs)),
+        } if part_all.size else {},
+        errors=gres.error_count + sum(corrupt),
+    )
+    res.extra["lifecycle"] = {
+        "op": "save",
+        "objects": len(manifest.objects),
+        "bytes": total,
+        "parts": sum(parts),
+        "part_bytes": lc.part_bytes,
+        "goodput_gbps": res.gbps,
+        "part_latency": (
+            summarize_ns(part_all).to_dict() if part_all.size else None
+        ),
+        "resumed_parts": sum(resumed),
+        "corrupt_finalizes": sum(corrupt),
+        "verified": bool(lc.verify) and sum(corrupt) == 0,
+        "worker_errors": gres.error_count,
+    }
+    _flight_finish(cfg, flight, res, "ckpt_save")
+    return res
+
+
+def run_ckpt_restore(cfg: BenchConfig, backend=None) -> RunResult:
+    lc = cfg.lifecycle
+    lane = cfg.staging.lane
+    owns = backend is None
+    backend = backend or open_backend(cfg)
+    flight = flight_from_config(cfg)
+    tlabel = transport_label(cfg)
+    try:
+        manifest = read_manifest(backend, lc.prefix)
+        use_device = lc.restore_device
+        mesh = None
+        n_shards = 1
+        if use_device:
+            try:
+                from tpubench.dist.reassemble import make_mesh
+
+                mesh = make_mesh(axis=cfg.dist.mesh_axis)
+                n_shards = int(mesh.devices.size)
+            except Exception as e:  # noqa: BLE001 — jax-free degrade
+                import sys
+
+                print(
+                    f"ckpt-restore: device staging unavailable ({e}); "
+                    "host-RAM restore", file=sys.stderr,
+                )
+                use_device = False
+        from tpubench.dist.shard import ShardTable
+
+        import numpy as np
+
+        tables = [
+            ShardTable.build(spec.size, n_shards, align=lane)
+            for spec in manifest.objects
+        ]
+        def _prefaulted(nbytes: int):
+            # Eager-touch the destination pages: np.zeros maps lazily,
+            # and first-touch faults inside the timed fetch window would
+            # bill host-memory setup to storage goodput.
+            b = np.empty(nbytes, dtype=np.uint8)
+            b.fill(0)
+            return b
+
+        buffers = [
+            [_prefaulted(t.shard_bytes) for _ in range(n_shards)]
+            for t in tables
+        ]
+        n_workers = min(lc.readers, len(manifest.objects) * n_shards)
+        verify_fail = [0] * max(1, n_workers)
+
+        # ---- fetch: every (object, shard) range, fanned over readers --
+        work = [
+            (oi, si)
+            for oi in range(len(manifest.objects))
+            for si in range(n_shards)
+        ]
+
+        def fetch(i: int, cancel) -> None:
+            from tpubench.workloads.common import fetch_shard
+
+            ring = flight.worker(f"restore{i}") if flight is not None else None
+            for oi, si in work[i::n_workers]:
+                if cancel.is_set():
+                    break
+                spec = manifest.objects[oi]
+                op = (
+                    ring.begin(spec.name, tlabel)
+                    if ring is not None else None
+                )
+                try:
+                    fetch_shard(
+                        backend, spec.name, tables[oi], si, buffers[oi][si]
+                    )
+                except BaseException as e:
+                    if op is not None:
+                        op.finish(error=e)
+                    raise
+                if op is not None:
+                    op.mark("body_complete")
+                    op.finish(tables[oi].shard(si).length)
+
+        import contextlib
+
+        t0 = time.perf_counter()
+        with (flight.activate() if flight is not None
+              else contextlib.nullcontext()):
+            gres = WorkerGroup(
+                abort_on_error=cfg.workload.abort_on_error
+            ).run(n_workers, fetch, name="ckpt-restore")
+        t_fetch = time.perf_counter() - t0
+
+        # ---- verify: byte identity against the manifest's crc32s ------
+        verified = True
+        if lc.verify:
+            for oi, spec in enumerate(manifest.objects):
+                crc = 0
+                for si in range(n_shards):
+                    sh = tables[oi].shard(si)
+                    crc = zlib.crc32(
+                        memoryview(buffers[oi][si])[:sh.length], crc
+                    )
+                if crc & 0xFFFFFFFF != spec.crc32:
+                    verified = False
+                    verify_fail[0] += 1
+
+        # ---- stage: shard buffers → sharded device arrays --------------
+        t0 = time.perf_counter()
+        arrays = []
+        if use_device:
+            import jax
+
+            from tpubench.dist.reassemble import shard_to_device_array
+
+            for oi in range(len(manifest.objects)):
+                arrays.append(shard_to_device_array(
+                    buffers[oi], mesh, cfg.dist.mesh_axis, lane
+                ))
+            for a in arrays:
+                jax.block_until_ready(a)
+        t_stage = time.perf_counter() - t0
+        time_to_restore = t_fetch + t_stage
+    finally:
+        if owns:
+            backend.close()
+
+    total = manifest.total_bytes
+    res = RunResult(
+        workload="ckpt_restore",
+        config=cfg.to_dict(),
+        bytes_total=total,
+        wall_seconds=time_to_restore,
+        gbps=(total / 1e9) / time_to_restore if time_to_restore > 0 else 0.0,
+        gbps_per_chip=(
+            (total / 1e9) / time_to_restore / max(1, n_shards)
+            if time_to_restore > 0 else 0.0
+        ),
+        n_chips=max(1, n_shards) if use_device else 1,
+        errors=gres.error_count + sum(verify_fail),
+    )
+    res.extra["lifecycle"] = {
+        "op": "restore",
+        "objects": len(manifest.objects),
+        "bytes": total,
+        "time_to_restore_s": time_to_restore,
+        "fetch_seconds": t_fetch,
+        "stage_seconds": t_stage,
+        "goodput_gbps": res.gbps,
+        "staged": use_device,
+        "shards_per_object": n_shards,
+        "verified": verified if lc.verify else None,
+        "worker_errors": gres.error_count,
+    }
+    _flight_finish(cfg, flight, res, "ckpt_restore")
+    return res

@@ -78,6 +78,18 @@ def _axis(run: dict) -> str:
             bits.append("coop-leave")
         if "kill_host" in actions:
             bits.append("killed")
+    lc = run.get("extra", {}).get("lifecycle")
+    if lc:
+        # Lifecycle runs label their op + the knob that shapes the A/B
+        # (part size for saves, arrival process for storms) so a faulted
+        # save doesn't render as a twin of its clean baseline.
+        bits.append(f"lifecycle:{lc.get('op', '?')}")
+        if lc.get("op") == "save" and lc.get("part_bytes"):
+            bits.append(f"part={lc['part_bytes']}")
+        if lc.get("op") == "meta_storm":
+            bits.append(lc.get("arrival", "?"))
+            if lc.get("sweep"):
+                bits.append("sweep")
     # Adaptive-vs-static is an A/B axis of its own: a run the controller
     # drove must not render as a twin of its static sibling.
     if (run.get("extra", {}).get("tune") or {}).get("enabled") or \
@@ -153,6 +165,14 @@ def summarize_run(run: dict, label: str = "") -> str:
         from tpubench.workloads.serve import format_membership_scorecard
 
         lines.append(format_membership_scorecard(mb))
+    lc = extra.get("lifecycle")
+    if lc:
+        # Storage-lifecycle scorecard: same body the CLI printed live
+        # (save goodput/parts/resume counts, time-to-restore, storm
+        # knee curve).
+        from tpubench.lifecycle import format_lifecycle_scorecard
+
+        lines.append(format_lifecycle_scorecard(lc))
     tel = extra.get("telemetry")
     if tel:
         # Live-telemetry stamp: where the run was scrapeable and what
@@ -329,6 +349,49 @@ def compare_runs(runs: list[dict]) -> str:
                 + ", failovers "
                 f"{omb.get('failovers', 0)} vs {bmb.get('failovers', 0)}"
             )
+        # Lifecycle diff: two saves (e.g. faulted vs clean, or part-size
+        # A/B) compare on what the write path exists for — goodput,
+        # resumed parts, part-level tail; restores on time-to-restore;
+        # storms on the knee.
+        olc = other.get("extra", {}).get("lifecycle")
+        blc = base.get("extra", {}).get("lifecycle")
+        if olc and blc and olc.get("op") == blc.get("op"):
+            op = olc.get("op")
+            if op == "save":
+                lines.append(
+                    "    ckpt-save: goodput "
+                    f"{cell(olc, '{:.4f}', 'goodput_gbps')} vs "
+                    f"{cell(blc, '{:.4f}', 'goodput_gbps')} GB/s, "
+                    "part p99 "
+                    f"{cell(olc, '{:.2f}ms', 'part_latency', 'p99_ms')} vs "
+                    f"{cell(blc, '{:.2f}ms', 'part_latency', 'p99_ms')}, "
+                    "resumed "
+                    f"{olc.get('resumed_parts', 0)} vs "
+                    f"{blc.get('resumed_parts', 0)}, corrupt "
+                    f"{olc.get('corrupt_finalizes', 0)} vs "
+                    f"{blc.get('corrupt_finalizes', 0)}"
+                )
+            elif op == "restore":
+                lines.append(
+                    "    ckpt-restore: time-to-restore "
+                    f"{cell(olc, '{:.3f}s', 'time_to_restore_s')} vs "
+                    f"{cell(blc, '{:.3f}s', 'time_to_restore_s')}, "
+                    "goodput "
+                    f"{cell(olc, '{:.4f}', 'goodput_gbps')} vs "
+                    f"{cell(blc, '{:.4f}', 'goodput_gbps')} GB/s"
+                )
+            elif op == "meta_storm":
+                lines.append(
+                    "    meta-storm: achieved "
+                    f"{cell(olc, '{:.1f}', 'achieved_rps')} vs "
+                    f"{cell(blc, '{:.1f}', 'achieved_rps')} rps, "
+                    "p99 "
+                    f"{cell(olc, '{:.2f}ms', 'p99_ms')} vs "
+                    f"{cell(blc, '{:.2f}ms', 'p99_ms')}, "
+                    "knee "
+                    f"{cell(olc, '{}', 'sweep', 'knee', 'offered_rps')} vs "
+                    f"{cell(blc, '{}', 'sweep', 'knee', 'offered_rps')}"
+                )
         # Tune diff: a static run against its adaptive sibling compares
         # on what the controller exists for — the converged operating
         # point and when it got there — alongside the throughput ratio
