@@ -423,6 +423,65 @@ def _serve_knee_cell() -> dict:
     }
 
 
+def _scenario_replay_cell() -> dict:
+    """Golden-scenario regression gate (the record/replay plane): the
+    checked-in ``scenarios/chaos-serve-gold.tpb.gz`` bundle — a chaos
+    serve run with a mid-run latency phase, recorded once at sleep
+    scale 1 — replays under the SAME system config it was recorded
+    with, and the cell gates on drift: the config fingerprints must
+    match (the bench config below IS the recording config's system
+    half), the replayed schedule must carry every recorded arrival, and
+    gold-class SLO attainment must stay within 5 points of the recorded
+    baseline. Structural gates only — wall-clock metrics (goodput,
+    p99) vary with TPUBENCH_BENCH_SLEEP_SCALE, the schedule does not.
+    CPU-only and jax-free, so it rides the quiet-CPU segment."""
+    from tpubench.config import BenchConfig
+    from tpubench.replay.bundle import load_bundle, validate_bundle
+    from tpubench.replay.driver import run_replay
+
+    bundle_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scenarios", "chaos-serve-gold.tpb.gz",
+    )
+    bundle = load_bundle(bundle_path)
+    if bundle is None:
+        return {"skipped": f"no golden bundle at {bundle_path}"}
+    validate_bundle(bundle, bundle_path)
+    # The golden scenario's SYSTEM half (scenarios/README.md): only
+    # transport.protocol lands in the fingerprint; the workload fields
+    # just size the hermetic population consistently.
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 4
+    cfg.workload.object_size = 1 * MB
+    cfg.obs.export = "none"
+    res = run_replay(cfg, bundle)
+    rp = res.extra["replay"]
+    delta = (rp.get("diff") or {}).get("gold_slo_delta_pts")
+    drifted = []
+    if not rp.get("config_match"):
+        drifted.append(
+            f"fingerprint {rp.get('fingerprint')} != recorded "
+            f"{rp.get('original_fingerprint')}"
+        )
+    if not rp.get("arrivals_match"):
+        drifted.append("replayed arrivals != recorded arrivals")
+    if delta is not None and abs(delta) > 5.0:
+        drifted.append(f"gold SLO drifted {delta:+.1f} pts")
+    return {
+        "bundle": rp.get("bundle"),
+        "config_match": bool(rp.get("config_match")),
+        "arrivals_match": bool(rp.get("arrivals_match")),
+        "gold_slo_delta_pts": delta,
+        "goodput_retention": (rp.get("diff") or {}).get(
+            "goodput_retention"
+        ),
+        "drift": drifted,
+        "ok": not drifted,
+        "sleep_scale": _SLEEP_SCALE,
+    }
+
+
 def _ckpt_roundtrip_cell() -> dict:
     """Storage-lifecycle roundtrip on the hermetic fake backend
     (BENCH_r06+): a sharded checkpoint saved through resumable
@@ -895,6 +954,21 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001 — the bench must not die here
         print(f"# ckpt roundtrip failed: {e}", file=sys.stderr)
 
+    # Golden-scenario replay gate (record/replay plane): hermetic,
+    # CPU-only and jax-free — quiet-CPU segment. A drift here means the
+    # serve stack no longer reproduces its own recorded scenario.
+    scenario_replay: dict = {}
+    try:
+        scenario_replay = _scenario_replay_cell()
+        if scenario_replay.get("drift"):
+            print(
+                "# scenario replay DRIFT: "
+                + "; ".join(scenario_replay["drift"]),
+                file=sys.stderr,
+            )
+    except Exception as e:  # noqa: BLE001 — the bench must not die here
+        print(f"# scenario replay failed: {e}", file=sys.stderr)
+
     dev = jax.local_devices()[0]  # first jax touch: AFTER the quiet-CPU A/B
 
     # Compile the pallas landing kernel at the pair slot shape BEFORE the
@@ -1167,6 +1241,7 @@ def main() -> int:
                 "serve_knee": serve_knee,
                 "elastic_resize": elastic_resize,
                 "ckpt_roundtrip": ckpt_roundtrip,
+                "scenario_replay": scenario_replay,
                 "shaped_verdict": shaped,
                 "probe_divergence_factor": pdf,
                 "host_cores": _usable_cores(),

@@ -154,3 +154,11 @@ def pytest_configure(config):
         "markers", "multihost: multi-process jax.distributed tests "
                    "(TPUBENCH_MULTIHOST_TESTS=1 to enable)"
     )
+    # Record/replay plane tests stay in tier-1 (same policy as the
+    # other subsystem markers): bundle byte-determinism and the
+    # replay-vs-original tolerance gate run on every pass; the marker
+    # exists for selective runs (`-m replay`).
+    config.addinivalue_line(
+        "markers", "replay: record/replay + regression plane "
+                   "(bundle determinism/replay fidelity/--fail-on gate)"
+    )

@@ -180,6 +180,15 @@ def test_bench_end_to_end_cpu():
         f"restore {cr['restore_gbps']} GB/s fell below 80% of the "
         f"materializing read comparator {cr['read_gbps']} GB/s"
     )
+    # Scenario-replay gate (record/replay plane): the checked-in golden
+    # bundle replayed under its recording config — config fingerprint
+    # and arrival count must match exactly, gold-class SLO within 5
+    # points of the recorded baseline (structural gates; wall-clock
+    # metrics vary with the sleep scale, the schedule does not).
+    sr = d["scenario_replay"]
+    assert sr.get("config_match") and sr.get("arrivals_match"), sr
+    assert sr.get("ok"), sr.get("drift")
+    assert abs(sr["gold_slo_delta_pts"]) <= 5.0, sr
     sweep = d["staging_depth_sweep"]
     assert set(sweep) == {"1", "2", "4"}
     assert sweep["1"]["drain"] == "inline"

@@ -1,0 +1,432 @@
+"""Record/replay + regression plane (tpubench/replay/).
+
+The contracts under test:
+
+* **bundle determinism** — record → replay → record converges on a
+  byte-identical bundle (canonical JSON, zeroed gzip mtime, source
+  passthrough for name/fingerprint/baseline);
+* **replay fidelity** — a recorded chaos serve scenario replayed at the
+  same sleep scale under the identical system config reproduces the
+  original scorecard within the regression tolerances;
+* **A/B replays** — the same bundle under a different system config is
+  marked as an A/B (fingerprint mismatch) and still renders a
+  meaningful diff;
+* **degrade + refusal** — torn/truncated/gz bundles degrade like
+  load_snapshot (warn + None, never a traceback), while well-formed
+  bundles this build can't honor refuse loudly (validate_bundle,
+  record_bundle);
+* **the --fail-on exit-code contract** — 0 gates hold, 1 a gate
+  tripped, 2 a named metric exists nowhere;
+* **journal schema stamping** — journals carry ``journal_schema``,
+  renderers warn once and continue on newer schemas, record refuses.
+
+Everything is hermetic on the fake backend at sleep scale 0 except the
+fidelity test, which needs real (scaled) wall time for its goodput
+comparison.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+
+import pytest
+
+from tpubench.config import BenchConfig
+from tpubench.replay.bundle import (
+    BUNDLE_FIELDS,
+    BUNDLE_FORMAT,
+    format_replay_block,
+    load_bundle,
+    record_bundle,
+    validate_bundle,
+    write_bundle,
+)
+from tpubench.replay.driver import run_replay
+from tpubench.replay.gate import (
+    metric_namespace,
+    parse_fail_on,
+    run_fail_on,
+)
+
+pytestmark = pytest.mark.replay
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO_ROOT, "scenarios", "chaos-serve-gold.tpb.gz")
+
+
+def _serve_cfg(tmp_path, name="j.json", qos=True):
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 4
+    cfg.workload.object_size = 1 << 20
+    cfg.workload.granule_bytes = 64 * 1024
+    cfg.obs.export = "none"
+    cfg.obs.flight_journal = str(tmp_path / name)
+    cfg.serve.duration_s = 1.5
+    cfg.serve.rate_rps = 80.0
+    cfg.serve.tenants = 30
+    cfg.serve.workers = 2
+    cfg.serve.qos = qos
+    cfg.serve.seed = 7
+    return cfg
+
+
+def _record_run(tmp_path, monkeypatch):
+    """One serve run + its bundle, at sleep scale 0 (schedule identity
+    is virtual-time; no wall-clock tolerance needed)."""
+    monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+    from tpubench.workloads.serve import run_serve
+
+    cfg = _serve_cfg(tmp_path)
+    run_serve(cfg)
+    bundle = record_bundle(
+        [cfg.obs.flight_journal], str(tmp_path / "s1.tpb.gz"),
+    )
+    return cfg, bundle
+
+
+# ------------------------------------------------------------ determinism --
+
+
+def test_record_replay_record_byte_identical(tmp_path, monkeypatch):
+    cfg, bundle = _record_run(tmp_path, monkeypatch)
+    rcfg = _serve_cfg(tmp_path, name="j2.json")
+    res = run_replay(rcfg, bundle)
+    rp = res.extra["replay"]
+    assert rp["config_match"], rp
+    assert rp["arrivals_match"], rp
+    # Re-record the REPLAY's journal into a differently named file: the
+    # source passthrough must reproduce the original bundle exactly.
+    p2 = record_bundle(
+        [rcfg.obs.flight_journal], str(tmp_path / "elsewhere.tpb.gz"),
+    )
+    assert p2 == bundle
+    with open(tmp_path / "s1.tpb.gz", "rb") as f:
+        raw1 = f.read()
+    # Same content re-written under the original path: byte-identical
+    # (canonical JSON + zeroed gzip mtime), so goldens diff cleanly.
+    write_bundle(p2, str(tmp_path / "s1.tpb.gz"))
+    with open(tmp_path / "s1.tpb.gz", "rb") as f:
+        assert f.read() == raw1
+
+
+def test_write_bundle_is_byte_deterministic(tmp_path):
+    bundle = {"format": BUNDLE_FORMAT, "name": "x", "z": 1, "a": [2, 3]}
+    a = write_bundle(bundle, str(tmp_path / "a.tpb.gz"))
+    b = write_bundle(dict(reversed(list(bundle.items()))),
+                     str(tmp_path / "b.tpb.gz"))
+    with open(a, "rb") as fa, open(b, "rb") as fb:
+        assert fa.read() == fb.read()
+    # And the .gz payload round-trips through load_bundle.
+    assert load_bundle(a) == bundle
+
+
+# --------------------------------------------------------------- fidelity --
+
+
+def test_replay_reproduces_chaos_scorecard_within_tolerance(
+    tmp_path, monkeypatch,
+):
+    """The e2e acceptance: a fake-backend chaos serve run recorded,
+    then replayed at the SAME sleep scale under the identical system
+    config — gold SLO within 2 points, goodput within tolerance. Runs
+    at scale 0.25 so each arm is sleep-dominated (~1s wall): wall-clock
+    goodput is then schedule-shaped, not host-load-shaped."""
+    monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0.25")
+    from tpubench.workloads.chaos import run_chaos
+
+    cfg = _serve_cfg(tmp_path, name="cj.json")
+    cfg.serve.duration_s = 3.0
+    cfg.serve.rate_rps = 60.0
+    run_chaos(
+        cfg, timeline=[[1.0, 2.0, {"latency_s": 0.01}]],
+        chaos_workload="serve",
+    )
+    bundle = record_bundle(
+        [cfg.obs.flight_journal], str(tmp_path / "c.tpb.gz"),
+    )
+    assert (bundle.get("fault") or {}).get("phases") == [
+        [1.0, 2.0, {"latency_s": 0.01}]
+    ]  # UNSCALED in the bundle; the driver re-scales on arm
+    rcfg = _serve_cfg(tmp_path, name="cj2.json")
+    rcfg.serve.duration_s = 3.0
+    rcfg.serve.rate_rps = 60.0
+    res = run_replay(rcfg, bundle)
+    rp = res.extra["replay"]
+    assert rp["config_match"] and rp["arrivals_match"], rp
+    d = rp["diff"]
+    assert abs(d["gold_slo_delta_pts"]) <= 2.0, rp
+    # Generous wall-clock band (shared CI hosts), tight enough that a
+    # mis-scaled fault plan or a dropped latency phase trips it.
+    assert 0.75 <= d["goodput_retention"] <= 1.35, rp
+    assert d["completed_delta"] == 0
+    assert res.errors == 0
+
+
+def test_replay_ab_under_different_config(tmp_path, monkeypatch):
+    cfg, bundle = _record_run(tmp_path, monkeypatch)
+    rcfg = _serve_cfg(tmp_path, name="j3.json", qos=False)
+    res = run_replay(rcfg, bundle)
+    rp = res.extra["replay"]
+    assert not rp["config_match"]
+    assert rp["fingerprint"] != rp["original_fingerprint"]
+    assert rp["arrivals_match"], rp  # same scenario, different system
+    assert rp["diff"]["goodput_retention"] is not None
+    block = format_replay_block(rp)
+    assert "A/B" in block and bundle["name"] in block
+
+
+def test_replay_refuses_non_hermetic_protocol(tmp_path, monkeypatch):
+    cfg, bundle = _record_run(tmp_path, monkeypatch)
+    cfg.transport.protocol = "grpc"
+    with pytest.raises(SystemExit, match="hermetic"):
+        run_replay(cfg, bundle)
+
+
+# --------------------------------------------------------- degrade model --
+
+
+def test_load_bundle_degrades_like_load_snapshot(tmp_path, capsys):
+    # Missing: silent None (a golden not checked in yet is not an error
+    # at load; validate/record decide loudly).
+    assert load_bundle(str(tmp_path / "nope.tpb.gz")) is None
+    assert capsys.readouterr().err == ""
+    # Empty file.
+    p = tmp_path / "empty.tpb"
+    p.write_bytes(b"")
+    assert load_bundle(str(p)) is None
+    assert "empty replay bundle" in capsys.readouterr().err
+    # Truncated JSON (torn write).
+    p = tmp_path / "torn.tpb"
+    p.write_bytes(b'{"format": "tpubench-bun')
+    assert load_bundle(str(p)) is None
+    assert "truncated/partial replay bundle" in capsys.readouterr().err
+    # Truncated gzip: magic bytes present, stream cut mid-member.
+    full = gzip.compress(json.dumps({"format": BUNDLE_FORMAT}).encode())
+    p = tmp_path / "torn.tpb.gz"
+    p.write_bytes(full[: len(full) // 2])
+    assert load_bundle(str(p)) is None
+    assert "replay bundle" in capsys.readouterr().err
+    # Valid JSON, wrong shape.
+    p = tmp_path / "list.tpb"
+    p.write_text("[1, 2]")
+    assert load_bundle(str(p)) is None
+    assert "not a JSON object" in capsys.readouterr().err
+
+
+def test_validate_bundle_refuses_unfaithful(tmp_path, monkeypatch):
+    _cfg, bundle = _record_run(tmp_path, monkeypatch)
+    with pytest.raises(SystemExit, match="not a replay bundle"):
+        validate_bundle({"format": "something-else"}, "p")
+    newer = dict(bundle, format="tpubench-bundle/9")
+    with pytest.raises(SystemExit, match="newer tpubench"):
+        validate_bundle(newer, "p")
+    missing = dict(bundle)
+    del missing["arrivals"]
+    with pytest.raises(SystemExit, match="missing fields: arrivals"):
+        validate_bundle(missing, "p")
+    with pytest.raises(SystemExit, match="serve only"):
+        validate_bundle(dict(bundle, workload="read"), "p")
+    with pytest.raises(SystemExit, match="journal_schema 99"):
+        validate_bundle(dict(bundle, journal_schema=99), "p")
+    bad_fault = dict(bundle)
+    bad_fault["fault"] = dict(bundle["fault"], wormhole_s=1.0)
+    with pytest.raises(SystemExit, match="newer bundle"):
+        validate_bundle(bad_fault, "p")
+
+
+# ------------------------------------------------------- journal schema --
+
+
+def test_journal_schema_stamped_and_warn_once(tmp_path, capsys):
+    from tpubench.obs import flight as fl
+
+    def _doc(schema):
+        return {
+            "format": fl.JOURNAL_FORMAT, "journal_schema": schema,
+            "host": 0, "dropped": 0, "records": [],
+        }
+
+    paths = []
+    for i, schema in enumerate((97, 97)):
+        p = tmp_path / f"new{i}.json"
+        p.write_text(json.dumps(_doc(schema)))
+        paths.append(str(p))
+    fl._SCHEMA_WARNED.discard(97)
+    docs = fl.load_journals(paths)
+    assert len(docs) == 2  # warn-and-continue, never a refusal here
+    err = capsys.readouterr().err
+    assert err.count("journal_schema 97 is newer") == 1  # once, not per file
+    # record/replay must NOT continue: it rebuilds, it doesn't render.
+    with pytest.raises(SystemExit, match="journal_schema 97"):
+        record_bundle([paths[0]], str(tmp_path / "x.tpb.gz"))
+
+
+def test_record_refuses_stampless_and_mixed_journals(
+    tmp_path, monkeypatch,
+):
+    from tpubench.obs.flight import JOURNAL_FORMAT
+
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps({
+        "format": JOURNAL_FORMAT, "journal_schema": 2,
+        "host": 0, "dropped": 0, "records": [],
+    }))
+    with pytest.raises(SystemExit, match="no replay stamp"):
+        record_bundle([str(bare)], str(tmp_path / "x.tpb.gz"))
+    # Two journals stamping different scenarios (e.g. sweep points)
+    # refuse instead of silently bundling one of them.
+    cfg, _bundle = _record_run(tmp_path, monkeypatch)
+    other = _serve_cfg(tmp_path, name="other.json")
+    other.serve.seed = 8
+    from tpubench.workloads.serve import run_serve
+
+    run_serve(other)
+    with pytest.raises(SystemExit, match="DIFFERENT scenario"):
+        record_bundle(
+            [cfg.obs.flight_journal, other.obs.flight_journal],
+            str(tmp_path / "x.tpb.gz"),
+        )
+
+
+# ------------------------------------------------------------- --fail-on --
+
+
+def test_parse_fail_on_grammar():
+    assert parse_fail_on("gold_slo<0.95") == ("gold_slo", "<", 0.95)
+    assert parse_fail_on("p99_ratio>=1.5") == ("p99_ratio", ">=", 1.5)
+    assert parse_fail_on("errors!=0") == ("errors", "!=", 0.0)
+    for bad in ("bogus", "<1", "a<b", "x<1<2"):
+        with pytest.raises(SystemExit, match="fail-on"):
+            parse_fail_on(bad)
+
+
+def test_metric_namespace_replay_diff_wins():
+    doc = {
+        "gbps": 1.0,
+        "extra": {
+            "chaos": {"scorecard": {"goodput_retention": 0.2}},
+            "replay": {
+                "config_match": True,
+                "replayed": {"gold_slo": 0.99},
+                "diff": {"goodput_retention": 0.97},
+            },
+        },
+    }
+    ns = metric_namespace(doc)
+    assert ns["goodput_retention"] == 0.97  # replay diff, not chaos
+    assert ns["config_match"] == 1.0
+    assert ns["gold_slo"] == 0.99
+
+
+def test_run_fail_on_exit_codes():
+    docs = [{"gbps": 2.0, "errors": 0}]
+    rc, _lines = run_fail_on(["gbps<1.0"], docs)
+    assert rc == 0
+    rc, lines = run_fail_on(["gbps>1.0"], docs, paths=["r.json"])
+    assert rc == 1
+    assert any("TRIPPED by r.json" in ln for ln in lines)
+    # Unknown metric dominates a tripped gate: a typo'd CI gate must
+    # fail louder than the regression it was meant to catch.
+    rc, lines = run_fail_on(["gbps>1.0", "tpyo<1"], docs)
+    assert rc == 2
+    assert any("not present in any document" in ln for ln in lines)
+
+
+def test_report_cli_fail_on_exit_codes(tmp_path, monkeypatch):
+    cfg, bundle = _record_run(tmp_path, monkeypatch)
+    res = run_replay(
+        _serve_cfg(tmp_path, name="j4.json"), bundle,
+    )
+    from tpubench.metrics.report import write_result
+
+    rpath = write_result(res, str(tmp_path))
+    from tpubench.cli import main as cli_main
+
+    assert cli_main(
+        ["report", rpath, "--fail-on", "config_match==0",
+         "--fail-on", "gold_slo<0.5"]
+    ) == 0
+    assert cli_main(["report", rpath, "--fail-on", "completed>=1"]) == 1
+    assert cli_main(["report", rpath, "--fail-on", "no_such>0"]) == 2
+
+
+# --------------------------------------------------------------- golden --
+
+
+def test_golden_bundle_is_valid_and_complete():
+    bundle = load_bundle(GOLDEN)
+    assert bundle is not None, "checked-in golden bundle missing"
+    validate_bundle(bundle, GOLDEN)
+    assert set(bundle) == set(BUNDLE_FIELDS)
+    assert bundle["name"] == "chaos-serve-gold"
+    assert len(bundle["arrivals"]) > 0
+    assert bundle["objects"]
+    assert (bundle["fault"] or {}).get("phases"), (
+        "the golden scenario must carry its chaos phase"
+    )
+    assert bundle["baseline"]["gold_slo"] >= 0.9
+
+
+def test_golden_bundle_replays_and_gates(tmp_path, monkeypatch):
+    """The regression spine end-to-end: golden bundle → replay under
+    its recording config → structural gates hold → report --fail-on
+    passes on the result and trips on a sabotaged threshold."""
+    monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+    bundle = load_bundle(GOLDEN)
+    assert bundle is not None
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 4
+    cfg.workload.object_size = 1 << 20
+    cfg.obs.export = "none"
+    res = run_replay(cfg, bundle)
+    rp = res.extra["replay"]
+    assert rp["config_match"], (
+        "bench/scenarios config drifted from the golden recording: "
+        f"{rp['fingerprint']} != {rp['original_fingerprint']}"
+    )
+    assert rp["arrivals_match"], rp
+    assert abs(rp["diff"]["gold_slo_delta_pts"]) <= 5.0, rp
+    from tpubench.metrics.report import write_result
+
+    rpath = write_result(res, str(tmp_path))
+    from tpubench.cli import main as cli_main
+
+    assert cli_main(
+        ["report", rpath, "--fail-on", "config_match==0",
+         "--fail-on", "arrivals_match==0"]
+    ) == 0
+    assert cli_main(["report", rpath, "--fail-on", "gold_slo<=1.0"]) == 1
+
+
+# ----------------------------------------------------- sweep timelines --
+
+
+def test_report_timeline_merges_pt_siblings(tmp_path, monkeypatch):
+    cfg, _bundle = _record_run(tmp_path, monkeypatch)
+    base = str(tmp_path / "sw.json")
+    with open(cfg.obs.flight_journal) as f:
+        doc = f.read()
+    for p in (f"{base}.pt0", f"{base}.pt1"):
+        with open(p, "w") as f:
+            f.write(doc)
+    from tpubench.workloads.report_cmd import run_timeline
+
+    # Handing only the BASE path discovers the .pt<i> siblings and
+    # renders them as labeled segments, never one pooled timeline.
+    out = run_timeline([base])
+    assert "serve sweep timeline: 2 segments" in out
+    assert "-- sweep point 0" in out and "-- sweep point 1" in out
+    # Base journal + points: base run leads.
+    with open(base, "w") as f:
+        f.write(doc)
+    out = run_timeline([base])
+    assert "serve sweep timeline: 3 segments" in out
+    assert out.index("-- base run") < out.index("-- sweep point 0")
+    # A single journal renders exactly as before — no segment framing.
+    out = run_timeline([cfg.obs.flight_journal])
+    assert "sweep timeline" not in out
+    assert "flight timeline" in out

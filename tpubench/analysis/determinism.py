@@ -46,6 +46,13 @@ CLOCK_MODULES = (
     # arrival stamps and per-op latencies must ride an injectable clock
     # so seeded storms replay deterministically in tests.
     "tpubench/lifecycle/storm.py",
+    # Record/replay plane: bundle distillation, the replay driver and
+    # the --fail-on gate must be pure functions of their inputs — a
+    # wall-clock or unseeded draw anywhere here breaks the
+    # record → replay → record byte-identity contract.
+    "tpubench/replay/bundle.py",
+    "tpubench/replay/driver.py",
+    "tpubench/replay/gate.py",
 )
 
 # Paths whose classes must bound every accumulator (obs/serve planes

@@ -211,12 +211,62 @@ def guard_tune_knobs(repo_root: str = REPO_ROOT) -> list[str]:
     return problems
 
 
+def guard_bundle_schema(repo_root: str = REPO_ROOT) -> list[str]:
+    """A freshly distilled bundle == BUNDLE_FIELDS == README bundle
+    table (the distiller is the source of truth: a field added to the
+    stamp must land in the catalog and the docs in the same PR)."""
+    from tpubench.config import BenchConfig
+    from tpubench.replay.bundle import (
+        BUNDLE_FIELDS,
+        bundle_from_stamp,
+        journal_replay_stamp,
+    )
+    from tpubench.storage.base import ObjectMeta
+
+    problems: list[str] = []
+    empty = sorted(n for n, h in BUNDLE_FIELDS.items() if not h)
+    if empty:
+        problems.append(f"bundle fields without help text: {empty}")
+    stamp = journal_replay_stamp(
+        BenchConfig(), [], [ObjectMeta("o0", 8, 1)],
+        {"arrivals": 0, "completed": 0, "shed": 0, "classes": {}},
+        rate_rps=1.0,
+    )
+    produced = set(bundle_from_stamp(stamp))
+    if produced != set(BUNDLE_FIELDS):
+        problems.append(
+            "bundle/catalog drift: "
+            f"bundle-only={sorted(produced - set(BUNDLE_FIELDS))} "
+            f"catalog-only={sorted(set(BUNDLE_FIELDS) - produced)}"
+        )
+    readme = _readme(repo_root)
+    m = re.search(
+        r"<!-- bundle-schema -->(.*?)<!-- /bundle-schema -->", readme, re.S
+    )
+    if not m:
+        problems.append("README bundle-schema table markers missing")
+    else:
+        documented = set(
+            re.findall(r"^\| `([a-z0-9_]+)` \|", m.group(1), re.M)
+        )
+        missing = sorted(set(BUNDLE_FIELDS) - documented)
+        if missing:
+            problems.append(f"bundle fields missing from README: {missing}")
+        stale = sorted(documented - set(BUNDLE_FIELDS))
+        if stale:
+            problems.append(
+                f"README documents dropped bundle fields: {stale}"
+            )
+    return problems
+
+
 # Surface file each guard anchors to, for finding display.
 DRIFT_GUARDS: dict[str, tuple[str, Callable[[str], list[str]]]] = {
     "metrics": ("tpubench/obs/telemetry.py", guard_metrics),
     "spans": ("tpubench/obs/trace.py", guard_spans),
     "native-counters": ("tpubench/obs/telemetry.py", guard_native_counters),
     "tune-knobs": ("tpubench/tune/controller.py", guard_tune_knobs),
+    "bundle-schema": ("tpubench/replay/bundle.py", guard_bundle_schema),
 }
 
 

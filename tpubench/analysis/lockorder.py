@@ -47,6 +47,10 @@ LOCK_ORDER_FILES = (
     # Storage-lifecycle storm ledger: its lock stays a leaf (backend
     # calls and flight appends run OUTSIDE it).
     "tpubench/lifecycle/storm.py",
+    # Replay driver: lock-free by design today; registered so any lock
+    # it ever grows joins the ordering graph from day one (it composes
+    # over the fake backend's fault plane and the serve planes).
+    "tpubench/replay/driver.py",
 )
 
 _LOCK_CTORS = {"Lock", "RLock", "Condition"}

@@ -1187,6 +1187,54 @@ def main(argv=None) -> int:
     probe.add_argument("--cycles", type=int, default=8,
                        help="identical measure cycles for burst/floor detection")
     probe.add_argument("--cycle-sleep", type=float, default=2.0)
+    rpl = add("replay", "re-drive a recorded scenario bundle through the "
+                        "CURRENT transport/cache/QoS/coop/membership "
+                        "config: arrivals ride the trace schedule, faults "
+                        "re-arm via FaultPlan, membership feeds the "
+                        "elastic serve plane; prints the replay-vs-"
+                        "original scorecard (hermetic: fake backend or "
+                        "in-process fake server)")
+    rpl.add_argument("bundle",
+                     help="replay bundle path from `tpubench record` "
+                          "(tpubench-bundle/1 JSON, .gz transparent)")
+    # Only the SYSTEM half of the serve knobs (the fingerprint's
+    # serve_system set): the scenario half — duration, rate, arrival,
+    # tenants, classes, seed, membership — comes from the bundle.
+    rpl.add_argument("--serve-workers", type=int,
+                     help="service worker threads for the replay arm")
+    rpl.add_argument("--no-serve-qos", action="store_true",
+                     help="replay the scenario with QoS off (an A/B arm "
+                          "against the recorded baseline)")
+    rpl.add_argument("--serve-admission-cap", type=int,
+                     help="requests in service at once")
+    rpl.add_argument("--serve-queue-limit", type=int,
+                     help="queued requests before overload shedding")
+    rpl.add_argument("--serve-readahead", type=int,
+                     help="readahead depth in chunks over the replayed "
+                          "schedule")
+    recp = sub.add_parser(
+        "record",
+        help="distill a serve run's flight journal(s) into a portable, "
+             "versioned replay bundle (tpubench-bundle/1): arrival "
+             "timeline, object population, fault plan, membership "
+             "timeline, tenant/class map, config fingerprint — "
+             "re-drivable via `tpubench replay`, diffable via "
+             "`tpubench report --fail-on`",
+    )
+    recp.add_argument("journals", nargs="+",
+                      help="flight-journal path(s) from ONE serve run "
+                           "(per-host .p<idx> siblings merge; sweep "
+                           ".pt<i> points are different runs — record "
+                           "them separately)")
+    recp.add_argument("--out", required=True,
+                      help="bundle output path; a .gz suffix gzips "
+                           "(canonical JSON either way, byte-stable "
+                           "across re-records)")
+    recp.add_argument("--name", default="",
+                      help="scenario name stamped into the bundle "
+                           "(default: the source bundle's name when "
+                           "recording a replay journal, else derived "
+                           "from the --out basename)")
     fs = {
         "read-fs": "sequential FS read (read_operation)",
         "write": "durable write (write_operations)",
@@ -1282,6 +1330,13 @@ def main(argv=None) -> int:
     rep.add_argument("--show-traces", type=int, default=3,
                      help="report trace: how many slowest span trees to "
                           "print in full (default 3)")
+    rep.add_argument("--fail-on", action="append", default=[],
+                     metavar="EXPR",
+                     help="regression gate <metric><op><threshold>, e.g. "
+                          "'gold_slo<0.95' or 'goodput_retention<0.9'; "
+                          "repeatable — exit 1 when any gate trips on "
+                          "any document, 2 when the metric exists in "
+                          "none (a typo'd gate must fail CI loudly)")
 
     args = top.parse_args(argv)
     if args.cmd == "check":
@@ -1330,6 +1385,43 @@ def main(argv=None) -> int:
                 ))
             return 0
         print(run_report(args.results))
+        if not args.fail_on:
+            return 0
+        # Regression gates run over a second load of the same documents:
+        # run_report already failed loudly on anything unreadable, so
+        # every path here parses.
+        from tpubench.replay.gate import run_fail_on
+
+        docs, labels = [], []
+        for p in args.results:
+            with open(p) as f:
+                doc = json.load(f)
+            if isinstance(doc, list):  # a sweep cells file
+                for i, cell in enumerate(doc):
+                    docs.append(cell)
+                    labels.append(f"{p}[{i}]")
+            elif isinstance(doc.get("parsed"), dict):
+                docs.append(doc["parsed"])  # driver BENCH_rN wrapper
+                labels.append(p)
+            else:
+                docs.append(doc)
+                labels.append(p)
+        rc, lines = run_fail_on(args.fail_on, docs, paths=labels)
+        for line in lines:
+            print(line)
+        return rc
+    if args.cmd == "record":
+        # Journal distillation: jax-free, no common config — the same
+        # coordinator-VM policy as report/top.
+        from tpubench.replay.bundle import record_bundle
+
+        bundle = record_bundle(args.journals, args.out, name=args.name)
+        print(
+            f"bundle written: {args.out} ({bundle['name']}: "
+            f"{len(bundle['arrivals'])} arrivals, "
+            f"{len(bundle['objects'])} objects, fingerprint "
+            f"{bundle['config_fingerprint']})"
+        )
         return 0
     if args.cmd == "multichip-sweep":
         # Parent needs no jax (children bring their own simulated mesh)
@@ -1527,6 +1619,33 @@ def main(argv=None) -> int:
             print(format_serve_scorecard(res.extra["serve"]))
             if res.extra.get("membership"):
                 print(format_membership_scorecard(res.extra["membership"]))
+        elif args.cmd == "replay":
+            from tpubench.obs.tracing import tracer_session
+            from tpubench.replay.bundle import (
+                format_replay_block,
+                load_bundle,
+                validate_bundle,
+            )
+            from tpubench.replay.driver import run_replay
+            from tpubench.workloads.serve import (
+                format_membership_scorecard,
+                format_serve_scorecard,
+            )
+
+            bundle = load_bundle(args.bundle)
+            if bundle is None:
+                raise SystemExit(
+                    f"replay: no usable bundle at {args.bundle!r} "
+                    "(missing, unreadable, or truncated — see warnings "
+                    "above)"
+                )
+            validate_bundle(bundle, args.bundle)
+            with tracer_session(cfg) as tracer:
+                res = run_replay(cfg, bundle, tracer=tracer)
+            print(format_serve_scorecard(res.extra["serve"]))
+            if res.extra.get("membership"):
+                print(format_membership_scorecard(res.extra["membership"]))
+            print(format_replay_block(res.extra["replay"]))
         elif args.cmd == "tune":
             from tpubench.obs.tracing import tracer_session
             from tpubench.workloads.tune_cmd import format_tune_block, run_tune

@@ -56,6 +56,17 @@ from tpubench.obs.tracing import (
 
 JOURNAL_FORMAT = "tpubench-flight-v1"
 
+# Journal CONTENT schema, stamped into every journal doc as
+# ``journal_schema`` (the format string above is the envelope and never
+# changes for compatible additions). Bump when a field's meaning changes
+# or a consumer-visible field is added: readers warn-once-and-continue
+# on a NEWER schema (additions are forward-readable), while
+# record/replay — which must rebuild a run faithfully, not just render
+# it — refuse journals newer than they understand. History:
+#   1 — implicit (journals predating the stamp carry no field)
+#   2 — the stamp itself + the serve plane's ``replay`` scenario block
+JOURNAL_SCHEMA = 2
+
 # Canonical phase order; segment durations are computed between
 # consecutive phases PRESENT in a record and attributed to the later one
 # ("time spent reaching first_byte from the previous milestone").
@@ -410,6 +421,7 @@ class FlightRecorder:
     def journal(self, extra: Optional[dict] = None) -> dict:
         doc = {
             "format": JOURNAL_FORMAT,
+            "journal_schema": JOURNAL_SCHEMA,
             "host": self.host,
             "time": time.time(),
             "dropped": self.dropped,
@@ -979,6 +991,11 @@ def read_journal_text(path: str) -> str:
     return raw.decode("utf-8", errors="replace")
 
 
+# journal_schema values already warned about (once per process, not per
+# file: a 40-host pod's journals are one upgrade notice, not 40).
+_SCHEMA_WARNED: set = set()
+
+
 def load_journals(paths: Iterable[str]) -> list[dict]:
     """Load journal docs, degrading gracefully on partial files: an empty
     or truncated journal (a run died mid-flush, or the stream writer was
@@ -1016,6 +1033,21 @@ def load_journals(paths: Iterable[str]) -> list[dict]:
             raise ValueError(
                 f"{p}: not a flight journal (format="
                 f"{doc.get('format')!r}; expected {JOURNAL_FORMAT!r})"
+            )
+        schema = doc.get("journal_schema", 1)
+        if isinstance(schema, int) and schema > JOURNAL_SCHEMA \
+                and schema not in _SCHEMA_WARNED:
+            # Warn ONCE per unknown schema, then render what we can:
+            # schema bumps are additive for rendering consumers (report
+            # timeline/trace, top), so continuing beats refusing — only
+            # record/replay, which must rebuild a run faithfully, hard-
+            # refuse newer journals (replay/bundle.py).
+            _SCHEMA_WARNED.add(schema)
+            print(
+                f"warning: {p}: journal_schema {schema} is newer than "
+                f"this build understands ({JOURNAL_SCHEMA}); rendering "
+                "the fields it knows",
+                file=sys.stderr,
             )
         docs.append(doc)
     return docs

@@ -1,0 +1,36 @@
+"""Record/replay + regression plane.
+
+Every serve-plane run journals enough to be re-driven: the arrival
+timeline, the object population, the fault timeline, the membership
+plan and the tenant/class map. This package closes that loop:
+
+* ``bundle``  — the portable, versioned replay bundle
+  (``tpubench record``): distilled from a run's flight journal,
+  gzip-JSON, byte-deterministic for a given run;
+* ``driver``  — ``tpubench replay <bundle>``: re-drives a bundle's
+  scenario through ANY transport/cache/QoS/coop/membership
+  configuration (arrivals ride the ``trace`` schedule kind, faults
+  re-arm via FaultPlan, membership entries feed the elastic pod) and
+  stamps the replay-vs-original scorecard diff;
+* ``gate``    — the ``tpubench report --fail-on <metric><op><threshold>``
+  exit-code contract that turns any diff into a CI gate.
+
+Golden bundles live under ``scenarios/`` and are gated by a bench.py
+replay cell — every incident run becomes a permanent named scenario.
+"""
+
+from tpubench.replay.bundle import (  # noqa: F401
+    BUNDLE_FIELDS,
+    BUNDLE_FORMAT,
+    BUNDLE_SCHEMA,
+    bundle_from_stamp,
+    config_fingerprint,
+    distill_baseline,
+    format_replay_block,
+    journal_replay_stamp,
+    load_bundle,
+    record_bundle,
+    scorecard_diff,
+    validate_bundle,
+    write_bundle,
+)

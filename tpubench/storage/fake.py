@@ -11,7 +11,7 @@ import random
 import threading
 import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import Iterable, Optional
 
 import numpy as np
 
@@ -256,6 +256,28 @@ class FakeBackend:
             name = f"{prefix}{i}"
             be._objects[name] = deterministic_bytes(name, size)
             be._generation[name] = 1
+        return be
+
+    @classmethod
+    def from_population(
+        cls,
+        objects: Iterable,
+        fault: Optional[FaultPlan] = None,
+    ) -> "FakeBackend":
+        """A store rebuilt from an explicit population — ``(name, size,
+        generation)`` triples or ObjectMeta — the replay-bundle path:
+        contents regenerate from :func:`deterministic_bytes` (name+size
+        fully determine the bytes, same as ``prepopulated``), and the
+        recorded generations are preserved so replayed chunk keys stay
+        identical to the original run's."""
+        be = cls(fault=fault)
+        for obj in objects:
+            if isinstance(obj, ObjectMeta):
+                name, size, gen = obj.name, obj.size, obj.generation
+            else:
+                name, size, gen = obj
+            be._objects[name] = deterministic_bytes(name, int(size))
+            be._generation[name] = int(gen) or 1
         return be
 
     # ----------------------------------------------------------- backend --

@@ -48,14 +48,26 @@ def _sleep_scale() -> float:
     return parse_sleep_scale("chaos timeline durations")
 
 
-def _scaled_phases(fc, scale: float) -> list:
-    out = []
-    for t0, t1, plan in fc.phases:
+def scaled_fault_dict(fdict: dict, scale: float) -> dict:
+    """A fault-config dict with every phase window and time-shaped fault
+    duration scaled by ``scale`` — the ONE definition of "run this
+    timeline under TPUBENCH_BENCH_SLEEP_SCALE", shared by chaos and the
+    replay driver (a replayed incident must scale exactly the way the
+    incident run did, or the timeline's shape drifts between them).
+    Returns a new dict; never mutates the input (the caller's config —
+    and a replay's bundle — must survive a second run unscaled)."""
+    out = dict(fdict)
+    phases = []
+    for t0, t1, plan in out.get("phases") or ():
         p = dict(plan)
         for f in _TIME_FIELDS:
             if p.get(f):
                 p[f] = p[f] * scale
-        out.append([t0 * scale, t1 * scale, p])
+        phases.append([float(t0) * scale, float(t1) * scale, p])
+    out["phases"] = phases
+    for f in _TIME_FIELDS:
+        if out.get(f):
+            out[f] = out[f] * scale
     return out
 
 
@@ -247,25 +259,28 @@ def format_scorecard(chaos: dict) -> str:
 # -------------------------------------------------------------- workload --
 
 
-def spawn_hermetic_server(cfg: BenchConfig, fault_plan=None):
+def spawn_hermetic_server(cfg: BenchConfig, fault_plan=None, store=None):
     """In-process fake server speaking the real wire protocol (h1.1, or
     the h2 server under ``transport.http2``), backed by a prepopulated
     fake store carrying ``fault_plan`` — server-side injection, so
-    stalls/resets/truncation happen ON THE WIRE. Sets
-    ``cfg.transport.endpoint`` (caller restores it) and pre-loads the
-    C++ engine where the client path needs it, so first-use costs never
-    land inside a measured window. One definition shared by ``tpubench
-    chaos`` and ``tpubench tune`` — the two hermetic-session surfaces
-    must not drift. Returns the started server (caller stops it)."""
+    stalls/resets/truncation happen ON THE WIRE. ``store`` overrides the
+    default population (the replay driver rebuilds a bundle's recorded
+    object set and serves THAT). Sets ``cfg.transport.endpoint`` (caller
+    restores it) and pre-loads the C++ engine where the client path
+    needs it, so first-use costs never land inside a measured window.
+    One definition shared by ``tpubench chaos``, ``tpubench tune`` and
+    ``tpubench replay`` — the hermetic-session surfaces must not drift.
+    Returns the started server (caller stops it)."""
     from tpubench.storage.fake import FakeBackend
 
     w = cfg.workload
-    store = FakeBackend.prepopulated(
-        prefix=w.object_name_prefix,
-        count=max(w.workers, w.threads),
-        size=w.object_size,
-        fault=fault_plan,
-    )
+    if store is None:
+        store = FakeBackend.prepopulated(
+            prefix=w.object_name_prefix,
+            count=max(w.workers, w.threads),
+            size=w.object_size,
+            fault=fault_plan,
+        )
     if cfg.transport.http2:
         from tpubench.storage.fake_h2_server import FakeH2Server
 
@@ -361,12 +376,8 @@ def run_chaos(
     # Scale into a LOCAL fault dict — never back into cfg, which the
     # caller may reuse (a second run must not double-scale its timeline).
     scale = _sleep_scale()
-    phases = _scaled_phases(fc, scale)
-    fdict = dataclasses.asdict(fc)
-    fdict["phases"] = phases
-    for f in _TIME_FIELDS:
-        if fdict.get(f):
-            fdict[f] = fdict[f] * scale
+    fdict = scaled_fault_dict(dataclasses.asdict(fc), scale)
+    phases = fdict["phases"]
     # The serve plane scales its own (virtual) clock, so the membership
     # timeline passes through UNSCALED; the resilience scorecard maps
     # real record stamps onto scaled seconds, so its fault-window

@@ -67,6 +67,14 @@ def _axis(run: dict) -> str:
         bits.append("serve " + ("qos" if sv.get("qos") else "qos-off"))
         if sv.get("sweep"):
             bits.append("sweep")
+    rp = run.get("extra", {}).get("replay")
+    if rp:
+        # Replay runs label the bundle they re-drove; an A/B replay
+        # (different system fingerprint than the original) must not
+        # render as a twin of the faithful regression arm.
+        bits.append(f"replay:{rp.get('bundle', '?')}")
+        if not rp.get("config_match"):
+            bits.append("ab")
     mb = run.get("extra", {}).get("membership")
     if mb:
         # Elastic-pod runs carry their own A/B axis: the cooperative-
@@ -165,6 +173,14 @@ def summarize_run(run: dict, label: str = "") -> str:
         from tpubench.workloads.serve import format_membership_scorecard
 
         lines.append(format_membership_scorecard(mb))
+    rp = extra.get("replay")
+    if rp:
+        # Replay-vs-original scorecard diff: the same body `tpubench
+        # replay` printed live — original vs replayed, fingerprints,
+        # and the drift deltas the --fail-on grammar gates on.
+        from tpubench.replay.bundle import format_replay_block
+
+        lines.append(format_replay_block(rp))
     lc = extra.get("lifecycle")
     if lc:
         # Storage-lifecycle scorecard: same body the CLI printed live
@@ -407,6 +423,24 @@ def compare_runs(runs: list[dict]) -> str:
                     + (f", converged in {conv} windows"
                        if ad.get("converged") else ", not converged")
                 )
+        # Replay diff: two replays of the same bundle under different
+        # system configs compare on what replay exists for — how far
+        # each drifted from the recorded original.
+        orp = other.get("extra", {}).get("replay")
+        brp = base.get("extra", {}).get("replay")
+        if orp and brp:
+            od, bd = orp.get("diff") or {}, brp.get("diff") or {}
+            lines.append(
+                f"    replay[{orp.get('bundle', '?')}]: retention "
+                f"{cell(od, '{:.1%}', 'goodput_retention')} vs "
+                f"{cell(bd, '{:.1%}', 'goodput_retention')}, "
+                "gold SLO delta "
+                f"{cell(od, '{:+.1f}pts', 'gold_slo_delta_pts')} vs "
+                f"{cell(bd, '{:+.1f}pts', 'gold_slo_delta_pts')}, "
+                "p99 "
+                f"{cell(od, '{:.2f}x', 'p99_ratio')} vs "
+                f"{cell(bd, '{:.2f}x', 'p99_ratio')}"
+            )
         # Scorecard diff: two chaos runs (e.g. hedged vs unhedged over the
         # same timeline) compare on resilience, not just throughput.
         osc = (other.get("extra", {}).get("chaos") or {}).get("scorecard")
@@ -520,10 +554,47 @@ def run_timeline(paths: list[str]) -> str:
     """``tpubench report timeline <journal...>`` — merge per-host flight
     journals (obs/flight.py) into the pod-level per-phase p50/p99 report
     with straggler attribution. One file = single-host timeline; many =
-    the cross-host aggregation pass."""
-    from tpubench.obs.flight import load_journals, render_timeline
+    the cross-host aggregation pass.
 
-    return render_timeline(load_journals(paths))
+    Sibling discovery rides the live aggregator's glob discipline
+    (``obs/live.discover_journal_paths``), so handing the BASE path of a
+    serve sweep (or a multi-host run) collects its ``.pt<i>`` /
+    ``.p<idx>`` siblings automatically. Sweep points are DIFFERENT runs
+    at different offered loads: they render as labeled segments (base
+    run, then each point in order), never silently pooled into one
+    timeline whose percentiles would belong to no run at all; per-host
+    siblings of one point still merge, the cross-host pass."""
+    import re
+
+    from tpubench.obs.flight import load_journals, render_timeline
+    from tpubench.obs.live import discover_journal_paths
+
+    expanded: list[str] = []
+    seen = set()
+    for base in paths:
+        # Per-base expansion, keeping a missing base so load_journals
+        # still emits its one-line unreadable warning for it.
+        for p in discover_journal_paths([base]) or [base]:
+            if p not in seen:
+                seen.add(p)
+                expanded.append(p)
+    groups: dict = {}
+    for p in expanded:
+        m = re.search(r"\.pt(\d+)", p)
+        groups.setdefault(int(m.group(1)) if m else None, []).append(p)
+    if len(groups) <= 1:
+        return render_timeline(load_journals(expanded))
+    out = [f"== serve sweep timeline: {len(groups)} segments =="]
+    for point in sorted(groups, key=lambda k: (k is not None, k or 0)):
+        docs = load_journals(groups[point])
+        if not docs:
+            continue
+        label = "base run" if point is None else f"sweep point {point}"
+        out.append(
+            f"-- {label} ({', '.join(groups[point])}) --\n"
+            + render_timeline(docs)
+        )
+    return "\n\n".join(out)
 
 
 def run_trace(paths: list[str], *, slow_fraction: float = 0.1,

@@ -77,15 +77,20 @@ from tpubench.workloads.arrivals import (
 
 
 def build_schedule(cfg: BenchConfig, backend: StorageBackend,
-                   rate_rps: Optional[float] = None) -> list[Request]:
+                   rate_rps: Optional[float] = None,
+                   objects: Optional[list] = None) -> list[Request]:
     """The run's merged open-loop schedule: arrival timestamps from the
     configured process, each assigned to a tenant (class-share-weighted)
     and to one chunk of that tenant's Zipf stream. Deterministic for a
-    given seed — the replayed-trace property every arrival kind gets."""
+    given seed — the replayed-trace property every arrival kind gets.
+    ``objects`` lets the caller pass an already-fetched listing (the
+    replay stamp must describe the SAME population the schedule was
+    built over, never a re-listing that could race a mutating store)."""
     sc = cfg.serve
     w = cfg.workload
     chunk = sc.chunk_bytes or w.granule_bytes
-    objects = backend.list(w.object_name_prefix)
+    if objects is None:
+        objects = backend.list(w.object_name_prefix)
     if not objects:
         raise SystemExit(
             f"serve: no objects under prefix {w.object_name_prefix!r} "
@@ -172,18 +177,25 @@ class _ShedLog:
 
 
 def run_serve(cfg: BenchConfig, backend: Optional[StorageBackend] = None,
-              rate_rps: Optional[float] = None, tracer=None) -> RunResult:
+              rate_rps: Optional[float] = None, tracer=None,
+              replay_source: Optional[dict] = None) -> RunResult:
     """One open-loop serve run at the configured offered load (or
     ``rate_rps``, the sweep's per-point override). ``serve.hosts > 1``
     fans the same schedule across an N-host elastic pod
-    (:class:`_ElasticServe`) whose membership may change mid-run."""
+    (:class:`_ElasticServe`) whose membership may change mid-run.
+    ``replay_source`` (set by ``tpubench replay``) is the identity of
+    the bundle this run re-drives; it passes through into the journal's
+    replay stamp so re-recording a replay reproduces the ORIGINAL
+    bundle."""
     validate_serve_config(cfg.serve)
     owns_backend = backend is None
     backend = backend or open_backend(cfg, tracer=tracer)
     try:
         if cfg.serve.hosts > 1:
-            return _ElasticServe(cfg, backend, rate_rps).run()
-        return _Serve(cfg, backend, rate_rps).run()
+            return _ElasticServe(cfg, backend, rate_rps,
+                                 replay_source=replay_source).run()
+        return _Serve(cfg, backend, rate_rps,
+                      replay_source=replay_source).run()
     finally:
         if owns_backend:
             backend.close()
@@ -191,15 +203,19 @@ def run_serve(cfg: BenchConfig, backend: Optional[StorageBackend] = None,
 
 class _Serve:
     def __init__(self, cfg: BenchConfig, backend: StorageBackend,
-                 rate_rps: Optional[float]):
+                 rate_rps: Optional[float],
+                 replay_source: Optional[dict] = None):
         self.cfg = cfg
         self.backend = backend
         self.rate_rps = rate_rps
+        self.replay_source = replay_source
 
     def run(self) -> RunResult:
         cfg, sc = self.cfg, self.cfg.serve
         chunk = sc.chunk_bytes or cfg.workload.granule_bytes
-        schedule = build_schedule(cfg, self.backend, self.rate_rps)
+        objects = self.backend.list(cfg.workload.object_name_prefix)
+        schedule = build_schedule(cfg, self.backend, self.rate_rps,
+                                  objects=objects)
         tlabel = transport_label(cfg)
         scale = parse_sleep_scale("serve arrival gaps")
         gaps = scaled_gaps([r.arrival_s for r in schedule], scale)
@@ -482,8 +498,29 @@ class _Serve:
         if flight is not None:
             res.extra["flight"] = flight.summary()
             if jpath_stream:
+                from tpubench.replay.bundle import journal_replay_stamp
+
+                s = summaries.get("request")
                 res.extra["flight_journal"] = flight.write_journal(
-                    jpath_stream, extra={"workload": "serve", "n_chips": 1},
+                    jpath_stream,
+                    extra={
+                        "workload": "serve", "n_chips": 1,
+                        # The replay stamp: everything `tpubench record`
+                        # needs to rebuild this run as a bundle. Rate is
+                        # the EFFECTIVE offered load (sweep points
+                        # override the config's).
+                        "replay": journal_replay_stamp(
+                            cfg, schedule, objects, serve_extra,
+                            rate_rps=(
+                                self.rate_rps
+                                if self.rate_rps is not None
+                                else sc.rate_rps
+                            ),
+                            errors=errors,
+                            p99_ms=s.p99_ms if s is not None else None,
+                            source=self.replay_source,
+                        ),
+                    },
                     max_bytes=cfg.obs.journal_max_bytes,
                 )
         return res
@@ -598,10 +635,12 @@ class _ElasticServe:
     with zero live hosts is the (counted) degenerate error case."""
 
     def __init__(self, cfg: BenchConfig, backend: StorageBackend,
-                 rate_rps: Optional[float]):
+                 rate_rps: Optional[float],
+                 replay_source: Optional[dict] = None):
         self.cfg = cfg
         self.backend = backend
         self.rate_rps = rate_rps
+        self.replay_source = replay_source
 
     def run(self) -> RunResult:
         # Lazy elastic-plane imports: the single-host serve path (and
@@ -627,7 +666,9 @@ class _ElasticServe:
                 "single-host plane"
             )
         chunk = sc.chunk_bytes or cfg.workload.granule_bytes
-        schedule = build_schedule(cfg, self.backend, self.rate_rps)
+        objects = self.backend.list(cfg.workload.object_name_prefix)
+        schedule = build_schedule(cfg, self.backend, self.rate_rps,
+                                  objects=objects)
         tlabel = transport_label(cfg)
         scale = parse_sleep_scale("serve arrival gaps")
         gaps = scaled_gaps([r.arrival_s for r in schedule], scale)
@@ -1017,9 +1058,29 @@ class _ElasticServe:
         if flight is not None:
             res.extra["flight"] = flight.summary()
             if jpath_stream:
+                from tpubench.replay.bundle import journal_replay_stamp
+
+                s = summaries.get("request")
                 res.extra["flight_journal"] = flight.write_journal(
                     jpath_stream,
-                    extra={"workload": "serve", "n_chips": 1},
+                    extra={
+                        "workload": "serve", "n_chips": 1,
+                        # The single-host plane's stamp, plus the
+                        # membership scorecard so the bundle baseline
+                        # carries rewarm/failover numbers.
+                        "replay": journal_replay_stamp(
+                            cfg, schedule, objects, serve_extra,
+                            rate_rps=(
+                                self.rate_rps
+                                if self.rate_rps is not None
+                                else sc.rate_rps
+                            ),
+                            membership=membership,
+                            errors=errors,
+                            p99_ms=s.p99_ms if s is not None else None,
+                            source=self.replay_source,
+                        ),
+                    },
                     max_bytes=cfg.obs.journal_max_bytes,
                 )
         return res
