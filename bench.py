@@ -675,6 +675,76 @@ def _elastic_resize_cell() -> dict:
     }
 
 
+def _incident_drill_cell() -> dict:
+    """Incident drill on the hermetic elastic pod (ISSUE 17): a 3-host
+    pod serves the seeded open-loop schedule while the scripted kill
+    takes a host down, a cold replacement joins and ckpt-restores
+    THROUGH the shared coop/admission stack, and periodic delta saves
+    ride under the same traffic. Fixed seed, sleep-scale honored
+    (virtual schedule seconds; wall scales with
+    TPUBENCH_BENCH_SLEEP_SCALE). The cell is gated by the SAME
+    ``tpubench report --fail-on`` grammar CI uses — the gate
+    expressions below run in-process over the result document, so a
+    drill whose restore fails verification, errors, or lets gold SLO
+    collapse during the restore window fails the cell, not just a
+    bespoke assert. CPU-only and jax-free — quiet-CPU segment."""
+    from tpubench.config import BenchConfig
+    from tpubench.replay.gate import run_fail_on
+    from tpubench.workloads.drill import run_drill
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 4
+    cfg.workload.object_size = 1 * MB
+    cfg.staging.mode = "none"
+    cfg.obs.export = "none"
+    cfg.pipeline.cache_bytes = 64 * MB
+    sv = cfg.serve
+    sv.seed = 13
+    sv.duration_s = 3.0  # virtual; wall scales with the sleep scale
+    sv.rate_rps = 80.0
+    sv.tenants = 24
+    sv.workers = 4
+    sv.hosts = 3
+    lc = cfg.lifecycle
+    lc.objects = 3
+    lc.object_bytes = 256 * 1024
+    lc.part_bytes = 64 * 1024
+    lc.seed = 13
+    dc = cfg.drill
+    dc.kill_at_s = 1.0
+    dc.join_at_s = 1.4
+    dc.save_interval_s = 0.8
+    res = run_drill(cfg)
+    doc = res.to_dict()
+    gates = (
+        "restore_verified<1",       # byte-identity of the restored ckpt
+        "restore_errors>0",
+        "save_errors>0",
+        "errors>0",                 # serve-plane request errors
+        "drill_gold_slo_restore<0.7",  # gold SLO through the window
+        "origin_amplification>20",
+    )
+    rc, lines = run_fail_on(gates, [doc], paths=["incident_drill"])
+    dr = res.extra["drill"]
+    return {
+        "restore": dr["restore"],
+        "saves": dr["saves"],
+        "gold_slo": dr["gold_slo"],
+        "time_to_rewarm_s": dr.get("time_to_rewarm_s"),
+        "amplification_ratio": dr["amplification"]["ratio"],
+        "pool_leaked_slabs": (
+            res.extra.get("membership", {}).get("pool_leaked_slabs")
+        ),
+        "gates": list(gates),
+        "gate_rc": rc,
+        "gate_trips": [l for l in lines if "TRIPPED" in l
+                       or "not present" in l],
+        "ok": rc == 0,
+        "sleep_scale": _SLEEP_SCALE,
+    }
+
+
 def _trace_overhead_cell() -> dict:
     """Tracing-on vs tracing-off goodput on the hermetic fake backend
     (BENCH_r06+): the SAME read config (fixed seed, staging off, flight
@@ -969,6 +1039,21 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001 — the bench must not die here
         print(f"# scenario replay failed: {e}", file=sys.stderr)
 
+    # Incident drill (restore-while-serving + delta saves on the elastic
+    # pod), gated by the --fail-on grammar: hermetic, CPU-only,
+    # jax-free — quiet-CPU segment.
+    incident_drill: dict = {}
+    try:
+        incident_drill = _incident_drill_cell()
+        if not incident_drill.get("ok"):
+            print(
+                "# incident drill GATES TRIPPED: "
+                + "; ".join(incident_drill.get("gate_trips", ())),
+                file=sys.stderr,
+            )
+    except Exception as e:  # noqa: BLE001 — the bench must not die here
+        print(f"# incident drill failed: {e}", file=sys.stderr)
+
     dev = jax.local_devices()[0]  # first jax touch: AFTER the quiet-CPU A/B
 
     # Compile the pallas landing kernel at the pair slot shape BEFORE the
@@ -1242,6 +1327,7 @@ def main() -> int:
                 "elastic_resize": elastic_resize,
                 "ckpt_roundtrip": ckpt_roundtrip,
                 "scenario_replay": scenario_replay,
+                "incident_drill": incident_drill,
                 "shaped_verdict": shaped,
                 "probe_divergence_factor": pdf,
                 "host_cores": _usable_cores(),

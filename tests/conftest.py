@@ -162,3 +162,12 @@ def pytest_configure(config):
         "markers", "replay: record/replay + regression plane "
                    "(bundle determinism/replay fidelity/--fail-on gate)"
     )
+    # Incident-drill tests (restore-while-serving on the elastic pod +
+    # delta checkpoint saves) stay in tier-1 — same policy as the other
+    # subsystem markers: the hermetic kill→cold-join→restore acceptance
+    # and the CAS/delta-ledger contracts run on every pass; the marker
+    # exists for selective runs (`-m drill`).
+    config.addinivalue_line(
+        "markers", "drill: incident drill (restore-while-serving/"
+                   "delta saves/drill scorecard)"
+    )

@@ -189,6 +189,22 @@ def test_bench_end_to_end_cpu():
     assert sr.get("config_match") and sr.get("arrivals_match"), sr
     assert sr.get("ok"), sr.get("drift")
     assert abs(sr["gold_slo_delta_pts"]) <= 5.0, sr
+    # Incident-drill cell (PR 17): restore-while-serving on a 3-host
+    # pod with delta saves riding under traffic — the cell gates
+    # itself through the --fail-on grammar (restore byte-identity,
+    # zero restore/save/serve errors, gold SLO through the restore
+    # window, bounded origin amplification); the smoke pins that the
+    # gates RAN and held, plus the delta-save ledger shape (delta
+    # passes skipped clean shards) and zero slab leaks.
+    idr = d["incident_drill"]
+    assert idr.get("ok"), idr.get("gate_trips")
+    assert idr["gate_rc"] == 0
+    assert idr["restore"]["verified"], idr["restore"]
+    assert (idr["restore"]["shards_restored"]
+            == idr["restore"]["shards"]), idr["restore"]
+    assert idr["saves"]["delta"] and idr["saves"]["passes"] > 0
+    assert idr["saves"]["skipped_clean"] > 0, idr["saves"]
+    assert idr["pool_leaked_slabs"] == 0
     sweep = d["staging_depth_sweep"]
     assert set(sweep) == {"1", "2", "4"}
     assert sweep["1"]["drain"] == "inline"

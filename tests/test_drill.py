@@ -1,0 +1,660 @@
+"""Incident drill (tpubench/workloads/drill.py + lifecycle/delta.py):
+the production-incident acceptance — restore-while-serving on the
+elastic pod with delta checkpoint saves riding under live traffic.
+
+The contracts under test:
+
+* **delta ledger** — a delta pass uploads ONLY the dirty shards
+  (skipped_clean accounts for the rest), CAS-guards each on the last
+  committed generation, classifies a 412 into exactly one unconditional
+  full-save fallback (never a silent retry of the stale guard), and
+  republishes the manifest LAST and only on an error-free pass;
+* **drill acceptance** — a scripted kill + cold join under live
+  open-loop traffic completes with the restored checkpoint
+  byte-identity verified through the coop/admission stack, gold SLO
+  held through the restore window, and zero slab leaks;
+* **restore QoS identity** — restore reads carry their own class tag
+  end-to-end (admission ledger + latency recorder), and a class-name
+  collision is a one-line SystemExit at config time;
+* **warm-handoff × restore** — a cooperatively-leaving host drains its
+  hot set while the cold joiner is restoring: handoff-arrived chunks
+  are never re-fetched from origin, and the kill path leaks no slabs;
+* **shared storm ledger** — concurrent metadata mixes account against
+  ONE injected quota ledger, not drifting copies;
+* **record → replay** — a recorded drill bundle replays within
+  tolerance and re-records byte-identically; the checked-in golden
+  drill scenario stays valid, complete and replayable;
+* **report + gates** — ``tpubench report`` renders the drill scorecard
+  and the ``--fail-on`` grammar gates its metrics.
+
+Marker: ``drill``. Hermetic on the fake backend at sleep scale 0.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import random
+import zlib
+
+import pytest
+
+from tpubench.config import BenchConfig, validate_drill_config
+from tpubench.lifecycle.delta import DeltaTracker, delta_save
+from tpubench.lifecycle.manifest import (
+    build_manifest,
+    manifest_name,
+)
+from tpubench.storage.fake import FakeBackend
+
+pytestmark = pytest.mark.drill
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO_ROOT, "scenarios", "drill-restore-gold.tpb.gz")
+
+MB = 1 << 20
+CHUNK = 64 * 1024
+
+
+def _drill_cfg(tmp_path=None, name="dj.json", **drill_kw):
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 4
+    cfg.workload.object_size = 1 * MB
+    cfg.obs.export = "none"
+    if tmp_path is not None:
+        cfg.obs.flight_journal = str(tmp_path / name)
+    sv = cfg.serve
+    sv.duration_s = 3.0
+    sv.rate_rps = 60.0
+    sv.tenants = 24
+    sv.workers = 4
+    sv.hosts = 3
+    sv.seed = 7
+    lc = cfg.lifecycle
+    lc.objects = 3
+    lc.object_bytes = 256 * 1024
+    lc.part_bytes = 64 * 1024
+    lc.seed = 7
+    dc = cfg.drill
+    dc.kill_at_s = 1.0
+    dc.join_at_s = 1.4
+    dc.save_interval_s = 0.8
+    for k, v in drill_kw.items():
+        setattr(dc, k, v)
+    return cfg
+
+
+# ------------------------------------------------------ config contract --
+
+
+def test_restore_class_collision_is_one_line_systemexit():
+    cfg = _drill_cfg()
+    cfg.drill.restore_class = "gold"  # collides with a serving class
+    with pytest.raises(SystemExit, match="collides"):
+        validate_drill_config(cfg.drill, cfg.serve)
+
+
+def test_drill_requires_a_pod_with_a_survivor():
+    cfg = _drill_cfg()
+    cfg.serve.hosts = 1
+    with pytest.raises(SystemExit, match="hosts >= 2"):
+        validate_drill_config(cfg.drill, cfg.serve)
+
+
+# ----------------------------------------------------------- delta plane --
+
+
+def _tracked_baseline(n=4, size=128 * 1024, part=32 * 1024):
+    backend = FakeBackend()
+    manifest = build_manifest("ckpt/", n, size)
+    tracker = DeltaTracker(manifest)
+    stats = delta_save(backend, tracker, part, delta=False)
+    assert stats["uploaded_shards"] == n and stats["errors"] == 0
+    return backend, tracker, part
+
+
+def test_delta_save_uploads_only_dirty_shards():
+    backend, tracker, part = _tracked_baseline()
+    names = [s.name for s in tracker.manifest.objects]
+    rng = random.Random(3)
+    dirty = tracker.mutate(rng, 0.25)
+    assert len(dirty) == 1
+    stats = delta_save(backend, tracker, part)
+    # The ledger IS the assertion: one dirty shard uploaded, the other
+    # three skipped clean, bytes account exactly for the dirty shard.
+    assert stats["uploaded_shards"] == stats["dirty_shards"] == 1
+    assert stats["skipped_clean"] == len(names) - 1
+    assert stats["bytes_uploaded"] == 128 * 1024
+    assert stats["cas_conflicts"] == stats["full_fallbacks"] == 0
+    assert stats["errors"] == 0
+    # A clean follow-up pass uploads nothing.
+    again = delta_save(backend, tracker, part)
+    assert again["uploaded_shards"] == 0
+    assert again["skipped_clean"] == len(names)
+
+
+def test_delta_cas_412_classified_into_one_full_fallback():
+    backend, tracker, part = _tracked_baseline()
+    rng = random.Random(3)
+    victim = tracker.mutate(rng, 0.25)[0]
+    # Another writer moves the shard out-of-band: the tracker's guard
+    # generation is now stale, so the CAS upload must 412.
+    backend.write(victim, b"x" * 16)
+    foreign_gen = backend.stat(victim).generation
+    stats = delta_save(backend, tracker, part)
+    # Classified, not silently retried: exactly one conflict, exactly
+    # one unconditional re-upload, zero errors — the pass stays correct.
+    assert stats["cas_conflicts"] == 1
+    assert stats["full_fallbacks"] == 1
+    assert stats["uploaded_shards"] == 1
+    assert stats["errors"] == 0
+    # The fallback re-adopted whatever generation resulted, PAST the
+    # foreign writer's.
+    assert tracker.generation[victim] > foreign_gen
+    # And the adopted crc matches the committed bytes.
+    reader = backend.open_read(victim)
+    data = bytearray()
+    buf = bytearray(64 * 1024)
+    while True:
+        n = reader.readinto(memoryview(buf))
+        if n == 0:
+            break
+        data.extend(buf[:n])
+    reader.close()
+    assert (zlib.crc32(bytes(data)) & 0xFFFFFFFF
+            == tracker.crc_for(victim, tracker.generation[victim]))
+
+
+def test_delta_manifest_published_last_and_only_when_clean():
+    backend, tracker, part = _tracked_baseline()
+    mname = manifest_name(tracker.manifest.prefix)
+    gen_after_baseline = backend.stat(mname).generation
+
+    class _ShardFails:
+        """Non-412 storage failure on one shard's upload."""
+
+        def __init__(self, inner, bad):
+            self._inner, self._bad = inner, bad
+
+        def __getattr__(self, attr):
+            return getattr(self._inner, attr)
+
+        def open_write(self, name, **kw):
+            if name == self._bad:
+                from tpubench.storage.base import StorageError
+
+                raise StorageError("disk on fire", transient=False)
+            return self._inner.open_write(name, **kw)
+
+    rng = random.Random(3)
+    victim = tracker.mutate(rng, 0.25)[0]
+    stats = delta_save(_ShardFails(backend, victim), tracker, part)
+    assert stats["errors"] == 1
+    # Publish-last discipline: an errored pass must NOT move the
+    # manifest.
+    assert backend.stat(mname).generation == gen_after_baseline
+    # The clean retry pass does.
+    stats = delta_save(backend, tracker, part)
+    assert stats["errors"] == 0 and stats["uploaded_shards"] == 1
+    assert backend.stat(mname).generation > gen_after_baseline
+
+
+# --------------------------------------------------- shared storm ledger --
+
+
+def test_storm_ledger_is_a_shared_injectable():
+    from tpubench.lifecycle.storm import (
+        StormLedger,
+        build_storm_schedule,
+        run_storm,
+    )
+
+    backend = FakeBackend.prepopulated(prefix="q/meta/", count=8, size=4096)
+    names = [o.name for o in backend.list("q/meta/")]
+    schedule = build_storm_schedule(
+        names, kind="poisson", rate_rps=400.0, duration_s=0.05,
+        mix="list:2,stat:5,open:3",
+        prefix="q/meta/", seed=5,
+    )
+    shared = StormLedger()
+    a = run_storm(backend, schedule, workers=2, page_size=4,
+                  read_bytes=1024, ledger=shared)
+    b = run_storm(backend, schedule, workers=2, page_size=4,
+                  read_bytes=1024, ledger=shared)
+    snap = shared.snapshot()
+    # Both mixes accounted against the ONE ledger: the second run's
+    # reported totals INCLUDE the first's (cumulative snapshot of the
+    # shared ledger), and the final snapshot matches.
+    assert a["completed"] > 0
+    assert b["completed"] == 2 * a["completed"]
+    assert sum(snap["completed"].values()) == b["completed"]
+
+
+# ------------------------------------------------------- the acceptance --
+
+
+def test_drill_acceptance_restore_while_serving(tmp_path, monkeypatch):
+    """The hermetic incident acceptance: scripted kill + cold join under
+    live open-loop traffic completes with the restored checkpoint
+    byte-identity verified, gold SLO through the restore window, delta
+    saves uploading only dirty shards, and zero slab leaks."""
+    monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+    from tpubench.workloads.drill import run_drill
+
+    cfg = _drill_cfg(tmp_path)
+    res = run_drill(cfg)
+    assert res.workload == "drill"
+    assert res.errors == 0
+
+    dr = res.extra["drill"]
+    rst = dr["restore"]
+    assert rst["requested"] and rst["completed"]
+    assert rst["verified"], rst  # byte-identity vs the published crcs
+    assert rst["shards_restored"] == rst["shards"] == 3
+    assert rst["errors"] == 0
+    assert rst["via_coop"]  # routed through the coop/admission stack
+    assert rst["time_to_restore_s"] is not None
+
+    # Gold SLO held through the restore window (the headline bound).
+    assert dr["gold_slo"]["restore_window"]["gold"] >= 0.9
+    assert dr["gold_slo"]["steady"]["gold"] >= 0.9
+
+    # Delta ledger: every pass uploaded ONLY its dirty shards.
+    sv = dr["saves"]
+    assert sv["delta"] and sv["passes"] >= 1
+    assert sv["uploaded_shards"] == sv["dirty_shards"]
+    assert sv["skipped_clean"] > 0
+    assert sv["cas_conflicts"] == 0 and sv["errors"] == 0
+
+    # Amplification accounting is populated and sane.
+    amp = dr["amplification"]
+    assert amp["checkpoint_bytes"] == 3 * 256 * 1024
+    assert amp["restore_bytes"] == amp["checkpoint_bytes"]
+    assert amp["ratio"] > 0
+
+    # The pod survived the incident: kill + join epochs, no leaks.
+    mb = res.extra["membership"]
+    assert mb["epoch"] >= 2
+    assert mb["pool_leaked_slabs"] == 0
+    assert dr["time_to_rewarm_s"] is not None
+
+    # Restore traffic carried its own QoS identity end-to-end.
+    assert "restore" in res.extra["serve"]["classes"]
+    assert "request_restore" in res.summaries
+
+
+def test_drill_direct_arm_bypasses_coop(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+    from tpubench.workloads.drill import run_drill
+
+    cfg = _drill_cfg(tmp_path, restore_via_coop=False)
+    res = run_drill(cfg)
+    dr = res.extra["drill"]
+    assert not dr["arm"]["restore_via_coop"]
+    assert not dr["restore"]["via_coop"]
+    assert dr["restore"]["verified"]
+    assert dr["restore"]["errors"] == 0
+
+
+def test_drill_runs_concurrent_meta_storm_mix(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+    from tpubench.workloads.drill import run_drill
+
+    cfg = _drill_cfg(tmp_path, meta_rate_rps=100.0)
+    res = run_drill(cfg)
+    dr = res.extra["drill"]
+    assert dr["restore"]["verified"]
+    meta = dr.get("meta") or {}
+    assert meta.get("completed", 0) > 0, meta
+
+
+# --------------------------------------------- warm handoff × restore ----
+
+
+def test_handoff_arrived_chunks_not_refetched_by_cold_joiner():
+    """The satellite's unit contract, on the fabric itself: a host dies
+    (kill path — cache closed, zero slab leaks), a cold replacement
+    rejoins with fresh caches (the drill recipe), then a warm host
+    leaves cooperatively — its hot set drains to the survivors
+    INCLUDING the cold joiner, and every handed-off chunk serves
+    without a new origin fetch."""
+    from tpubench.dist.membership import ElasticFabric
+    from tpubench.mem.slab import SlabPool, release_payload
+    from tpubench.pipeline.cache import ChunkCache, ChunkKey
+    from tpubench.pipeline.coop import CoopCache, LoopbackChannel
+    from tpubench.pipeline.prefetch import fetch_chunk
+
+    backend = FakeBackend.prepopulated(prefix="hx/f_", count=4, size=MB)
+    fetches = {"n": 0}
+    fab = ElasticFabric(3, clock=lambda: 0.0)
+    hosts = {}
+    pools = []
+
+    def build_host(h):
+        pool = SlabPool(CHUNK, 64, use_native=False)
+        pools.append(pool)
+        cache = ChunkCache(64 * MB)
+
+        def origin_fetch(k, _pool=pool):
+            fetches["n"] += 1
+            return fetch_chunk(backend, k, pool=_pool)
+
+        coop = CoopCache(
+            cache, host_id=h, ring=fab.ring,
+            channel=LoopbackChannel(fab.broker, h),
+            origin_fetch=origin_fetch, pool=pool, enabled=True,
+        )
+        return {"coop": coop, "cache": cache}
+
+    for h in range(3):
+        entry = build_host(h)
+        fab.add_host(entry["coop"])
+        hosts[h] = entry
+
+    keys = [
+        ChunkKey("tpubench-fake", o.name, o.generation, s, CHUNK)
+        for o in backend.list("hx/f_") for s in range(0, MB, CHUNK)
+    ]
+    # Host 0 resolves everything once — its cache is the hot set.
+    for k in keys:
+        data = hosts[0]["cache"].get_or_fetch(
+            k, lambda kk=k: hosts[0]["coop"].fetch(kk)
+        )
+        release_payload(data)
+
+    # The incident: host 2 dies (kill path closes its cache with leases
+    # inside — the leak check at the end covers it)...
+    assert fab.kill_host(2)
+    retired = hosts[2]
+    # ...and a cold replacement rejoins with FRESH caches — the drill's
+    # cold-replacement recipe.
+    hosts[2] = build_host(2)
+    fab.add_host(hosts[2]["coop"])
+    assert fab.rejoin_host(2)
+    assert hosts[2]["cache"].stats()["entries"] == 0  # genuinely cold
+
+    origin_before = fetches["n"]
+    # Host 0 leaves cooperatively mid-"restore": its hot set drains to
+    # hosts 1 and 2 — the cold joiner receives handoff chunks.
+    st = fab.leave_host(0)
+    assert st["chunks"] == len(keys) and st["rejected"] == 0
+    assert hosts[2]["cache"].stats()["entries"] > 0, (
+        "the cold joiner received none of the handoff"
+    )
+    # Every handed-off chunk now serves WITHOUT a new origin fetch: the
+    # handoff replaced the re-fetch, on the joiner too.
+    for k in keys:
+        owner = fab.ring.owner(k)
+        entry = hosts[owner]
+        data = entry["cache"].get_or_fetch(
+            k, lambda kk=k, c=entry["coop"]: c.fetch(kk)
+        )
+        assert len(data) == CHUNK
+        release_payload(data)
+    assert fetches["n"] == origin_before, (
+        "handoff-arrived chunks were re-fetched from origin"
+    )
+    # Zero slab leaks through the kill path (and everywhere else).
+    fab.close()
+    for entry in list(hosts.values()) + [retired]:
+        entry["cache"].close()
+    leaked = sum(p.close()["leaked_slabs"] for p in pools)
+    assert leaked == 0
+
+
+def test_drill_with_cooperative_leave_during_restore(tmp_path, monkeypatch):
+    """The satellite's integration contract: a cooperatively-leaving
+    host drains its hot set while the joiner restores — the composed
+    run completes verified, the handoff moved bytes, nothing leaks."""
+    monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+    from tpubench.workloads.drill import run_drill
+
+    cfg = _drill_cfg(tmp_path)
+    cfg.serve.hosts = 4
+    cfg.drill.victim = 3
+    # Host 1 leaves cooperatively right as the joiner's restore starts.
+    cfg.serve.membership_timeline = [[1.5, 1.5, {"leave_host": 1}]]
+    res = run_drill(cfg)
+    assert res.errors == 0
+    dr = res.extra["drill"]
+    assert dr["restore"]["verified"]
+    mb = res.extra["membership"]
+    assert mb["handoff"]["out_bytes"] > 0
+    assert mb["handoff"]["in_bytes"] == mb["handoff"]["out_bytes"]
+    assert mb["pool_leaked_slabs"] == 0
+    actions = [e["action"] for e in mb["events"]]
+    assert "leave_host" in actions
+
+
+# ------------------------------------------------------ record / replay --
+
+
+def _recorded_drill(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+    from tpubench.replay.bundle import record_bundle
+    from tpubench.workloads.drill import run_drill
+
+    cfg = _drill_cfg(tmp_path)
+    run_drill(cfg)
+    bundle = record_bundle(
+        [cfg.obs.flight_journal], str(tmp_path / "d1.tpb.gz"),
+    )
+    return cfg, bundle
+
+
+def test_drill_bundle_records_plan_and_replays_within_tolerance(
+    tmp_path, monkeypatch,
+):
+    from tpubench.replay.bundle import BUNDLE_FIELDS, validate_bundle
+    from tpubench.replay.driver import run_replay
+
+    cfg, bundle = _recorded_drill(tmp_path, monkeypatch)
+    validate_bundle(bundle, "d1")
+    assert set(bundle) == set(BUNDLE_FIELDS)
+    assert bundle["workload"] == "drill"
+    plan = bundle["drill"]["plan"]
+    assert plan["kill_at_s"] == 1.0 and plan["join_at_s"] == 1.4
+    assert plan["victim"] == 2  # resolved, not the -1 sentinel
+    assert bundle["drill"]["checkpoint"]["objects"] == 3
+    assert bundle["drill"]["baseline"]["restore_verified"]
+
+    rcfg = _drill_cfg(tmp_path, name="dj2.json")
+    res = run_replay(rcfg, bundle)
+    rp = res.extra["replay"]
+    assert rp["config_match"], rp
+    assert rp["arrivals_match"], rp
+    drp = rp["drill"]
+    assert drp["replayed"]["restore_verified"]
+    assert drp["diff"]["verified_match"]
+    assert abs(drp["diff"]["save_pass_delta"]) <= 1
+    worst = drp["diff"]["worst_restore_slo_delta_pts"]
+    assert worst is None or abs(worst) <= 25.0, drp["diff"]
+    # The replayed run's own drill scorecard rode along.
+    assert res.extra["drill"]["restore"]["verified"]
+
+
+def test_drill_replay_rerecords_byte_identically(tmp_path, monkeypatch):
+    from tpubench.replay.bundle import record_bundle
+    from tpubench.replay.driver import run_replay
+
+    cfg, bundle = _recorded_drill(tmp_path, monkeypatch)
+    rcfg = _drill_cfg(tmp_path, name="dj3.json")
+    run_replay(rcfg, bundle)
+    b2 = record_bundle(
+        [rcfg.obs.flight_journal], str(tmp_path / "d2.tpb.gz"),
+        name=bundle["name"],
+    )
+    # Source passthrough: the re-record reproduces the ORIGINAL bundle
+    # (plan, checkpoint shape AND baseline), byte-identically on disk.
+    assert b2 == bundle
+    with open(tmp_path / "d1.tpb.gz", "rb") as f:
+        orig = f.read()
+    with open(tmp_path / "d2.tpb.gz", "rb") as f:
+        rerec = f.read()
+    assert orig == rerec
+
+
+def test_serve_bundles_carry_null_drill(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+    from tpubench.replay.bundle import record_bundle
+    from tpubench.workloads.serve import run_serve
+
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 4
+    cfg.workload.object_size = 1 * MB
+    cfg.obs.export = "none"
+    cfg.obs.flight_journal = str(tmp_path / "sj.json")
+    cfg.serve.duration_s = 1.0
+    cfg.serve.rate_rps = 50.0
+    cfg.serve.tenants = 10
+    cfg.serve.workers = 2
+    run_serve(cfg)
+    bundle = record_bundle(
+        [cfg.obs.flight_journal], str(tmp_path / "s.tpb.gz"),
+    )
+    assert bundle["workload"] == "serve"
+    assert bundle["drill"] is None
+
+
+# ----------------------------------------------------------- the golden --
+
+
+def test_golden_drill_bundle_is_valid_and_complete():
+    from tpubench.replay.bundle import (
+        BUNDLE_FIELDS,
+        load_bundle,
+        validate_bundle,
+    )
+
+    bundle = load_bundle(GOLDEN)
+    assert bundle is not None, "checked-in golden drill bundle missing"
+    validate_bundle(bundle, GOLDEN)
+    assert set(bundle) == set(BUNDLE_FIELDS)
+    assert bundle["name"] == "drill-restore-gold"
+    assert bundle["workload"] == "drill"
+    assert len(bundle["arrivals"]) > 0
+    assert bundle["drill"]["plan"]["kill_at_s"] >= 0
+    assert bundle["drill"]["baseline"]["restore_verified"]
+
+
+def test_golden_drill_bundle_replays_and_gates(tmp_path, monkeypatch):
+    """The drill regression spine end-to-end: golden bundle → replay
+    under its recording config → structural gates hold → report
+    --fail-on passes on the result and trips when sabotaged."""
+    monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+    from tpubench.replay.bundle import load_bundle
+    from tpubench.replay.driver import run_replay
+
+    bundle = load_bundle(GOLDEN)
+    assert bundle is not None
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 4
+    cfg.workload.object_size = 1 * MB
+    cfg.obs.export = "none"
+    res = run_replay(cfg, bundle)
+    rp = res.extra["replay"]
+    assert rp["config_match"], (
+        "bench/scenarios config drifted from the golden drill "
+        f"recording: {rp['fingerprint']} != {rp['original_fingerprint']}"
+    )
+    assert rp["arrivals_match"], rp
+    assert rp["drill"]["replayed"]["restore_verified"]
+    assert rp["drill"]["diff"]["verified_match"]
+
+    from tpubench.metrics.report import write_result
+
+    rpath = write_result(res, str(tmp_path))
+    from tpubench.cli import main as cli_main
+
+    assert cli_main(
+        ["report", rpath, "--fail-on", "restore_verified<1",
+         "--fail-on", "restore_errors>0",
+         "--fail-on", "save_cas_conflicts>0"]
+    ) == 0
+    assert cli_main(
+        ["report", rpath, "--fail-on", "restore_verified>=1"]
+    ) == 1
+
+
+# -------------------------------------------------------- report render --
+
+
+def test_report_renders_drill_scorecard_and_ab(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+    from tpubench.metrics.report import write_result
+    from tpubench.workloads.drill import run_drill
+
+    coop = run_drill(_drill_cfg(tmp_path, name="r1.json"))
+    direct_cfg = _drill_cfg(tmp_path, name="r2.json",
+                            restore_via_coop=False, delta_saves=False)
+    direct = run_drill(direct_cfg)
+    p1 = write_result(coop, str(tmp_path / "a"))
+    p2 = write_result(direct, str(tmp_path / "b"))
+    from tpubench.cli import main as cli_main
+
+    assert cli_main(["report", p1, p2]) == 0
+    out = capsys.readouterr().out
+    assert "incident drill scorecard" in out
+    assert "restore via coop" in out and "restore direct" in out
+    # The A/B axis labels distinguish the arms...
+    assert "drill coop+delta" in out and "drill direct+full" in out
+    # ...and the drill diff line compares what the drill exists for.
+    assert "time-to-restore" in out
+    # The full arm re-uploaded every shard; the delta arm only dirty
+    # ones — visible straight off the ledger in the diff line.
+    assert (direct.extra["drill"]["saves"]["bytes_uploaded"]
+            > coop.extra["drill"]["saves"]["bytes_uploaded"])
+
+
+def test_gate_namespace_carries_drill_metrics(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+    from tpubench.replay.gate import metric_namespace
+    from tpubench.workloads.drill import run_drill
+
+    res = run_drill(_drill_cfg(tmp_path, name="g.json"))
+    ns = metric_namespace(res.to_dict())
+    for name in ("time_to_restore_s", "restore_verified", "restore_errors",
+                 "time_to_rewarm_s", "save_cas_conflicts",
+                 "origin_amplification", "drill_gold_slo_restore",
+                 "drill_gold_slo_steady"):
+        assert name in ns, name
+    assert ns["restore_verified"] == 1.0
+    assert ns["restore_errors"] == 0.0
+
+
+# -------------------------------------------------------------- sweep ----
+
+
+def test_drill_sweep_emits_points_and_knee_inputs(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+    from tpubench.workloads.drill import run_drill_sweep
+
+    cfg = _drill_cfg(tmp_path, sweep_points=[0.5, 1.0])
+    cfg.serve.duration_s = 2.0
+    res = run_drill_sweep(cfg)
+    ds = res.extra["drill_sweep"]
+    assert len(ds["points"]) == 2
+    offered = [p["offered_rps"] for p in ds["points"]]
+    assert offered == sorted(offered)  # ascending, the find_knee contract
+    for p in ds["points"]:
+        assert p["save_passes"] >= 1
+        assert p["time_to_restore_s"] is not None
+        assert "gold_slo_restore_window" in p
+    assert "knee" in ds
+
+
+def test_drill_replay_plan_resolves_scenario_halves(tmp_path, monkeypatch):
+    """The bundle's drill block folds back into config: plan → drill,
+    checkpoint → lifecycle — the replay driver's scenario fold."""
+    from tpubench.replay.driver import _scenario_config
+
+    cfg, bundle = _recorded_drill(tmp_path, monkeypatch)
+    mutated = copy.deepcopy(bundle)
+    mutated["drill"]["plan"]["kill_at_s"] = 0.5
+    mutated["drill"]["checkpoint"]["objects"] = 7
+    rcfg = _scenario_config(BenchConfig(), mutated, "/tmp/trace.json")
+    assert rcfg.drill.kill_at_s == 0.5
+    assert rcfg.lifecycle.objects == 7

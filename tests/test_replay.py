@@ -227,7 +227,7 @@ def test_validate_bundle_refuses_unfaithful(tmp_path, monkeypatch):
     del missing["arrivals"]
     with pytest.raises(SystemExit, match="missing fields: arrivals"):
         validate_bundle(missing, "p")
-    with pytest.raises(SystemExit, match="serve only"):
+    with pytest.raises(SystemExit, match="serve and drill only"):
         validate_bundle(dict(bundle, workload="read"), "p")
     with pytest.raises(SystemExit, match="journal_schema 99"):
         validate_bundle(dict(bundle, journal_schema=99), "p")

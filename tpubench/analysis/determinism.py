@@ -53,6 +53,12 @@ CLOCK_MODULES = (
     "tpubench/replay/bundle.py",
     "tpubench/replay/driver.py",
     "tpubench/replay/gate.py",
+    # Incident drill + delta saves: the kill/join script, the save
+    # cadence and the dirty-shard draws all ride virtual schedule time
+    # and seeded RNGs — a naked clock here would make the recorded
+    # drill bundle unreplayable.
+    "tpubench/workloads/drill.py",
+    "tpubench/lifecycle/delta.py",
 )
 
 # Paths whose classes must bound every accumulator (obs/serve planes

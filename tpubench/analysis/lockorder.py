@@ -51,6 +51,14 @@ LOCK_ORDER_FILES = (
     # it ever grows joins the ordering graph from day one (it composes
     # over the fake backend's fault plane and the serve planes).
     "tpubench/replay/driver.py",
+    # Incident drill: its ledger lock guards restore/save byte counters
+    # and stays a leaf — backend reads, cache fetches and flight
+    # appends all run OUTSIDE it (it composes over the admission queue,
+    # the coop broker and the storm ledger, each with locks of its own).
+    "tpubench/workloads/drill.py",
+    # Delta tracker: the shard-state lock is a leaf; CAS writes and
+    # manifest uploads never run under it.
+    "tpubench/lifecycle/delta.py",
 )
 
 _LOCK_CTORS = {"Lock", "RLock", "Condition"}

@@ -393,6 +393,138 @@ def _add_lifecycle_flags(p: argparse.ArgumentParser) -> None:
                         "identical storms)")
 
 
+def _add_serve_flags(p: argparse.ArgumentParser) -> None:
+    """The serve-plane flag surface, shared by the ``serve`` and
+    ``drill`` subcommands (the drill IS a serve run with an incident
+    scripted into it — the knobs must never fork)."""
+    p.add_argument("--serve-duration", type=float,
+                   help="virtual schedule length in seconds "
+                        "(default 4; wall time scales with "
+                        "TPUBENCH_BENCH_SLEEP_SCALE)")
+    p.add_argument("--serve-rate", type=float,
+                   help="aggregate offered load, requests/second "
+                        "(default 200)")
+    p.add_argument("--serve-arrival",
+                   choices=("poisson", "bursty", "diurnal", "trace"),
+                   help="arrival process (default poisson; bursty = "
+                        "two-state MMPP, diurnal = sinusoidal-rate "
+                        "Poisson, trace = replayed timestamps from "
+                        "--serve-trace)")
+    p.add_argument("--serve-trace",
+                   help="replayed-trace arrivals: JSON list of "
+                        "arrival seconds (implies "
+                        "--serve-arrival trace)")
+    p.add_argument("--serve-tenants", type=int,
+                   help="synthetic tenant population (default 100), "
+                        "expanded over the class shares")
+    p.add_argument("--serve-classes",
+                   help="priority-class spec: JSON list of {name, "
+                        "share, weight, deadline_ms, priority} "
+                        "dicts, inline or @path (default "
+                        "gold/silver/best_effort)")
+    p.add_argument("--serve-workers", type=int,
+                   help="service worker threads (default 8)")
+    p.add_argument("--no-serve-qos", action="store_true",
+                   help="QoS off: FIFO admission, no shedding, no "
+                        "weighted budgets — the baseline arm of "
+                        "the QoS A/B")
+    p.add_argument("--serve-admission-cap", type=int,
+                   help="requests in service at once (default = "
+                        "--serve-workers; live-tunable via the "
+                        "workers tune knob)")
+    p.add_argument("--serve-queue-limit", type=int,
+                   help="queued requests before overload shedding "
+                        "(QoS mode; default 8x workers)")
+    p.add_argument("--serve-readahead", type=int,
+                   help="readahead depth in chunks over the arrival "
+                        "schedule (0 = demand-only, the default)")
+    p.add_argument("--serve-burst-factor", type=float,
+                   help="bursty: burst-to-quiet rate ratio "
+                        "(default 4)")
+    p.add_argument("--serve-burst-fraction", type=float,
+                   help="bursty: fraction of each cycle bursting "
+                        "(default 0.25)")
+    p.add_argument("--serve-seed", type=int,
+                   help="arrival/popularity seed (identical seeds "
+                        "replay identical schedules)")
+    p.add_argument("--serve-sweep-points",
+                   help="comma list of offered-load multipliers for "
+                        "--serve-sweep (default 0.25,0.5,1,2,4)")
+    p.add_argument("--serve-hosts", type=int,
+                   help="elastic pod: fan the serve plane across N "
+                        "hermetic threaded hosts whose misses route "
+                        "through coop-cache consistent-hash "
+                        "ownership (default 1 = single-host plane)")
+    p.add_argument("--membership-timeline",
+                   help="elastic membership events: JSON list of "
+                        "[t0, t1, {action: host}] entries (inline "
+                        "or @path) in virtual schedule seconds — "
+                        "actions kill_host / leave_host (warm "
+                        "handoff) / pause_host (resumes at t1) / "
+                        "rejoin_host")
+    p.add_argument("--resize-window", type=float,
+                   help="virtual seconds of resize window the "
+                        "scorecard brackets each membership event "
+                        "with (default 1.0)")
+
+
+def _add_drill_flags(p: argparse.ArgumentParser) -> None:
+    """Flags owned by the ``drill`` subcommand — the incident script
+    and the delta-save cadence."""
+    p.add_argument("--drill-kill-at", type=float, dest="drill_kill_at",
+                   help="virtual second the victim host is KILLED at "
+                        "(default 1.0)")
+    p.add_argument("--drill-join-at", type=float, dest="drill_join_at",
+                   help="virtual second the cold replacement joins and "
+                        "starts restoring (default 1.5; >= --drill-"
+                        "kill-at)")
+    p.add_argument("--drill-victim", type=int, dest="drill_victim",
+                   help="host id to kill (default -1 = last host)")
+    p.add_argument("--restore-class", dest="restore_class",
+                   help="QoS class tag restore reads carry end-to-end "
+                        "(default 'restore'; must not collide with a "
+                        "serving class)")
+    p.add_argument("--restore-priority", type=int, dest="restore_priority",
+                   help="admission priority of restore reads "
+                        "(default 1 — below gold, above best-effort)")
+    p.add_argument("--restore-weight", type=float, dest="restore_weight",
+                   help="cache/prefetch budget weight of the restore "
+                        "class (default 2.0)")
+    p.add_argument("--restore-deadline", type=float,
+                   dest="restore_deadline",
+                   help="restore-read deadline in ms (default 500)")
+    p.add_argument("--restore-inflight", type=int, dest="restore_inflight",
+                   help="restore reads in flight through the shared "
+                        "admission queue (default 8)")
+    p.add_argument("--restore-retries", type=int, dest="restore_retries",
+                   help="re-stat retries per shard on torn reads "
+                        "(default 3)")
+    p.add_argument("--restore-direct", action="store_true",
+                   dest="restore_direct",
+                   help="A/B arm: restore reads bypass the coop cache "
+                        "and fetch direct from origin (still holding "
+                        "admission slots and cache budget)")
+    p.add_argument("--save-interval", type=float, dest="save_interval",
+                   help="virtual seconds between checkpoint saves under "
+                        "traffic (default 1.0; 0 = no periodic saves)")
+    p.add_argument("--full-saves", action="store_true", dest="full_saves",
+                   help="A/B arm: every periodic save re-uploads ALL "
+                        "shards instead of only dirty ones")
+    p.add_argument("--dirty-fraction", type=float, dest="dirty_fraction",
+                   help="fraction of shards each save pass dirties "
+                        "(default 0.25)")
+    p.add_argument("--drill-meta-rate", type=float, dest="drill_meta_rate",
+                   help="concurrent metadata-storm mix, ops/second "
+                        "(default 0 = no storm; shares the storm "
+                        "quota ledger)")
+    p.add_argument("--drill-sweep", action="store_true",
+                   help="step the save interval through the multipliers "
+                        "and locate the save-rate-vs-latency knee")
+    p.add_argument("--drill-sweep-points",
+                   help="comma list of save-interval multipliers for "
+                        "--drill-sweep (default 0.5,1,2)")
+
+
 def build_config(args) -> BenchConfig:
     if args.config:
         with open(args.config) as f:
@@ -692,6 +824,44 @@ def build_config(args) -> BenchConfig:
     from tpubench.config import validate_lifecycle_config
 
     validate_lifecycle_config(lc)
+    dc = cfg.drill
+    for attr, dest in (
+        ("drill_kill_at", "kill_at_s"), ("drill_join_at", "join_at_s"),
+        ("drill_victim", "victim"),
+        ("restore_class", "restore_class"),
+        ("restore_priority", "restore_priority"),
+        ("restore_weight", "restore_weight"),
+        ("restore_deadline", "restore_deadline_ms"),
+        ("restore_inflight", "restore_inflight"),
+        ("restore_retries", "restore_retries"),
+        ("save_interval", "save_interval_s"),
+        ("dirty_fraction", "dirty_fraction"),
+        ("drill_meta_rate", "meta_rate_rps"),
+    ):
+        v = getattr(args, attr, None)
+        if v is not None:
+            setattr(dc, dest, v)
+    if getattr(args, "restore_direct", False):
+        dc.restore_via_coop = False
+    if getattr(args, "full_saves", False):
+        dc.delta_saves = False
+    if getattr(args, "drill_sweep_points", None):
+        try:
+            dc.sweep_points = [
+                float(x) for x in args.drill_sweep_points.split(",") if x
+            ]
+        except ValueError:
+            raise SystemExit(
+                f"--drill-sweep-points {args.drill_sweep_points!r}: "
+                "expected a comma list of positive numbers"
+            ) from None
+    if getattr(args, "cmd", None) == "drill":
+        # Only the drill command pays the drill's cross-plane
+        # constraints (hosts >= 2, class collision) — a serve run with
+        # default drill config must not be refused.
+        from tpubench.config import validate_drill_config
+
+        validate_drill_config(dc, sv)
     tn = cfg.tune
     if getattr(args, "tune", False):
         tn.enabled = True
@@ -1081,75 +1251,20 @@ def main(argv=None) -> int:
                             "multipliers of --serve-rate and emit the "
                             "latency-vs-load curve with the knee "
                             "identified (p99 inflection)")
-    serve.add_argument("--serve-duration", type=float,
-                       help="virtual schedule length in seconds "
-                            "(default 4; wall time scales with "
-                            "TPUBENCH_BENCH_SLEEP_SCALE)")
-    serve.add_argument("--serve-rate", type=float,
-                       help="aggregate offered load, requests/second "
-                            "(default 200)")
-    serve.add_argument("--serve-arrival",
-                       choices=("poisson", "bursty", "diurnal", "trace"),
-                       help="arrival process (default poisson; bursty = "
-                            "two-state MMPP, diurnal = sinusoidal-rate "
-                            "Poisson, trace = replayed timestamps from "
-                            "--serve-trace)")
-    serve.add_argument("--serve-trace",
-                       help="replayed-trace arrivals: JSON list of "
-                            "arrival seconds (implies "
-                            "--serve-arrival trace)")
-    serve.add_argument("--serve-tenants", type=int,
-                       help="synthetic tenant population (default 100), "
-                            "expanded over the class shares")
-    serve.add_argument("--serve-classes",
-                       help="priority-class spec: JSON list of {name, "
-                            "share, weight, deadline_ms, priority} "
-                            "dicts, inline or @path (default "
-                            "gold/silver/best_effort)")
-    serve.add_argument("--serve-workers", type=int,
-                       help="service worker threads (default 8)")
-    serve.add_argument("--no-serve-qos", action="store_true",
-                       help="QoS off: FIFO admission, no shedding, no "
-                            "weighted budgets — the baseline arm of "
-                            "the QoS A/B")
-    serve.add_argument("--serve-admission-cap", type=int,
-                       help="requests in service at once (default = "
-                            "--serve-workers; live-tunable via the "
-                            "workers tune knob)")
-    serve.add_argument("--serve-queue-limit", type=int,
-                       help="queued requests before overload shedding "
-                            "(QoS mode; default 8x workers)")
-    serve.add_argument("--serve-readahead", type=int,
-                       help="readahead depth in chunks over the arrival "
-                            "schedule (0 = demand-only, the default)")
-    serve.add_argument("--serve-burst-factor", type=float,
-                       help="bursty: burst-to-quiet rate ratio "
-                            "(default 4)")
-    serve.add_argument("--serve-burst-fraction", type=float,
-                       help="bursty: fraction of each cycle bursting "
-                            "(default 0.25)")
-    serve.add_argument("--serve-seed", type=int,
-                       help="arrival/popularity seed (identical seeds "
-                            "replay identical schedules)")
-    serve.add_argument("--serve-sweep-points",
-                       help="comma list of offered-load multipliers for "
-                            "--serve-sweep (default 0.25,0.5,1,2,4)")
-    serve.add_argument("--serve-hosts", type=int,
-                       help="elastic pod: fan the serve plane across N "
-                            "hermetic threaded hosts whose misses route "
-                            "through coop-cache consistent-hash "
-                            "ownership (default 1 = single-host plane)")
-    serve.add_argument("--membership-timeline",
-                       help="elastic membership events: JSON list of "
-                            "[t0, t1, {action: host}] entries (inline "
-                            "or @path) in virtual schedule seconds — "
-                            "actions kill_host / leave_host (warm "
-                            "handoff) / pause_host (resumes at t1) / "
-                            "rejoin_host")
-    serve.add_argument("--resize-window", type=float,
-                       help="virtual seconds of resize window the "
-                            "scorecard brackets each membership event "
-                            "with (default 1.0)")
+    _add_serve_flags(serve)
+    drill = add("drill", "production incident drill: the elastic pod "
+                         "serves open-loop multi-tenant traffic while a "
+                         "scripted kill takes a host down and a cold "
+                         "replacement joins and ckpt-restores THROUGH "
+                         "the shared coop-cache/admission stack, with "
+                         "periodic delta checkpoint saves riding under "
+                         "the same traffic; scorecard: gold SLO during "
+                         "the restore window vs steady state, "
+                         "time-to-restore vs time-to-rewarm, origin-"
+                         "byte amplification, per-phase blame")
+    _add_serve_flags(drill)
+    _add_lifecycle_flags(drill)
+    _add_drill_flags(drill)
     for name, help_ in (
         ("ckpt-save", "storage lifecycle: save a sharded checkpoint "
                       "through resumable multi-part uploads (session -> "
@@ -1619,6 +1734,30 @@ def main(argv=None) -> int:
             print(format_serve_scorecard(res.extra["serve"]))
             if res.extra.get("membership"):
                 print(format_membership_scorecard(res.extra["membership"]))
+        elif args.cmd == "drill":
+            from tpubench.obs.tracing import tracer_session
+            from tpubench.workloads.drill import (
+                format_drill_scorecard,
+                format_drill_sweep,
+                run_drill,
+                run_drill_sweep,
+            )
+            from tpubench.workloads.serve import (
+                format_membership_scorecard,
+                format_serve_scorecard,
+            )
+
+            with tracer_session(cfg) as tracer:
+                if getattr(args, "drill_sweep", False):
+                    res = run_drill_sweep(cfg, tracer=tracer)
+                else:
+                    res = run_drill(cfg, tracer=tracer)
+            print(format_serve_scorecard(res.extra["serve"]))
+            if res.extra.get("membership"):
+                print(format_membership_scorecard(res.extra["membership"]))
+            print(format_drill_scorecard(res.extra["drill"]))
+            if res.extra.get("drill_sweep"):
+                print(format_drill_sweep(res.extra["drill_sweep"]))
         elif args.cmd == "replay":
             from tpubench.obs.tracing import tracer_session
             from tpubench.replay.bundle import (
@@ -1645,6 +1784,10 @@ def main(argv=None) -> int:
             print(format_serve_scorecard(res.extra["serve"]))
             if res.extra.get("membership"):
                 print(format_membership_scorecard(res.extra["membership"]))
+            if res.extra.get("drill"):
+                from tpubench.workloads.drill import format_drill_scorecard
+
+                print(format_drill_scorecard(res.extra["drill"]))
             print(format_replay_block(res.extra["replay"]))
         elif args.cmd == "tune":
             from tpubench.obs.tracing import tracer_session

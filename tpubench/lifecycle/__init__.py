@@ -10,9 +10,12 @@ top):
 * :mod:`upload` — the resumable multi-part upload driver (parts, flight
   phases, part latency, resumed-part accounting);
 * :mod:`storm` — the open-loop metadata storm engine (arrivals-plane
-  schedules over list/stat/open mixes, knee-curve inputs).
+  schedules over list/stat/open mixes, knee-curve inputs);
+* :mod:`delta` — per-shard dirty tracking + ``ifGenerationMatch``-CAS
+  delta saves (the incident drill's save-under-traffic arm).
 """
 
+from tpubench.lifecycle.delta import DeltaTracker, delta_save  # noqa: F401
 from tpubench.lifecycle.manifest import (  # noqa: F401
     CkptManifest,
     build_manifest,

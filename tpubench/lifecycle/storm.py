@@ -163,6 +163,7 @@ def run_storm(
     transport_label: str = "",
     sleep: Callable[[float], None] = time.sleep,
     clock_ns: Callable[[], int] = time.perf_counter_ns,
+    ledger: Optional[StormLedger] = None,
 ) -> dict:
     """Replay one storm schedule open-loop and measure it.
 
@@ -172,8 +173,13 @@ def run_storm(
     service falls behind the arrival process the queue grows and
     latencies carry the backlog — exactly the open-loop saturation shape
     the knee detector looks for. Per-op latency is completion minus
-    ARRIVAL (queue wait included)."""
-    ledger = StormLedger()
+    ARRIVAL (queue wait included).
+
+    ``ledger`` injects a shared StormLedger so concurrent storm mixes
+    (the drill's metadata arm and a standalone meta-storm) count
+    against ONE quota ledger implementation instead of drifting
+    copies; None keeps a private per-run ledger."""
+    ledger = ledger if ledger is not None else StormLedger()
     recs = {
         (i, k): LatencyRecorder(f"storm{i}.{k}")
         for i in range(workers) for k in ("list", "stat", "open")

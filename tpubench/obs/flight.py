@@ -93,6 +93,13 @@ JOURNAL_SCHEMA = 2
 # the read falls through to origin, so connect/first_byte follow on the
 # SAME record). owner_fetch marks an origin read made AS the chunk's
 # ring owner (the one fetch pod-wide single-flight permits).
+# Drill phases (PR 17): delta_commit stamps a delta save committing one
+# CAS-guarded shard generation (the delta_commit segment IS that
+# shard's upload+finalize time under live traffic), and shard_restored
+# stamps a restoring joiner completing one shard — all its chunk reads
+# landed and the crc verified against the stat-pinned generation (the
+# shard_restored segment IS the shard's restore time, contention
+# included).
 PHASES = (
     "enqueue",
     "cache_hit",
@@ -110,6 +117,8 @@ PHASES = (
     "meta_op",
     "part_sent",
     "upload_complete",
+    "delta_commit",
+    "shard_restored",
     "stall_begin",
     "stall_end",
     "stage_submit",

@@ -93,6 +93,8 @@ _PHASE_HELP = {
     "meta_op": "metadata operation completed (service time incl. queue)",
     "part_sent": "first upload part committed",
     "upload_complete": "resumable upload finalized",
+    "delta_commit": "delta save committed one CAS-guarded shard",
+    "shard_restored": "joiner finished restoring one verified shard",
     "stall_begin": "train-ingest step began waiting for data",
     "stall_end": "train-ingest step's data wait ended",
     "stage_submit": "host-to-HBM transfer left the reaper",

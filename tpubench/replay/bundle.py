@@ -45,7 +45,7 @@ BUNDLE_SCHEMA = 1
 BUNDLE_FIELDS = {
     "format": "bundle envelope version (tpubench-bundle/1)",
     "name": "scenario name (CLI --name, or derived from the output path)",
-    "workload": "workload the bundle replays (serve)",
+    "workload": "workload the bundle replays (serve | drill)",
     "journal_schema": "journal_schema of the source flight journal",
     "config_fingerprint": "system-half config fingerprint of the source run",
     "arrivals": "virtual arrival timestamps, seconds from run start",
@@ -61,6 +61,8 @@ BUNDLE_FIELDS = {
     "bucket": "bucket the chunk keys are scoped to",
     "fault": "unscaled fault plan (FaultConfig fields incl. phases)",
     "membership": "elastic pod plan: hosts, timeline, resize_window_s",
+    "drill": "incident-drill plan + checkpoint shape + drill baseline "
+             "(null for serve bundles)",
     "baseline": "the source run's distilled scorecard (the diff target)",
 }
 
@@ -158,6 +160,7 @@ def journal_replay_stamp(
     *,
     rate_rps: float,
     membership: Optional[dict] = None,
+    drill: Optional[dict] = None,
     errors: int = 0,
     p99_ms: Optional[float] = None,
     source: Optional[dict] = None,
@@ -167,7 +170,10 @@ def journal_replay_stamp(
     ``objects`` MUST be the same list the schedule was built over (the
     population, not a re-listing that might race a mutating backend);
     ``rate_rps`` is the EFFECTIVE offered load (sweep points override
-    the config's). ``source`` is set by replay runs: the bundle identity
+    the config's). ``drill`` (from :func:`drill_replay_plan`) marks the
+    run as an incident drill: the bundle carries the incident plan and
+    checkpoint shape alongside the serve scenario, and replays through
+    ``run_drill``. ``source`` is set by replay runs: the bundle identity
     they were driven from, so re-recording a replay reproduces the
     original bundle byte-for-byte."""
     sc = cfg.serve
@@ -176,6 +182,7 @@ def journal_replay_stamp(
 
     stamp = {
         "bundle_schema": BUNDLE_SCHEMA,
+        "workload": "drill" if drill is not None else "serve",
         "scenario": {
             "arrivals": [float(r.arrival_s) for r in schedule],
             "rate_rps": float(rate_rps),
@@ -199,6 +206,9 @@ def journal_replay_stamp(
                 ],
                 "resize_window_s": float(sc.resize_window_s),
             },
+            # Emitted unconditionally (None for serve) — the bundle
+            # field catalog is a drift-guard surface, never optional.
+            "drill": drill,
         },
         "baseline": distill_baseline(
             serve_extra, errors=errors, p99_ms=p99_ms,
@@ -209,6 +219,106 @@ def journal_replay_stamp(
     if source:
         stamp["source"] = dict(source)
     return stamp
+
+
+def drill_replay_plan(cfg, drill_extra: dict,
+                      save_interval_s: float) -> dict:
+    """The drill half of a replay stamp: the incident plan (kill/join
+    epochs, restore identity, save cadence), the checkpoint shape the
+    run rebuilds deterministically (shard contents are
+    ``shard_content``-derived, so only the SHAPE needs recording), and
+    the distilled drill baseline a replay diffs against.
+    ``save_interval_s`` is the EFFECTIVE interval (sweep points override
+    the config's)."""
+    dc, lc, sc = cfg.drill, cfg.lifecycle, cfg.serve
+    return {
+        "plan": {
+            "kill_at_s": float(dc.kill_at_s),
+            "join_at_s": float(dc.join_at_s),
+            "victim": int(
+                dc.victim if dc.victim >= 0 else sc.hosts - 1
+            ),
+            "restore_class": dc.restore_class,
+            "restore_priority": int(dc.restore_priority),
+            "restore_weight": float(dc.restore_weight),
+            "restore_deadline_ms": float(dc.restore_deadline_ms),
+            "restore_inflight": int(dc.restore_inflight),
+            "restore_retries": int(dc.restore_retries),
+            "restore_via_coop": bool(dc.restore_via_coop),
+            "save_interval_s": float(save_interval_s),
+            "delta_saves": bool(dc.delta_saves),
+            "dirty_fraction": float(dc.dirty_fraction),
+            "meta_rate_rps": float(dc.meta_rate_rps),
+        },
+        "checkpoint": {
+            "objects": int(lc.objects),
+            "object_bytes": int(lc.object_bytes),
+            "part_bytes": int(lc.part_bytes),
+            "prefix": lc.prefix,
+            "seed": int(lc.seed),
+            "meta_objects": int(lc.meta_objects),
+            "meta_object_bytes": int(lc.meta_object_bytes),
+        },
+        "baseline": distill_drill(drill_extra),
+    }
+
+
+def distill_drill(drill_extra: dict) -> dict:
+    """The replay-comparable core of a drill scorecard — the incident
+    numbers a replayed drill is judged against."""
+    d = drill_extra or {}
+    rst = d.get("restore") or {}
+    saves = d.get("saves") or {}
+    amp = d.get("amplification") or {}
+    slo = d.get("gold_slo") or {}
+    return {
+        "time_to_restore_s": rst.get("time_to_restore_s"),
+        "time_to_rewarm_s": d.get("time_to_rewarm_s"),
+        "restore_verified": rst.get("verified"),
+        "shards_restored": rst.get("shards_restored"),
+        "torn_rereads": rst.get("torn_rereads"),
+        "forced_direct": rst.get("forced_direct"),
+        "restore_errors": rst.get("errors"),
+        "slo_restore_window": dict(slo.get("restore_window") or {}),
+        "slo_steady": dict(slo.get("steady") or {}),
+        "save_passes": saves.get("passes"),
+        "save_uploaded_shards": saves.get("uploaded_shards"),
+        "save_cas_conflicts": saves.get("cas_conflicts"),
+        "save_bytes_uploaded": saves.get("bytes_uploaded"),
+        "origin_amplification": amp.get("ratio"),
+    }
+
+
+def drill_diff(baseline: dict, replayed: dict) -> dict:
+    """Drill replay-vs-original deltas, None-safe — the drill analogue
+    of :func:`scorecard_diff` (which still covers the serve half)."""
+    b, r = baseline or {}, replayed or {}
+    slo_deltas = {}
+    b_slo = b.get("slo_restore_window") or {}
+    r_slo = r.get("slo_restore_window") or {}
+    for cls in sorted(set(b_slo) & set(r_slo)):
+        if b_slo[cls] is not None and r_slo[cls] is not None:
+            slo_deltas[cls] = (r_slo[cls] - b_slo[cls]) * 100.0
+    worst = min(slo_deltas.values()) if slo_deltas else None
+    return {
+        "time_to_restore_ratio": _ratio(
+            r.get("time_to_restore_s"), b.get("time_to_restore_s")
+        ),
+        "verified_match": (
+            bool(b.get("restore_verified"))
+            == bool(r.get("restore_verified"))
+        ),
+        "restore_slo_delta_pts": slo_deltas,
+        "worst_restore_slo_delta_pts": worst,
+        "amplification_ratio": _ratio(
+            r.get("origin_amplification"), b.get("origin_amplification")
+        ),
+        "save_pass_delta": (
+            r["save_passes"] - b["save_passes"]
+            if r.get("save_passes") is not None
+            and b.get("save_passes") is not None else None
+        ),
+    }
 
 
 def bundle_from_stamp(
@@ -223,7 +333,7 @@ def bundle_from_stamp(
     bundle = {
         "format": BUNDLE_FORMAT,
         "name": name or src.get("name") or "unnamed",
-        "workload": "serve",
+        "workload": stamp.get("workload", "serve"),
         "journal_schema": int(journal_schema),
         "config_fingerprint": (
             src.get("fingerprint") or stamp.get("fingerprint")
@@ -231,6 +341,9 @@ def bundle_from_stamp(
         "baseline": src.get("baseline") or stamp.get("baseline"),
     }
     bundle.update(stamp["scenario"])
+    # Pre-drill stamps (older journals) have no drill key: rebuild them
+    # as explicit serve bundles rather than missing-field refusals.
+    bundle.setdefault("drill", None)
     return bundle
 
 
@@ -334,10 +447,10 @@ def validate_bundle(bundle: dict, path: str) -> None:
         raise SystemExit(
             f"{path}: replay bundle missing fields: {', '.join(missing)}"
         )
-    if bundle.get("workload") != "serve":
+    if bundle.get("workload") not in ("serve", "drill"):
         raise SystemExit(
             f"{path}: bundle workload {bundle.get('workload')!r} is not "
-            "replayable (serve only)"
+            "replayable (serve and drill only)"
         )
     from tpubench.obs.flight import JOURNAL_SCHEMA
 

@@ -41,6 +41,8 @@ from tpubench.metrics.report import RunResult
 from tpubench.replay.bundle import (
     config_fingerprint,
     distill_baseline,
+    distill_drill,
+    drill_diff,
     scorecard_diff,
 )
 
@@ -74,6 +76,21 @@ def _scenario_config(cfg: BenchConfig, bundle: dict,
     # own replay stamp re-records); the ARMED plan is scaled below.
     rcfg.transport.fault = FaultConfig(**(bundle.get("fault") or {}))
     validate_fault_config(rcfg.transport.fault, "bundle fault")
+    drill = bundle.get("drill") or None
+    if drill:
+        # The incident plan and checkpoint shape are scenario, not
+        # system: a drill bundle replays the SAME kill/join/save/restore
+        # script under the caller's stack. Unknown plan keys (newer
+        # bundle) are refused by validate_bundle's field check upstream;
+        # here only knobs this build knows are folded.
+        dc = rcfg.drill
+        for k, v in (drill.get("plan") or {}).items():
+            if hasattr(dc, k):
+                setattr(dc, k, v)
+        lc = rcfg.lifecycle
+        for k, v in (drill.get("checkpoint") or {}).items():
+            if hasattr(lc, k):
+                setattr(lc, k, v)
     return rcfg
 
 
@@ -91,6 +108,8 @@ def run_replay(cfg: BenchConfig, bundle: dict, tracer=None) -> RunResult:
         spawn_hermetic_server,
     )
     from tpubench.workloads.serve import run_serve
+
+    is_drill = bundle.get("workload") == "drill"
 
     proto = cfg.transport.protocol
     if proto not in ("fake", "http") or (
@@ -154,14 +173,33 @@ def run_replay(cfg: BenchConfig, bundle: dict, tracer=None) -> RunResult:
         except Exception:  # noqa: BLE001 — the run will surface it
             pass
         plan.arm()
-        res = run_serve(
-            rcfg, backend=backend, tracer=tracer,
-            replay_source={
-                "name": bundle["name"],
-                "fingerprint": bundle["config_fingerprint"],
-                "baseline": bundle["baseline"],
-            },
-        )
+        replay_source = {
+            "name": bundle["name"],
+            "fingerprint": bundle["config_fingerprint"],
+            "baseline": bundle["baseline"],
+        }
+        if is_drill:
+            from tpubench.workloads.drill import run_drill
+
+            # The original drill block passes through so re-recording a
+            # drill replay reproduces the ORIGINAL bundle (plan and
+            # checkpoint shape rebuild identically anyway; the BASELINE
+            # must be the original's, not the replay's).
+            replay_source["drill"] = bundle.get("drill")
+
+            res = run_drill(
+                rcfg, backend=backend, tracer=tracer,
+                replay_source=replay_source,
+                save_interval_s=(
+                    (bundle["drill"].get("plan") or {})
+                    .get("save_interval_s")
+                ),
+            )
+        else:
+            res = run_serve(
+                rcfg, backend=backend, tracer=tracer,
+                replay_source=replay_source,
+            )
     finally:
         if backend is not None:
             backend.close()
@@ -194,4 +232,12 @@ def run_replay(cfg: BenchConfig, bundle: dict, tracer=None) -> RunResult:
         "replayed": replayed,
         "diff": scorecard_diff(baseline, replayed),
     }
+    if is_drill:
+        drill_baseline = (bundle.get("drill") or {}).get("baseline") or {}
+        drill_replayed = distill_drill(res.extra.get("drill") or {})
+        res.extra["replay"]["drill"] = {
+            "baseline": drill_baseline,
+            "replayed": drill_replayed,
+            "diff": drill_diff(drill_baseline, drill_replayed),
+        }
     return res

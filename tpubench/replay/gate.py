@@ -90,14 +90,42 @@ def metric_namespace(doc: dict) -> dict:
               "completed", "shed", "jain_fairness"):
         _put(ns, k, sv.get(k))
     classes = sv.get("classes") or {}
+    gold_name = None
     if classes:
-        gold = min(
-            classes.values(), key=lambda c: c.get("priority", 0)
+        gold_name, gold = min(
+            classes.items(), key=lambda kv: kv[1].get("priority", 0)
         )
         _put(ns, "gold_slo", gold.get("slo_attainment"))
         _put(ns, "gold_p99_ms", gold.get("p99_ms"))
     knee = (sv.get("sweep") or {}).get("knee") or {}
     _put(ns, "knee_rps", knee.get("offered_rps"))
+    dr = extra.get("drill") or {}
+    if dr:
+        rst = dr.get("restore") or {}
+        _put(ns, "time_to_restore_s", rst.get("time_to_restore_s"))
+        _put(ns, "restore_verified", rst.get("verified"))
+        _put(ns, "restore_errors", rst.get("errors"))
+        _put(ns, "restore_torn_rereads", rst.get("torn_rereads"))
+        _put(ns, "restore_forced_direct", rst.get("forced_direct"))
+        _put(ns, "time_to_rewarm_s", dr.get("time_to_rewarm_s"))
+        saves = dr.get("saves") or {}
+        _put(ns, "save_cas_conflicts", saves.get("cas_conflicts"))
+        _put(ns, "save_errors", saves.get("errors"))
+        _put(ns, "save_bytes_uploaded", saves.get("bytes_uploaded"))
+        amp = dr.get("amplification") or {}
+        _put(ns, "origin_amplification", amp.get("ratio"))
+        slo = dr.get("gold_slo") or {}
+        if gold_name is not None:
+            _put(
+                ns, "drill_gold_slo_restore",
+                (slo.get("restore_window") or {}).get(gold_name),
+            )
+            _put(
+                ns, "drill_gold_slo_steady",
+                (slo.get("steady") or {}).get(gold_name),
+            )
+    dknee = (extra.get("drill_sweep") or {}).get("knee") or {}
+    _put(ns, "save_knee_rps", dknee.get("offered_rps"))
     mb = extra.get("membership") or {}
     if mb:
         rewarms = [
@@ -120,6 +148,11 @@ def metric_namespace(doc: dict) -> dict:
         # The diff wins every collision: in a replay document,
         # goodput_retention MEANS replay-vs-original.
         for k, v in (rp.get("diff") or {}).items():
+            _put(ns, k, v)
+        drp = rp.get("drill") or {}
+        for k, v in (drp.get("replayed") or {}).items():
+            _put(ns, k, v)
+        for k, v in (drp.get("diff") or {}).items():
             _put(ns, k, v)
     return ns
 

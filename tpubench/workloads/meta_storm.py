@@ -39,7 +39,8 @@ def populate_meta_objects(backend, prefix: str, count: int,
 
 
 def _storm_point(cfg: BenchConfig, backend, names: list[str],
-                 rate_rps: float, flight, tlabel: str) -> dict:
+                 rate_rps: float, flight, tlabel: str,
+                 ledger=None) -> dict:
     lc = cfg.lifecycle
     schedule = build_storm_schedule(
         names,
@@ -61,6 +62,7 @@ def _storm_point(cfg: BenchConfig, backend, names: list[str],
         read_bytes=lc.meta_read_bytes,
         flight=flight,
         transport_label=tlabel,
+        ledger=ledger,
     )
 
 

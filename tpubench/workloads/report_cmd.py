@@ -67,6 +67,19 @@ def _axis(run: dict) -> str:
         bits.append("serve " + ("qos" if sv.get("qos") else "qos-off"))
         if sv.get("sweep"):
             bits.append("sweep")
+    dr = run.get("extra", {}).get("drill")
+    if dr:
+        # The drill's own A/B axes: restore-through-coop vs direct-to-
+        # origin, and delta vs full saves — the two arms the scorecard
+        # diff exists to compare must never render as twins.
+        arm = dr.get("arm") or {}
+        bits.append(
+            "drill "
+            + ("coop" if arm.get("restore_via_coop") else "direct")
+            + ("+delta" if arm.get("delta_saves") else "+full")
+        )
+        if run.get("extra", {}).get("drill_sweep"):
+            bits.append("save-sweep")
     rp = run.get("extra", {}).get("replay")
     if rp:
         # Replay runs label the bundle they re-drove; an A/B replay
@@ -173,6 +186,21 @@ def summarize_run(run: dict, label: str = "") -> str:
         from tpubench.workloads.serve import format_membership_scorecard
 
         lines.append(format_membership_scorecard(mb))
+    dr = extra.get("drill")
+    if dr:
+        # Incident-drill scorecard: same body `tpubench drill` printed
+        # live — time-to-restore vs time-to-rewarm, gold SLO during the
+        # restore window vs steady state, delta-save ledger, origin-byte
+        # amplification.
+        from tpubench.workloads.drill import format_drill_scorecard
+
+        lines.append(format_drill_scorecard(dr))
+    ds = extra.get("drill_sweep")
+    if ds:
+        # Save-interval sweep curve with the knee identified.
+        from tpubench.workloads.drill import format_drill_sweep
+
+        lines.append(format_drill_sweep(ds))
     rp = extra.get("replay")
     if rp:
         # Replay-vs-original scorecard diff: the same body `tpubench
@@ -364,6 +392,46 @@ def compare_runs(runs: list[dict]) -> str:
                 + (f"{bg2:.1%}" if bg2 is not None else "n/a")
                 + ", failovers "
                 f"{omb.get('failovers', 0)} vs {bmb.get('failovers', 0)}"
+            )
+        # Drill diff: the restore-through-coop arm against the direct-
+        # to-origin arm (or delta vs full saves) compares on what the
+        # drill exists for — time-to-restore, the protected class's SLO
+        # through the restore window, origin-byte amplification, and
+        # what the save cadence uploaded.
+        odr = other.get("extra", {}).get("drill")
+        bdr = base.get("extra", {}).get("drill")
+        if odr and bdr:
+            def _gold_restore_slo(doc, dr):
+                # Gold = the min-priority serving class; the restore
+                # class never appears in the arrival-SLO tally.
+                cl = (doc.get("extra", {}).get("serve") or {}) \
+                    .get("classes") or {}
+                win = (dr.get("gold_slo") or {}).get("restore_window") or {}
+                names = [n for n in win if n in cl]
+                if not names:
+                    return None
+                gold = min(names, key=lambda n: cl[n].get("priority", 0))
+                return win.get(gold)
+
+            og2 = _gold_restore_slo(other, odr)
+            bg3 = _gold_restore_slo(base, bdr)
+            lines.append(
+                "    drill: time-to-restore "
+                f"{cell(odr, '{:.3f}s', 'restore', 'time_to_restore_s')} vs "
+                f"{cell(bdr, '{:.3f}s', 'restore', 'time_to_restore_s')}, "
+                "gold SLO in restore window "
+                + (f"{og2:.1%}" if og2 is not None else "n/a")
+                + " vs "
+                + (f"{bg3:.1%}" if bg3 is not None else "n/a")
+                + ", amplification "
+                f"{cell(odr, '{:.2f}x', 'amplification', 'ratio')} vs "
+                f"{cell(bdr, '{:.2f}x', 'amplification', 'ratio')}, "
+                "save bytes "
+                f"{(odr.get('saves') or {}).get('bytes_uploaded', 0)} vs "
+                f"{(bdr.get('saves') or {}).get('bytes_uploaded', 0)}, "
+                "cas conflicts "
+                f"{(odr.get('saves') or {}).get('cas_conflicts', 0)} vs "
+                f"{(bdr.get('saves') or {}).get('cas_conflicts', 0)}"
             )
         # Lifecycle diff: two saves (e.g. faulted vs clean, or part-size
         # A/B) compare on what the write path exists for — goodput,

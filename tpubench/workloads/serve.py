@@ -1090,127 +1090,139 @@ class _ElasticServe:
                               windows, snapshots, per_host, failovers,
                               no_live_host_errors, pool_leaks, classes,
                               fabric) -> dict:
-        sc = self.cfg.serve
+        return membership_scorecard(
+            self.cfg.serve, schedule, outcome, events_out, windows,
+            snapshots, per_host, failovers, no_live_host_errors,
+            pool_leaks, classes, fabric,
+        )
 
-        # Per-class SLO, resize windows vs steady state — by ARRIVAL
+
+def membership_scorecard(sc, schedule, outcome, events_out,
+                         windows, snapshots, per_host, failovers,
+                         no_live_host_errors, pool_leaks, classes,
+                         fabric) -> dict:
+    """The resize scorecard (``extra["membership"]``), shared by the
+    elastic serve plane and the incident drill (workloads/drill.py) —
+    their A/B must never come from scorecard-math drift."""
+    # Per-class SLO, resize windows vs steady state — by ARRIVAL
         # time (the open-loop convention: the system owns everything
         # that arrived in the window, including what it shed).
-        split: dict = {"resize": {}, "steady": {}}
-        counts = {"resize": 0, "steady": 0}
-        tally: dict = {}
-        for req in schedule:
-            seg = "resize" if _in_windows(req.arrival_s, windows) \
-                else "steady"
-            counts[seg] += 1
-            met, tot = tally.get((seg, req.tenant.cls), (0, 0))
-            tally[(seg, req.tenant.cls)] = (
-                met + (1 if outcome[req.index] else 0), tot + 1
-            )
-        for c in classes:
-            cls = str(c["name"])
-            for seg in ("resize", "steady"):
-                met, tot = tally.get((seg, cls), (0, 0))
-                split[seg][cls] = (met / tot) if tot else None
-
-        # Counter series helpers over the (virtual-time, aggregate)
-        # snapshots: value at t = the last snapshot at or before t.
-        def value_at(t: float, key: str) -> int:
-            v = 0
-            for st, agg in snapshots:
-                if st <= t:
-                    v = agg.get(key, 0)
-                else:
-                    break
-            return v
-
-        total_origin = snapshots[-1][1].get("origin_bytes", 0) \
-            if snapshots else 0
-        # Clip windows to the run's virtual span for the byte/length
-        # split: an event near the bell opens a window that extends
-        # past end-of-run, and charging that phantom tail would both
-        # shrink steady_len and inflate steady_rate_bps — exactly the
-        # comparison this block exists to keep honest.
-        clipped = [
-            (min(w0, sc.duration_s), min(w1, sc.duration_s))
-            for w0, w1 in windows
-        ]
-        window_origin = sum(
-            value_at(w1, "origin_bytes") - value_at(w0, "origin_bytes")
-            for w0, w1 in clipped
+    split: dict = {"resize": {}, "steady": {}}
+    counts = {"resize": 0, "steady": 0}
+    tally: dict = {}
+    for req in schedule:
+        seg = "resize" if _in_windows(req.arrival_s, windows) \
+            else "steady"
+        counts[seg] += 1
+        met, tot = tally.get((seg, req.tenant.cls), (0, 0))
+        tally[(seg, req.tenant.cls)] = (
+            met + (1 if outcome[req.index] else 0), tot + 1
         )
-        window_len = sum(w1 - w0 for w0, w1 in clipped)
-        steady_len = max(0.0, sc.duration_s - window_len)
-        steady_origin = max(0, total_origin - window_origin)
-        steady_rate = steady_origin / steady_len if steady_len > 0 \
-            else None
+    for c in classes:
+        cls = str(c["name"])
+        for seg in ("resize", "steady"):
+            met, tot = tally.get((seg, cls), (0, 0))
+            split[seg][cls] = (met / tot) if tot else None
 
-        # Time-to-rewarm per view-changing event: first post-event
-        # snapshot window whose peer-hit ratio is back to >= 90% of the
-        # cumulative pre-event ratio.
-        def ratio(agg: dict) -> Optional[float]:
-            req = agg.get("peer_requests", 0)
-            return agg.get("peer_hits", 0) / req if req else None
+    # Counter series helpers over the (virtual-time, aggregate)
+    # snapshots: value at t = the last snapshot at or before t.
+    def value_at(t: float, key: str) -> int:
+        v = 0
+        for st, agg in snapshots:
+            if st <= t:
+                v = agg.get(key, 0)
+            else:
+                break
+        return v
 
-        for ev in events_out:
-            if ev["action"] not in (
-                "kill_host", "leave_host", "pause_host",
-            ):
-                continue
-            te = ev["t_s"]
-            pre = None
+    total_origin = snapshots[-1][1].get("origin_bytes", 0) \
+        if snapshots else 0
+    # Clip windows to the run's virtual span for the byte/length
+    # split: an event near the bell opens a window that extends
+    # past end-of-run, and charging that phantom tail would both
+    # shrink steady_len and inflate steady_rate_bps — exactly the
+    # comparison this block exists to keep honest.
+    clipped = [
+        (min(w0, sc.duration_s), min(w1, sc.duration_s))
+        for w0, w1 in windows
+    ]
+    window_origin = sum(
+        value_at(w1, "origin_bytes") - value_at(w0, "origin_bytes")
+        for w0, w1 in clipped
+    )
+    window_len = sum(w1 - w0 for w0, w1 in clipped)
+    steady_len = max(0.0, sc.duration_s - window_len)
+    steady_origin = max(0, total_origin - window_origin)
+    steady_rate = steady_origin / steady_len if steady_len > 0 \
+        else None
+
+    # Time-to-rewarm per view-changing event: first post-event
+    # snapshot window whose peer-hit ratio is back to >= 90% of the
+    # cumulative pre-event ratio.
+    def ratio(agg: dict) -> Optional[float]:
+        req = agg.get("peer_requests", 0)
+        return agg.get("peer_hits", 0) / req if req else None
+
+    for ev in events_out:
+        if ev["action"] not in (
+            "kill_host", "leave_host", "pause_host",
+        ):
+            continue
+        te = ev["t_s"]
+        pre = None
+        for st, agg in snapshots:
+            if st <= te:
+                pre = ratio(agg)
+            else:
+                break
+        ev["pre_event_peer_hit_ratio"] = pre
+        rewarm = None
+        if pre:
+            prev = None
             for st, agg in snapshots:
-                if st <= te:
-                    pre = ratio(agg)
-                else:
-                    break
-            ev["pre_event_peer_hit_ratio"] = pre
-            rewarm = None
-            if pre:
-                prev = None
-                for st, agg in snapshots:
-                    if st < te:
-                        continue
-                    if prev is not None:
-                        dreq = (agg.get("peer_requests", 0)
-                                - prev[1].get("peer_requests", 0))
-                        dhit = (agg.get("peer_hits", 0)
-                                - prev[1].get("peer_hits", 0))
-                        if dreq > 0 and dhit / dreq >= 0.9 * pre:
-                            rewarm = max(0.0, st - te)
-                            break
-                    prev = (st, agg)
-            ev["time_to_rewarm_s"] = rewarm
+                if st < te:
+                    continue
+                if prev is not None:
+                    dreq = (agg.get("peer_requests", 0)
+                            - prev[1].get("peer_requests", 0))
+                    dhit = (agg.get("peer_hits", 0)
+                            - prev[1].get("peer_hits", 0))
+                    if dreq > 0 and dhit / dreq >= 0.9 * pre:
+                        rewarm = max(0.0, st - te)
+                        break
+                prev = (st, agg)
+        ev["time_to_rewarm_s"] = rewarm
 
-        agg = fabric.aggregate()
-        final_ratio = ratio(agg)
-        return {
-            "hosts": sc.hosts,
-            "epoch": agg["epoch"],
-            "resize_window_s": sc.resize_window_s,
-            "events": events_out,
-            "windows_s": [list(w) for w in windows],
-            "slo": split,
-            "arrivals": counts,
-            "origin_bytes": {
-                "total": total_origin,
-                "resize_windows": window_origin,
-                "steady": steady_origin,
-                "steady_rate_bps": steady_rate,
-            },
-            "handoff": {
-                "out_chunks": agg["handoff_out_chunks"],
-                "out_bytes": agg["handoff_out_bytes"],
-                "in_chunks": agg["handoff_in_chunks"],
-                "in_bytes": agg["handoff_in_bytes"],
-                "rejects": agg["handoff_rejects"],
-            },
-            "peer_hit_ratio": final_ratio,
-            "pod_coalesced": agg["pod_coalesced"],
-            "failovers": failovers,
-            "no_live_host_errors": no_live_host_errors,
-            "pool_leaked_slabs": pool_leaks,
-            "per_host": per_host,
-        }
+    agg = fabric.aggregate()
+    final_ratio = ratio(agg)
+    return {
+        "hosts": sc.hosts,
+        "epoch": agg["epoch"],
+        "resize_window_s": sc.resize_window_s,
+        "events": events_out,
+        "windows_s": [list(w) for w in windows],
+        "slo": split,
+        "arrivals": counts,
+        "origin_bytes": {
+            "total": total_origin,
+            "resize_windows": window_origin,
+            "steady": steady_origin,
+            "steady_rate_bps": steady_rate,
+        },
+        "handoff": {
+            "out_chunks": agg["handoff_out_chunks"],
+            "out_bytes": agg["handoff_out_bytes"],
+            "in_chunks": agg["handoff_in_chunks"],
+            "in_bytes": agg["handoff_in_bytes"],
+            "rejects": agg["handoff_rejects"],
+        },
+        "peer_hit_ratio": final_ratio,
+        "pod_coalesced": agg["pod_coalesced"],
+        "failovers": failovers,
+        "no_live_host_errors": no_live_host_errors,
+        "pool_leaked_slabs": pool_leaks,
+        "per_host": per_host,
+    }
 
 
 def _build_serve_controller(cfg, queue, pf, guard_rec, bytes_fn, flight):
