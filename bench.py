@@ -603,6 +603,129 @@ def _ckpt_roundtrip_cell() -> dict:
     }
 
 
+def _transport_ab_cell() -> dict:
+    """Transport as a first-class A/B axis (the gRPC wire plane): the
+    SAME read grid — object size {256 KiB, 2 MiB, 16 MiB} × fan-out
+    {4, 16}, fixed seed — driven once over the native h2 client and
+    once over the dependency-free gRPC wire stack, each arm against its
+    own in-process fake server carrying an IDENTICAL fault plan (same
+    light per-open latency, same open-time 503 rate, same seed — the
+    chaos timeline is the control variable, the transport the only
+    difference; the fault is open-time rather than mid-stream so the
+    grid measures transfer goodput, not retry-restart cost). A faulted ckpt-save arm per transport rides
+    along (the mid-part reset + stall shape from the roundtrip cell,
+    injected ON THE WIRE: h2 resumable PUTs vs gRPC BidiWriteObject).
+    Goodput and read p99 per grid point are the cell's data; the smoke
+    guards (test_bench_smoke) pin that both transports complete the
+    full grid error-free, both save arms resumed parts, and neither
+    finalized corrupt bytes. CPU-only and jax-free — quiet-CPU
+    segment with the other A/B cells."""
+    from tpubench.config import BenchConfig
+    from tpubench.storage.fake import FaultPlan
+    from tpubench.workloads.chaos import hermetic_target, spawn_hermetic_server
+    from tpubench.workloads.ckpt import run_ckpt_save
+    from tpubench.workloads.read import run_read
+
+    SIZES = {"256k": 256 * 1024, "2m": 2 * MB, "16m": 16 * MB}
+    FANOUTS = (4, 16)
+    SEED = 23
+
+    def _fault() -> FaultPlan:
+        # ONE fault shape for both arms — what makes the A/B honest.
+        # Open-time 503s only: a mid-stream error RSTs the stream and
+        # forces a resume-from-offset reopen, which at the 16 MiB point
+        # turns the grid into a retry benchmark instead of a transport
+        # benchmark (and crushes the native h2 arm's goodput).
+        return FaultPlan(
+            latency_s=min(0.002, 0.002 * _SLEEP_SCALE),
+            error_rate=0.05,
+            seed=SEED,
+        )
+
+    def _cfg(proto: str) -> "BenchConfig":
+        cfg = BenchConfig()
+        cfg.transport.protocol = proto
+        if proto == "http":
+            cfg.transport.http2 = True
+        # Retry pacing shrunk to bench scale (the gax 1 s initial would
+        # dominate the injected open-time 503s' recovery).
+        cfg.transport.retry.initial_backoff_s = 0.005
+        cfg.transport.retry.max_backoff_s = 0.02
+        cfg.staging.mode = "none"
+        cfg.obs.export = "none"
+        return cfg
+
+    def _read_arm(proto: str) -> dict:
+        grid: dict = {}
+        for sname, size in SIZES.items():
+            cfg = _cfg(proto)
+            w = cfg.workload
+            w.object_size = size
+            w.threads = 2
+            w.workers = max(FANOUTS)  # population covers the widest fan-out
+            server = spawn_hermetic_server(cfg, fault_plan=_fault())
+            try:
+                for fan in FANOUTS:
+                    w.workers = fan
+                    # ~constant bytes per worker across sizes keeps the
+                    # big points from dominating the cell's wall.
+                    w.read_calls_per_worker = max(1, (4 * MB) // size)
+                    res = run_read(cfg)
+                    s = res.summaries.get("read")
+                    grid[f"{sname}_w{fan}"] = {
+                        "gbps": round(res.gbps, 4),
+                        "p99_ms": (
+                            round(s.to_dict().get("p99_ms", 0.0), 3)
+                            if s is not None else None
+                        ),
+                        "errors": res.errors,
+                    }
+            finally:
+                server.stop()
+        return grid
+
+    def _save_arm(proto: str) -> dict:
+        cfg = _cfg(proto)
+        cfg.workload.workers = 2
+        cfg.workload.object_size = 256 * 1024  # tiny prepopulated store
+        lc = cfg.lifecycle
+        lc.objects = 2
+        lc.object_bytes = 3 * MB
+        lc.part_bytes = 512 * 1024
+        lc.writers = 2
+        lc.restore_device = False  # quiet-CPU segment stays jax-free
+        # Mid-part reset + probabilistic stall, injected on the wire —
+        # the same shape for both transports.
+        f = cfg.transport.fault
+        f.upload_reset_after_bytes = 1 * MB + 128 * 1024
+        f.upload_stall_s = min(0.01, 0.01 * _SLEEP_SCALE)
+        f.upload_stall_rate = 0.5
+        f.seed = SEED
+        cfg.transport.retry.max_attempts = 100
+        with hermetic_target(cfg):
+            res = run_ckpt_save(cfg)
+        slc = res.extra["lifecycle"]
+        return {
+            "goodput_gbps": round(slc["goodput_gbps"], 4),
+            "parts": slc["parts"],
+            "resumed_parts": slc["resumed_parts"],
+            "corrupt_finalizes": slc["corrupt_finalizes"],
+            "verified": slc["verified"],
+            "errors": res.errors,
+        }
+
+    return {
+        "arms": {
+            "h2": {"read": _read_arm("http"), "save": _save_arm("http")},
+            "grpc": {"read": _read_arm("grpc"), "save": _save_arm("grpc")},
+        },
+        "grid": [f"{s}_w{f}" for s in SIZES for f in FANOUTS],
+        "fault": {"error_rate": 0.05, "seed": SEED,
+                  "upload_reset_after_bytes": 1 * MB + 128 * 1024},
+        "sleep_scale": _SLEEP_SCALE,
+    }
+
+
 def _elastic_resize_cell() -> dict:
     """Cooperative-leave vs killed-host resize A/B on the hermetic
     elastic serve pod (BENCH_r06+): two identical 4-host pods replay the
@@ -1054,6 +1177,15 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001 — the bench must not die here
         print(f"# incident drill failed: {e}", file=sys.stderr)
 
+    # h2-vs-gRPC transport A/B (the gRPC wire plane): both arms against
+    # in-process wire servers under one fault plan — hermetic, CPU-only,
+    # jax-free — quiet-CPU segment.
+    transport_ab: dict = {}
+    try:
+        transport_ab = _transport_ab_cell()
+    except Exception as e:  # noqa: BLE001 — the bench must not die here
+        print(f"# transport A/B failed: {e}", file=sys.stderr)
+
     dev = jax.local_devices()[0]  # first jax touch: AFTER the quiet-CPU A/B
 
     # Compile the pallas landing kernel at the pair slot shape BEFORE the
@@ -1328,6 +1460,7 @@ def main() -> int:
                 "ckpt_roundtrip": ckpt_roundtrip,
                 "scenario_replay": scenario_replay,
                 "incident_drill": incident_drill,
+                "transport_ab": transport_ab,
                 "shaped_verdict": shaped,
                 "probe_divergence_factor": pdf,
                 "host_cores": _usable_cores(),

@@ -154,6 +154,16 @@ def pytest_configure(config):
         "markers", "multihost: multi-process jax.distributed tests "
                    "(TPUBENCH_MULTIHOST_TESTS=1 to enable)"
     )
+    # gRPC tests run hermetically in tier-1 against the dependency-free
+    # wire stack (tpubench/storage/grpc_wire) — no grpcio, no generated
+    # storage-v2 stubs needed. The handful of tests that exercise the
+    # OPTIONAL grpcio/gapic library mode (channel construction,
+    # DirectPath c2p resolver) are env-gated like `multihost`: they need
+    # the real libraries installed, which this container lacks.
+    config.addinivalue_line(
+        "markers", "grpc_lib: grpcio/storage-v2 library-mode tests "
+                   "(TPUBENCH_GRPC_LIB_TESTS=1 to enable)"
+    )
     # Record/replay plane tests stay in tier-1 (same policy as the
     # other subsystem markers): bundle byte-determinism and the
     # replay-vs-original tolerance gate run on every pass; the marker

@@ -205,6 +205,24 @@ def test_bench_end_to_end_cpu():
     assert idr["saves"]["delta"] and idr["saves"]["passes"] > 0
     assert idr["saves"]["skipped_clean"] > 0, idr["saves"]
     assert idr["pool_leaked_slabs"] == 0
+    # Transport A/B cell (PR 18): the same faulted read grid over the
+    # native h2 client and the dependency-free gRPC wire stack, plus a
+    # faulted ckpt-save arm per transport. The smoke pins the STRUCTURE
+    # (both transports complete every grid point error-free, both save
+    # arms resumed parts after the mid-part reset and finalized zero
+    # corrupt objects) — never the goodput numbers themselves.
+    tab = d["transport_ab"]
+    assert set(tab["arms"]) == {"h2", "grpc"}, tab
+    for arm_name, arm in tab["arms"].items():
+        for point in tab["grid"]:
+            cell = arm["read"][point]
+            assert cell["gbps"] > 0, (arm_name, point, cell)
+            assert cell["errors"] == 0, (arm_name, point, cell)
+        save = arm["save"]
+        assert save["resumed_parts"] > 0, (arm_name, save)
+        assert save["corrupt_finalizes"] == 0, (arm_name, save)
+        assert save["verified"], (arm_name, save)
+        assert save["errors"] == 0, (arm_name, save)
     sweep = d["staging_depth_sweep"]
     assert set(sweep) == {"1", "2", "4"}
     assert sweep["1"]["drain"] == "inline"

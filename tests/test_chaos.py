@@ -164,8 +164,17 @@ def test_chaos_fake_stall_recovers():
 def test_chaos_requires_timeline_and_hermetic_protocol():
     with pytest.raises(SystemExit, match="timeline"):
         run_chaos(chaos_cfg(), timeline=None)
+    # grpc is hermetic now (wire fake) — but only with no endpoint
+    # override: pointing chaos at a REAL server stays rejected, for
+    # http and grpc alike.
+    for proto in ("http", "grpc"):
+        cfg = chaos_cfg()
+        cfg.transport.protocol = proto
+        cfg.transport.endpoint = "https://storage.googleapis.com"
+        with pytest.raises(SystemExit, match="hermetic"):
+            run_chaos(cfg, timeline=[list(p) for p in STALL_TL])
     cfg = chaos_cfg()
-    cfg.transport.protocol = "grpc"
+    cfg.transport.protocol = "local"
     with pytest.raises(SystemExit, match="hermetic"):
         run_chaos(cfg, timeline=[list(p) for p in STALL_TL])
 
@@ -254,6 +263,24 @@ def test_chaos_truncate_fault_over_h1_server_resumes():
     )
     assert res.errors == 0
     assert res.bytes_total == 2 * 12 * 64 * 1024
+
+
+def test_chaos_reset_fault_over_grpc_wire_resumes():
+    """Satellite: `tpubench chaos --protocol grpc` end-to-end — the
+    same mid-body reset window the h1.1 twin above survives, injected
+    on the gRPC wire (stream error → transient → resume at offset):
+    zero failed reads, bytes exact, scorecard stamped."""
+    cfg = chaos_cfg(calls=12, pace=0.001)
+    cfg.transport.protocol = "grpc"
+    cfg.transport.retry.max_attempts = 50
+    res = run_chaos(
+        cfg,
+        timeline=[[0.05, 0.3, {"reset_after_bytes": 20_000}]],
+    )
+    assert res.errors == 0
+    assert res.bytes_total == 2 * 12 * 64 * 1024
+    sc = res.extra["chaos"]["scorecard"]
+    assert sc["failed_reads"] == 0
 
 
 # ------------------------------------------------- acceptance (h2 server) --

@@ -284,6 +284,26 @@ def test_drill_acceptance_restore_while_serving(tmp_path, monkeypatch):
     assert "request_restore" in res.summaries
 
 
+def test_drill_over_grpc_wire_target(tmp_path, monkeypatch):
+    """Satellite: `tpubench drill --protocol grpc` end-to-end — the
+    incident drill's serve/save/restore planes all ride the hermetic
+    gRPC wire fake (one FakeBackend behind FakeGrpcWireServer), so the
+    drill's A/B arms can run per transport."""
+    monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+    from tpubench.workloads.chaos import hermetic_target
+    from tpubench.workloads.drill import run_drill
+
+    cfg = _drill_cfg(tmp_path, name="dg.json")
+    cfg.transport.protocol = "grpc"
+    with hermetic_target(cfg):
+        res = run_drill(cfg)
+    assert res.errors == 0
+    dr = res.extra["drill"]
+    assert dr["restore"]["completed"] and dr["restore"]["verified"]
+    assert dr["restore"]["errors"] == 0
+    assert dr["saves"]["errors"] == 0
+
+
 def test_drill_direct_arm_bypasses_coop(tmp_path, monkeypatch):
     monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
     from tpubench.workloads.drill import run_drill

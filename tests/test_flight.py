@@ -223,15 +223,15 @@ def test_h2_path_phases():
 
 
 def test_grpc_python_path_phases():
-    pytest.importorskip("google.cloud._storage_v2")
-    from tpubench.storage.fake_grpc_server import FakeGrpcGcsServer
-    from tpubench.storage.gcs_grpc import GcsGrpcBackend
+    # Hermetic: the wire-mode client against the wire fake — no grpcio,
+    # no generated stubs.
+    from tpubench.storage.fake_grpc_wire_server import FakeGrpcWireServer
 
     be = FakeBackend.prepopulated("tpubench/file_", count=1, size=512 * 1024)
-    with FakeGrpcGcsServer(be) as srv:
+    with FakeGrpcWireServer(be) as srv:
         cfg = BenchConfig()
         cfg.transport.protocol = "grpc"
-        cfg.transport.endpoint = f"insecure://{srv.address}"
+        cfg.transport.endpoint = srv.endpoint
         cfg.transport.directpath = False
         cfg.workload.workers = 1
         cfg.workload.read_calls_per_worker = 2

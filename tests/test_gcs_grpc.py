@@ -1,33 +1,44 @@
 """Hermetic gRPC (storage v2) backend tests against the in-process fake
-server — the gRPC twin of test_gcs_http."""
+server — the gRPC twin of test_gcs_http.
+
+These run with NO grpcio and NO generated storage-v2 stubs: the client
+is GcsGrpcBackend in wire mode (tpubench/storage/grpc_wire) and the
+server is FakeGrpcWireServer, both hand-rolled gRPC-over-h2. The few
+tests that exercise the optional grpcio/gapic library mode itself are
+env-gated behind the `grpc_lib` marker (TPUBENCH_GRPC_LIB_TESTS=1).
+"""
+
+import os
 
 import pytest
 
-# Optional dependency: the gRPC path needs the generated storage-v2
-# stubs (and grpcio). Collect as a clean module skip where they are
-# absent — not a collection error.
-pytest.importorskip("grpc")
-pytest.importorskip("google.cloud._storage_v2")
-
-from tpubench.config import BenchConfig, RetryConfig, TransportConfig  # noqa: E402
-from tpubench.storage import (  # noqa: E402
+from tpubench.config import BenchConfig, RetryConfig, TransportConfig
+from tpubench.storage import (
     FakeBackend,
     FaultPlan,
     RetryingBackend,
     StorageError,
 )
-from tpubench.storage.base import (  # noqa: E402
+from tpubench.storage.base import (
     deterministic_bytes,
     read_object_through,
 )
-from tpubench.storage.fake_grpc_server import FakeGcsGrpcServer  # noqa: E402
-from tpubench.storage.gcs_grpc import GcsGrpcBackend  # noqa: E402
+from tpubench.storage.fake_grpc_wire_server import FakeGrpcWireServer
+from tpubench.storage.gcs_grpc import GcsGrpcBackend
+
+# Library-mode tests need grpcio + the generated storage-v2 types
+# installed; same gating pattern as `multihost`.
+_lib_gate = pytest.mark.skipif(
+    not os.environ.get("TPUBENCH_GRPC_LIB_TESTS"),
+    reason="grpcio/storage-v2 library-mode tests disabled "
+           "(set TPUBENCH_GRPC_LIB_TESTS=1 to run)",
+)
 
 
 @pytest.fixture(scope="module")
 def server():
     be = FakeBackend.prepopulated("bench/file_", count=3, size=3_000_000)
-    with FakeGcsGrpcServer(be) as srv:
+    with FakeGrpcWireServer(be) as srv:
         yield srv
 
 
@@ -110,7 +121,7 @@ def test_unavailable_is_transient_and_retryable():
     be = FakeBackend.prepopulated(
         "bench/file_", count=1, size=100_000, fault=FaultPlan(error_rate=0.5, seed=3)
     )
-    with FakeGcsGrpcServer(be) as srv:
+    with FakeGrpcWireServer(be) as srv:
         raw = _client(srv)
         rb = RetryingBackend(
             raw,
@@ -160,11 +171,14 @@ def test_conn_pool_round_robin(server):
 # --------------------------------------------------------------- DirectPath
 
 
+@pytest.mark.grpc_lib
+@_lib_gate
 def test_directpath_builds_c2p_channel(monkeypatch):
     """transport.directpath against the real endpoint builds the google-c2p
     resolver channel with compute-engine credentials — the grpcio
     equivalent of the Go rls/xds blank imports (main.go:24-26), not an
-    env-var no-op."""
+    env-var no-op. Library mode only: wire mode has no channel factory
+    to monkeypatch."""
     import grpc as grpc_mod
 
     captured = {}
@@ -402,7 +416,7 @@ def test_native_grpc_over_tls_alpn(jax_cpu_devices):
     server's self-signed PEM, bytes match. The Python secure channel
     (stat for buffer sizing) trusts the same CA file."""
     be = FakeBackend.prepopulated("bench/file_", count=2, size=1_000_000)
-    with FakeGcsGrpcServer(be, tls=True) as srv:
+    with FakeGrpcWireServer(be, tls=True) as srv:
         t = TransportConfig(
             protocol="grpc", endpoint=srv.endpoint, directpath=False,
             native_receive=True, tls_ca_file=srv.cafile,
@@ -426,7 +440,7 @@ def test_native_grpc_over_tls_alpn(jax_cpu_devices):
 @pytestmark_native
 def test_native_grpc_tls_untrusted_cert_rejected(jax_cpu_devices):
     be = FakeBackend.prepopulated("bench/file_", count=1, size=100_000)
-    with FakeGcsGrpcServer(be, tls=True) as srv:
+    with FakeGrpcWireServer(be, tls=True) as srv:
         t = TransportConfig(
             protocol="grpc", endpoint=srv.endpoint, directpath=False,
             native_receive=True,  # no CA file: verification must fail

@@ -1,0 +1,355 @@
+"""Hermetic gRPC wire stack: codec properties, framing rejection,
+shared fault/session state across transports, and the resumable-write
+choreography under injected faults (PR 18 satellites 2 and 6).
+
+Everything here runs with no grpcio and no storage-v2 types installed —
+that is the point of the wire stack."""
+
+import random
+import threading
+
+import pytest
+
+from tpubench.config import RetryConfig, TransportConfig
+from tpubench.storage.base import StorageError, deterministic_bytes
+from tpubench.storage.fake import FakeBackend, FaultPlan
+from tpubench.storage.fake_grpc_wire_server import FakeGrpcWireServer
+from tpubench.storage.gcs_grpc import GcsGrpcBackend
+from tpubench.storage.grpc_wire import proto as wp
+from tpubench.storage.grpc_wire.framing import (
+    FrameDecoder,
+    WireCodecError,
+    encode_frame,
+    status_to_storage_error,
+    storage_error_to_status,
+)
+from tpubench.storage.retrying import RetryingBackend
+
+
+def _det(name: str, size: int) -> bytes:
+    return bytes(memoryview(deterministic_bytes(name, size)))
+
+
+def _drain(reader, granule: int = 1 << 20) -> bytes:
+    out = bytearray()
+    buf = bytearray(granule)
+    mv = memoryview(buf)
+    while True:
+        n = reader.readinto(mv)
+        if n <= 0:
+            break
+        out += mv[:n]
+    reader.close()
+    return bytes(out)
+
+
+# ---------------------------------------------------------------- codec ----
+
+
+def test_varint_roundtrip_property():
+    rng = random.Random(0xC0DEC)
+    values = [0, 1, 127, 128, 300, 2**32 - 1, 2**63, 2**64 - 1]
+    values += [rng.getrandbits(rng.randrange(1, 64)) for _ in range(500)]
+    for v in values:
+        enc = wp.encode_varint(v)
+        got, i = wp.decode_varint(enc, 0)
+        assert got == v and i == len(enc), v
+
+
+def test_varint_rejects_negative_truncated_overlong():
+    with pytest.raises(WireCodecError):
+        wp.encode_varint(-1)
+    # Truncated: continuation bit set, then nothing.
+    with pytest.raises(WireCodecError):
+        wp.decode_varint(b"\x80", 0)
+    with pytest.raises(WireCodecError):
+        wp.decode_varint(b"", 0)
+    # Overlong: 11 continuation bytes can never be a valid 64-bit varint.
+    with pytest.raises(WireCodecError):
+        wp.decode_varint(b"\x80" * 11, 0)
+
+
+def _random_bidi_request(rng: random.Random) -> wp.BidiWriteObjectRequest:
+    return wp.BidiWriteObjectRequest(
+        upload_id="upload-%d" % rng.randrange(1000) if rng.random() < 0.5 else "",
+        write_object_spec=(
+            wp.WriteObjectSpec(
+                resource=wp.Object(
+                    name="o/%d" % rng.randrange(100),
+                    bucket="projects/_/buckets/b",
+                    generation=rng.randrange(5),
+                    size=rng.randrange(1 << 40),
+                ),
+                if_generation_match=rng.choice([None, 0, 1, 7]),
+            )
+            if rng.random() < 0.5
+            else None
+        ),
+        write_offset=rng.randrange(1 << 50),
+        checksummed_data=(
+            wp.ChecksummedData(
+                content=bytes(rng.getrandbits(8) for _ in range(rng.randrange(64))),
+                crc32c=rng.choice([None, 0, rng.getrandbits(32)]),
+            )
+            if rng.random() < 0.7
+            else None
+        ),
+        state_lookup=rng.random() < 0.5,
+        flush=rng.random() < 0.5,
+        finish_write=rng.random() < 0.3,
+    )
+
+
+def test_message_roundtrip_property():
+    """Random messages survive encode→decode field-for-field, including
+    the explicit-presence cases (if_generation_match=0, crc32c=0)."""
+    rng = random.Random(0x5EED)
+    for _ in range(200):
+        msg = _random_bidi_request(rng)
+        back = wp.BidiWriteObjectRequest.decode(msg.encode())
+        assert back.upload_id == msg.upload_id
+        assert back.write_offset == msg.write_offset
+        assert back.state_lookup == msg.state_lookup
+        assert back.flush == msg.flush
+        assert back.finish_write == msg.finish_write
+        if msg.checksummed_data is None:
+            assert back.checksummed_data is None
+        else:
+            assert back.checksummed_data.content == msg.checksummed_data.content
+            assert back.checksummed_data.crc32c == msg.checksummed_data.crc32c
+        if msg.write_object_spec is None:
+            assert back.write_object_spec is None
+        else:
+            assert (
+                back.write_object_spec.if_generation_match
+                == msg.write_object_spec.if_generation_match
+            )
+            assert (
+                back.write_object_spec.resource.name
+                == msg.write_object_spec.resource.name
+            )
+
+
+def test_decode_skips_unknown_fields():
+    """A server may send fields this codec doesn't model: unknown tags
+    of every wire type are skipped, known fields around them decode."""
+    body = wp.Object(name="x", size=5).encode()
+    # field 99 varint, field 98 length-delimited, field 97 fixed32,
+    # field 96 fixed64 — all unknown to Object.
+    extra = (
+        wp.encode_varint((99 << 3) | 0) + wp.encode_varint(7)
+        + wp.encode_varint((98 << 3) | 2) + wp.encode_varint(3) + b"abc"
+        + wp.encode_varint((97 << 3) | 5) + b"\x01\x02\x03\x04"
+        + wp.encode_varint((96 << 3) | 1) + b"\x00" * 8
+    )
+    o = wp.Object.decode(extra + body)
+    assert o.name == "x" and o.size == 5
+
+
+def test_decode_never_hangs_or_short_reads():
+    """Truncations and corruptions either decode (when the cut lands on
+    a field boundary) or raise a classified WireCodecError — never an
+    uncaught exception, never a hang (satellite 6's contract)."""
+    rng = random.Random(0xBAD)
+    msg = _random_bidi_request(rng)
+    enc = msg.encode()
+    for cut in range(len(enc)):
+        try:
+            wp.BidiWriteObjectRequest.decode(enc[:cut])
+        except WireCodecError as e:
+            assert not e.transient  # corrupt bytes must not be retried
+    for _ in range(300):
+        blob = bytes(rng.getrandbits(8) for _ in range(rng.randrange(1, 40)))
+        try:
+            wp.BidiWriteObjectRequest.decode(blob)
+        except WireCodecError:
+            pass
+
+
+# -------------------------------------------------------------- framing ----
+
+
+def test_frame_roundtrip_across_arbitrary_splits():
+    rng = random.Random(7)
+    msgs = [bytes(rng.getrandbits(8) for _ in range(n)) for n in (0, 1, 100, 5000)]
+    wire = b"".join(encode_frame(m) for m in msgs)
+    for _ in range(20):
+        dec = FrameDecoder()
+        i = 0
+        got = []
+        while i < len(wire):
+            step = rng.randrange(1, 37)
+            dec.feed(wire[i : i + step])
+            i += step
+            while True:
+                m = dec.next()
+                if m is None:
+                    break
+                got.append(m)
+        dec.finish()
+        assert got == msgs
+
+
+def test_frame_rejects_compressed_flag():
+    dec = FrameDecoder()
+    dec.feed(b"\x01\x00\x00\x00\x01x")
+    with pytest.raises(WireCodecError):
+        dec.next()
+
+
+def test_frame_rejects_oversized_length():
+    dec = FrameDecoder(max_message=1024)
+    dec.feed(b"\x00\x7f\xff\xff\xff")
+    with pytest.raises(WireCodecError):
+        dec.next()
+
+
+def test_frame_rejects_truncation_at_finish():
+    """A stream that ends mid-frame is a classified error, not a silent
+    short read."""
+    dec = FrameDecoder()
+    dec.feed(encode_frame(b"hello")[:-2])
+    assert dec.next() is None  # incomplete: wait for more
+    with pytest.raises(WireCodecError):
+        dec.finish()
+
+
+def test_status_maps_are_inverse_and_classified():
+    for status, code in ((3, 400), (5, 404), (9, 412), (11, 416), (14, 503)):
+        e = status_to_storage_error(status, "x", "op")
+        assert e.code == code
+        back_status, _ = storage_error_to_status(e)
+        assert back_status == status
+    assert status_to_storage_error(14, "x", "op").transient
+    assert status_to_storage_error(4, "x", "op").transient  # DEADLINE
+    assert not status_to_storage_error(5, "x", "op").transient
+    assert not status_to_storage_error(9, "x", "op").transient
+    # Unknown-shape errors: transient → UNAVAILABLE, permanent → UNKNOWN.
+    assert storage_error_to_status(StorageError("t", transient=True))[0] == 14
+    assert storage_error_to_status(StorageError("p", transient=False))[0] == 2
+
+
+# ------------------------------------------------- shared state audit ----
+
+
+def test_h1_h2_grpc_fakes_share_one_fault_and_session_store():
+    """Satellite 2: the h1.1, h2 and gRPC wire fakes constructed over
+    one FakeBackend resolve to ONE FaultPlan epoch and ONE upload
+    session store — a transport A/B that armed two fault plans would
+    measure nothing."""
+    from tpubench.storage.fake_h2_server import FakeH2Server
+    from tpubench.storage.fake_server import FakeGcsServer
+
+    plan = FaultPlan(seed=5)
+    be = FakeBackend(fault=plan)
+    with FakeGcsServer(be) as h1, FakeH2Server(be) as h2, \
+            FakeGrpcWireServer(be) as g:
+        assert h1.backend is be and h2.backend is be and g.backend is be
+        assert h1.backend.fault is h2.backend.fault is g.backend.fault
+        plan.arm()
+        assert h1.backend.fault._epoch == g.backend.fault._epoch
+        # One session store: a session begun over the gRPC wire is
+        # visible to the shared backend (and hence to the h1/h2 upload
+        # surfaces) under the same upload id.
+        t = TransportConfig(
+            protocol="grpc", endpoint=g.endpoint, directpath=False
+        )
+        c = GcsGrpcBackend(bucket="bench", transport=t)
+        w = c.open_write("audit/obj")
+        w.write(b"z" * 70_000)
+        committed, final = be.upload_status(w._uid)
+        assert committed == 70_000 and final is None
+        w.finalize()
+        _, final = be.upload_status(w._uid)
+        assert final is not None and final.size == 70_000
+        c.close()
+
+
+# ------------------------------------------------ wire client/server ----
+
+
+@pytest.fixture()
+def wiresrv():
+    be = FakeBackend.prepopulated("bench/file_", count=3, size=3_000_000)
+    with FakeGrpcWireServer(be) as srv:
+        yield srv
+
+
+def _client(srv, **kw):
+    t = TransportConfig(
+        protocol="grpc",
+        endpoint=srv.endpoint,
+        directpath=False,
+        retry=RetryConfig(
+            jitter=False, initial_backoff_s=0.001, max_backoff_s=0.01
+        ),
+        **kw,
+    )
+    return GcsGrpcBackend(bucket="bench", transport=t)
+
+
+def test_wire_mode_refuses_real_gcs_loudly():
+    """No auth stack in the wire client: pointing it at googleapis.com
+    is a classified config error, not an eventual UNAUTHENTICATED."""
+    import tpubench.storage.gcs_grpc as m
+
+    if m._HAVE_LIB:
+        pytest.skip("library mode installed: wire refusal not reachable")
+    with pytest.raises(StorageError):
+        GcsGrpcBackend(
+            bucket="b",
+            transport=TransportConfig(protocol="grpc", directpath=False),
+        )
+
+
+def test_wire_concurrent_readers_fan_out_conns(wiresrv):
+    c = _client(wiresrv)
+    errs = []
+
+    def one(i):
+        try:
+            data = _drain(c.open_read(f"bench/file_{i % 3}"))
+            assert data == _det(f"bench/file_{i % 3}", 3_000_000)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ths = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert not errs
+    c.close()
+
+
+def test_wire_bidi_resume_under_reset_and_stall(wiresrv):
+    """The ckpt-save fault shape end to end: mid-part connection reset
+    (code 104 → server kills the socket) plus a one-shot stall; the
+    _ResumingWriter re-probes QueryWriteStatus and resends the tail.
+    Zero corrupt bytes, resumed part counted."""
+    be = wiresrv.backend
+    be.fault = FaultPlan(
+        upload_reset_after_bytes=96 * 1024,
+        upload_stall_s=0.01,
+        upload_stall_rate=0.5,
+        seed=11,
+    )
+    c = RetryingBackend(
+        _client(wiresrv),
+        RetryConfig(
+            jitter=False,
+            initial_backoff_s=0.001,
+            max_backoff_s=0.01,
+            max_attempts=100,
+        ),
+    )
+    data = _det("ck/shard0", 1_500_000)
+    w = c.open_write("ck/shard0")
+    step = 256 * 1024
+    for off in range(0, len(data), step):
+        w.write(data[off : off + step])
+    meta = w.finalize()
+    assert meta.size == len(data)
+    assert w.resumed_parts > 0
+    assert _drain(be.open_read("ck/shard0")) == data
+    c.close()

@@ -490,12 +490,13 @@ def test_pod_ingest_multiplexed_http2(h2srv):
 
 @pytest.fixture(scope="module")
 def grpcsrv():
-    grpc = pytest.importorskip("grpc")  # noqa: F841
-    pytest.importorskip("google.cloud._storage_v2")
-    from tpubench.storage.fake_grpc_server import FakeGcsGrpcServer
+    # Hermetic: the dependency-free wire fake speaks real gRPC-over-h2,
+    # so the native engine's multiplexed client runs against it with no
+    # grpcio in the image.
+    from tpubench.storage.fake_grpc_wire_server import FakeGrpcWireServer
 
     be = FakeBackend.prepopulated("bench/file_", count=4, size=3_000_000)
-    with FakeGcsGrpcServer(be) as srv:
+    with FakeGrpcWireServer(be) as srv:
         yield srv
 
 
@@ -957,18 +958,16 @@ def test_mux_retry_chains_are_per_range():
     failing for the first time in a later round still gets max_attempts
     tries of its own (ADVICE r3: one shared round counter starved
     late-failing ranges)."""
-    pytest.importorskip("grpc")
-    pytest.importorskip("google.cloud._storage_v2")
     import numpy as np
 
     from tpubench.config import BenchConfig
     from tpubench.dist.shard import ShardTable
     from tpubench.storage.base import StorageError
-    from tpubench.storage.fake_grpc_server import FakeGcsGrpcServer
+    from tpubench.storage.fake_grpc_wire_server import FakeGrpcWireServer
     from tpubench.workloads.common import fetch_shards_mux
 
     be = FakeBackend.prepopulated("bench/file_", count=1, size=4000)
-    with FakeGcsGrpcServer(be) as srv:
+    with FakeGrpcWireServer(be) as srv:
         from tpubench.config import TransportConfig
         from tpubench.storage.gcs_grpc import GcsGrpcBackend
 
@@ -1028,8 +1027,6 @@ def test_mux_retry_deadline_never_oversleeps():
     With a deadline smaller than the first backoff pause, the failing
     range must be abandoned immediately: exactly one read_ranges round,
     no backoff sleep."""
-    pytest.importorskip("grpc")
-    pytest.importorskip("google.cloud._storage_v2")
     import time as _t
 
     import numpy as np
@@ -1037,11 +1034,11 @@ def test_mux_retry_deadline_never_oversleeps():
     from tpubench.config import BenchConfig
     from tpubench.dist.shard import ShardTable
     from tpubench.storage.base import StorageError
-    from tpubench.storage.fake_grpc_server import FakeGcsGrpcServer
+    from tpubench.storage.fake_grpc_wire_server import FakeGrpcWireServer
     from tpubench.workloads.common import fetch_shards_mux
 
     be = FakeBackend.prepopulated("bench/file_", count=1, size=4000)
-    with FakeGcsGrpcServer(be) as srv:
+    with FakeGrpcWireServer(be) as srv:
         from tpubench.config import TransportConfig
         from tpubench.storage.gcs_grpc import GcsGrpcBackend
 
@@ -1105,15 +1102,13 @@ def test_pod_ingest_mux_retries_injected_faults():
     """The mux fetch path applies the gax policy to failed ranges (policy
     parity with the RetryingBackend-wrapped threaded path): injected
     UNAVAILABLEs heal and the pod verifies."""
-    grpc = pytest.importorskip("grpc")  # noqa: F841
-    pytest.importorskip("google.cloud._storage_v2")
     from tpubench.storage.fake import FaultPlan
-    from tpubench.storage.fake_grpc_server import FakeGcsGrpcServer
+    from tpubench.storage.fake_grpc_wire_server import FakeGrpcWireServer
     from tpubench.workloads.pod_ingest import run_pod_ingest
 
     be = FakeBackend.prepopulated("bench/file_", count=1, size=2_000_000)
     be.fault = FaultPlan(error_rate=0.4, seed=11)
-    with FakeGcsGrpcServer(be) as srv:
+    with FakeGrpcWireServer(be) as srv:
         cfg = BenchConfig()
         cfg.transport.protocol = "grpc"
         cfg.transport.endpoint = srv.endpoint

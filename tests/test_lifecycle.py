@@ -667,6 +667,48 @@ class TestRoundtripAcceptance:
         out2 = run_report([p1, p1])
         assert "ckpt-save:" in out2 and "resumed" in out2
 
+    def test_cli_ckpt_save_over_grpc_wire(self, tmp_path, monkeypatch,
+                                          capsys):
+        """PR 18 acceptance: ``tpubench ckpt-save --protocol grpc``
+        under a mid-part reset + stall fault timeline rides the
+        hermetic gRPC wire fake end-to-end (StartResumableWrite →
+        BidiWriteObject → QueryWriteStatus resume) — resumed parts > 0,
+        zero corrupt finalizes, byte-identity verified."""
+        monkeypatch.setenv("TPUBENCH_BENCH_SLEEP_SCALE", "0")
+        from tpubench.cli import main
+
+        cfg = _hermetic_cfg(objects=3, object_bytes=192 * 1024,
+                            part_bytes=64 * 1024)
+        f = cfg.transport.fault
+        f.upload_reset_after_bytes = 96 * 1024  # mid part 2, once/session
+        f.upload_stall_s = 0.01
+        f.upload_stall_rate = 0.5
+        f.seed = 11
+        cfg.transport.retry = RetryConfig(
+            initial_backoff_s=0.002, max_backoff_s=0.01
+        )
+        cfgp = tmp_path / "cfg.json"
+        cfgp.write_text(cfg.to_json())
+        res_dir = tmp_path / "res"
+        rc = main([
+            "ckpt-save", "--config", str(cfgp), "--protocol", "grpc",
+            "--results-dir", str(res_dir),
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "resumed" in out  # scorecard printed
+        files = [n for n in os.listdir(res_dir) if n.endswith(".json")]
+        assert len(files) == 1
+        with open(res_dir / files[0]) as fh:
+            data = json.load(fh)
+        assert data["workload"] == "ckpt_save"
+        assert data["config"]["transport"]["protocol"] == "grpc"
+        assert data["errors"] == 0
+        slc = data["extra"]["lifecycle"]
+        assert slc["resumed_parts"] > 0, slc
+        assert slc["corrupt_finalizes"] == 0
+        assert slc["verified"] is True
+
 
 # ---------------------------------------------------------------- config ----
 

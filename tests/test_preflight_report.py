@@ -166,6 +166,51 @@ def test_report_ab_deltas(tmp_path):
     assert "p50 8.000 ms (-2.000)" in out
 
 
+def test_report_transport_ab_h2_vs_grpc(tmp_path):
+    """Transport as a first-class A/B axis: an h2 run and a grpc run
+    under the SAME fault plan render with distinct transport bits in
+    their A/B labels plus a dedicated transport diff line — goodput,
+    read p99, watchdog stalls, and save goodput."""
+    fault = {"read_error_rate": 0.1, "seed": 7, "active": True}
+    a = _result_doc(proto="http", gbps=2.0, p50=5.0, p99=12.0,
+                    transport={"http2": True, "fault": fault})
+    b = _result_doc(proto="grpc", gbps=1.6, p50=6.0, p99=15.0,
+                    transport={"directpath": False, "fault": fault})
+    a["extra"] = {
+        "tail": {"watchdog": {"stalls": 1}},
+        "lifecycle": {"op": "save", "goodput_gbps": 1.9,
+                      "resumed_parts": 0, "corrupt_finalizes": 0},
+    }
+    b["extra"] = {
+        "tail": {"watchdog": {"stalls": 3}},
+        "lifecycle": {"op": "save", "goodput_gbps": 1.5,
+                      "resumed_parts": 2, "corrupt_finalizes": 0},
+    }
+    pa, pb = tmp_path / "h2.json", tmp_path / "grpc.json"
+    pa.write_text(json.dumps(a))
+    pb.write_text(json.dumps(b))
+    from tpubench.workloads.report_cmd import run_report
+
+    out = run_report([str(pa), str(pb)])
+    # The axis bit in both labels: baseline h2, the other arm grpc
+    # (DirectPath off — a hermetic wire run — carries no +dp suffix,
+    # while a DirectPath channel would render grpc+dp).
+    assert "A/B vs baseline [http+h2" in out
+    assert "[grpc " in out
+    # The transport diff line with all four comparisons.
+    assert "transport [grpc vs http+h2]:" in out
+    assert "goodput 1.6000 vs 2.0000 GB/s" in out
+    assert "read p99 15.000ms vs 12.000ms" in out
+    assert "stalls 3 vs 1" in out
+    assert "save goodput 1.5000 vs 1.9000 GB/s" in out
+    # DirectPath channels get their own bit — grpc+dp is a different
+    # transport arm than the hermetic wire run above.
+    from tpubench.workloads.report_cmd import _transport_bit
+
+    assert _transport_bit({"protocol": "grpc", "directpath": True}) \
+        == "grpc+dp"
+
+
 def test_report_bench_files(tmp_path, capsys):
     """`report` understands bench.py output lines and the driver's
     BENCH_rN.json wrapper ({"parsed": {...}}) — the files a reviewer has

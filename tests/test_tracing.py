@@ -156,17 +156,15 @@ def test_http_request_span_ends_on_error():
 
 
 def test_grpc_backend_emits_request_spans():
-    pytest.importorskip("grpc")
-    pytest.importorskip("google.cloud._storage_v2")
     from tpubench.config import TransportConfig
     from tpubench.storage import FakeBackend
     from tpubench.storage.base import read_object_through
-    from tpubench.storage.fake_grpc_server import FakeGcsGrpcServer
+    from tpubench.storage.fake_grpc_wire_server import FakeGrpcWireServer
     from tpubench.storage.gcs_grpc import GcsGrpcBackend
 
     be = FakeBackend.prepopulated("tr/file_", count=1, size=3_000_000)
     tracer = RecordingTracer()
-    with FakeGcsGrpcServer(be) as srv:
+    with FakeGrpcWireServer(be) as srv:
         t = TransportConfig(protocol="grpc", endpoint=srv.endpoint,
                             directpath=False)
         c = GcsGrpcBackend(bucket="testbucket", transport=t, tracer=tracer)
@@ -223,11 +221,9 @@ def test_make_tracer_falls_back_when_otel_broken(monkeypatch):
 def test_failed_grpc_stream_closes_span_with_error():
     """Mid-stream failure must export a FAILED request span (closed with
     the error), not an OK one."""
-    pytest.importorskip("grpc")
-    pytest.importorskip("google.cloud._storage_v2")
     from tpubench.config import TransportConfig
     from tpubench.storage import FakeBackend, FaultPlan, StorageError
-    from tpubench.storage.fake_grpc_server import FakeGcsGrpcServer
+    from tpubench.storage.fake_grpc_wire_server import FakeGrpcWireServer
     from tpubench.storage.gcs_grpc import GcsGrpcBackend
 
     be = FakeBackend.prepopulated(
@@ -235,7 +231,7 @@ def test_failed_grpc_stream_closes_span_with_error():
         fault=FaultPlan(read_error_rate=1.0, seed=5),
     )
     tracer = RecordingTracer()
-    with FakeGcsGrpcServer(be) as srv:
+    with FakeGrpcWireServer(be) as srv:
         t = TransportConfig(protocol="grpc", endpoint=srv.endpoint,
                             directpath=False)
         c = GcsGrpcBackend(bucket="testbucket", transport=t, tracer=tracer)

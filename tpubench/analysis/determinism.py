@@ -59,6 +59,15 @@ CLOCK_MODULES = (
     # drill bundle unreplayable.
     "tpubench/workloads/drill.py",
     "tpubench/lifecycle/delta.py",
+    # gRPC wire plane: the hand-rolled codec/framing/call layers and
+    # the hermetic wire server must stay clock-free (perf_counter_ns
+    # for span stamps only) — the fault timeline they serve is the
+    # record/replay control variable, so a naked wall clock or
+    # unseeded draw here would skew A/B runs that share a FaultPlan.
+    "tpubench/storage/grpc_wire/proto.py",
+    "tpubench/storage/grpc_wire/framing.py",
+    "tpubench/storage/grpc_wire/client.py",
+    "tpubench/storage/fake_grpc_wire_server.py",
 )
 
 # Paths whose classes must bound every accumulator (obs/serve planes

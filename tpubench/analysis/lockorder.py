@@ -59,6 +59,12 @@ LOCK_ORDER_FILES = (
     # Delta tracker: the shard-state lock is a leaf; CAS writes and
     # manifest uploads never run under it.
     "tpubench/lifecycle/delta.py",
+    # gRPC wire plane: the client conn's write/stream locks and the
+    # wire fake's per-conn write lock each stay leaves — backend
+    # reads, fault sleeps and session mutations all run OUTSIDE them
+    # (the h2 frame loop is single-threaded per conn by design).
+    "tpubench/storage/grpc_wire/client.py",
+    "tpubench/storage/fake_grpc_wire_server.py",
 )
 
 _LOCK_CTORS = {"Lock", "RLock", "Condition"}

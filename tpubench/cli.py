@@ -1742,12 +1742,13 @@ def main(argv=None) -> int:
                 run_drill,
                 run_drill_sweep,
             )
+            from tpubench.workloads.chaos import hermetic_target
             from tpubench.workloads.serve import (
                 format_membership_scorecard,
                 format_serve_scorecard,
             )
 
-            with tracer_session(cfg) as tracer:
+            with tracer_session(cfg) as tracer, hermetic_target(cfg):
                 if getattr(args, "drill_sweep", False):
                     res = run_drill_sweep(cfg, tracer=tracer)
                 else:
@@ -1804,6 +1805,7 @@ def main(argv=None) -> int:
             print(format_tune_block(res.extra["tune"]))
         elif args.cmd in ("ckpt-save", "ckpt-restore"):
             from tpubench.lifecycle import format_lifecycle_scorecard
+            from tpubench.workloads.chaos import hermetic_target
             from tpubench.workloads.ckpt import (
                 run_ckpt_restore,
                 run_ckpt_save,
@@ -1812,7 +1814,11 @@ def main(argv=None) -> int:
             runner = (
                 run_ckpt_save if args.cmd == "ckpt-save" else run_ckpt_restore
             )
-            res = runner(cfg)
+            # http/grpc with no endpoint = hermetic: the write path runs
+            # against the matching in-process fake server, transport.fault
+            # injected on the wire.
+            with hermetic_target(cfg):
+                res = runner(cfg)
             print(format_lifecycle_scorecard(res.extra["lifecycle"]))
         elif args.cmd == "meta-storm":
             from tpubench.lifecycle import format_lifecycle_scorecard

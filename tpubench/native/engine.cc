@@ -446,9 +446,9 @@ int tb_http_connect(const char* host, int port) {
 int tb_http_close(int fd) { return close(fd) == 0 ? 0 : -errno; }
 
 // ------------------------------------------------------------------- TLS --
-// TLS via dlopen(libssl.so.3): the image ships OpenSSL runtime libraries
-// but not headers, so the handful of client-side entry points are declared
-// here and resolved at first use. The receive loop itself is shared with
+// TLS via dlopen(libssl.so.3 / .so.1.1): the image ships OpenSSL runtime
+// libraries but not headers, so the handful of client-side entry points are
+// declared here and resolved at first use. The receive loop itself is shared with
 // the plaintext path through the tb_conn vtable below — TLS is a transport
 // detail, not a second implementation.
 namespace tls {
@@ -483,9 +483,13 @@ static int (*X509_VERIFY_PARAM_set1_ip_asc_)(void*, const char*) = nullptr;
 static bool do_load() {
   // RTLD_GLOBAL so libssl can resolve its libcrypto dependency if the
   // loader brings them in separately.
+  // Try 3.x, then 1.1 (every symbol used here exists since 1.0.2),
+  // then the unversioned dev symlink.
   libcrypto = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+  if (!libcrypto) libcrypto = dlopen("libcrypto.so.1.1", RTLD_NOW | RTLD_GLOBAL);
   if (!libcrypto) libcrypto = dlopen("libcrypto.so", RTLD_NOW | RTLD_GLOBAL);
   libssl = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+  if (!libssl) libssl = dlopen("libssl.so.1.1", RTLD_NOW | RTLD_GLOBAL);
   if (!libssl) libssl = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
   if (!libssl || !libcrypto) return false;
 #define TB_SYM(lib, name)                                       \

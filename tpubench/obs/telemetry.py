@@ -400,6 +400,12 @@ COUNTER_METRICS = {
         "(committed offset re-probed, tail resent)",
     "tpubench_upload_bytes_total":
         "bytes finalized through resumable uploads",
+    "tpubench_grpc_frames_total":
+        "gRPC wire events on client calls "
+        "(stream open / message sent / message received)",
+    "tpubench_bidi_acks_total":
+        "BidiWriteObject persisted-size acks received "
+        "(one per lockstep flush)",
     "tpubench_meta_ops_total":
         "open-loop metadata ops completed (meta-storm list/stat/open)",
     "tpubench_meta_errors_total": "metadata ops that failed",
@@ -616,6 +622,10 @@ class FlightFeeder:
                     reg.get("tpubench_upload_resumed_parts_total").inc()
             elif nk == "part":
                 reg.get("tpubench_upload_parts_total").inc()
+            elif nk == "grpc_frame":
+                reg.get("tpubench_grpc_frames_total").inc()
+            elif nk == "bidi_ack":
+                reg.get("tpubench_bidi_acks_total").inc()
             elif nk == "hedge":
                 if n.get("event") == "launch":
                     reg.get("tpubench_hedges_total").inc()

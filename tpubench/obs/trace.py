@@ -74,6 +74,10 @@ SPAN_KINDS = {
 NOTE_SPANS = {
     "retry": "one retry/resume attempt (span covers the backoff pause)",
     "hedge": "one hedged-read leg (launch to win/lose verdict)",
+    "grpc_frame": "one gRPC wire event on a client call — stream open, "
+                  "message sent, or message received (point span)",
+    "bidi_ack": "one BidiWriteObject persisted-size ack — the server's "
+                "committed state after a lockstep flush (point span)",
 }
 
 _PHASE_HELP = {
@@ -213,6 +217,17 @@ def _synth_children(node: SpanNode, rec: dict) -> list[SpanNode]:
                 trace_id=node.trace_id, parent_id=node.span_id,
                 name="retry", kind=node.kind, host=node.host,
                 start_ns=t, end_ns=end, synth=True,
+            ))
+            idx += 1
+        elif nk in ("grpc_frame", "bidi_ack"):
+            # Point spans: the wire event has no duration story of its
+            # own — its value is WHERE it lands on the parent's timeline
+            # (ack cadence exposes lockstep stalls in the trace view).
+            out.append(SpanNode(
+                span_id=derive_span_id(node.span_id, f"{nk}#{idx}"),
+                trace_id=node.trace_id, parent_id=node.span_id,
+                name=nk, kind=node.kind, host=node.host,
+                start_ns=t, end_ns=t, synth=True,
             ))
             idx += 1
         elif nk == "hedge":

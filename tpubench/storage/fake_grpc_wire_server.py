@@ -1,0 +1,572 @@
+"""Hermetic storage-v2 gRPC server speaking raw HTTP/2 frames — no grpcio.
+
+The wire twin of :class:`FakeGcsGrpcServer`: same ``endpoint`` shape
+(``insecure://host:port`` h2c, ``host:port`` + ``cafile`` for TLS), same
+constructor, same context-manager lifecycle — tests retarget by class
+swap. It serves ReadObject / GetObject / ListObjects / DeleteObject /
+StartResumableWrite / WriteObject / BidiWriteObject / QueryWriteStatus
+from the SAME :class:`FakeBackend` instance the h1.1 and h2 fakes use,
+so one FaultPlan epoch and one ``_UploadSession`` store govern every
+transport in a run — a transport A/B under chaos compares transports,
+not two independently-armed fault timelines.
+
+Fault surfaces, kept aligned with the other fakes:
+
+- read-plane open faults (latency, error_rate, 404/416) fire inside
+  ``backend.open_read``/``stat`` and map to grpc-status trailers;
+- mid-stream read faults from the backend reader map to trailers,
+  EXCEPT the injected connection-reset shape (StorageError code 104)
+  which kills the socket with an RST — the client must exercise its
+  EOF path, exactly as against the h1.1 fake's mid-body close;
+- upload faults (503 rolls, the one-shot stall, commit-a-prefix-then-
+  reset) fire inside ``backend.upload_append`` — the stall manifests
+  as a delayed bidi ack, the reset as a dead socket mid-stream.
+
+Unlike :class:`fake_h2_server._Conn` (whose frame loop discards DATA —
+it serves GETs), this loop routes DATA payloads into per-stream queues
+so client-streaming and bidi methods consume messages incrementally.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import ssl
+import struct
+import threading
+import time
+from typing import Optional
+
+from tpubench.storage.base import StorageError
+from tpubench.storage.fake import FakeBackend
+from tpubench.storage.fake_h2_server import (
+    _PREFACE,
+    _HpackError,
+    _hp_literal,
+    decode_request_headers,
+)
+from tpubench.storage.grpc_wire import proto
+from tpubench.storage.grpc_wire.framing import (
+    OK,
+    FrameDecoder,
+    WireCodecError,
+    encode_frame,
+    storage_error_to_status,
+)
+
+_DATA, _HEADERS, _RST_STREAM, _SETTINGS, _PING, _GOAWAY = 0, 1, 3, 4, 6, 7
+_UNIMPLEMENTED = 12
+
+# Largest content per ReadObjectResponse — mirrors the library path's
+# server (google.storage.v2 caps ChecksummedData at 2 MiB).
+MAX_READ_CHUNK = 2 * 1024 * 1024
+
+
+class _Kill(Exception):
+    """Socket already aborted (injected reset); unwind silently."""
+
+
+class _Stream:
+    def __init__(self, stream_id: int, headers: dict):
+        self.id = stream_id
+        self.headers = headers
+        self.q: "queue.Queue[Optional[bytes]]" = queue.Queue()
+        self.cancelled = threading.Event()
+
+
+class _Rsp:
+    """Per-stream response side: lazy initial HEADERS, framed DATA
+    messages, trailers (trailers-only when nothing was sent yet)."""
+
+    def __init__(self, conn: "_GrpcConn", stream_id: int):
+        self._conn = conn
+        self._sid = stream_id
+        self._opened = False
+        self.done = False
+
+    def msg(self, m: "proto.Msg") -> None:
+        conn = self._conn
+        if not self._opened:
+            self._opened = True
+            conn.send_frame(
+                _HEADERS, 0x4, self._sid,
+                _hp_literal(":status", "200")
+                + _hp_literal("content-type", "application/grpc"),
+            )
+        framed = encode_frame(m.encode())
+        mv = memoryview(framed)
+        step = conn.client_max_frame
+        for off in range(0, len(mv), step):
+            conn.send_frame(_DATA, 0, self._sid, bytes(mv[off : off + step]))
+
+    def trailers(self, status: int, message: str = "") -> None:
+        if self.done:
+            return
+        self.done = True
+        block = b""
+        if not self._opened:
+            # Trailers-only response (legal gRPC: one HEADERS frame).
+            block += _hp_literal(":status", "200") + _hp_literal(
+                "content-type", "application/grpc"
+            )
+        block += _hp_literal("grpc-status", str(status))
+        if message:
+            block += _hp_literal(
+                "grpc-message", message.replace("\r", " ").replace("\n", " ")
+            )
+        self._conn.send_frame(_HEADERS, 0x4 | 0x1, self._sid, block)
+
+
+class _GrpcConn:
+    def __init__(self, sock: socket.socket, backend: FakeBackend):
+        self.sock = sock
+        self.backend = backend
+        self.wlock = threading.Lock()
+        self.client_max_frame = 16384
+        self._streams: dict[int, _Stream] = {}
+
+    # ---------------------------------------------------------- frame io --
+    def _recv_all(self, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def send_frame(self, ftype: int, flags: int, stream: int, payload: bytes):
+        hdr = struct.pack("!I", len(payload))[1:] + bytes(
+            [ftype, flags]
+        ) + struct.pack("!I", stream & 0x7FFFFFFF)
+        with self.wlock:
+            self.sock.sendall(hdr + payload)
+
+    def abort(self) -> None:
+        """Abrupt RST-style kill: the injected-reset fault shape (code
+        104) — the peer sees a reset mid-RPC, never trailers.
+
+        Called from a stream-handler thread while the frame loop is
+        blocked in ``recv`` on the same fd: that in-flight syscall holds
+        the kernel socket open, so ``close()`` alone would defer the
+        teardown (and the RST) until the peer's read deadline fires.
+        ``shutdown(SHUT_RD)`` is purely local — it wakes the blocked
+        reader without putting a FIN on the wire — so the last close
+        drops the fd with ``SO_LINGER(1,0)`` armed and the peer sees a
+        genuine reset immediately."""
+        try:
+            self.sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER,
+                struct.pack("ii", 1, 0),
+            )
+        except OSError:
+            pass
+        try:
+            self.sock.shutdown(socket.SHUT_RD)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------ serving --
+    def serve(self) -> None:
+        try:
+            first = self._recv_all(len(_PREFACE))
+            if first != _PREFACE:
+                return
+            self.send_frame(_SETTINGS, 0, 0, b"")
+            while True:
+                fh = self._recv_all(9)
+                if fh is None:
+                    return
+                flen = (fh[0] << 16) | (fh[1] << 8) | fh[2]
+                ftype, fflags = fh[3], fh[4]
+                stream = struct.unpack("!I", fh[5:9])[0] & 0x7FFFFFFF
+                payload = self._recv_all(flen) if flen else b""
+                if payload is None:
+                    return
+                if ftype == _SETTINGS and not fflags & 0x1:
+                    for off in range(0, len(payload) - 5, 6):
+                        ident, value = struct.unpack_from("!HI", payload, off)
+                        if ident == 0x5:  # SETTINGS_MAX_FRAME_SIZE
+                            self.client_max_frame = value
+                    self.send_frame(_SETTINGS, 0x1, 0, b"")
+                elif ftype == _PING and not fflags & 0x1:
+                    self.send_frame(_PING, 0x1, 0, payload)
+                elif ftype == _HEADERS:
+                    if not fflags & 0x4:
+                        return  # CONTINUATION unsupported: drop conn
+                    block = payload
+                    if fflags & 0x8:  # PADDED
+                        pad = block[0]
+                        block = block[1 : len(block) - pad]
+                    if fflags & 0x20:  # PRIORITY
+                        block = block[5:]
+                    try:
+                        hdrs = decode_request_headers(block)
+                    except _HpackError:
+                        continue
+                    st = _Stream(stream, hdrs)
+                    self._streams[stream] = st
+                    if fflags & 0x1:
+                        st.q.put(None)
+                    threading.Thread(
+                        target=self._dispatch, args=(st,),
+                        name=f"grpc-wire-stream-{stream}", daemon=True,
+                    ).start()
+                elif ftype == _DATA:
+                    st = self._streams.get(stream)
+                    if st is not None:
+                        if fflags & 0x8 and payload:  # PADDED
+                            pad = payload[0]
+                            payload = payload[1 : len(payload) - pad]
+                        if payload:
+                            st.q.put(payload)
+                        if fflags & 0x1:
+                            st.q.put(None)
+                elif ftype == _RST_STREAM:
+                    st = self._streams.pop(stream, None)
+                    if st is not None:
+                        st.cancelled.set()
+                        st.q.put(None)
+                elif ftype == _GOAWAY:
+                    return
+        except OSError:
+            return
+        finally:
+            for st in self._streams.values():
+                st.q.put(None)  # unblock any handler still reading
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+    # --------------------------------------------------------- dispatch --
+    def _dispatch(self, st: _Stream) -> None:
+        method = st.headers.get(":path", "").rsplit("/", 1)[-1]
+        handler = getattr(self, f"_rpc_{method}", None)
+        rsp = _Rsp(self, st.id)
+        try:
+            if handler is None:
+                rsp.trailers(_UNIMPLEMENTED, f"unknown method {method!r}")
+                return
+            handler(st, rsp)
+        except _Kill:
+            return
+        except StorageError as e:
+            if getattr(e, "code", None) == 104:
+                self.abort()
+                return
+            status, msg = storage_error_to_status(e)
+            try:
+                rsp.trailers(status, msg)
+            except OSError:
+                pass
+        except OSError:
+            pass
+        except Exception as e:  # handler bug: surface as UNKNOWN, not a hang
+            try:
+                rsp.trailers(2, f"{type(e).__name__}: {e}")
+            except OSError:
+                pass
+
+    # ------------------------------------------------------ message input --
+    def _iter_msgs(self, st: _Stream):
+        dec = FrameDecoder()
+        while True:
+            m = dec.next()
+            if m is not None:
+                yield m
+                continue
+            item = st.q.get()
+            if item is None:
+                dec.finish()  # partial frame at END_STREAM → WireCodecError
+                return
+            dec.feed(item)
+
+    def _one_msg(self, st: _Stream) -> bytes:
+        msgs = list(self._iter_msgs(st))
+        if len(msgs) != 1:
+            raise WireCodecError(
+                f"unary call carried {len(msgs)} messages"
+            )
+        return msgs[0]
+
+    def _gate(self) -> None:
+        """Open-time fault gate for the metadata unaries, mirroring the
+        h2 fake's handler gate (read-plane opens roll inside
+        ``backend.open_read`` instead — one roll per op either way)."""
+        be = self.backend
+        fault = be.fault.at()
+        if fault.latency_s:
+            time.sleep(fault.latency_s)
+        if fault.error_rate:
+            with be._rng_lock:
+                r = be._rng.random()
+            if r < fault.error_rate:
+                be.injected_errors += 1
+                raise StorageError(
+                    "injected unavailability", transient=True, code=503
+                )
+
+    @staticmethod
+    def _obj(meta) -> proto.Object:
+        return proto.Object(
+            name=meta.name, generation=meta.generation, size=meta.size
+        )
+
+    # --------------------------------------------------------- read plane --
+    def _rpc_ReadObject(self, st: _Stream, rsp: _Rsp) -> None:
+        req = proto.ReadObjectRequest.decode(self._one_msg(st))
+        be = self.backend
+        meta = be.stat(req.object)
+        start = req.read_offset
+        length = req.read_limit or max(meta.size - start, 0)
+        reader = be.open_read(req.object, start=start, length=length)
+        try:
+            sent_meta = False
+            buf = bytearray(MAX_READ_CHUNK)
+            mv = memoryview(buf)
+            while True:
+                if st.cancelled.is_set():
+                    return
+                n = reader.readinto(mv)
+                if n <= 0:
+                    break
+                content = bytes(mv[:n])
+                rsp.msg(
+                    proto.ReadObjectResponse(
+                        checksummed_data=proto.ChecksummedData(
+                            content=content,
+                            crc32c=proto.crc32c_of(content),
+                        ),
+                        metadata=None if sent_meta else self._obj(meta),
+                    )
+                )
+                sent_meta = True
+            if not sent_meta:
+                # Empty body: metadata still rides the (only) response.
+                rsp.msg(proto.ReadObjectResponse(metadata=self._obj(meta)))
+            rsp.trailers(OK)
+        finally:
+            reader.close()
+
+    def _rpc_GetObject(self, st: _Stream, rsp: _Rsp) -> None:
+        req = proto.GetObjectRequest.decode(self._one_msg(st))
+        self._gate()
+        meta = self.backend.stat(req.object)
+        rsp.msg(self._obj(meta))
+        rsp.trailers(OK)
+
+    def _rpc_ListObjects(self, st: _Stream, rsp: _Rsp) -> None:
+        req = proto.ListObjectsRequest.decode(self._one_msg(st))
+        self._gate()
+        metas = self.backend.list(req.prefix)
+        start = int(req.page_token) if req.page_token else 0
+        if req.page_size:
+            page = metas[start : start + req.page_size]
+        else:
+            page = metas[start:]
+        nxt = ""
+        if req.page_size and start + len(page) < len(metas):
+            nxt = str(start + len(page))
+        rsp.msg(
+            proto.ListObjectsResponse(
+                objects=[self._obj(m) for m in page], next_page_token=nxt
+            )
+        )
+        rsp.trailers(OK)
+
+    def _rpc_DeleteObject(self, st: _Stream, rsp: _Rsp) -> None:
+        req = proto.DeleteObjectRequest.decode(self._one_msg(st))
+        self._gate()
+        self.backend.delete(req.object)
+        rsp.msg(proto.Msg())  # google.protobuf.Empty
+        rsp.trailers(OK)
+
+    # -------------------------------------------------------- write plane --
+    def _rpc_StartResumableWrite(self, st: _Stream, rsp: _Rsp) -> None:
+        req = proto.StartResumableWriteRequest.decode(self._one_msg(st))
+        spec = req.write_object_spec
+        if spec is None or spec.resource is None or not spec.resource.name:
+            raise WireCodecError("StartResumableWrite without object name")
+        uid = self.backend.begin_upload(
+            spec.resource.name, if_generation_match=spec.if_generation_match
+        )
+        rsp.msg(proto.StartResumableWriteResponse(upload_id=uid))
+        rsp.trailers(OK)
+
+    def _rpc_QueryWriteStatus(self, st: _Stream, rsp: _Rsp) -> None:
+        req = proto.QueryWriteStatusRequest.decode(self._one_msg(st))
+        committed, final = self.backend.upload_status(req.upload_id)
+        if final is not None:
+            rsp.msg(
+                proto.QueryWriteStatusResponse(
+                    persisted_size=final.size, resource=self._obj(final)
+                )
+            )
+        else:
+            rsp.msg(proto.QueryWriteStatusResponse(persisted_size=committed))
+        rsp.trailers(OK)
+
+    def _bidi_begin(self, msg) -> str:
+        if msg.upload_id:
+            return msg.upload_id
+        spec = msg.write_object_spec
+        if spec is not None and spec.resource is not None and spec.resource.name:
+            return self.backend.begin_upload(
+                spec.resource.name,
+                if_generation_match=spec.if_generation_match,
+            )
+        raise WireCodecError(
+            "first write message needs upload_id or write_object_spec"
+        )
+
+    def _append(self, uid: str, msg) -> int:
+        """One chunk through the shared fault point; code-104 resets
+        kill the socket (the client sees a dead conn, not trailers)."""
+        cd = msg.checksummed_data
+        if cd is None or not cd.content:
+            return self.backend.upload_committed(uid)
+        try:
+            return self.backend.upload_append(uid, msg.write_offset, cd.content)
+        except StorageError as e:
+            if getattr(e, "code", None) == 104:
+                self.abort()
+                raise _Kill() from e
+            raise
+
+    def _rpc_WriteObject(self, st: _Stream, rsp: _Rsp) -> None:
+        uid: Optional[str] = None
+        committed = 0
+        for raw in self._iter_msgs(st):
+            msg = proto.WriteObjectRequest.decode(raw)
+            if uid is None:
+                uid = self._bidi_begin(msg)
+            committed = self._append(uid, msg)
+            if msg.finish_write:
+                cd = msg.checksummed_data
+                total = msg.write_offset + (len(cd.content) if cd else 0)
+                meta = self.backend.finalize_upload(uid, total=total)
+                rsp.msg(
+                    proto.WriteObjectResponse(
+                        persisted_size=meta.size, resource=self._obj(meta)
+                    )
+                )
+                rsp.trailers(OK)
+                return
+        if uid is None:
+            raise WireCodecError("WriteObject stream carried no messages")
+        # Half-close without finish_write: report progress; the session
+        # stays open for QueryWriteStatus / a resumed stream.
+        rsp.msg(proto.WriteObjectResponse(persisted_size=committed))
+        rsp.trailers(OK)
+
+    def _rpc_BidiWriteObject(self, st: _Stream, rsp: _Rsp) -> None:
+        uid: Optional[str] = None
+        for raw in self._iter_msgs(st):
+            msg = proto.BidiWriteObjectRequest.decode(raw)
+            if uid is None:
+                uid = self._bidi_begin(msg)
+            committed = self._append(uid, msg)
+            if msg.finish_write:
+                cd = msg.checksummed_data
+                if cd is not None and cd.content:
+                    total = msg.write_offset + len(cd.content)
+                else:
+                    total = msg.write_offset or None
+                meta = self.backend.finalize_upload(uid, total=total)
+                rsp.msg(
+                    proto.BidiWriteObjectResponse(
+                        persisted_size=meta.size, resource=self._obj(meta)
+                    )
+                )
+                rsp.trailers(OK)
+                return
+            if msg.state_lookup:
+                rsp.msg(proto.BidiWriteObjectResponse(persisted_size=committed))
+        # Input ended without finish_write (client broke away to
+        # re-probe): close our side cleanly, session stays resumable.
+        rsp.trailers(OK)
+
+
+class FakeGrpcWireServer:
+    """Threaded hermetic storage-v2 gRPC server (raw frames, no grpcio).
+
+    Same surface as :class:`FakeGcsGrpcServer`: ``endpoint`` is
+    ``insecure://host:port`` (h2c) by default; ``tls=True`` serves TLS
+    with an ephemeral self-signed cert and ALPN h2, ``cafile`` pointing
+    at the PEM to trust.
+    """
+
+    def __init__(
+        self,
+        backend: Optional[FakeBackend] = None,
+        port: int = 0,
+        tls: bool = False,
+    ):
+        self.backend = backend or FakeBackend()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", port))
+        self._sock.listen(16)
+        self._port = self._sock.getsockname()[1]
+        self._tls = tls
+        self.cafile = ""
+        self._ctx = None
+        if tls:
+            from tpubench.storage.fake_server import make_self_signed_cert
+
+            self.cafile, keyfile = make_self_signed_cert()
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.cafile, keyfile)
+            ctx.set_alpn_protocols(["h2"])
+            self._ctx = ctx
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def endpoint(self) -> str:
+        if self._tls:
+            return f"127.0.0.1:{self._port}"  # no scheme = TLS (like real GCS)
+        return f"insecure://127.0.0.1:{self._port}"
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            if self._ctx is not None:
+                try:
+                    conn = self._ctx.wrap_socket(conn, server_side=True)
+                except (ssl.SSLError, OSError):
+                    continue
+            threading.Thread(
+                target=_GrpcConn(conn, self.backend).serve,
+                name="grpc-wire-conn", daemon=True,
+            ).start()
+
+    def start(self) -> "FakeGrpcWireServer":
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="grpc-wire-accept", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "FakeGrpcWireServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

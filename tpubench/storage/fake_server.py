@@ -422,15 +422,22 @@ class FakeGcsServer:
 def make_self_signed_cert(hostname: str = "localhost") -> tuple[str, str]:
     """Ephemeral self-signed server certificate (SAN: ``hostname`` +
     127.0.0.1), written to a temp dir. Returns ``(certfile, keyfile)`` —
-    the cert PEM doubles as the CA bundle clients should trust."""
+    the cert PEM doubles as the CA bundle clients should trust.
+
+    Generated with ``cryptography`` when importable, else the
+    ``openssl`` CLI (hermetic CI images often ship the binary but not
+    the Python package); raises StorageError when neither exists."""
     import datetime
     import ipaddress
     import tempfile
 
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import rsa
-    from cryptography.x509.oid import NameOID
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.x509.oid import NameOID
+    except ImportError:
+        return _make_self_signed_cert_cli(hostname)
 
     key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
     name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, hostname)])
@@ -466,5 +473,37 @@ def make_self_signed_cert(hostname: str = "localhost") -> tuple[str, str]:
                 serialization.PrivateFormat.TraditionalOpenSSL,
                 serialization.NoEncryption(),
             )
+        )
+    return certfile, keyfile
+
+
+def _make_self_signed_cert_cli(hostname: str) -> tuple[str, str]:
+    import shutil
+    import subprocess
+    import tempfile
+
+    exe = shutil.which("openssl")
+    if exe is None:
+        raise StorageError(
+            "self-signed TLS cert needs the `cryptography` package or "
+            "an `openssl` binary — neither found",
+            transient=False,
+        )
+    d = tempfile.mkdtemp(prefix="tpubench-tls-")
+    certfile = f"{d}/cert.pem"
+    keyfile = f"{d}/key.pem"
+    proc = subprocess.run(
+        [
+            exe, "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", keyfile, "-out", certfile, "-days", "1",
+            "-subj", f"/CN={hostname}",
+            "-addext", f"subjectAltName=DNS:{hostname},IP:127.0.0.1",
+        ],
+        capture_output=True, text=True, timeout=30,
+    )
+    if proc.returncode != 0:
+        raise StorageError(
+            f"openssl cert generation failed: {proc.stderr.strip()}",
+            transient=False,
         )
     return certfile, keyfile

@@ -19,6 +19,20 @@ Built on the raw generated stubs (``google.cloud._storage_v2.types``) over a
 bare channel rather than the GAPIC client, so the hermetic fake server
 (:mod:`fake_grpc_server`) and the benchmark share one code path and the
 hot loop has no client-library overhead in it.
+
+Two modes, one surface:
+
+* **library mode** — ``grpcio`` + the generated storage-v2 types, when
+  both import (and always when an explicit ``channel`` is injected);
+* **wire mode** — the dependency-free :mod:`tpubench.storage.grpc_wire`
+  stack (hand-rolled protobuf + gRPC framing over raw h2 frames) when
+  they don't. Hermetic endpoints only: it carries no auth stack, so it
+  refuses ``googleapis.com`` loudly instead of failing UNAUTHENTICATED.
+
+Resumable writes (``open_write``) speak StartResumableWrite →
+BidiWriteObject with lockstep persisted-size acks → QueryWriteStatus
+re-probe on break → idempotent finalize, in both modes — composed under
+``_ResumingWriter`` so ckpt-save rides gRPC through upload-side chaos.
 """
 
 from __future__ import annotations
@@ -29,24 +43,37 @@ import threading
 import time
 from typing import Optional
 
-import grpc
+try:  # Library mode needs BOTH grpcio and the generated storage-v2 types.
+    import grpc
+    from google.cloud._storage_v2 import types as s2
+
+    _HAVE_LIB = True
+except ImportError:  # Wire mode: tpubench.storage.grpc_wire, no deps.
+    grpc = None  # type: ignore[assignment]
+    s2 = None  # type: ignore[assignment]
+    _HAVE_LIB = False
 
 from tpubench.config import TransportConfig
+from tpubench.obs.flight import annotate
 from tpubench.obs.flight import note_phase as flight_note
 from tpubench.obs.tracing import NoopTracer, SpanCarrier
 from tpubench.storage.base import ObjectMeta, StorageError
-
-from google.cloud._storage_v2 import types as s2
+from tpubench.storage.grpc_wire import proto as wp
+from tpubench.storage.grpc_wire.client import GrpcWireChannel
 
 _SVC = "/google.storage.v2.Storage"
 
-_TRANSIENT_CODES = {
-    grpc.StatusCode.UNAVAILABLE,
-    grpc.StatusCode.DEADLINE_EXCEEDED,
-    grpc.StatusCode.RESOURCE_EXHAUSTED,
-    grpc.StatusCode.ABORTED,
-    grpc.StatusCode.INTERNAL,
-}
+_TRANSIENT_CODES = (
+    {
+        grpc.StatusCode.UNAVAILABLE,
+        grpc.StatusCode.DEADLINE_EXCEEDED,
+        grpc.StatusCode.RESOURCE_EXHAUSTED,
+        grpc.StatusCode.ABORTED,
+        grpc.StatusCode.INTERNAL,
+    }
+    if _HAVE_LIB
+    else frozenset()
+)
 
 # gRPC server chunk ceiling (storage v2 ServiceConstants.MAX_READ_CHUNK_BYTES
 # is 2 MiB) — mirrored by the fake server.
@@ -143,27 +170,96 @@ def _stamped_read_deserializer(b: bytes):
     return time.perf_counter_ns(), s2.ReadObjectResponse.deserialize(b)
 
 
+class _WireGrpcReader:
+    """Wire-mode twin of :class:`_GrpcReader`: streams framed
+    ReadObjectResponse messages off a :class:`WireCall`, carrying
+    leftover message bytes between ``readinto`` calls. The first-byte
+    stamp is taken on the raw message bytes BEFORE protobuf decode,
+    matching the library path's wrapped deserializer."""
+
+    def __init__(self, call, carrier=None):
+        self._call = call
+        self._pending = memoryview(b"")
+        self.first_byte_ns: Optional[int] = None
+        self._done = False
+        self._carrier = carrier
+
+    def readinto(self, buf: memoryview) -> int:
+        if self._done and not self._pending:
+            return 0
+        while not self._pending:
+            try:
+                raw = self._call.recv_message()
+            except StorageError as e:
+                self._done = True
+                self._call.cancel()
+                if self._carrier is not None:
+                    self._carrier.close(e)
+                raise
+            if raw is None:
+                self._done = True
+                return 0
+            arrival_ns = time.perf_counter_ns()
+            msg = wp.ReadObjectResponse.decode(raw)
+            if self.first_byte_ns is None:
+                self.first_byte_ns = arrival_ns
+                if self._carrier is not None:
+                    self._carrier.event("first_byte")
+            cd = msg.checksummed_data
+            if cd is not None and cd.content:
+                self._pending = memoryview(cd.content)
+        n = min(len(buf), len(self._pending))
+        buf[:n] = self._pending[:n]
+        self._pending = self._pending[n:]
+        return n
+
+    def close(self) -> None:
+        if self._done:
+            self._call.close()  # clean end: the conn can be reused
+        else:
+            self._call.cancel()  # abandoned mid-stream: RST + discard
+        self._done = True
+        if self._carrier is not None:
+            self._carrier.close()
+
+
 class GcsGrpcBackend:
     def __init__(
         self,
         bucket: str,
         transport: Optional[TransportConfig] = None,
-        channel: Optional[grpc.Channel] = None,
+        channel=None,
         tracer=None,
     ):
         self.bucket = bucket
         self.transport = transport or TransportConfig()
         self._tracer = tracer or NoopTracer()
         n = max(1, self.transport.grpc_conn_pool_size)
+        # Mode: library (grpcio + storage-v2 types) when importable or a
+        # channel is injected; the dependency-free wire stack otherwise.
+        self._wire = not _HAVE_LIB and channel is None
         if channel is not None:
+            if not _HAVE_LIB:
+                raise StorageError(
+                    "explicit grpc channel needs grpcio + "
+                    "google.cloud._storage_v2 installed",
+                    transient=False,
+                )
             self._channels = [channel]
             self._owns_channels = False
+        elif self._wire:
+            self._channels = [self._make_wire_channel() for _ in range(n)]
+            self._owns_channels = True
         else:
             self._channels = [self._make_channel() for _ in range(n)]
             self._owns_channels = True
         self._rr = itertools.cycle(range(len(self._channels)))
         self._rr_lock = threading.Lock()
-        self._stubs = [self._make_stubs(ch) for ch in self._channels]
+        self._stubs = (
+            []
+            if self._wire
+            else [self._make_stubs(ch) for ch in self._channels]
+        )
         # Native-receive pool (transport.native_receive): engine tb_conn
         # handles carrying h2 sessions; sequential RPCs reuse a handle.
         # Shared pool machinery (same discipline as gcs_http's native
@@ -223,8 +319,50 @@ class GcsGrpcBackend:
     def native_conn_stats(self) -> dict:
         return self._native_pool().stats
 
+    # ------------------------------------------------------ wire channel --
+    def _make_wire_channel(self) -> GrpcWireChannel:
+        endpoint = self.transport.endpoint or "storage.googleapis.com:443"
+        if self.transport.directpath and not (
+            endpoint in ("storage.googleapis.com:443", "storage.googleapis.com")
+        ):
+            # Same no-silent-no-op rule (and the same message) as the
+            # library-mode channel factory below.
+            import warnings
+
+            warnings.warn(
+                f"transport.directpath=True ignored for custom endpoint "
+                f"{endpoint!r}: DirectPath serves storage.googleapis.com only",
+                stacklevel=3,
+            )
+        if "googleapis.com" in endpoint:
+            # The wire stack carries no auth/resolver machinery: real GCS
+            # (and DirectPath, which only serves it) needs library mode.
+            raise StorageError(
+                "grpc wire mode is hermetic-only: point transport.endpoint "
+                "at a test server (e.g. FakeGrpcWireServer), or install "
+                "grpcio + google.cloud._storage_v2 for real GCS",
+                transient=False,
+            )
+        host, port, tls = self._native_endpoint()
+        return GrpcWireChannel(
+            host,
+            port,
+            tls=tls,
+            cafile=self.transport.tls_ca_file or None,
+            insecure_skip_verify=self.transport.tls_insecure_skip_verify,
+        )
+
+    def _wire_chan(self) -> GrpcWireChannel:
+        with self._rr_lock:
+            return self._channels[next(self._rr)]
+
+    def _wire_unary(self, method: str, req: "wp.Msg") -> bytes:
+        """One wire-mode unary RPC; errors arrive pre-classified from
+        the frame layer (grpc-status → StorageError mapping)."""
+        return self._wire_chan().unary(method, req.encode())
+
     # ----------------------------------------------------------- channel --
-    def _make_channel(self) -> grpc.Channel:
+    def _make_channel(self) -> "grpc.Channel":
         endpoint = self.transport.endpoint or "storage.googleapis.com:443"
         opts = [
             ("grpc.max_receive_message_length", 16 * 1024 * 1024),
@@ -327,6 +465,23 @@ class GcsGrpcBackend:
                 f"{_SVC}/WriteObject",
                 request_serializer=s2.WriteObjectRequest.serialize,
                 response_deserializer=s2.WriteObjectResponse.deserialize,
+            ),
+            "start_resumable": ch.unary_unary(
+                f"{_SVC}/StartResumableWrite",
+                request_serializer=s2.StartResumableWriteRequest.serialize,
+                response_deserializer=(
+                    s2.StartResumableWriteResponse.deserialize
+                ),
+            ),
+            "query_write": ch.unary_unary(
+                f"{_SVC}/QueryWriteStatus",
+                request_serializer=s2.QueryWriteStatusRequest.serialize,
+                response_deserializer=s2.QueryWriteStatusResponse.deserialize,
+            ),
+            "bidi_write": ch.stream_stream(
+                f"{_SVC}/BidiWriteObject",
+                request_serializer=s2.BidiWriteObjectRequest.serialize,
+                response_deserializer=s2.BidiWriteObjectResponse.deserialize,
             ),
         }
 
@@ -591,6 +746,26 @@ class GcsGrpcBackend:
     def open_read(self, name: str, start: int = 0, length: Optional[int] = None):
         if self.transport.native_receive:
             return self._open_read_native(name, start, length)
+        if self._wire:
+            wreq = wp.ReadObjectRequest(
+                bucket=self._bucket_path,
+                object=name,
+                read_offset=start,
+                read_limit=length or 0,
+            )
+            carrier = SpanCarrier(
+                self._tracer, "gcs_grpc.read_object",
+                object=name, bucket=self.bucket,
+            )
+            try:
+                call = self._wire_chan().server_stream(
+                    f"{_SVC}/ReadObject", wreq.encode()
+                )
+                flight_note("stream_open")
+                return _WireGrpcReader(call, carrier=carrier)
+            except BaseException as e:
+                carrier.close(e)
+                raise
         req = s2.ReadObjectRequest(
             bucket=self._bucket_path,
             object_=name,
@@ -610,8 +785,71 @@ class GcsGrpcBackend:
                 raise _wrap_rpc_error(e, f"ReadObject {name}") from e
             raise
 
+    def _wire_write(self, name: str, data, if_generation_match) -> ObjectMeta:
+        """One-shot WriteObject as a client-streaming wire call."""
+        spec = wp.WriteObjectSpec(
+            resource=wp.Object(name=name, bucket=self._bucket_path),
+            if_generation_match=(
+                int(if_generation_match)
+                if if_generation_match is not None
+                else None
+            ),
+        )
+        mv = memoryview(data) if not isinstance(data, memoryview) else data
+        call = self._wire_chan().bidi(f"{_SVC}/WriteObject")
+        try:
+            if not len(mv):
+                call.send_message(
+                    wp.WriteObjectRequest(
+                        write_object_spec=spec, finish_write=True
+                    ).encode(),
+                    end=True,
+                )
+            else:
+                off = 0
+                first = True
+                while off < len(mv):
+                    chunk = mv[off : off + MAX_READ_CHUNK]
+                    last = off + len(chunk) >= len(mv)
+                    content = bytes(chunk)
+                    call.send_message(
+                        wp.WriteObjectRequest(
+                            write_object_spec=spec if first else None,
+                            write_offset=off,
+                            checksummed_data=wp.ChecksummedData(
+                                content=content,
+                                crc32c=wp.crc32c_of(content),
+                            ),
+                            finish_write=last,
+                        ).encode(),
+                        end=last,
+                    )
+                    first = False
+                    off += len(chunk)
+            raw = call.recv_message()
+            if raw is None:
+                raise StorageError(
+                    f"WriteObject {name}: no response message", transient=True
+                )
+            resp = wp.WriteObjectResponse.decode(raw)
+            while call.recv_message() is not None:
+                pass
+        except BaseException:
+            call.cancel()
+            raise
+        finally:
+            call.close()
+        res = resp.resource
+        size = res.size if res is not None else resp.persisted_size
+        with self._stat_cache_lock:
+            self._stat_cache[name] = size
+        return ObjectMeta(res.name if res is not None else name, size)
+
     def write(self, name: str, data: bytes,
               if_generation_match=None) -> ObjectMeta:
+        if self._wire:
+            return self._wire_write(name, data, if_generation_match)
+
         def requests():
             spec = s2.WriteObjectSpec(
                 resource=s2.Object(name=name, bucket=self._bucket_path)
@@ -652,17 +890,27 @@ class GcsGrpcBackend:
         return ObjectMeta(resp.resource.name, int(resp.resource.size))
 
     def open_write(self, name: str, if_generation_match=None):
-        # Resumable sessions over gRPC are StartResumableWrite/
-        # BidiWriteObject — a different streaming protocol than the one-
-        # shot WriteObject above; not implemented yet (ROADMAP: lifecycle
-        # depth × storage-v2 fake). Fail classified, not AttributeError.
-        raise StorageError(
-            "resumable uploads are not implemented on the grpc "
-            "transport; use --protocol http|fake|local for ckpt-save",
-            transient=False,
-        )
+        """Resumable session: StartResumableWrite → BidiWriteObject
+        chunks with lockstep persisted-size acks → QueryWriteStatus
+        re-probe on break → idempotent finalize (412 non-transient).
+        The RetryingBackend wraps this in ``_ResumingWriter``, which
+        drives the re-probe + tail-resend choreography."""
+        if self._wire:
+            return _WireBidiWriter(self, name, if_generation_match)
+        return _LibBidiWriter(self, name, if_generation_match)
 
     def list(self, prefix: str = "", page_size: int = 0) -> list[ObjectMeta]:
+        if self._wire:
+            wreq = wp.ListObjectsRequest(
+                parent=self._bucket_path, prefix=prefix,
+                page_size=max(0, page_size),
+            )
+            raw = self._wire_unary(f"{_SVC}/ListObjects", wreq)
+            resp = wp.ListObjectsResponse.decode(raw)
+            return [
+                ObjectMeta(o.name, o.size, o.generation)
+                for o in resp.objects
+            ]
         req = s2.ListObjectsRequest(parent=self._bucket_path, prefix=prefix)
         if page_size > 0:
             req.page_size = page_size
@@ -675,6 +923,15 @@ class GcsGrpcBackend:
         ]
 
     def stat(self, name: str) -> ObjectMeta:
+        if self._wire:
+            raw = self._wire_unary(
+                f"{_SVC}/GetObject",
+                wp.GetObjectRequest(bucket=self._bucket_path, object=name),
+            )
+            o = wp.Object.decode(raw)
+            with self._stat_cache_lock:
+                self._stat_cache[name] = o.size
+            return ObjectMeta(o.name, o.size, o.generation)
         req = s2.GetObjectRequest(bucket=self._bucket_path, object_=name)
         try:
             o = self._stub()["get"](req)
@@ -688,6 +945,14 @@ class GcsGrpcBackend:
         return ObjectMeta(o.name, int(o.size), int(o.generation))
 
     def delete(self, name: str) -> None:
+        if self._wire:
+            self._wire_unary(
+                f"{_SVC}/DeleteObject",
+                wp.DeleteObjectRequest(bucket=self._bucket_path, object=name),
+            )
+            with self._stat_cache_lock:
+                self._stat_cache.pop(name, None)
+            return
         req = s2.DeleteObjectRequest(bucket=self._bucket_path, object_=name)
         try:
             self._stub()["delete"](req)
@@ -702,6 +967,294 @@ class GcsGrpcBackend:
                 ch.close()
         if self._native_pool_obj is not None:
             self._native_pool_obj.close()  # also drains its BufferPool
+
+
+class _WireBidiWriter:
+    """Wire-mode resumable gRPC write (the ObjectWriter contract).
+
+    StartResumableWrite issues the session; each ``write`` chunk rides
+    a BidiWriteObject message with ``flush`` + ``state_lookup`` set and
+    waits for the persisted-size ack in lockstep — ``offset`` is always
+    the server's committed watermark, never an optimistic local count.
+    A transient break tears the stream down and re-raises; the
+    ``_ResumingWriter`` above re-probes ``committed()`` (QueryWriteStatus)
+    and resends the tail on a fresh stream (first message re-carries the
+    upload id). ``finalize`` sends ``finish_write`` and half-closes; a
+    412 precondition verdict arrives non-transient."""
+
+    def __init__(self, backend: GcsGrpcBackend, name: str,
+                 if_generation_match=None):
+        self._b = backend
+        self.name = name
+        spec = wp.WriteObjectSpec(
+            resource=wp.Object(name=name, bucket=backend._bucket_path),
+            if_generation_match=(
+                int(if_generation_match)
+                if if_generation_match is not None
+                else None
+            ),
+        )
+        raw = backend._wire_unary(
+            f"{_SVC}/StartResumableWrite",
+            wp.StartResumableWriteRequest(write_object_spec=spec),
+        )
+        self._uid = wp.StartResumableWriteResponse.decode(raw).upload_id
+        self.offset = 0
+        self._call = None
+        self._fresh = True
+        self._final: Optional[ObjectMeta] = None
+
+    # ----------------------------------------------------------- stream --
+    def _send(self, msg: "wp.BidiWriteObjectRequest", end: bool = False):
+        if self._call is None:
+            self._call = self._b._wire_chan().bidi(f"{_SVC}/BidiWriteObject")
+            self._fresh = True
+        if self._fresh:
+            # The upload id rides only the FIRST message of each stream
+            # (the storage-v2 first_message contract).
+            msg.upload_id = self._uid
+            self._fresh = False
+        self._call.send_message(msg.encode(), end=end)
+        return self._call
+
+    def _break_stream(self) -> None:
+        call, self._call = self._call, None
+        if call is not None:
+            call.cancel()
+
+    # --------------------------------------------------------- contract --
+    def write(self, data) -> int:
+        mv = memoryview(data) if not isinstance(data, memoryview) else data
+        off = 0
+        try:
+            while off < len(mv):
+                chunk = mv[off : off + MAX_READ_CHUNK]
+                content = bytes(chunk)
+                call = self._send(
+                    wp.BidiWriteObjectRequest(
+                        write_offset=self.offset,
+                        checksummed_data=wp.ChecksummedData(
+                            content=content, crc32c=wp.crc32c_of(content)
+                        ),
+                        flush=True,
+                        state_lookup=True,
+                    )
+                )
+                raw = call.recv_message()
+                if raw is None:
+                    raise StorageError(
+                        f"BidiWriteObject {self.name}: stream closed "
+                        "before persisted-size ack",
+                        transient=True,
+                    )
+                ack = wp.BidiWriteObjectResponse.decode(raw)
+                annotate(
+                    "bidi_ack", persisted=ack.persisted_size, object=self.name
+                )
+                self.offset = ack.persisted_size
+                off += len(chunk)
+        except StorageError:
+            self._break_stream()
+            raise
+        return self.offset
+
+    def committed(self) -> int:
+        raw = self._b._wire_unary(
+            f"{_SVC}/QueryWriteStatus",
+            wp.QueryWriteStatusRequest(upload_id=self._uid),
+        )
+        resp = wp.QueryWriteStatusResponse.decode(raw)
+        self.offset = resp.persisted_size
+        return self.offset
+
+    def finalize(self) -> ObjectMeta:
+        if self._final is not None:
+            return self._final
+        try:
+            call = self._send(
+                wp.BidiWriteObjectRequest(
+                    write_offset=self.offset, finish_write=True
+                ),
+                end=True,
+            )
+            raw = call.recv_message()
+            if raw is None:
+                raise StorageError(
+                    f"BidiWriteObject {self.name}: no finalize response",
+                    transient=True,
+                )
+            resp = wp.BidiWriteObjectResponse.decode(raw)
+            while call.recv_message() is not None:
+                pass
+            call.close()
+            self._call = None
+        except StorageError:
+            self._break_stream()
+            raise
+        res = resp.resource
+        if res is not None:
+            meta = ObjectMeta(res.name or self.name, res.size, res.generation)
+        else:
+            meta = ObjectMeta(self.name, resp.persisted_size)
+        with self._b._stat_cache_lock:
+            self._b._stat_cache[meta.name] = meta.size
+        self._final = meta
+        return meta
+
+    def abort(self) -> None:
+        try:
+            self._break_stream()
+        except Exception:
+            pass
+
+
+class _LibBidiWriter:
+    """Library-mode twin of :class:`_WireBidiWriter`: the same RPC
+    choreography over grpcio ``stream_stream`` with a queue-driven
+    request iterator (lockstep: enqueue one request, pull one ack —
+    ``state_lookup`` guarantees the server answers per chunk)."""
+
+    def __init__(self, backend: GcsGrpcBackend, name: str,
+                 if_generation_match=None):
+        self._b = backend
+        self.name = name
+        spec = s2.WriteObjectSpec(
+            resource=s2.Object(name=name, bucket=backend._bucket_path)
+        )
+        if if_generation_match is not None:
+            spec.if_generation_match = int(if_generation_match)
+        try:
+            resp = backend._stub()["start_resumable"](
+                s2.StartResumableWriteRequest(write_object_spec=spec)
+            )
+        except grpc.RpcError as e:
+            raise _wrap_rpc_error(e, f"StartResumableWrite {name}") from e
+        self._uid = resp.upload_id
+        self.offset = 0
+        self._q = None
+        self._resp_iter = None
+        self._fresh = True
+        self._final: Optional[ObjectMeta] = None
+
+    # ----------------------------------------------------------- stream --
+    def _send(self, req, end: bool = False):
+        if self._resp_iter is None:
+            import queue as _queue
+
+            q = _queue.Queue()
+
+            def gen():
+                while True:
+                    item = q.get()
+                    if item is None:
+                        return
+                    yield item
+
+            self._q = q
+            self._resp_iter = self._b._stub()["bidi_write"](gen())
+            self._fresh = True
+        if self._fresh:
+            req.upload_id = self._uid
+            self._fresh = False
+        self._q.put(req)
+        if end:
+            self._q.put(None)
+        return self._resp_iter
+
+    def _break_stream(self) -> None:
+        it, self._resp_iter = self._resp_iter, None
+        q, self._q = self._q, None
+        if q is not None:
+            q.put(None)
+        if it is not None:
+            try:
+                it.cancel()
+            except Exception:
+                pass
+
+    def _recv(self, it, what: str):
+        try:
+            return next(it)
+        except StopIteration:
+            raise StorageError(
+                f"{what}: stream closed before ack", transient=True
+            ) from None
+        except grpc.RpcError as e:
+            raise _wrap_rpc_error(e, what) from e
+
+    # --------------------------------------------------------- contract --
+    def write(self, data) -> int:
+        mv = memoryview(data) if not isinstance(data, memoryview) else data
+        off = 0
+        try:
+            while off < len(mv):
+                chunk = mv[off : off + MAX_READ_CHUNK]
+                it = self._send(
+                    s2.BidiWriteObjectRequest(
+                        write_offset=self.offset,
+                        checksummed_data=s2.ChecksummedData(
+                            content=bytes(chunk)
+                        ),
+                        flush=True,
+                        state_lookup=True,
+                    )
+                )
+                ack = self._recv(it, f"BidiWriteObject {self.name}")
+                annotate(
+                    "bidi_ack",
+                    persisted=int(ack.persisted_size),
+                    object=self.name,
+                )
+                self.offset = int(ack.persisted_size)
+                off += len(chunk)
+        except StorageError:
+            self._break_stream()
+            raise
+        return self.offset
+
+    def committed(self) -> int:
+        try:
+            resp = self._b._stub()["query_write"](
+                s2.QueryWriteStatusRequest(upload_id=self._uid)
+            )
+        except grpc.RpcError as e:
+            raise _wrap_rpc_error(e, f"QueryWriteStatus {self.name}") from e
+        self.offset = int(resp.persisted_size)
+        return self.offset
+
+    def finalize(self) -> ObjectMeta:
+        if self._final is not None:
+            return self._final
+        try:
+            it = self._send(
+                s2.BidiWriteObjectRequest(
+                    write_offset=self.offset, finish_write=True
+                ),
+                end=True,
+            )
+            resp = self._recv(it, f"BidiWriteObject {self.name} finalize")
+            for _ in it:
+                pass
+            self._resp_iter = None
+            self._q = None
+        except StorageError:
+            self._break_stream()
+            raise
+        meta = ObjectMeta(
+            resp.resource.name or self.name,
+            int(resp.resource.size),
+            int(resp.resource.generation),
+        )
+        with self._b._stat_cache_lock:
+            self._b._stat_cache[meta.name] = meta.size
+        self._final = meta
+        return meta
+
+    def abort(self) -> None:
+        try:
+            self._break_stream()
+        except Exception:
+            pass
 
 
 def _empty_deserializer(b: bytes):

@@ -1,0 +1,23 @@
+"""Dependency-free gRPC-over-HTTP/2 wire stack for storage v2.
+
+The hermetic transport plane ROADMAP item 1 asks for: a hand-rolled
+protobuf codec (:mod:`proto`) for the handful of storage-v2 messages
+tpubench speaks, gRPC message framing + status mapping (:mod:`framing`),
+and a client connection (:mod:`client`) that runs those frames over a
+plain socket (h2c prior knowledge) or TLS+ALPN h2 — no ``grpcio``, no
+gapic types. :class:`~tpubench.storage.gcs_grpc.GcsGrpcBackend` rides
+this stack whenever the real libraries are absent, against the
+:class:`~tpubench.storage.fake_grpc_wire_server.FakeGrpcWireServer`
+twin that serves the same frames from the shared :class:`FakeBackend`.
+"""
+
+from tpubench.storage.grpc_wire.framing import (  # noqa: F401
+    FrameDecoder,
+    WireCodecError,
+    encode_frame,
+    status_to_storage_error,
+    storage_error_to_status,
+)
+from tpubench.storage.grpc_wire.client import (  # noqa: F401
+    GrpcWireChannel,
+)

@@ -19,14 +19,16 @@ scorecard is computed offline from the run's own flight journal — and
 
 Hermetic by construction: the fault plane only exists in the fake
 backend and the fake servers, so chaos supports ``--protocol fake``
-(in-process store), ``http`` (in-process HTTP/1.1 server) and ``http``
-+ ``--http2`` (in-process h2 server, native client). Wall-clock is
+(in-process store), ``http`` (in-process HTTP/1.1 server), ``http``
++ ``--http2`` (in-process h2 server, native client) and ``grpc``
+(in-process gRPC wire server, dependency-free wire client). Wall-clock is
 bounded: every phase window and time-shaped fault duration scales by
 ``TPUBENCH_BENCH_SLEEP_SCALE`` so CI can run a miniature timeline.
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
@@ -260,8 +262,9 @@ def format_scorecard(chaos: dict) -> str:
 
 
 def spawn_hermetic_server(cfg: BenchConfig, fault_plan=None, store=None):
-    """In-process fake server speaking the real wire protocol (h1.1, or
-    the h2 server under ``transport.http2``), backed by a prepopulated
+    """In-process fake server speaking the real wire protocol (h1.1, the
+    h2 server under ``transport.http2``, or the gRPC wire server under
+    ``--protocol grpc``), backed by a prepopulated
     fake store carrying ``fault_plan`` — server-side injection, so
     stalls/resets/truncation happen ON THE WIRE. ``store`` overrides the
     default population (the replay driver rebuilds a bundle's recorded
@@ -281,7 +284,14 @@ def spawn_hermetic_server(cfg: BenchConfig, fault_plan=None, store=None):
             size=w.object_size,
             fault=fault_plan,
         )
-    if cfg.transport.http2:
+    if cfg.transport.protocol == "grpc":
+        from tpubench.storage.fake_grpc_wire_server import FakeGrpcWireServer
+
+        server = FakeGrpcWireServer(backend=store).start()
+        # DirectPath can't apply to a loopback fake; forcing it off here
+        # keeps the hermetic run warning-free (caller restores cfg).
+        cfg.transport.directpath = False
+    elif cfg.transport.http2:
         from tpubench.storage.fake_h2_server import FakeH2Server
 
         server = FakeH2Server(backend=store).start()
@@ -295,6 +305,33 @@ def spawn_hermetic_server(cfg: BenchConfig, fault_plan=None, store=None):
 
         get_engine()
     return server
+
+
+@contextlib.contextmanager
+def hermetic_target(cfg: BenchConfig):
+    """Hermetic-target guard for the lifecycle/drill CLI paths: under
+    ``--protocol http``/``--protocol grpc`` with no endpoint, spawn the
+    matching in-process fake server (carrying ``transport.fault`` when
+    active, so ``tpubench ckpt-save --protocol grpc --fault-*`` injects
+    ON THE WIRE) and restore the touched transport fields on exit.
+    Yields ``None`` when the run already has a target (explicit endpoint,
+    or a protocol like ``fake``/``local`` that needs no server)."""
+    t = cfg.transport
+    if t.protocol not in ("http", "grpc") or t.endpoint:
+        yield None
+        return
+    from tpubench.storage.fake import FaultPlan
+
+    plan = (
+        FaultPlan(**dataclasses.asdict(t.fault)) if t.fault.active else None
+    )
+    restore = (t.endpoint, t.directpath)
+    server = spawn_hermetic_server(cfg, fault_plan=plan)
+    try:
+        yield server
+    finally:
+        server.stop()
+        t.endpoint, t.directpath = restore
 
 
 def run_chaos(
@@ -363,14 +400,14 @@ def run_chaos(
             "(fault.phases in a config file also works)"
         )
     proto = cfg.transport.protocol
-    if proto not in ("fake", "http") or (
-        proto == "http" and cfg.transport.endpoint
+    if proto not in ("fake", "http", "grpc") or (
+        proto in ("http", "grpc") and cfg.transport.endpoint
     ):
         raise SystemExit(
-            "chaos: hermetic protocols only (fake, or http[--http2] "
-            f"against the in-process fake server), not {proto!r} with "
-            f"endpoint {cfg.transport.endpoint!r} — the fault plane "
-            "lives in the fake backend/servers"
+            "chaos: hermetic protocols only (fake, http[--http2] or "
+            "grpc against the in-process fake servers), not "
+            f"{proto!r} with endpoint {cfg.transport.endpoint!r} — the "
+            "fault plane lives in the fake backend/servers"
         )
 
     # Scale into a LOCAL fault dict — never back into cfg, which the
@@ -397,6 +434,7 @@ def run_chaos(
     w = cfg.workload
     cfg_restore = {
         "endpoint": cfg.transport.endpoint,
+        "directpath": cfg.transport.directpath,
         "flight_records": cfg.obs.flight_records,
         "flight_journal": cfg.obs.flight_journal,
         "journal_max_bytes": cfg.obs.journal_max_bytes,
@@ -428,7 +466,7 @@ def run_chaos(
     backend = None
     plan = FaultPlan(**fdict)
     try:
-        if proto == "http":
+        if proto in ("http", "grpc"):
             server = spawn_hermetic_server(cfg, fault_plan=plan)
 
         # Pre-build everything expensive (workload import, client
@@ -532,6 +570,7 @@ def run_chaos(
             except OSError:
                 pass
         cfg.transport.endpoint = cfg_restore["endpoint"]
+        cfg.transport.directpath = cfg_restore["directpath"]
         cfg.obs.flight_records = cfg_restore["flight_records"]
         cfg.obs.flight_journal = cfg_restore["flight_journal"]
         cfg.obs.journal_max_bytes = cfg_restore["journal_max_bytes"]

@@ -7,8 +7,9 @@ dependency-free report over the framework's own result files
 (``write_result`` JSONs): the ssd_test percentile block per summary
 (Avg/P20/P50/P90/p99/Min/Max — ``ssd_test/main.go:157-163`` format), a
 throughput line per run, and — given two or more runs — pairwise deltas
-grouped by config axis (protocol, staging mode, fetch executor), which is
-the h1-vs-h2 / python-vs-native A/B table the sweep produces.
+grouped by config axis (transport bit, staging mode, fetch executor),
+which is the h1-vs-h2 / h2-vs-grpc / python-vs-native A/B table the
+sweep produces.
 
 Pure functions over parsed dicts; the CLI wires file loading around them.
 """
@@ -28,19 +29,34 @@ def _cell(d, fmt, *path):
     return fmt.format(d) if d is not None else "n/a"
 
 
+def _transport_bit(t: dict) -> str:
+    """The transport axis bit of a run's A/B label: protocol plus the
+    wire variant that makes two arms comparable-but-different — h2 or
+    native receive for HTTP, DirectPath for gRPC. Transport is a
+    first-class A/B axis: an h2 arm and a grpc arm under the same fault
+    plan must never render as twins, and :func:`compare_runs` keys its
+    transport diff block off this bit differing."""
+    proto = t.get("protocol", "?")
+    if proto == "grpc":
+        if t.get("native_receive"):
+            proto += "+native"
+        elif t.get("directpath"):
+            proto += "+dp"
+    elif t.get("http2"):
+        proto += "+h2"
+    elif t.get("native_receive"):
+        proto += "+native"
+    return proto
+
+
 def _axis(run: dict) -> str:
-    """The config axis label an A/B varies: protocol(+http2/native), the
-    staging mode, and the fetch executor."""
+    """The config axis label an A/B varies: transport bit (protocol +
+    h2/native/DirectPath), the staging mode, and the fetch executor."""
     cfg = run.get("config", {})
     t = cfg.get("transport", {})
     w = cfg.get("workload", {})
     s = cfg.get("staging", {})
-    proto = t.get("protocol", "?")
-    if t.get("http2"):
-        proto += "+h2"
-    elif t.get("native_receive"):
-        proto += "+native"
-    bits = [proto]
+    bits = [_transport_bit(t)]
     if s.get("mode") and s.get("mode") != "none":
         bits.append(f"staging={s['mode']}")
     if w.get("fetch_executor") and w.get("fetch_executor") != "python":
@@ -285,6 +301,46 @@ def compare_runs(runs: list[dict]) -> str:
                 f"({d99:+.3f})"
             )
         cell = _cell
+        # Transport diff: the first-class A/B axis the gRPC plane adds.
+        # An h2 arm against a grpc arm under the same fault plan compares
+        # on what the transport exists for — goodput, read tail, watchdog
+        # stalls, and (when the arms wrote) checkpoint save goodput
+        # through the same wire faults.
+        ot_ = (other.get("config") or {}).get("transport") or {}
+        bt_ = (base.get("config") or {}).get("transport") or {}
+        o_bit, b_bit = _transport_bit(ot_), _transport_bit(bt_)
+        if o_bit != b_bit:
+            def _read_p99(doc):
+                ss = doc.get("summaries") or {}
+                s_ = ss.get("read") or next(iter(ss.values()), None)
+                return s_.get("p99_ms") if s_ else None
+
+            def _stalls(doc):
+                return ((doc.get("extra", {}).get("tail") or {})
+                        .get("watchdog") or {}).get("stalls", 0)
+
+            def _save_gbps(doc):
+                lc_ = doc.get("extra", {}).get("lifecycle") or {}
+                return (lc_.get("goodput_gbps")
+                        if lc_.get("op") == "save" else None)
+
+            def _na(v, fmt):
+                return fmt.format(v) if v is not None else "n/a"
+
+            tline = (
+                f"    transport [{o_bit} vs {b_bit}]: goodput "
+                f"{og:.4f} vs {bg:.4f} GB/s, read p99 "
+                f"{_na(_read_p99(other), '{:.3f}ms')} vs "
+                f"{_na(_read_p99(base), '{:.3f}ms')}, "
+                f"stalls {_stalls(other)} vs {_stalls(base)}"
+            )
+            osg, bsg = _save_gbps(other), _save_gbps(base)
+            if osg is not None or bsg is not None:
+                tline += (
+                    ", save goodput "
+                    f"{_na(osg, '{:.4f}')} vs {_na(bsg, '{:.4f}')} GB/s"
+                )
+            lines.append(tline)
         # Pipeline diff: two train-ingest runs (readahead on vs cold)
         # compare on what the pipeline exists for — stall time, stalled
         # fraction, hit ratio — not just throughput.
