@@ -128,6 +128,15 @@ def test_probe_divergence_none_when_consistent():
     assert br.probe_divergence(0.0, 0.5) is None
 
 
+def test_probe_divergence_never_rounds_to_zero():
+    # Windows crushed 500x below the probe (host contention): the
+    # factor is 0.002 — rounding it to 0.0 would make build_note's
+    # 1/pdf inversion divide by zero.
+    pdf = br.probe_divergence(0.002, 1.0)
+    assert pdf is not None and pdf > 0
+    assert "ABOVE" in br.build_note(_fields(probe_divergence_factor=pdf))
+
+
 # ------------------------------------------------------------------ note --
 
 

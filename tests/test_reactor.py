@@ -362,6 +362,337 @@ def test_reactor_executor_retries_injected_503s():
     assert res.extra["executor_mode"] == "reactor"
 
 
+# ------------------------------------------------- TLS + h2 (ISSUE 19) ----
+
+
+def _tls_available() -> bool:
+    from tpubench.native.engine import get_engine
+
+    eng = get_engine()
+    return eng is not None and eng.tls_available()
+
+
+tls_required = pytest.mark.skipif(
+    not _tls_available(), reason="OpenSSL unavailable to the native engine"
+)
+
+
+@pytest.fixture(scope="module")
+def tlssrv():
+    """Self-signed TLS fake-GCS origin (no ALPN — also the h1.1-fallback
+    peer for ALPN-enabled pools)."""
+    be = FakeBackend.prepopulated("bench/file_", count=4, size=500_000)
+    with FakeGcsServer(be, tls=True) as srv:
+        yield srv, be
+
+
+def _hostport(server) -> tuple[str, int]:
+    u = urllib.parse.urlparse(server.endpoint)
+    return u.hostname, u.port
+
+
+@tls_required
+def test_reactor_tls_roundtrip_resume_and_counters(engine, tlssrv):
+    """Nonblocking TLS on the reactor: checksummed roundtrips, the
+    handshake counter advances, and conns opened AFTER the first
+    completed request resume the cached session (TLS 1.3 tickets ride
+    keep-alive reconnects)."""
+    srv, be = tlssrv
+    host, port = _hostport(srv)
+    stats0 = engine.stats()
+    pool = engine.pool_create(
+        4, 32, tls=True, cafile=srv.cafile, mode="reactor"
+    )
+    assert pool.mode == "reactor"
+    try:
+        # One task first: its completion caches the session ticket.
+        b0 = engine.alloc(500_000)
+        pool.submit(host, port, "/storage/v1/b/testbucket/o/bench%2Ffile_0"
+                    "?alt=media", b0, tag=0)
+        c = pool.next(timeout_ms=10_000)
+        assert c is not None and c["result"] == 500_000 and c["status"] == 200
+        assert bytes(b0.array) == be._objects["bench/file_0"].data.tobytes()
+        # Burst: the target pump opens the remaining conns against a
+        # non-empty queue; each new handshake resumes.
+        bufs = {}
+        for i in range(1, 9):
+            b = engine.alloc(500_000)
+            bufs[i] = b
+            pool.submit(
+                host, port,
+                f"/storage/v1/b/testbucket/o/bench%2Ffile_{i % 4}?alt=media",
+                b, tag=i,
+            )
+        got = 0
+        while got < 8:
+            cs = pool.next_batch(timeout_ms=10_000)
+            assert cs, "TLS reactor drain stalled"
+            for cc in cs:
+                assert cc["result"] == 500_000 and cc["status"] == 200, cc
+                want = be._objects[f"bench/file_{cc['tag'] % 4}"].data
+                assert bytes(bufs[cc["tag"]].array) == want.tobytes()
+            got += len(cs)
+    finally:
+        pool.close()
+        b0.free()
+        for b in bufs.values():
+            b.free()
+    delta = {k: v - stats0.get(k, 0) for k, v in engine.stats().items()}
+    assert delta["reactor_tls_handshakes"] >= 2
+    assert delta["reactor_tls_resumes"] >= 1
+    assert delta["reactor_completions"] >= 9
+
+
+@tls_required
+def test_reactor_tls_e2e_run_read_engages(tlssrv):
+    """ACCEPTANCE: ``--fetch-executor native`` against a TLS endpoint
+    runs reactor-mode — no silent legacy downgrade — and the bytes
+    survive the nonblocking receive path."""
+    from tpubench.workloads.read import run_read
+
+    srv, _ = tlssrv
+    cfg = _cfg(srv, "native", workers=4)
+    cfg.transport.tls_ca_file = srv.cafile
+    res = run_read(cfg)
+    assert res.errors == 0
+    assert res.extra["executor_mode"] == "reactor"
+    assert "executor_fallback" not in res.extra
+    assert res.bytes_total == 4 * 3 * 500_000
+
+
+@tls_required
+def test_reactor_tls_chaos_roundtrip_retries(monkeypatch):
+    """TLS under chaos: injected mid-body connection kills (the reset
+    shape) ride the retry ladder to byte-complete success on the
+    reactor's TLS path — and the post-reset reconnects stay on TLS."""
+    from tpubench.storage.fake import FaultPlan
+    from tpubench.workloads.read import run_read
+
+    be = FakeBackend.prepopulated("bench/file_", count=2, size=300_000)
+    be.fault = FaultPlan(read_error_rate=0.15, seed=11)
+    with FakeGcsServer(be, tls=True) as srv:
+        cfg = _cfg(srv, "native-reactor", workers=2)
+        cfg.workload.read_calls_per_worker = 4
+        cfg.transport.tls_ca_file = srv.cafile
+        cfg.transport.retry.initial_backoff_s = 0.005
+        cfg.transport.retry.max_backoff_s = 0.02
+        res = run_read(cfg)
+    assert res.errors == 0
+    assert res.bytes_total == 2 * 4 * 300_000
+    assert res.extra["executor_mode"] == "reactor"
+    assert res.extra["retries"] > 0  # the chaos plan really fired
+
+
+def test_reactor_h2_many_streams_exactly_once(engine):
+    """h2c prior-knowledge: many tasks multiplex as streams over at most
+    2 connections, each tag completes exactly once, and the h2 stream
+    counter attributes the multiplexing."""
+    from tpubench.storage.fake_h2_server import FakeH2Server
+
+    be = FakeBackend.prepopulated("bench/file_", count=4, size=200_000)
+    with FakeH2Server(be) as srv:
+        host, port = _hostport(srv)
+        stats0 = engine.stats()
+        pool = engine.pool_create(2, 64, mode="reactor", h2=True)
+        try:
+            n = 40
+            bufs = {}
+            for i in range(n):
+                b = engine.alloc(200_000)
+                bufs[i] = b
+                pool.submit(
+                    host, port,
+                    f"/storage/v1/b/testbucket/o/bench%2Ffile_{i % 4}"
+                    "?alt=media", b, tag=i,
+                )
+            seen: dict = {}
+            while len(seen) < n:
+                cs = pool.next_batch(timeout_ms=10_000)
+                assert cs, "h2 drain stalled"
+                for c in cs:
+                    assert c["tag"] not in seen, "duplicate completion"
+                    seen[c["tag"]] = c
+                    assert c["result"] == 200_000 and c["status"] == 200, c
+            for i, c in seen.items():
+                want = be._objects[f"bench/file_{i % 4}"].data
+                assert bytes(bufs[i].array) == want.tobytes()
+        finally:
+            pool.close()
+            for b in bufs.values():
+                b.free()
+    delta = {k: v - stats0.get(k, 0) for k, v in engine.stats().items()}
+    assert delta["reactor_h2_streams"] >= n
+    assert delta["h2_streams_opened"] >= n
+
+
+@tls_required
+def test_reactor_alpn_h2_over_tls(engine):
+    """ALPN against an h2-speaking TLS peer selects h2: streams open
+    over the TLS session and the bytes checksum."""
+    from tpubench.storage.fake_h2_server import FakeH2Server
+
+    be = FakeBackend.prepopulated("bench/file_", count=2, size=150_000)
+    with FakeH2Server(be, tls=True) as srv:
+        host, port = _hostport(srv)
+        stats0 = engine.stats()
+        pool = engine.pool_create(
+            2, 32, tls=True, cafile=srv.cafile, mode="reactor", h2=True
+        )
+        try:
+            bufs = {}
+            for i in range(12):
+                b = engine.alloc(150_000)
+                bufs[i] = b
+                pool.submit(
+                    host, port,
+                    f"/storage/v1/b/testbucket/o/bench%2Ffile_{i % 2}"
+                    "?alt=media", b, tag=i,
+                )
+            got = 0
+            while got < 12:
+                cs = pool.next_batch(timeout_ms=10_000)
+                assert cs, "ALPN h2 drain stalled"
+                for c in cs:
+                    assert c["result"] == 150_000 and c["status"] == 200, c
+                    want = be._objects[f"bench/file_{c['tag'] % 2}"].data
+                    assert bytes(bufs[c["tag"]].array) == want.tobytes()
+                got += len(cs)
+        finally:
+            pool.close()
+            for b in bufs.values():
+                b.free()
+    delta = {k: v - stats0.get(k, 0) for k, v in engine.stats().items()}
+    assert delta["reactor_h2_streams"] >= 12
+    assert delta["reactor_tls_handshakes"] >= 1
+
+
+@tls_required
+def test_reactor_alpn_falls_back_to_h11(engine, tlssrv):
+    """ALPN against a peer that never offers h2 (the plain TLS fake)
+    lands on http/1.1: roundtrips succeed, zero h2 streams open."""
+    srv, be = tlssrv
+    host, port = _hostport(srv)
+    stats0 = engine.stats()
+    pool = engine.pool_create(
+        2, 16, tls=True, cafile=srv.cafile, mode="reactor", h2=True
+    )
+    try:
+        bufs = {}
+        for i in range(6):
+            b = engine.alloc(500_000)
+            bufs[i] = b
+            pool.submit(
+                host, port,
+                f"/storage/v1/b/testbucket/o/bench%2Ffile_{i % 4}?alt=media",
+                b, tag=i,
+            )
+        got = 0
+        while got < 6:
+            cs = pool.next_batch(timeout_ms=10_000)
+            assert cs, "ALPN-fallback drain stalled"
+            for c in cs:
+                assert c["result"] == 500_000 and c["status"] == 200, c
+                want = be._objects[f"bench/file_{c['tag'] % 4}"].data
+                assert bytes(bufs[c["tag"]].array) == want.tobytes()
+            got += len(cs)
+    finally:
+        pool.close()
+        for b in bufs.values():
+            b.free()
+    delta = {k: v - stats0.get(k, 0) for k, v in engine.stats().items()}
+    assert delta["reactor_h2_streams"] == 0
+    assert delta["reactor_tls_handshakes"] >= 1
+
+
+@tls_required
+def test_degrade_ladder_tls_and_h2_repinned(engine, tlssrv, monkeypatch):
+    """Re-pinned 3-rung degrade contract for the new modes: a stale .so
+    (no tb_pool_create2) degrades a TLS reactor request to the legacy
+    blocking TLS pool (mode says so, bytes still flow); an h2 request
+    can NEVER degrade silently — h2 has no legacy fallback, so it
+    raises; and on the fresh .so the same TLS request engages the
+    reactor."""
+    from tpubench.native.engine import NativeError
+
+    srv, be = tlssrv
+    host, port = _hostport(srv)
+
+    def roundtrip(pool):
+        try:
+            b = engine.alloc(500_000)
+            pool.submit(
+                host, port,
+                "/storage/v1/b/testbucket/o/bench%2Ffile_0?alt=media",
+                b, tag=0,
+            )
+            c = pool.next(timeout_ms=10_000)
+            assert c is not None and c["result"] == 500_000
+            assert bytes(b.array) == be._objects["bench/file_0"].data.tobytes()
+        finally:
+            pool.close()
+            b.free()
+
+    # Fresh .so: TLS + reactor engages.
+    pool = engine.pool_create(2, 8, tls=True, cafile=srv.cafile,
+                              mode="reactor")
+    assert pool.mode == "reactor"
+    roundtrip(pool)
+    # Stale .so: TLS reactor request degrades to the legacy TLS pool.
+    monkeypatch.setattr(engine, "_has_pool_create2", False)
+    pool = engine.pool_create(2, 8, tls=True, cafile=srv.cafile,
+                              mode="reactor")
+    assert pool.mode == "threads"
+    roundtrip(pool)
+    # h2 on a stale .so is an impossible config: hard error, not a
+    # silent h1 downgrade.
+    with pytest.raises(NativeError):
+        engine.pool_create(2, 8, mode="reactor", h2=True)
+
+
+def test_run_read_counts_honest_fallback_warning(pysrv, monkeypatch, capsys):
+    """Plain ``native`` on a stale .so falls back with the ONE-LINE
+    counted warning and stamps the result; pinned ``native-reactor``
+    refuses the silent downgrade with a hard error."""
+    from tpubench.native.engine import get_engine
+    from tpubench.workloads import fetch_executor as fx
+    from tpubench.workloads.read import run_read
+
+    eng = get_engine()
+    monkeypatch.setattr(eng, "_has_pool_create2", False)
+    before = fx.executor_fallbacks()
+    res = run_read(_cfg(pysrv, "native", workers=2))
+    assert res.errors == 0
+    assert res.extra["executor_mode"] == "threads"
+    assert res.extra["executor_fallback"] is True
+    assert fx.executor_fallbacks() == before + 1
+    err = capsys.readouterr().err
+    assert "fell back to 'threads'" in err
+    assert f"fallback #{before + 1}" in err
+    with pytest.raises(RuntimeError, match="silent downgrade"):
+        run_read(_cfg(pysrv, "native-reactor", workers=2))
+
+
+def test_preflight_executor_check(pysrv, monkeypatch):
+    """The preflight predicts executor engagement: ok on a fresh .so,
+    warning detail for plain ``native`` on a stale one, FAIL for pinned
+    ``native-reactor``."""
+    from tpubench.native.engine import get_engine
+    from tpubench.workloads import preflight as pf
+
+    cfg = _cfg(pysrv, "native", workers=2)
+    check = pf._executor_check(cfg)
+    assert check["ok"] and "reactor engages" in check["detail"]
+
+    eng = get_engine()
+    monkeypatch.setattr(eng, "_has_pool_create2", False)
+    check = pf._executor_check(cfg)
+    assert check["ok"] and "stale" in check["detail"]
+    cfg.workload.fetch_executor = "native-reactor"
+    check = pf._executor_check(cfg)
+    assert not check["ok"]
+    assert "pinned native-reactor" in check["detail"]
+
+
 def test_reactor_executor_tune_admission_cap_survives(pysrv):
     """The PR-5 live actuation contract: the tune controller's
     runnable-queue admission cap still bounds and completes the run on

@@ -2,8 +2,9 @@
 reference shipped a real latency-slice data race, ssd_test/main.go:80).
 
 One stress binary (engine.cc + stress.cc: per-thread arrays, fetch
-pool, srv/discard, reactor exactly-once, stale churn, destroy hammer)
-built three ways — TSAN (races), ASAN with leak checking (heap errors;
+pool, srv/discard, reactor exactly-once, stale churn, destroy hammer,
+h2c multiplexing, TLS mid-handshake garbage/reset, and destroy with
+handshakes in flight) built three ways — TSAN (races), ASAN with leak checking (heap errors;
 the destroy-hammer phase is where an engine-teardown leak would hide),
 UBSAN non-recovering (UB traps) — via the matrix in
 ``tpubench.native.build``. A compiler lacking a sanitizer runtime

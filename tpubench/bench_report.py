@@ -125,7 +125,13 @@ def probe_divergence(
     if not probe_median or probe_median <= 0 or window_median <= 0:
         return None
     factor = window_median / probe_median
-    return round(factor, 2) if (factor > 3 or factor < 1 / 3) else None
+    if not (factor > 3 or factor < 1 / 3):
+        return None
+    # Round for the report, but never TO zero: a sub-0.005x factor (the
+    # windows were crushed, e.g. by host contention) must stay nonzero
+    # so build_note can invert it.
+    rounded = round(factor, 2)
+    return rounded if rounded > 0 else factor
 
 
 def build_note(f: dict) -> str:

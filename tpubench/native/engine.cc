@@ -97,6 +97,17 @@ enum {
   TB_STAT_REACTOR_RING_DEPTH_SUM,  // ring depth observed at each enqueue,
                                    // summed — mean depth = sum/completions
   TB_STAT_REACTOR_RING_DEPTH_MAX,  // max ring depth observed (per reset)
+  // Reactor TLS/h2 (the nonblocking transport state machines):
+  TB_STAT_REACTOR_TLS_HANDSHAKES,  // handshakes completed by the epoll-
+                                   // driven WANT_READ/WANT_WRITE machine
+  TB_STAT_REACTOR_TLS_RESUMES,     // handshakes that resumed a cached
+                                   // session (keep-alive reconnect hits)
+  TB_STAT_REACTOR_H2_STREAMS,      // h2 streams opened by the reactor
+                                   // (many per connection — the FIFO's
+                                   // in-flight dimension)
+  TB_STAT_REACTOR_FLOW_STALL_NS,   // ns flow-control credit (WINDOW_
+                                   // UPDATE) sat queued before reaching
+                                   // the wire — credit-return latency
   TB_STAT_COUNT
 };
 static int64_t tb_stats_v[TB_STAT_COUNT];
@@ -122,6 +133,10 @@ static const char* const tb_stats_names[TB_STAT_COUNT] = {
     "reactor_doorbell_wakes",
     "reactor_ring_depth_sum",
     "reactor_ring_depth_max",
+    "reactor_tls_handshakes",
+    "reactor_tls_resumes",
+    "reactor_h2_streams",
+    "reactor_flow_stall_ns",
 };
 
 static inline void tb_stat_add(int idx, int64_t v) {
@@ -479,6 +494,13 @@ static void (*SSL_get0_alpn_selected_)(const void*, const unsigned char**,
                                        unsigned*) = nullptr;
 static int (*X509_VERIFY_PARAM_set1_host_)(void*, const char*, size_t) = nullptr;
 static int (*X509_VERIFY_PARAM_set1_ip_asc_)(void*, const char*) = nullptr;
+// Nonblocking-reactor additions: WANT_READ/WANT_WRITE classification and
+// session resumption on keep-alive reconnect.
+static int (*SSL_get_error_)(const void*, int) = nullptr;
+static int (*SSL_session_reused_)(void*) = nullptr;
+static void* (*SSL_get1_session_)(void*) = nullptr;
+static int (*SSL_set_session_)(void*, void*) = nullptr;
+static void (*SSL_SESSION_free_)(void*) = nullptr;
 
 static bool do_load() {
   // RTLD_GLOBAL so libssl can resolve its libcrypto dependency if the
@@ -518,6 +540,11 @@ static bool do_load() {
   TB_SYM(libssl, SSL_get0_alpn_selected);
   TB_SYM(libcrypto, X509_VERIFY_PARAM_set1_host);
   TB_SYM(libcrypto, X509_VERIFY_PARAM_set1_ip_asc);
+  TB_SYM(libssl, SSL_get_error);
+  TB_SYM(libssl, SSL_session_reused);
+  TB_SYM(libssl, SSL_get1_session);
+  TB_SYM(libssl, SSL_set_session);
+  TB_SYM(libssl, SSL_SESSION_free);
 #undef TB_SYM
   return true;
 }
@@ -1809,11 +1836,14 @@ static void* worker_main(void* arg) {
 //     caller already serializes submits) with its own eventfd doorbell
 //     into the loop.
 //
-// Scope: plaintext HTTP/1.1 (what tb_srv_* and the loopback A/B speak,
-// and what the legacy pool's hot path serves). TLS and h2 stay on the
-// legacy pool / conn-handle stream machinery (tb_grpc_submit /
-// tb_h2_submit_get) — nonblocking TLS is a different state machine, and
-// the h2 path already multiplexes 32 streams per connection.
+// Scope: HTTP/1.1 and HTTP/2 over plaintext or TLS. TLS is a nonblocking
+// OpenSSL state machine driven by WANT_READ/WANT_WRITE off epoll
+// readiness (C_TLS_HANDSHAKE below), with session resumption cached per
+// target for keep-alive reconnects. h2 grows the same state machine to
+// frame multiplexing: many concurrent streams ride one connection (the
+// per-target FIFO's in-flight dimension), with connection+stream
+// flow-control credit surfaced through tb_stats_*. ALPN picks h2 vs
+// http/1.1 per target; plaintext h2 uses prior knowledge (test servers).
 // Error-code and retransmit contracts match the legacy pool exactly: the
 // first use of a kept-alive connection gets one retransmit on a fresh
 // socket (transient codes only); per-task errors land in the completion's
@@ -1822,14 +1852,40 @@ namespace rx {
 
 enum {
   C_CONNECTING = 0,
+  C_TLS_HANDSHAKE,  // SSL_connect in flight, driven by epoll readiness
   C_SEND,
   C_HDR,
   C_BODY,
   C_IDLE,
+  C_H2,             // established h2 session (streams carry the tasks)
+};
+
+// SSL_get_error results the nonblocking machine dispatches on.
+enum {
+  kSslErrWantRead = 2,
+  kSslErrWantWrite = 3,
+  kSslErrSyscall = 5,
+  kSslErrZeroReturn = 6,
 };
 
 struct Loop;
 struct Target;
+
+// One h2 stream in flight on a reactor connection (id == 0 = slot free).
+struct H2Stream {
+  uint32_t id;
+  fp::Task* task;
+  int64_t body_got;
+  int status;            // :status from response HEADERS (0 until seen)
+  int64_t content_len;   // -1 until response HEADERS carry content-length
+  int got_headers;
+  int64_t unacked;       // consumed DATA not yet returned as stream window
+};
+
+static const int kRxH2Streams = 32;              // streams per connection
+static const int64_t kRxStreamWindow = 1 << 20;  // SETTINGS initial window
+static const int64_t kRxConnWindow = 1 << 23;    // connection window target
+static const int kRxH2OutCap = 32 * 1024;        // pending-frame send buffer
 
 struct Conn {
   int fd;
@@ -1839,10 +1895,17 @@ struct Conn {
   uint32_t events;  // current epoll interest
   Target* target;
   Loop* loop;
-  fp::Task* task;   // in-flight task (null when IDLE)
+  fp::Task* task;   // in-flight task (null when IDLE); during
+                    // CONNECTING/TLS_HANDSHAKE: the task waiting for the
+                    // transport to come up (not yet begun)
   int64_t last_activity_ns;
   int resp_bytes;   // any response bytes seen for the CURRENT task
   int dead;         // closed this iteration; freed at the batch edge
+  // TLS (nonblocking): ssl != null once the handshake starts. tls_want
+  // records the last WANT_READ/WANT_WRITE so the epoll interest can
+  // follow OpenSSL's state machine, not just the socket direction.
+  void* ssl;
+  int tls_want;     // 0, EPOLLIN or EPOLLOUT
   // request send state
   char req[4608];
   int req_len, req_off;
@@ -1854,8 +1917,34 @@ struct Conn {
   int64_t content_len, body_got;
   // body bytes that arrived in the same recv as the headers
   int lo_off, lo_len;  // window into hdr[]
+  // ---- h2 flavor (ALPN selected h2, or prior-knowledge mode) ----
+  int h2;                   // transport is h2
+  int h2_started;           // preface+SETTINGS queued
+  uint32_t h2_next_stream;  // next odd stream id
+  int h2_nstreams;          // active streams
+  int h2_peer_max_streams;  // peer SETTINGS_MAX_CONCURRENT_STREAMS
+  uint8_t* h2_out;          // pending frame bytes (lazily allocated)
+  int h2_out_len, h2_out_off;
+  int64_t h2_wu_queued_ns;  // oldest unflushed WINDOW_UPDATE enqueue time
+  uint8_t h2_fh[9];         // frame-header accumulate
+  int h2_fh_got;
+  uint32_t h2_flen, h2_fstream;
+  uint8_t h2_ftype, h2_fflags;
+  int h2_fbuf_got;          // non-DATA payload accumulated into hdr[]
+  int h2_data_rem;          // DATA payload bytes still to stream
+  int h2_pad_rem;           // trailing padding still to discard
+  int h2_pad_pending;       // PADDED flag seen, pad-length byte unread
+  int64_t h2_conn_unacked;  // consumed bytes not yet conn-window-updated
+  uint8_t* h2_hb;           // HEADERS+CONTINUATION accumulate (lazy)
+  int h2_hb_len;
+  uint32_t h2_hdr_stream;   // stream whose header block is accumulating
+  uint8_t h2_hdr_flags;     // flags of the initiating HEADERS frame
+  int h2_hdr_cont;          // awaiting CONTINUATION
+  H2Stream h2_streams[kRxH2Streams];
   Conn* next;  // intrusive list per target
 };
+
+static const int kRxH2HbCap = 32 * 1024;  // header-block accumulate cap
 
 struct Target {
   char host[256];
@@ -1863,6 +1952,7 @@ struct Target {
   int resolved;  // sockaddr cached (getaddrinfo once per target)
   struct sockaddr_storage addr;
   socklen_t addr_len;
+  void* tls_session;  // cached SSL_SESSION: resumption on reconnect
   fp::Task *q_head, *q_tail;  // pending tasks FIFO
   Conn* conns;
   int n_conns;
@@ -1908,6 +1998,13 @@ struct Reactor {
   int inflight;  // atomic
   uint64_t rr;   // round-robin submit cursor (atomic)
   Loop* loops;
+  // Endpoint transport (reactor-wide, mirroring fp::Pool's):
+  int tls;
+  int insecure;
+  int h2_mode;   // 0 = h1 only; 1 = ALPN h2-or-http/1.1 (TLS);
+                 // 2 = h2 prior knowledge (plaintext test servers)
+  char cafile[512];
+  void* ssl_ctx; // one owned SSL_CTX reference for the reactor lifetime
 };
 
 static const int64_t kIoTimeoutNs = 60LL * 1000000000LL;  // legacy parity
@@ -1971,6 +2068,74 @@ static void complete_task(Loop* L, fp::Task* t, int64_t result) {
   ring_push(L, t);
 }
 
+// ---- transport I/O (plaintext or nonblocking TLS) ----
+// Same contract as send/recv on a nonblocking socket: >0 bytes moved,
+// 0 = orderly EOF (recv only), -1 with errno. OpenSSL's WANT_READ /
+// WANT_WRITE both surface as errno=EAGAIN with c->tls_want recording
+// WHICH readiness unblocks the machine (an SSL_read can want EPOLLOUT
+// mid-renegotiation) so callers can set epoll interest accordingly.
+static ssize_t rx_send(Conn* c, const void* p, size_t n) {
+  if (!c->ssl) {
+    ssize_t k = send(c->fd, p, n, MSG_NOSIGNAL);
+    if (k > 0) tb_stat_add(TB_STAT_BYTES_TX, k);
+    return k;
+  }
+  if (n > kTlsIoCap) n = kTlsIoCap;
+  errno = 0;
+  int k = tls::SSL_write_(c->ssl, p, static_cast<int>(n));
+  if (k > 0) {
+    c->tls_want = 0;
+    tb_stat_add(TB_STAT_BYTES_TX, k);
+    return k;
+  }
+  int err = tls::SSL_get_error_(c->ssl, k);
+  if (err == kSslErrWantRead) {
+    c->tls_want = EPOLLIN;
+    errno = EAGAIN;
+    return -1;
+  }
+  if (err == kSslErrWantWrite) {
+    c->tls_want = EPOLLOUT;
+    errno = EAGAIN;
+    return -1;
+  }
+  if (err == kSslErrSyscall && errno == EINTR) return -1;  // caller loops
+  if (errno == 0 || errno == EAGAIN) errno = ECONNRESET;
+  return -1;  // classified transient, like any mid-stream break (legacy)
+}
+
+static ssize_t rx_recv(Conn* c, void* p, size_t n) {
+  if (!c->ssl) {
+    ssize_t k = recv(c->fd, p, n, 0);
+    if (k > 0) tb_stat_add(TB_STAT_BYTES_RX, k);
+    return k;
+  }
+  if (n > kTlsIoCap) n = kTlsIoCap;
+  errno = 0;
+  int k = tls::SSL_read_(c->ssl, p, static_cast<int>(n));
+  if (k > 0) {
+    c->tls_want = 0;
+    tb_stat_add(TB_STAT_BYTES_RX, k);
+    return k;
+  }
+  int err = tls::SSL_get_error_(c->ssl, k);
+  if (err == kSslErrZeroReturn) return 0;  // close_notify = orderly EOF
+  if (err == kSslErrWantRead) {
+    c->tls_want = EPOLLIN;
+    errno = EAGAIN;
+    return -1;
+  }
+  if (err == kSslErrWantWrite) {
+    c->tls_want = EPOLLOUT;
+    errno = EAGAIN;
+    return -1;
+  }
+  if (err == kSslErrSyscall && k == 0) return 0;  // EOF sans close_notify
+  if (err == kSslErrSyscall && errno == EINTR) return -1;  // caller loops
+  if (errno == 0 || errno == EAGAIN) errno = ECONNRESET;
+  return -1;
+}
+
 // ---- connection helpers ----
 static void conn_want(Conn* c, uint32_t ev) {
   if (c->registered && c->events == ev) return;
@@ -1993,6 +2158,15 @@ static void conn_free(Loop* L, Conn* c) {
   if (*pp) *pp = c->next;
   t->n_conns--;
   epoll_ctl(L->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+  if (c->ssl) {
+    tls::SSL_shutdown_(c->ssl);  // best-effort close_notify (nonblocking)
+    tls::SSL_free_(c->ssl);
+    c->ssl = nullptr;
+  }
+  free(c->h2_out);
+  c->h2_out = nullptr;
+  free(c->h2_hb);
+  c->h2_hb = nullptr;
   close(c->fd);
   tb_stat_add(TB_STAT_CONN_CLOSES, 1);
   c->dead = 1;
@@ -2061,14 +2235,30 @@ static void conn_fail(Loop* L, Conn* c, int64_t code) {
   pump_target(L, t);
 }
 
+// Cache this connection's TLS session on the target so the NEXT fresh
+// connection resumes it (abbreviated handshake on keep-alive reconnect).
+// Captured on the first completed request, not at handshake time: TLS 1.3
+// session tickets arrive after the handshake, and by the first response
+// they have been consumed into the session.
+static void tls_cache_session(Conn* c) {
+  if (!c->ssl || !c->fresh) return;
+  void* sess = tls::SSL_get1_session_(c->ssl);
+  if (!sess) return;
+  if (c->target->tls_session)
+    tls::SSL_SESSION_free_(c->target->tls_session);
+  c->target->tls_session = sess;
+}
+
 // Finish the current task successfully and decide connection reuse.
 static void conn_finish(Loop* L, Conn* c) {
   fp::Task* task = c->task;
   c->task = nullptr;
+  tls_cache_session(c);
   c->fresh = 0;
   task->status = c->status;
   int reusable = c->content_len >= 0 && !c->server_close &&
-                 c->http_minor >= 1 && !c->junk;
+                 c->http_minor >= 1 && !c->junk &&
+                 !(c->ssl && tls::SSL_pending_(c->ssl) > 0);
   complete_task(L, task, c->body_got);
   if (!reusable) {
     Target* t = c->target;
@@ -2082,8 +2272,371 @@ static void conn_finish(Loop* L, Conn* c) {
   pump_target(L, c->target);
 }
 
-// Begin a task on an idle/new connection: build the request and enter
-// the SEND state (the actual write happens in conn_io).
+// =================== h2 flavor: nonblocking frame multiplexing ==========
+// The h1 state machine above owns one task per connection; the h2 flavor
+// owns a STREAM TABLE — queued tasks become concurrent streams on the
+// same socket, which is where the per-target FIFO's in-flight dimension
+// moves from sockets to stream ids. Frame building reuses the blocking
+// path's HPACK helpers (h2::hp_header / h2::parse_header_block); frame
+// I/O is rebuilt nonblocking: sends accumulate in h2_out and drain on
+// writability, DATA payloads stream straight into task buffers.
+
+static void conn_h2_io(Loop* L, Conn* c);
+
+// Ensure `need` bytes of send-buffer room (compacts; lazily allocates).
+static int h2_out_room(Conn* c, int need) {
+  if (!c->h2_out) {
+    c->h2_out = static_cast<uint8_t*>(malloc(kRxH2OutCap));
+    if (!c->h2_out) return 0;
+  }
+  if (c->h2_out_off > 0) {
+    memmove(c->h2_out, c->h2_out + c->h2_out_off,
+            c->h2_out_len - c->h2_out_off);
+    c->h2_out_len -= c->h2_out_off;
+    c->h2_out_off = 0;
+  }
+  return kRxH2OutCap - c->h2_out_len >= need;
+}
+
+// Append one frame (caller guaranteed room via h2_out_room).
+static void h2_out_frame(Conn* c, uint8_t type, uint8_t flags,
+                         uint32_t stream, const uint8_t* payload,
+                         uint32_t len) {
+  uint8_t* p = c->h2_out + c->h2_out_len;
+  p[0] = len >> 16;
+  p[1] = len >> 8;
+  p[2] = len;
+  p[3] = type;
+  p[4] = flags;
+  h2::put32(p + 5, stream & 0x7fffffffu);
+  if (len) memcpy(p + 9, payload, len);
+  c->h2_out_len += 9 + static_cast<int>(len);
+}
+
+// Queue the session prologue: preface, SETTINGS (zero HPACK dynamic
+// table — parse_header_block never indexes; finite per-stream window so
+// flow control is real, not 2^31-sized), push disabled, and the
+// connection window top-up.
+static int h2_session_begin(Conn* c) {
+  if (!h2_out_room(c, 128)) return -ENOMEM;
+  memcpy(c->h2_out + c->h2_out_len, h2::kPreface, 24);
+  c->h2_out_len += 24;
+  uint8_t s[18];
+  s[0] = 0; s[1] = 0x1;  // HEADER_TABLE_SIZE = 0
+  h2::put32(s + 2, 0);
+  s[6] = 0; s[7] = 0x2;  // ENABLE_PUSH = 0
+  h2::put32(s + 8, 0);
+  s[12] = 0; s[13] = 0x4;  // INITIAL_WINDOW_SIZE
+  h2::put32(s + 14, static_cast<uint32_t>(kRxStreamWindow));
+  h2_out_frame(c, 4, 0, 0, s, 18);
+  uint8_t wu[4];
+  h2::put32(wu, static_cast<uint32_t>(kRxConnWindow - 65535));
+  h2_out_frame(c, 8, 0, 0, wu, 4);
+  tb_stat_add(TB_STAT_H2_WINDOW_UPDATES_TX, 1);
+  c->h2_started = 1;
+  c->h2_next_stream = 1;
+  c->h2_peer_max_streams = kRxH2Streams;
+  c->state = C_H2;
+  return 0;
+}
+
+// Can this connection take another queued task as a new stream?
+static int h2_can_admit(Conn* c) {
+  if (!c->h2 || !c->h2_started || c->dead) return 0;
+  int max = c->h2_peer_max_streams < kRxH2Streams ? c->h2_peer_max_streams
+                                                  : kRxH2Streams;
+  if (c->h2_nstreams >= max) return 0;
+  if (c->h2_next_stream >= 0x40000000u) return 0;  // id space spent
+  // Room for a worst-case HEADERS frame, keeping slack for control
+  // frames (ACKs / WINDOW_UPDATEs are tens of bytes).
+  return h2_out_room(c, 4608 + 9 + 256);
+}
+
+static H2Stream* rx_h2_stream_of(Conn* c, uint32_t id) {
+  if (id == 0) return nullptr;
+  for (int i = 0; i < kRxH2Streams; i++)
+    if (c->h2_streams[i].id == id) return &c->h2_streams[i];
+  return nullptr;
+}
+
+static void h2_stream_done(Loop* L, Conn* c, H2Stream* s, int64_t result) {
+  fp::Task* task = s->task;
+  task->status = s->status;
+  s->id = 0;
+  s->task = nullptr;
+  c->h2_nstreams--;
+  tls_cache_session(c);
+  c->fresh = 0;  // a completed stream proves the connection live
+  complete_task(L, task, result);
+}
+
+// Fail one stream, honoring the stale-keep-alive retransmit discipline
+// per stream: first failure on a NON-fresh connection with none of this
+// stream's response seen retransmits once on a fresh socket.
+static void h2_stream_fail(Loop* L, Conn* c, H2Stream* s, int64_t code) {
+  fp::Task* task = s->task;
+  int saw = s->got_headers || s->body_got > 0;
+  s->id = 0;
+  s->task = nullptr;
+  c->h2_nstreams--;
+  int permanent = code == TB_EPROTO || code == TB_ETOOBIG ||
+                  code == TB_ECHUNKED || code == TB_ETLS;
+  if (!c->fresh && !saw && task->attempt == 0 && !permanent) {
+    task->attempt = 1;
+    target_queue_push(c->target, task, /*front=*/1);
+  } else {
+    complete_task(L, task, code);
+  }
+}
+
+// Connection-level failure: every active stream settles (retransmit rule
+// per stream), then the socket dies.
+static void h2_conn_fail(Loop* L, Conn* c, int64_t code) {
+  Target* t = c->target;
+  for (int i = 0; i < kRxH2Streams; i++)
+    if (c->h2_streams[i].id) h2_stream_fail(L, c, &c->h2_streams[i], code);
+  if (c->task) {  // transport-pending task (pre-session failure)
+    fp::Task* task = c->task;
+    c->task = nullptr;
+    complete_task(L, task, code);
+  }
+  conn_free(L, c);
+  pump_target(L, t);
+}
+
+// Open a queued task as a new stream: HPACK-encode the request (h2 wants
+// lowercase names; Host becomes :authority) and queue HEADERS with
+// END_STREAM|END_HEADERS.
+static void h2_admit(Loop* L, Conn* c, fp::Task* task) {
+  uint8_t hb[4608];
+  size_t n = 0;
+  char auth[300];
+  snprintf(auth, sizeof auth, "%s:%d", task->host, task->port);
+  n += h2::hp_header(hb + n, ":method", "GET");
+  n += h2::hp_header(hb + n, ":scheme", c->loop->r->tls ? "https" : "http");
+  n += h2::hp_header(hb + n, ":authority", auth);
+  n += h2::hp_header(hb + n, ":path", task->path);
+  const char* h = task->headers;
+  char name[256], val[2048];
+  int bad = 0;
+  while (*h && !bad) {
+    const char* eol = strstr(h, "\r\n");
+    if (!eol) eol = h + strlen(h);
+    const char* colon = static_cast<const char*>(
+        memchr(h, ':', static_cast<size_t>(eol - h)));
+    if (colon && colon > h) {
+      size_t nl = static_cast<size_t>(colon - h);
+      const char* v = colon + 1;
+      while (v < eol && *v == ' ') v++;
+      size_t vl = static_cast<size_t>(eol - v);
+      if (nl >= sizeof name || vl >= sizeof val) {
+        bad = 1;
+        break;
+      }
+      for (size_t i = 0; i < nl; i++)
+        name[i] = static_cast<char>(tolower(static_cast<unsigned char>(h[i])));
+      name[nl] = 0;
+      memcpy(val, v, vl);
+      val[vl] = 0;
+      // Connection-specific headers don't exist in h2; Host rode in as
+      // :authority above.
+      if (strcmp(name, "host") != 0 && strcmp(name, "connection") != 0) {
+        if (n + nl + vl + 12 > sizeof hb) {
+          bad = 1;
+          break;
+        }
+        n += h2::hp_header(hb + n, name, val);
+      }
+    }
+    h = *eol ? eol + 2 : eol;
+  }
+  if (bad) {
+    complete_task(L, task, TB_EPROTO);
+    return;
+  }
+  H2Stream* s = nullptr;
+  for (int i = 0; i < kRxH2Streams; i++)
+    if (c->h2_streams[i].id == 0) {
+      s = &c->h2_streams[i];
+      break;
+    }
+  if (!s || !h2_out_room(c, static_cast<int>(n) + 9)) {
+    // h2_can_admit guards both; belt+braces.
+    target_queue_push(c->target, task, /*front=*/1);
+    return;
+  }
+  memset(s, 0, sizeof *s);
+  s->id = c->h2_next_stream;
+  c->h2_next_stream += 2;
+  s->task = task;
+  s->content_len = -1;
+  c->h2_nstreams++;
+  task->start_ns = tb_now_ns();
+  h2_out_frame(c, 1, 0x4 | 0x1 /*END_HEADERS|END_STREAM*/, s->id, hb,
+               static_cast<uint32_t>(n));
+  tb_stat_add(TB_STAT_H2_STREAMS_OPENED, 1);
+  tb_stat_add(TB_STAT_REACTOR_H2_STREAMS, 1);
+}
+
+// Return consumed flow-control credit. The WHOLE DATA frame length
+// (padding included) counts against both windows, so the caller credits
+// once per frame. Updates are queued at half-window consumption;
+// REACTOR_FLOW_STALL_NS measures how long queued credit waits for the
+// wire (stamped here, settled when h2_out drains).
+static void h2_credit(Conn* c, H2Stream* s, int64_t nbytes) {
+  c->h2_conn_unacked += nbytes;
+  if (s) s->unacked += nbytes;
+  int queued = 0;
+  uint8_t wu[4];
+  if (c->h2_conn_unacked > kRxConnWindow / 2 && h2_out_room(c, 13)) {
+    h2::put32(wu, static_cast<uint32_t>(c->h2_conn_unacked));
+    h2_out_frame(c, 8, 0, 0, wu, 4);
+    c->h2_conn_unacked = 0;
+    tb_stat_add(TB_STAT_H2_WINDOW_UPDATES_TX, 1);
+    queued = 1;
+  }
+  if (s && s->unacked > kRxStreamWindow / 2 && h2_out_room(c, 13)) {
+    h2::put32(wu, static_cast<uint32_t>(s->unacked));
+    h2_out_frame(c, 8, 0, s->id, wu, 4);
+    s->unacked = 0;
+    tb_stat_add(TB_STAT_H2_WINDOW_UPDATES_TX, 1);
+    queued = 1;
+  }
+  if (queued && !c->h2_wu_queued_ns) c->h2_wu_queued_ns = tb_now_ns();
+}
+
+// A stream's response ended (END_STREAM): settle against content-length
+// the way the h1 machine settles against TB_ESHORT.
+static void h2_stream_end(Loop* L, Conn* c, H2Stream* s) {
+  if (s->content_len >= 0 && s->body_got != s->content_len) {
+    h2_stream_fail(L, c, s,
+                   s->body_got < s->content_len ? TB_ESHORT : TB_EPROTO);
+    return;
+  }
+  h2_stream_done(L, c, s, s->body_got);
+}
+
+// Parse one complete header block for a stream (response HEADERS or
+// trailers). Returns 0, or a connection-fatal code.
+static int64_t h2_on_header_block(Loop* L, Conn* c, const uint8_t* p,
+                                  size_t n, uint32_t stream_id,
+                                  int end_stream) {
+  int status = 0;
+  int64_t clen = -1;
+  if (h2::parse_header_block(p, n, nullptr, &status, &clen) != 0)
+    return TB_EPROTO;
+  H2Stream* s = rx_h2_stream_of(c, stream_id);
+  if (!s) return 0;  // already settled (e.g. RST after overflow)
+  if (!s->got_headers) {
+    s->got_headers = 1;
+    s->status = status ? status : s->status;
+    if (clen >= 0) s->content_len = clen;
+    if (s->task->first_byte_ns == 0) s->task->first_byte_ns = tb_now_ns();
+    // The h1 machine rejects a known-length body that can't fit before
+    // landing a byte; same here.
+    if (s->task->buf && s->content_len > s->task->buf_len) {
+      h2_stream_fail(L, c, s, TB_ETOOBIG);
+      return 0;
+    }
+  }
+  if (end_stream && s->id) h2_stream_end(L, c, s);
+  return 0;
+}
+
+// Dispatch one fully-buffered non-DATA frame (payload in c->hdr).
+// Returns 0, or a code that fails the whole connection.
+static int64_t h2_on_frame(Loop* L, Conn* c) {
+  const uint8_t* p = c->hdr;
+  uint32_t len = c->h2_flen;
+  switch (c->h2_ftype) {
+    case 1: {  // HEADERS
+      uint32_t off = 0, end = len;
+      if (c->h2_fflags & 0x8) {  // PADDED
+        if (len < 1) return TB_EPROTO;
+        uint8_t pl = p[0];
+        off = 1;
+        if (1u + pl > len) return TB_EPROTO;
+        end = len - pl;
+      }
+      if (c->h2_fflags & 0x20) {  // PRIORITY fields
+        if (off + 5 > end) return TB_EPROTO;
+        off += 5;
+      }
+      if (off > end) return TB_EPROTO;
+      if (c->h2_fflags & 0x4) {  // END_HEADERS: parse in place
+        return h2_on_header_block(L, c, p + off, end - off, c->h2_fstream,
+                                  c->h2_fflags & 0x1);
+      }
+      // CONTINUATION follows: start accumulating.
+      if (!c->h2_hb) {
+        c->h2_hb = static_cast<uint8_t*>(malloc(kRxH2HbCap));
+        if (!c->h2_hb) return -ENOMEM;
+      }
+      if (end - off > static_cast<uint32_t>(kRxH2HbCap)) return TB_EPROTO;
+      memcpy(c->h2_hb, p + off, end - off);
+      c->h2_hb_len = static_cast<int>(end - off);
+      c->h2_hdr_stream = c->h2_fstream;
+      c->h2_hdr_flags = c->h2_fflags;
+      c->h2_hdr_cont = 1;
+      return 0;
+    }
+    case 9: {  // CONTINUATION
+      if (!c->h2_hdr_cont || c->h2_fstream != c->h2_hdr_stream)
+        return TB_EPROTO;
+      if (c->h2_hb_len + len > static_cast<uint32_t>(kRxH2HbCap))
+        return TB_EPROTO;
+      memcpy(c->h2_hb + c->h2_hb_len, p, len);
+      c->h2_hb_len += static_cast<int>(len);
+      if (c->h2_fflags & 0x4) {
+        c->h2_hdr_cont = 0;
+        return h2_on_header_block(L, c, c->h2_hb,
+                                  static_cast<size_t>(c->h2_hb_len),
+                                  c->h2_hdr_stream, c->h2_hdr_flags & 0x1);
+      }
+      return 0;
+    }
+    case 3: {  // RST_STREAM
+      if (len != 4) return TB_EPROTO;
+      tb_stat_add(TB_STAT_H2_RST_RX, 1);
+      H2Stream* s = rx_h2_stream_of(c, c->h2_fstream);
+      if (s) h2_stream_fail(L, c, s, -ECONNRESET);
+      return 0;
+    }
+    case 4: {  // SETTINGS
+      if (c->h2_fflags & 0x1) return 0;  // ACK of ours
+      if (len % 6 != 0) return TB_EPROTO;
+      for (uint32_t i = 0; i + 6 <= len; i += 6) {
+        uint16_t id = static_cast<uint16_t>(p[i] << 8 | p[i + 1]);
+        uint32_t v = static_cast<uint32_t>(p[i + 2]) << 24 |
+                     static_cast<uint32_t>(p[i + 3]) << 16 |
+                     static_cast<uint32_t>(p[i + 4]) << 8 | p[i + 5];
+        if (id == 0x3)  // MAX_CONCURRENT_STREAMS (0 would deadlock: clamp)
+          c->h2_peer_max_streams =
+              v == 0 ? 1
+                     : (v > static_cast<uint32_t>(kRxH2Streams)
+                            ? kRxH2Streams
+                            : static_cast<int>(v));
+      }
+      if (!h2_out_room(c, 9)) return -ENOMEM;
+      h2_out_frame(c, 4, 0x1 /*ACK*/, 0, nullptr, 0);
+      return 0;
+    }
+    case 6: {  // PING
+      if (len != 8) return TB_EPROTO;
+      if (c->h2_fflags & 0x1) return 0;
+      if (!h2_out_room(c, 17)) return -ENOMEM;
+      h2_out_frame(c, 6, 0x1 /*ACK*/, 0, p, 8);
+      return 0;
+    }
+    case 7:  // GOAWAY: the peer is done with this connection
+      tb_stat_add(TB_STAT_H2_GOAWAY_RX, 1);
+      return -ECONNRESET;
+    case 5:  // PUSH_PROMISE with ENABLE_PUSH=0 advertised is a violation
+      return TB_EPROTO;
+    default:  // PRIORITY / WINDOW_UPDATE (we send no DATA) / unknown
+      return 0;
+  }
+}
 static void conn_begin(Loop* L, Conn* c, fp::Task* task) {
   c->task = task;
   c->resp_bytes = 0;
@@ -2114,6 +2667,145 @@ static void conn_begin(Loop* L, Conn* c, fp::Task* task) {
 }
 
 static void conn_io(Loop* L, Conn* c);
+
+// ---- TLS handshake (nonblocking SSL_connect off epoll readiness) ----
+
+// Attach an SSL object to a connected fd: SNI + hostname verification +
+// ALPN offer + cached-session resumption, mirroring tb_conn_tls's setup.
+// Returns 0 (state = C_TLS_HANDSHAKE) or TB_ETLS.
+static int64_t rx_tls_begin(Loop* L, Conn* c) {
+  Reactor* r = L->r;
+  Target* t = c->target;
+  void* ssl = tls::SSL_new_(r->ssl_ctx);
+  if (!ssl) return TB_ETLS;
+  // SNI (SSL_set_tlsext_host_name macro = SSL_ctrl 55/0).
+  tls::SSL_ctrl_(ssl, 55, 0, t->host);
+  if (!r->insecure) {
+    void* param = tls::SSL_get0_param_(ssl);
+    struct in_addr a4;
+    struct in6_addr a6;
+    int is_ip = inet_pton(AF_INET, t->host, &a4) == 1 ||
+                inet_pton(AF_INET6, t->host, &a6) == 1;
+    int ok = is_ip ? tls::X509_VERIFY_PARAM_set1_ip_asc_(param, t->host)
+                   : tls::X509_VERIFY_PARAM_set1_host_(param, t->host, 0);
+    if (ok != 1) {
+      tls::SSL_free_(ssl);
+      return TB_ETLS;
+    }
+  }
+  if (r->h2_mode == 1) {
+    // Offer h2 AND http/1.1: unlike the gRPC conn path, the reactor has
+    // an h1 state machine to fall back to when the server declines h2.
+    static const unsigned char kAlpn[] = {2,  'h', '2', 8,   'h', 't',
+                                          't', 'p', '/', '1', '.', '1'};
+    if (tls::SSL_set_alpn_protos_(ssl, kAlpn, sizeof kAlpn) != 0) {
+      tls::SSL_free_(ssl);
+      return TB_ETLS;
+    }
+  }
+  if (t->tls_session) tls::SSL_set_session_(ssl, t->tls_session);
+  if (tls::SSL_set_fd_(ssl, c->fd) != 1) {
+    tls::SSL_free_(ssl);
+    return TB_ETLS;
+  }
+  c->ssl = ssl;
+  c->state = C_TLS_HANDSHAKE;
+  return 0;
+}
+
+static void conn_transport_ready(Loop* L, Conn* c);
+
+// Drive SSL_connect one readiness notification's worth: WANT_READ /
+// WANT_WRITE retune the epoll interest; completion classifies ALPN and
+// hands off; failure is terminal for the pending task (handshakes only
+// ever run on fresh sockets — legacy parity with the worker's
+// tb_conn_tls failure path, transient-errno carve-out included).
+static void rx_tls_handshake(Loop* L, Conn* c) {
+  errno = 0;
+  int k = tls::SSL_connect_(c->ssl);
+  if (k == 1) {
+    tb_stat_add(TB_STAT_TLS_HANDSHAKES, 1);
+    tb_stat_add(TB_STAT_REACTOR_TLS_HANDSHAKES, 1);
+    if (tls::SSL_session_reused_(c->ssl))
+      tb_stat_add(TB_STAT_REACTOR_TLS_RESUMES, 1);
+    if (L->r->h2_mode == 1) {
+      const unsigned char* sel = nullptr;
+      unsigned sel_len = 0;
+      tls::SSL_get0_alpn_selected_(c->ssl, &sel, &sel_len);
+      if (sel_len == 2 && memcmp(sel, "h2", 2) == 0) c->h2 = 1;
+    }
+    conn_transport_ready(L, c);
+    return;
+  }
+  int err = tls::SSL_get_error_(c->ssl, k);
+  if (err == kSslErrWantRead) {
+    conn_want(c, EPOLLIN);
+    return;
+  }
+  if (err == kSslErrWantWrite) {
+    conn_want(c, EPOLLOUT);
+    return;
+  }
+  int e = errno;
+  int64_t code = (e == EAGAIN || e == EWOULDBLOCK || e == ETIMEDOUT ||
+                  e == ECONNRESET || e == EPIPE || e == EINTR)
+                     ? -e
+                     : TB_ETLS;
+  fp::Task* task = c->task;
+  c->task = nullptr;
+  Target* t = c->target;
+  conn_free(L, c);
+  if (task) complete_task(L, task, code);
+  pump_target(L, t);
+}
+
+// The transport (TCP, and TLS when configured) is up: start the h2
+// session or begin the pending h1 request.
+static void conn_transport_ready(Loop* L, Conn* c) {
+  fp::Task* task = c->task;
+  c->task = nullptr;
+  if (c->h2) {
+    if (h2_session_begin(c) != 0) {
+      Target* t = c->target;
+      conn_free(L, c);
+      if (task) complete_task(L, task, -ENOMEM);
+      pump_target(L, t);
+      return;
+    }
+    if (task) h2_admit(L, c, task);
+    conn_h2_io(L, c);  // flush the prologue + HEADERS now
+    if (!c->dead) pump_target(L, c->target);
+    return;
+  }
+  if (!task) {  // nothing pending anymore (cannot happen today)
+    c->state = C_IDLE;
+    conn_want(c, EPOLLIN);
+    pump_target(L, c->target);
+    return;
+  }
+  conn_begin(L, c, task);
+  if (c->task && c->state == C_SEND) conn_io(L, c);
+}
+
+// TCP connect completed: count it and enter the transport bring-up.
+static void conn_connected(Loop* L, Conn* c) {
+  tb_stat_add(TB_STAT_CONNECTS, 1);
+  if (L->r->tls) {
+    int64_t rc = rx_tls_begin(L, c);
+    if (rc != 0) {
+      fp::Task* task = c->task;
+      c->task = nullptr;
+      Target* t = c->target;
+      conn_free(L, c);
+      if (task) complete_task(L, task, rc);
+      pump_target(L, t);
+      return;
+    }
+    rx_tls_handshake(L, c);
+    return;
+  }
+  conn_transport_ready(L, c);
+}
 
 // Open a new nonblocking connection for `t` carrying `task`.
 static void conn_open(Loop* L, Target* t, fp::Task* task) {
@@ -2150,31 +2842,25 @@ static void conn_open(Loop* L, Target* t, fp::Task* task) {
   c->loop = L;
   c->target = t;
   c->fresh = 1;
+  if (L->r->h2_mode == 2) c->h2 = 1;  // prior-knowledge h2c
+  c->task = task;  // pending: begun once the transport is up
+  task->start_ns = tb_now_ns();
   c->next = t->conns;
   t->conns = c;
   t->n_conns++;
   int rc = connect(fd, reinterpret_cast<struct sockaddr*>(&t->addr),
                    t->addr_len);
-  int cerr = errno;  // conn_begin's epoll calls must not clobber it
-  conn_begin(L, c, task);  // SEND state + request buffer + registration
-  if (!c->task) {
-    // Request build failed (inputs are bounded at submit; belt+braces):
-    // conn_begin already completed the task with the error.
-    conn_free(L, c);
-    return;
-  }
   if (rc == 0) {
-    tb_stat_add(TB_STAT_CONNECTS, 1);
-    conn_io(L, c);
+    conn_connected(L, c);
     return;
   }
-  if (cerr != EINPROGRESS) {
+  if (errno != EINPROGRESS) {
     // conn_fail would retransmit; a connect failure on a FRESH socket is
     // terminal for the task (legacy parity: tb_http_connect error).
-    fp::Task* task2 = c->task;
+    int cerr = errno;
     c->task = nullptr;
     conn_free(L, c);
-    complete_task(L, task2, -cerr);
+    complete_task(L, task, -cerr);
     pump_target(L, t);
     return;
   }
@@ -2192,6 +2878,22 @@ static void conn_open(Loop* L, Target* t, fp::Task* task) {
 static void pump_target(Loop* L, Target* t) {
   for (;;) {
     if (!t->q_head) return;
+    // h2: established connections with free stream slots take queued
+    // tasks first — the FIFO's in-flight dimension is stream ids, not
+    // sockets. Retransmits still demand a FRESH socket (below).
+    if (t->q_head->attempt == 0) {
+      Conn* hc = nullptr;
+      for (Conn* c = t->conns; c; c = c->next)
+        if (h2_can_admit(c)) {
+          hc = c;
+          break;
+        }
+      if (hc) {
+        h2_admit(L, hc, target_queue_pop(t));
+        conn_h2_io(L, hc);  // flush the HEADERS now
+        continue;
+      }
+    }
     Conn* idle = nullptr;
     for (Conn* c = t->conns; c; c = c->next)
       if (c->state == C_IDLE && !c->task) {
@@ -2271,6 +2973,14 @@ static void conn_body_done(Loop* L, Conn* c) { conn_finish(L, c); }
 // state machine until EAGAIN or the task settles.
 static void conn_io(Loop* L, Conn* c) {
   c->last_activity_ns = tb_now_ns();
+  if (c->state == C_H2) {
+    conn_h2_io(L, c);
+    return;
+  }
+  if (c->state == C_TLS_HANDSHAKE) {
+    rx_tls_handshake(L, c);
+    return;
+  }
   if (c->state == C_CONNECTING) {
     int err = 0;
     socklen_t len = sizeof err;
@@ -2285,21 +2995,25 @@ static void conn_io(Loop* L, Conn* c) {
       pump_target(L, t);
       return;
     }
-    tb_stat_add(TB_STAT_CONNECTS, 1);
-    c->state = C_SEND;
-    conn_want(c, EPOLLIN | EPOLLOUT);
+    conn_connected(L, c);
+    return;
   }
   if (c->state == C_SEND) {
     while (c->req_off < c->req_len) {
-      ssize_t k = send(c->fd, c->req + c->req_off, c->req_len - c->req_off,
-                       MSG_NOSIGNAL);
+      ssize_t k = rx_send(c, c->req + c->req_off, c->req_len - c->req_off);
       if (k < 0) {
         if (errno == EINTR) continue;
-        if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          // A TLS write can want READ readiness (and vice versa): follow
+          // the state machine, not the socket direction.
+          conn_want(c, c->ssl && c->tls_want == EPOLLIN
+                           ? EPOLLIN
+                           : EPOLLIN | EPOLLOUT);
+          return;
+        }
         conn_fail(L, c, errno ? -errno : -ECONNRESET);
         return;
       }
-      tb_stat_add(TB_STAT_BYTES_TX, k);
       c->req_off += static_cast<int>(k);
     }
     c->state = C_HDR;
@@ -2312,10 +3026,15 @@ static void conn_io(Loop* L, Conn* c) {
         conn_fail(L, c, TB_EPROTO);
         return;
       }
-      ssize_t k = recv(c->fd, c->hdr + c->hlen, cap, 0);
+      ssize_t k = rx_recv(c, c->hdr + c->hlen, cap);
       if (k < 0) {
         if (errno == EINTR) continue;
-        if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          conn_want(c, c->ssl && c->tls_want == EPOLLOUT
+                           ? EPOLLIN | EPOLLOUT
+                           : EPOLLIN);
+          return;
+        }
         conn_fail(L, c, errno ? -errno : -ECONNRESET);
         return;
       }
@@ -2323,7 +3042,6 @@ static void conn_io(Loop* L, Conn* c) {
         conn_fail(L, c, TB_ESHORT);
         return;
       }
-      tb_stat_add(TB_STAT_BYTES_RX, k);
       c->resp_bytes = 1;
       if (c->task->first_byte_ns == 0) c->task->first_byte_ns = tb_now_ns();
       c->hlen += static_cast<int>(k);
@@ -2369,10 +3087,15 @@ static void conn_io(Loop* L, Conn* c) {
         // byte — EOF proves an exact fit; more data is a real overflow
         // (legacy request_on parity).
         uint8_t probe;
-        ssize_t pk = recv(c->fd, &probe, 1, 0);
+        ssize_t pk = rx_recv(c, &probe, 1);
         if (pk < 0) {
           if (errno == EINTR) continue;
-          if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+          if (errno == EAGAIN || errno == EWOULDBLOCK) {
+            conn_want(c, c->ssl && c->tls_want == EPOLLOUT
+                             ? EPOLLIN | EPOLLOUT
+                             : EPOLLIN);
+            return;
+          }
           conn_fail(L, c, errno ? -errno : -ECONNRESET);
           return;
         }
@@ -2388,10 +3111,15 @@ static void conn_io(Loop* L, Conn* c) {
         conn_body_done(L, c);
         return;
       }
-      ssize_t k = recv(c->fd, dst, static_cast<size_t>(want), 0);
+      ssize_t k = rx_recv(c, dst, static_cast<size_t>(want));
       if (k < 0) {
         if (errno == EINTR) continue;
-        if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          conn_want(c, c->ssl && c->tls_want == EPOLLOUT
+                           ? EPOLLIN | EPOLLOUT
+                           : EPOLLIN);
+          return;
+        }
         conn_fail(L, c, errno ? -errno : -ECONNRESET);
         return;
       }
@@ -2403,7 +3131,6 @@ static void conn_io(Loop* L, Conn* c) {
         conn_fail(L, c, TB_ESHORT);
         return;
       }
-      tb_stat_add(TB_STAT_BYTES_RX, k);
       c->body_got += k;
       if (c->content_len >= 0 && c->body_got >= c->content_len) {
         conn_body_done(L, c);
@@ -2418,6 +3145,215 @@ static void conn_io(Loop* L, Conn* c) {
     conn_free(L, c);
     pump_target(L, t);
   }
+}
+
+// One readiness notification worth of h2 I/O: drain the send buffer,
+// then consume frames until EAGAIN. DATA payloads stream directly into
+// task buffers (discard tasks land in the loop scratch); non-DATA frames
+// buffer whole in c->hdr (bounded by the default 16384 MAX_FRAME_SIZE we
+// never raise) and dispatch through h2_on_frame.
+static void conn_h2_io(Loop* L, Conn* c) {
+  if (c->dead) return;
+  c->last_activity_ns = tb_now_ns();
+  // ---- send side ----
+  for (;;) {
+    if (c->h2_out_off >= c->h2_out_len) {
+      c->h2_out_off = c->h2_out_len = 0;
+      if (c->h2_wu_queued_ns) {
+        tb_stat_add(TB_STAT_REACTOR_FLOW_STALL_NS,
+                    tb_now_ns() - c->h2_wu_queued_ns);
+        c->h2_wu_queued_ns = 0;
+      }
+      break;
+    }
+    ssize_t k = rx_send(c, c->h2_out + c->h2_out_off,
+                        c->h2_out_len - c->h2_out_off);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      h2_conn_fail(L, c, errno ? -errno : -ECONNRESET);
+      return;
+    }
+    c->h2_out_off += static_cast<int>(k);
+  }
+  // ---- receive side ----
+  int blocked = 0;
+  while (!blocked) {
+    if (c->h2_fh_got < 9) {
+      ssize_t k = rx_recv(c, c->h2_fh + c->h2_fh_got, 9 - c->h2_fh_got);
+      if (k < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          blocked = 1;
+          break;
+        }
+        h2_conn_fail(L, c, errno ? -errno : -ECONNRESET);
+        return;
+      }
+      if (k == 0) {
+        // Orderly close: with streams in flight it's an early end
+        // (TB_ESHORT, per-stream retransmit rule applies); an idle
+        // keep-alive close settles nothing.
+        h2_conn_fail(L, c, TB_ESHORT);
+        return;
+      }
+      c->h2_fh_got += static_cast<int>(k);
+      if (c->h2_fh_got < 9) continue;
+      c->h2_flen = static_cast<uint32_t>(c->h2_fh[0]) << 16 |
+                   static_cast<uint32_t>(c->h2_fh[1]) << 8 | c->h2_fh[2];
+      c->h2_ftype = c->h2_fh[3];
+      c->h2_fflags = c->h2_fh[4];
+      c->h2_fstream = (static_cast<uint32_t>(c->h2_fh[5]) << 24 |
+                       static_cast<uint32_t>(c->h2_fh[6]) << 16 |
+                       static_cast<uint32_t>(c->h2_fh[7]) << 8 | c->h2_fh[8]) &
+                      0x7fffffffu;
+      tb_stat_add(TB_STAT_H2_FRAMES_RX, 1);
+      if (c->h2_ftype == 0) {  // DATA: stream it
+        tb_stat_add(TB_STAT_H2_DATA_BYTES_RX, c->h2_flen);
+        // The WHOLE payload (padding included) counts against both
+        // flow-control windows; credit once, up front.
+        h2_credit(c, rx_h2_stream_of(c, c->h2_fstream), c->h2_flen);
+        c->h2_data_rem = static_cast<int>(c->h2_flen);
+        c->h2_pad_rem = 0;
+        c->h2_pad_pending = (c->h2_fflags & 0x8) ? 1 : 0;
+      } else {
+        if (c->h2_flen > sizeof c->hdr) {
+          h2_conn_fail(L, c, TB_EPROTO);
+          return;
+        }
+        c->h2_fbuf_got = 0;
+      }
+    }
+    if (c->h2_ftype == 0) {
+      if (c->h2_pad_pending) {
+        uint8_t pl;
+        ssize_t k = rx_recv(c, &pl, 1);
+        if (k < 0) {
+          if (errno == EINTR) continue;
+          if (errno == EAGAIN || errno == EWOULDBLOCK) {
+            blocked = 1;
+            break;
+          }
+          h2_conn_fail(L, c, errno ? -errno : -ECONNRESET);
+          return;
+        }
+        if (k == 0) {
+          h2_conn_fail(L, c, TB_ESHORT);
+          return;
+        }
+        if (1u + pl > c->h2_flen) {
+          h2_conn_fail(L, c, TB_EPROTO);
+          return;
+        }
+        c->h2_pad_pending = 0;
+        c->h2_data_rem = static_cast<int>(c->h2_flen) - 1 - pl;
+        c->h2_pad_rem = pl;
+      }
+      while (c->h2_data_rem > 0) {
+        H2Stream* s = rx_h2_stream_of(c, c->h2_fstream);
+        uint8_t* dst;
+        int64_t cap;
+        if (s && s->task->buf) {
+          cap = s->task->buf_len - s->body_got;
+          dst = s->task->buf + s->body_got;
+          if (cap <= 0) {
+            // Over-delivery into a sized buffer: stream-level TB_ETOOBIG
+            // (permanent), cancel the stream, swallow the rest.
+            uint32_t sid = s->id;
+            h2_stream_fail(L, c, s, TB_ETOOBIG);
+            if (h2_out_room(c, 13)) {
+              uint8_t rst[4];
+              h2::put32(rst, 0x8 /*CANCEL*/);
+              h2_out_frame(c, 3, 0, sid, rst, 4);
+            }
+            continue;
+          }
+        } else {
+          dst = L->scratch;
+          cap = kDiscardWin;
+        }
+        int64_t want = cap < c->h2_data_rem ? cap : c->h2_data_rem;
+        ssize_t k = rx_recv(c, dst, static_cast<size_t>(want));
+        if (k < 0) {
+          if (errno == EINTR) continue;
+          if (errno == EAGAIN || errno == EWOULDBLOCK) {
+            blocked = 1;
+            break;
+          }
+          h2_conn_fail(L, c, errno ? -errno : -ECONNRESET);
+          return;
+        }
+        if (k == 0) {
+          h2_conn_fail(L, c, TB_ESHORT);
+          return;
+        }
+        c->h2_data_rem -= static_cast<int>(k);
+        if (s) {
+          if (s->body_got == 0 && s->task->first_byte_ns == 0)
+            s->task->first_byte_ns = tb_now_ns();
+          s->body_got += k;
+        }
+      }
+      if (blocked) break;
+      while (c->h2_pad_rem > 0) {
+        int64_t want =
+            c->h2_pad_rem < kDiscardWin ? c->h2_pad_rem : kDiscardWin;
+        ssize_t k = rx_recv(c, L->scratch, static_cast<size_t>(want));
+        if (k < 0) {
+          if (errno == EINTR) continue;
+          if (errno == EAGAIN || errno == EWOULDBLOCK) {
+            blocked = 1;
+            break;
+          }
+          h2_conn_fail(L, c, errno ? -errno : -ECONNRESET);
+          return;
+        }
+        if (k == 0) {
+          h2_conn_fail(L, c, TB_ESHORT);
+          return;
+        }
+        c->h2_pad_rem -= static_cast<int>(k);
+      }
+      if (blocked) break;
+      if (c->h2_fflags & 0x1) {  // END_STREAM
+        H2Stream* s = rx_h2_stream_of(c, c->h2_fstream);
+        if (s) h2_stream_end(L, c, s);
+      }
+      c->h2_fh_got = 0;
+      continue;
+    }
+    // Non-DATA: buffer the whole payload, then dispatch.
+    while (c->h2_fbuf_got < static_cast<int>(c->h2_flen)) {
+      ssize_t k =
+          rx_recv(c, c->hdr + c->h2_fbuf_got, c->h2_flen - c->h2_fbuf_got);
+      if (k < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          blocked = 1;
+          break;
+        }
+        h2_conn_fail(L, c, errno ? -errno : -ECONNRESET);
+        return;
+      }
+      if (k == 0) {
+        h2_conn_fail(L, c, TB_ESHORT);
+        return;
+      }
+      c->h2_fbuf_got += static_cast<int>(k);
+    }
+    if (blocked) break;
+    int64_t rc = h2_on_frame(L, c);
+    if (rc != 0) {
+      h2_conn_fail(L, c, rc);
+      return;
+    }
+    c->h2_fh_got = 0;
+  }
+  if (c->dead) return;
+  uint32_t ev = EPOLLIN;
+  if (c->h2_out_off < c->h2_out_len) ev |= EPOLLOUT;
+  if (c->ssl && c->tls_want == EPOLLOUT) ev |= EPOLLOUT;
+  conn_want(c, ev);
 }
 
 static Target* find_target(Loop* L, const char* host, int port) {
@@ -2448,13 +3384,23 @@ static void sweep_timeouts(Loop* L) {
     Conn* c = t->conns;
     while (c) {
       Conn* nxt = c->next;
-      if (c->task && now - c->last_activity_ns > kIoTimeoutNs) {
-        // Same surface as the legacy pool's SO_RCVTIMEO expiry: the
-        // task fails -EAGAIN (transient), the connection dies.
+      int busy = c->task != nullptr || (c->h2 && c->h2_nstreams > 0);
+      if (busy && now - c->last_activity_ns > kIoTimeoutNs) {
+        // Same surface as the legacy pool's SO_RCVTIMEO expiry: every
+        // in-flight task fails -EAGAIN (transient, bypasses the stale
+        // retransmit rule), the connection dies.
         fp::Task* task = c->task;
         c->task = nullptr;
+        for (int si = 0; si < kRxH2Streams; si++) {
+          if (!c->h2_streams[si].id) continue;
+          fp::Task* st = c->h2_streams[si].task;
+          c->h2_streams[si].id = 0;
+          c->h2_streams[si].task = nullptr;
+          c->h2_nstreams--;
+          complete_task(L, st, -EAGAIN);
+        }
         conn_free(L, c);
-        complete_task(L, task, -EAGAIN);
+        if (task) complete_task(L, task, -EAGAIN);
         pump_target(L, t);
         // conn list mutated: restart the walk for this target.
         nxt = t->conns;
@@ -2495,7 +3441,9 @@ static void* loop_main(void* arg) {
       Conn* c = static_cast<Conn*>(evs[i].data.ptr);
       if (c->dead) continue;  // closed earlier in this same batch
       if (evs[i].events & (EPOLLERR | EPOLLHUP)) {
-        if (c->state == C_IDLE || !c->task) {
+        if (c->state == C_H2 && c->h2_nstreams > 0) {
+          conn_io(L, c);  // h2 streams in flight: surface the error per stream
+        } else if (c->state == C_IDLE || !c->task) {
           Target* t = c->target;
           conn_free(L, c);
           pump_target(L, t);
@@ -2532,7 +3480,8 @@ static uint32_t pow2_at_least(uint32_t v) {
   return p;
 }
 
-static int64_t reactor_create(int conns, int cap, int n_loops) {
+static int64_t reactor_create(int conns, int cap, int n_loops, int tls,
+                              const char* cafile, int insecure, int h2_mode) {
   if (conns <= 0 || cap <= 0) return 0;
   if (n_loops <= 0) n_loops = 1;
   if (n_loops > conns) n_loops = conns;
@@ -2542,11 +3491,23 @@ static int64_t reactor_create(int conns, int cap, int n_loops) {
   r->kind = fp::kPoolKindReactor;
   r->cap = cap;
   r->n_loops = n_loops;
+  r->tls = tls;
+  r->insecure = insecure;
+  r->h2_mode = h2_mode;
+  snprintf(r->cafile, sizeof r->cafile, "%s", cafile ? cafile : "");
+  if (tls) {
+    r->ssl_ctx = tls::get_ctx(r->cafile[0] ? r->cafile : nullptr, insecure);
+    if (!r->ssl_ctx) {
+      free(r);
+      return 0;
+    }
+  }
   r->done_efd = eventfd(0, EFD_NONBLOCK);
   r->loops = static_cast<Loop*>(calloc(n_loops, sizeof(Loop)));
   if (r->done_efd < 0 || !r->loops) {
     if (r->done_efd >= 0) close(r->done_efd);
     free(r->loops);
+    if (r->ssl_ctx) tls::SSL_CTX_free_(r->ssl_ctx);
     free(r);
     return 0;
   }
@@ -2599,6 +3560,7 @@ static int64_t reactor_create(int conns, int cap, int n_loops) {
     }
     close(r->done_efd);
     free(r->loops);
+    if (r->ssl_ctx) tls::SSL_CTX_free_(r->ssl_ctx);
     free(r);
     return 0;
   }
@@ -2711,12 +3673,18 @@ static int reactor_destroy(Reactor* r) {
       Conn* c = tg->conns;
       while (c) {
         Conn* cn = c->next;
+        if (c->ssl) tls::SSL_free_(c->ssl);
         close(c->fd);
         tb_stat_add(TB_STAT_CONN_CLOSES, 1);
+        for (int si = 0; si < kRxH2Streams; si++)
+          if (c->h2_streams[si].id) free(c->h2_streams[si].task);
+        free(c->h2_out);
+        free(c->h2_hb);
         free(c->task);
         free(c);
         c = cn;
       }
+      if (tg->tls_session) tls::SSL_SESSION_free_(tg->tls_session);
       free(tg);
       tg = tn;
     }
@@ -2735,6 +3703,7 @@ static int reactor_destroy(Reactor* r) {
   }
   close(r->done_efd);
   free(r->loops);
+  if (r->ssl_ctx) tls::SSL_CTX_free_(r->ssl_ctx);
   free(r);
   return 0;
 }
@@ -2795,24 +3764,31 @@ int64_t tb_pool_create(int threads, int cap, int tls, const char* cafile,
 
 // Mode-aware pool creation. ``mode`` low byte: 0 = legacy
 // thread-per-connection pool (exactly tb_pool_create), 1 = reactor
-// (epoll event loop + SPSC completion rings); bits 8+ carry the reactor
-// loop-thread count (0 → 1). Reactor mode is plaintext-only — TLS rides
-// the legacy pool (returns 0 here so the caller can fall back loudly,
-// never silently mislabel an A/B). In reactor mode ``threads`` is the
-// CONNECTION budget, not a thread count: the loop multiplexes all of
-// them; in-flight GETs beyond it queue per target and reuse keep-alive
-// sockets as they free — many GETs, few sockets, zero per-request
-// threads.
+// (epoll event loop + SPSC completion rings); bits 8-15 carry the
+// reactor loop-thread count (0 → 1); bit 16 (0x10000) offers h2 via
+// ALPN and falls back to http/1.1 per the server's selection (TLS
+// only); bit 17 (0x20000) speaks h2 with prior knowledge on plaintext
+// sockets (h2c test servers). TLS in reactor mode is the same
+// nonblocking state machine (handshake off epoll readiness, session
+// resumption on keep-alive reconnect) — it no longer falls back to the
+// legacy pool. In reactor mode ``threads`` is the CONNECTION budget,
+// not a thread count: the loop multiplexes all of them; in-flight GETs
+// beyond it queue per target (and, on h2, fan out as concurrent
+// streams) and reuse keep-alive sockets as they free — many GETs, few
+// sockets, zero per-request threads.
 int64_t tb_pool_create2(int threads, int cap, int tls, const char* cafile,
                         int insecure, int mode) {
   int flavor = mode & 0xff;
   if (flavor == 0) return tb_pool_create(threads, cap, tls, cafile, insecure);
   if (flavor != 1) return 0;
-  if (tls) return 0;  // reactor mode is plaintext-only (see above)
-  (void)cafile;
-  (void)insecure;
   int loops = (mode >> 8) & 0xff;
-  return rx::reactor_create(threads, cap, loops);
+  int h2_mode = (mode & 0x20000) ? 2 : ((mode & 0x10000) ? 1 : 0);
+  if (h2_mode == 1 && !tls) return 0;  // ALPN needs a TLS handshake
+  if (h2_mode == 2 && tls) return 0;   // prior knowledge is plaintext h2c
+  if (tls && !tb_tls_available()) return 0;
+  if (cafile && strlen(cafile) >= sizeof(rx::Reactor{}.cafile)) return 0;
+  return rx::reactor_create(threads, cap, loops, tls, cafile, insecure,
+                            h2_mode);
 }
 
 // 1 when the handle is a reactor-mode pool (introspection for tests and

@@ -718,6 +718,7 @@ class NativeEngine:
         insecure: bool = False,
         mode: str = "threads",
         loops: int = 0,
+        h2: bool = False,
     ) -> "NativeFetchPool":
         """Native fetch executor. Two dispatch shapes behind one handle:
 
@@ -731,20 +732,36 @@ class NativeEngine:
         completions delivered over lock-free SPSC rings with an eventfd
         doorbell — zero lock crossings on the steady-state hot path
         (the BENCH_r05 handoff tax, removed). ``loops`` sets the
-        event-loop thread count (0 = one). Plaintext only: TLS requests
-        and stale ``.so``s without the reactor symbols fall back to the
-        legacy pool — check :attr:`NativeFetchPool.mode` for what
-        actually engaged (A/Bs must label arms honestly).
+        event-loop thread count (0 = one). TLS runs the same nonblocking
+        state machine (handshake off epoll readiness, session resumption
+        across keep-alive reconnects). ``h2=True`` multiplexes GETs as
+        concurrent HTTP/2 streams: ALPN-negotiated on TLS (the server
+        may still pick http/1.1 — the pool follows), prior-knowledge
+        h2c on plaintext. Only a stale ``.so`` without the reactor
+        symbols (or a creation failure) falls back to the legacy pool —
+        check :attr:`NativeFetchPool.mode` for what actually engaged
+        (A/Bs must label arms honestly).
         """
         want_reactor = mode == "reactor"
-        if want_reactor and self._has_pool_create2 and not tls:
+        if want_reactor and self._has_pool_create2:
+            mbits = 1 | (max(0, min(loops, 16)) << 8)
+            if h2:
+                mbits |= 0x10000 if tls else 0x20000
             h = self.lib.tb_pool_create2(
-                threads, cap, 0, cafile.encode(), 1 if insecure else 0,
-                1 | (max(0, min(loops, 16)) << 8),
+                threads, cap, 1 if tls else 0, cafile.encode(),
+                1 if insecure else 0, mbits,
             )
             if h != 0:
                 return NativeFetchPool(self, h, mode="reactor")
             # Reactor creation failed (fd limits?): legacy still serves.
+        if h2:
+            # No legacy h2 GET pool exists: quietly serving http/1.1
+            # under an ``h2=True`` request would mislabel an A/B arm.
+            raise NativeError(
+                "h2 fetch pool requires reactor mode "
+                "(stale .so without tb_pool_create2, or creation failed)",
+                code=-22,
+            )
         h = self.lib.tb_pool_create(
             threads, cap, 1 if tls else 0, cafile.encode(),
             1 if insecure else 0,

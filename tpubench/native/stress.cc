@@ -8,6 +8,11 @@
 // buffers/latency arrays. Any aliasing bug in the engine shows up as a
 // ThreadSanitizer report, failing the test.
 //
+// The reactor phases at the bottom extend the matrix to the TLS and
+// HTTP/2 state machines: h2c exactly-once multiplexing against a canned
+// in-file server, mid-handshake plaintext garbage, pre-handshake RSTs,
+// and pool destroy with handshakes still in flight.
+//
 // Exit 0 + no TSAN output = clean.
 
 #include <cstdint>
@@ -60,6 +65,7 @@ int tb_pool_destroy(int64_t h);
 void* tb_srv_start(const void* body, int64_t body_len, const char* meta_json,
                    int* port_out);
 int tb_srv_stop(void* handle);
+int tb_tls_available();
 }
 
 // Minimal single-purpose HTTP server for the pool stress: keep-alive —
@@ -628,6 +634,360 @@ static int stress_reactor_destroy_hammer() {
   return bad ? 40 : 0;
 }
 
+// Loopback listener helper for the TLS/h2 reactor phases below (the
+// earlier phases predate it and keep their inline setup).
+static int mk_listener(int* fd_out, int* port_out) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in a;
+  memset(&a, 0, sizeof a);
+  a.sin_family = AF_INET;
+  a.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  a.sin_port = 0;
+  if (bind(fd, reinterpret_cast<struct sockaddr*>(&a), sizeof a) != 0) {
+    close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof a;
+  getsockname(fd, reinterpret_cast<struct sockaddr*>(&a), &alen);
+  listen(fd, 16);
+  *fd_out = fd;
+  *port_out = ntohs(a.sin_port);
+  return 0;
+}
+
+// TLS reactor vs a server that answers the ClientHello with PLAINTEXT
+// GARBAGE mid-handshake: every task must settle with a surfaced error
+// (TB_ETLS is permanent — no retransmit storm, no hang), exactly once,
+// and the nonblocking handshake state machine's error path plus the
+// SSL teardown run under the sanitizer.
+static int stress_reactor_tls_midreset() {
+  if (!tb_tls_available()) return 0;  // no OpenSSL in this image: skip
+  int lfd = -1, port = 0;
+  if (mk_listener(&lfd, &port)) return 1;
+  std::thread srv([lfd]() {
+    std::vector<std::thread> handlers;
+    for (;;) {
+      int c = accept(lfd, nullptr, nullptr);
+      if (c < 0) break;
+      handlers.emplace_back([c]() {
+        char b[512];
+        recv(c, b, sizeof b, 0);  // swallow (part of) the ClientHello
+        const char* junk = "HTTP/1.1 400 this is not TLS\r\n\r\n";
+        send(c, junk, strlen(junk), 0);
+        close(c);
+      });
+    }
+    for (auto& h : handlers) h.join();
+  });
+  const int kTasks = 12;
+  int64_t pool = tb_pool_create2(2, 16, 1, "", 1, 1);
+  int bad = 0;
+  std::vector<void*> bufs(kTasks, nullptr);
+  std::vector<int> seen(kTasks, 0);
+  if (!pool) {
+    bad = 100;
+  } else {
+    int ok_sub = 0;
+    for (int i = 0; i < kTasks; i++) {
+      bufs[i] = tb_alloc_aligned(4096, 4096);
+      if (!bufs[i]) {
+        bad++;
+        continue;
+      }
+      if (tb_pool_submit(pool, "127.0.0.1", port, "/x", "", bufs[i], 4096, i))
+        bad++;
+      else
+        ok_sub++;
+    }
+    for (int n = 0; n < ok_sub; n++) {
+      uint64_t tag;
+      int64_t result, fb, total, start;
+      int status;
+      int rc = tb_pool_next(pool, 30000, &tag, &result, &status, &fb, &total,
+                            &start);
+      if (rc != 1) {  // stall: bail instead of hanging
+        bad++;
+        break;
+      }
+      int t = static_cast<int>(tag);
+      if (t < 0 || t >= kTasks || seen[t]++) {
+        bad++;
+        continue;
+      }
+      if (result >= 0) bad++;  // garbage-for-TLS MUST surface as an error
+    }
+    tb_pool_destroy(pool);
+  }
+  shutdown(lfd, SHUT_RDWR);
+  close(lfd);
+  srv.join();
+  for (auto b : bufs)
+    if (b) tb_free_aligned(b);
+  return bad ? 60 : 0;
+}
+
+// TLS reactor vs a server that RSTs every accepted connection before a
+// single handshake byte flows (SO_LINGER{1,0} close): the reset lands
+// in C_CONNECTING or C_TLS_HANDSHAKE depending on timing, and either
+// way each task must settle exactly once — fresh-connection failures
+// surface, they never loop the retransmit rule.
+static int stress_reactor_tls_reset() {
+  if (!tb_tls_available()) return 0;
+  int lfd = -1, port = 0;
+  if (mk_listener(&lfd, &port)) return 1;
+  std::thread srv([lfd]() {
+    for (;;) {
+      int c = accept(lfd, nullptr, nullptr);
+      if (c < 0) break;
+      struct linger lg;
+      lg.l_onoff = 1;
+      lg.l_linger = 0;
+      setsockopt(c, SOL_SOCKET, SO_LINGER, &lg, sizeof lg);
+      close(c);  // RST, not FIN
+    }
+  });
+  const int kTasks = 12;
+  int64_t pool = tb_pool_create2(2, 16, 1, "", 1, 1);
+  int bad = 0;
+  std::vector<int> seen(kTasks, 0);
+  if (!pool) {
+    bad = 100;
+  } else {
+    int ok_sub = 0;
+    for (int i = 0; i < kTasks; i++) {
+      if (tb_pool_submit(pool, "127.0.0.1", port, "/x", "", nullptr, 0, i))
+        bad++;
+      else
+        ok_sub++;
+    }
+    for (int n = 0; n < ok_sub; n++) {
+      uint64_t tag;
+      int64_t result, fb, total, start;
+      int status;
+      int rc = tb_pool_next(pool, 30000, &tag, &result, &status, &fb, &total,
+                            &start);
+      if (rc != 1) {
+        bad++;
+        break;
+      }
+      int t = static_cast<int>(tag);
+      if (t < 0 || t >= kTasks || seen[t]++) {
+        bad++;
+        continue;
+      }
+      if (result >= 0) bad++;  // the RST must surface, not succeed
+    }
+    tb_pool_destroy(pool);
+  }
+  shutdown(lfd, SHUT_RDWR);
+  close(lfd);
+  srv.join();
+  return bad ? 70 : 0;
+}
+
+// Destroy-with-handshake-in-flight: the server accepts and then says
+// NOTHING, so every connection parks in C_TLS_HANDSHAKE waiting for a
+// ServerHello that never comes — and tb_pool_destroy tears the reactor
+// down mid-handshake, repeatedly. SSL objects owned by half-open
+// connections must be freed exactly once (ASAN), and the loop join
+// must not race the in-flight wakes (TSAN).
+static int stress_reactor_tls_destroy_inflight() {
+  if (!tb_tls_available()) return 0;
+  int lfd = -1, port = 0;
+  if (mk_listener(&lfd, &port)) return 1;
+  std::thread srv([lfd]() {
+    std::vector<int> conns;
+    for (;;) {
+      int c = accept(lfd, nullptr, nullptr);
+      if (c < 0) break;
+      conns.push_back(c);  // hold silently: the handshake never advances
+    }
+    for (int c : conns) close(c);
+  });
+  int bad = 0;
+  for (int it = 0; it < 6; it++) {
+    int64_t pool = tb_pool_create2(2, 16, 1, "", 1, 1 | ((it % 2 + 1) << 8));
+    if (!pool) {
+      bad++;
+      continue;
+    }
+    for (int i = 0; i < 6; i++)
+      tb_pool_submit(pool, "127.0.0.1", port, "/x", "", nullptr, 0, i);
+    if (it % 2) {  // sometimes give the handshakes a beat to get airborne
+      uint64_t tag;
+      int64_t result, fb, total, start;
+      int status;
+      tb_pool_next(pool, 20, &tag, &result, &status, &fb, &total, &start);
+    }
+    if (tb_pool_destroy(pool) != 0) bad++;
+  }
+  shutdown(lfd, SHUT_RDWR);
+  close(lfd);
+  srv.join();
+  return bad ? 80 : 0;
+}
+
+// Minimal canned h2c server for the multiplexing stress: consume the
+// client preface, speak just enough HTTP/2 (SETTINGS + ACK, canned
+// ":status 200" HEADERS and a 16-byte END_STREAM DATA per request
+// stream) to complete real streams. Everything else (WINDOW_UPDATE,
+// PRIORITY) is read and ignored.
+static void h2c_handle(int c) {
+  uint8_t buf[65536];
+  size_t got = 0;
+  while (got < 24) {  // client connection preface
+    ssize_t n = recv(c, buf + got, sizeof buf - got, 0);
+    if (n <= 0) {
+      close(c);
+      return;
+    }
+    got += static_cast<size_t>(n);
+  }
+  uint8_t sf[9] = {0, 0, 0, 4, 0, 0, 0, 0, 0};  // empty server SETTINGS
+  send(c, sf, sizeof sf, 0);
+  memmove(buf, buf + 24, got - 24);
+  got -= 24;
+  for (;;) {
+    while (got < 9) {
+      ssize_t n = recv(c, buf + got, sizeof buf - got, 0);
+      if (n <= 0) {
+        close(c);
+        return;
+      }
+      got += static_cast<size_t>(n);
+    }
+    size_t flen = static_cast<size_t>(buf[0]) << 16 |
+                  static_cast<size_t>(buf[1]) << 8 | buf[2];
+    uint8_t ftype = buf[3], fflags = buf[4];
+    uint32_t sid = (static_cast<uint32_t>(buf[5]) << 24 |
+                    static_cast<uint32_t>(buf[6]) << 16 |
+                    static_cast<uint32_t>(buf[7]) << 8 | buf[8]) &
+                   0x7fffffffu;
+    if (9 + flen > sizeof buf) {
+      close(c);
+      return;
+    }
+    while (got < 9 + flen) {
+      ssize_t n = recv(c, buf + got, sizeof buf - got, 0);
+      if (n <= 0) {
+        close(c);
+        return;
+      }
+      got += static_cast<size_t>(n);
+    }
+    if (ftype == 4 && !(fflags & 0x1)) {  // SETTINGS: ACK it
+      uint8_t ack[9] = {0, 0, 0, 4, 1, 0, 0, 0, 0};
+      send(c, ack, sizeof ack, 0);
+    } else if (ftype == 1) {  // HEADERS: canned 200 + END_STREAM DATA
+      uint8_t resp[9 + 1 + 9 + 16];
+      resp[0] = 0; resp[1] = 0; resp[2] = 1;    // HEADERS, len 1
+      resp[3] = 1; resp[4] = 0x4;               // END_HEADERS
+      resp[5] = static_cast<uint8_t>(sid >> 24);
+      resp[6] = static_cast<uint8_t>(sid >> 16);
+      resp[7] = static_cast<uint8_t>(sid >> 8);
+      resp[8] = static_cast<uint8_t>(sid);
+      resp[9] = 0x88;                           // indexed ":status 200"
+      uint8_t* d = resp + 10;
+      d[0] = 0; d[1] = 0; d[2] = 16;            // DATA, len 16
+      d[3] = 0; d[4] = 0x1;                     // END_STREAM
+      d[5] = static_cast<uint8_t>(sid >> 24);
+      d[6] = static_cast<uint8_t>(sid >> 16);
+      d[7] = static_cast<uint8_t>(sid >> 8);
+      d[8] = static_cast<uint8_t>(sid);
+      memcpy(d + 9, "0123456789abcdef", 16);
+      send(c, resp, sizeof resp, 0);
+    }
+    memmove(buf, buf + 9 + flen, got - 9 - flen);
+    got -= 9 + flen;
+  }
+}
+
+// h2c prior-knowledge reactor stress: 48 tasks multiplex as streams
+// over at most 2 connections against the canned server while the main
+// thread drains — frame reassembly, the per-stream ledger in the conn
+// state machine, and stream-vs-connection completion all race under
+// the sanitizer, with exactly-once delivery asserted per tag.
+static int stress_reactor_h2() {
+  int lfd = -1, port = 0;
+  if (mk_listener(&lfd, &port)) return 1;
+  std::thread srv([lfd]() {
+    std::vector<std::thread> handlers;
+    for (;;) {
+      int c = accept(lfd, nullptr, nullptr);
+      if (c < 0) break;
+      handlers.emplace_back(h2c_handle, c);
+    }
+    for (auto& h : handlers) h.join();
+  });
+  const int kTasks = 48;
+  int64_t pool = tb_pool_create2(2, 32, 0, "", 0, 1 | 0x20000);
+  int bad = 0;
+  std::vector<void*> bufs(kTasks, nullptr);
+  std::vector<int> seen(kTasks, 0);
+  if (!pool) {
+    bad = 100;
+  } else {
+    int next = 0, drained = 0, ok_sub = 0;
+    bool sub_done = false;
+    while (!sub_done || drained < ok_sub) {
+      while (next < kTasks) {
+        void* b = tb_alloc_aligned(4096, 4096);
+        if (!b) {
+          bad++;
+          next++;
+          continue;
+        }
+        int rc = tb_pool_submit(pool, "127.0.0.1", port, "/x", "", b, 4096,
+                                next);
+        if (rc == -EAGAIN) {
+          tb_free_aligned(b);
+          break;  // backpressure: drain below
+        }
+        if (rc != 0) {
+          tb_free_aligned(b);
+          bad++;
+          next++;
+          continue;
+        }
+        bufs[next++] = b;
+        ok_sub++;
+      }
+      sub_done = next >= kTasks;
+      if (sub_done && drained >= ok_sub) break;
+      uint64_t tags[8];
+      int64_t results[8], fbs[8], totals[8], starts[8];
+      int statuses[8];
+      int n = tb_pool_ring_next_batch(pool, 30000, 8, tags, results, statuses,
+                                      fbs, totals, starts);
+      if (n <= 0) {  // stall: bail instead of hanging
+        bad++;
+        break;
+      }
+      for (int i = 0; i < n; i++) {
+        int t = static_cast<int>(tags[i]);
+        if (t < 0 || t >= kTasks || seen[t]++) {
+          bad++;
+          continue;
+        }
+        if (results[i] != 16 || statuses[i] != 200 ||
+            memcmp(bufs[t], "0123456789abcdef", 16) != 0)
+          bad++;
+      }
+      drained += n;
+    }
+    tb_pool_destroy(pool);
+  }
+  shutdown(lfd, SHUT_RDWR);
+  close(lfd);
+  srv.join();
+  for (auto b : bufs)
+    if (b) tb_free_aligned(b);
+  return bad ? 90 : 0;
+}
+
 int main(int argc, char** argv) {
   if (argc < 2) {
     std::fprintf(stderr, "usage: %s <scratch-dir>\n", argv[0]);
@@ -690,6 +1050,14 @@ int main(int argc, char** argv) {
   if (crc) { std::fprintf(stderr, "reactor stale-churn stress failed rc=%d\n", crc); return 1; }
   int hrc = stress_reactor_destroy_hammer();
   if (hrc) { std::fprintf(stderr, "reactor destroy hammer failed rc=%d\n", hrc); return 1; }
+  int h2rc = stress_reactor_h2();
+  if (h2rc) { std::fprintf(stderr, "reactor h2 stress failed rc=%d\n", h2rc); return 1; }
+  int t1 = stress_reactor_tls_midreset();
+  if (t1) { std::fprintf(stderr, "reactor tls midreset stress failed rc=%d\n", t1); return 1; }
+  int t2 = stress_reactor_tls_reset();
+  if (t2) { std::fprintf(stderr, "reactor tls reset stress failed rc=%d\n", t2); return 1; }
+  int t3 = stress_reactor_tls_destroy_inflight();
+  if (t3) { std::fprintf(stderr, "reactor tls destroy-inflight stress failed rc=%d\n", t3); return 1; }
   std::puts("stress ok");
   return 0;
 }

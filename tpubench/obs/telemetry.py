@@ -454,6 +454,13 @@ NATIVE_TRANSPORT_COUNTERS = {
     "reactor_ring_depth_sum":
         "ring depth observed at each enqueue, summed (mean = sum/completions)",
     "reactor_ring_depth_max": "max reactor ring depth observed",
+    "reactor_tls_handshakes":
+        "TLS handshakes completed by the reactor's nonblocking state machine",
+    "reactor_tls_resumes":
+        "reactor handshakes that resumed a cached per-target TLS session",
+    "reactor_h2_streams": "h2 streams opened by the reactor's multiplexer",
+    "reactor_flow_stall_ns":
+        "time queued h2 flow-control credit waited for the socket to drain",
 }
 
 GAUGE_METRICS = {

@@ -56,10 +56,16 @@ def open_backend(cfg, fault=None, tracer=None) -> StorageBackend:
         )
     elif proto == "http":
         from tpubench.storage.gcs_http import GcsHttpBackend
+        from tpubench.storage.reactor_backend import maybe_wrap_reactor_fetch
 
         inner = GcsHttpBackend(
             bucket=cfg.workload.bucket, transport=cfg.transport, tracer=tracer
         )
+        # Native fetch executors route backend reads (the serve plane's
+        # open_backend fetches, prefetcher warms, demand misses) through
+        # the shared reactor pool; the wrapper is lazy, so workloads that
+        # drive tb_pool_* themselves never spin a second pool.
+        inner = maybe_wrap_reactor_fetch(inner, cfg)
     elif proto == "grpc":
         from tpubench.storage.gcs_grpc import GcsGrpcBackend
 

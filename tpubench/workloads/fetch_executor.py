@@ -159,7 +159,11 @@ def _require_native_http(cfg: BenchConfig, backend: StorageBackend):
             "workload.fetch_executor='native' but the native engine is "
             "unavailable (C++ toolchain missing?)"
         )
-    inner = getattr(backend, "inner", backend)
+    # Unwrap the whole decorator chain (retry → tail → reactor-fetch):
+    # the runners need the raw GcsHttpBackend for native_request_parts.
+    inner = backend
+    while not isinstance(inner, GcsHttpBackend) and hasattr(inner, "inner"):
+        inner = inner.inner
     if not isinstance(inner, GcsHttpBackend) or inner.scheme not in (
         "http", "https",
     ):
@@ -172,12 +176,16 @@ def _require_native_http(cfg: BenchConfig, backend: StorageBackend):
             "fetch_executor='native' on an https endpoint, but the engine "
             "could not load OpenSSL (libssl.so.3)"
         )
-    if inner.transport.http2:
-        # The executor's pool speaks HTTP/1.1; running it under an
-        # http2=True config would silently mislabel the h1-vs-h2 A/B.
+    if inner.transport.http2 and executor_mode(
+        cfg.workload.fetch_executor
+    ) != "reactor":
+        # Only the reactor multiplexes h2 streams; the legacy pool speaks
+        # HTTP/1.1. Running it under an http2=True config would silently
+        # mislabel the h1-vs-h2 A/B.
         raise ValueError(
-            "fetch_executor='native' fetches over HTTP/1.1 (tb_pool_*); "
-            "combine http2=True with the Python orchestration paths"
+            "fetch_executor='native-threads' fetches over HTTP/1.1; "
+            "http2=True needs the reactor ('native'/'native-reactor') or "
+            "the Python orchestration paths"
         )
     return engine, inner
 
@@ -186,9 +194,63 @@ def executor_mode(fetch_executor: str) -> str:
     """Requested pool dispatch shape for a ``fetch_executor`` config value:
     "native" prefers the reactor (the post-BENCH_r05 default — the epoll
     loop + SPSC-ring handoff), "native-reactor"/"native-threads" pin it
-    explicitly. What actually engaged is ``NativeFetchPool.mode`` (TLS
-    endpoints and stale .so builds fall back to the thread pool)."""
+    explicitly. What actually engaged is ``NativeFetchPool.mode`` (only a
+    stale .so build without the reactor symbols still falls back to the
+    thread pool — TLS runs the reactor's nonblocking state machine)."""
     return "threads" if fetch_executor == "native-threads" else "reactor"
+
+
+#: Process-wide count of honest reactor→legacy fallbacks (satellite:
+#: a TLS user must not benchmark the wrong executor without noticing).
+_fallback_count = 0
+
+
+def executor_fallbacks() -> int:
+    """How many requested-reactor runs fell back to the legacy pool in
+    this process (preflight surfaces this next to the engine row)."""
+    return _fallback_count
+
+
+def check_executor_engaged(pool, fetch_executor: str) -> int:
+    """Honest-fallback contract for a freshly created pool.
+
+    ``native`` PREFERS the reactor but may legitimately run legacy (stale
+    .so): that emits ONE counted warning line, never silence. Explicitly
+    pinned ``native-reactor`` that cannot engage the reactor is a hard
+    error — a pinned A/B arm must fail loudly, not mislabel itself.
+    Returns 1 when a fallback was recorded, else 0.
+    """
+    global _fallback_count
+    requested = executor_mode(fetch_executor)
+    if pool.mode == requested:
+        return 0
+    if fetch_executor == "native-reactor":
+        pool.close()
+        raise RuntimeError(
+            "fetch_executor='native-reactor' was pinned but the engine "
+            f"engaged '{pool.mode}' (stale libtpubench.so without the "
+            "reactor symbols, or reactor creation failed) — refusing the "
+            "silent downgrade"
+        )
+    warn_fallback(requested, pool.mode, f"fetch_executor={fetch_executor!r}")
+    return 1
+
+
+def warn_fallback(requested: str, engaged: str, why: str = "") -> None:
+    """The one-line counted fallback warning (shared by run_read and
+    preflight): every honest reactor→legacy downgrade prints exactly one
+    stderr line carrying the running process-wide count."""
+    global _fallback_count
+    _fallback_count += 1
+    import sys
+
+    tail = f"; {why}" if why else ""
+    print(
+        f"tpubench: warning: fetch executor fell back to '{engaged}' "
+        f"(requested '{requested}'{tail}; fallback #{_fallback_count} "
+        "this process)",
+        file=sys.stderr,
+    )
 
 
 def _reactor_loops() -> int:
@@ -207,7 +269,10 @@ def _reactor_loops() -> int:
 
 
 def _make_pool(engine, inner, threads: int, cap: int, mode: str = "reactor"):
-    """Executor pool matching the backend's endpoint transport."""
+    """Executor pool matching the backend's endpoint transport: TLS from
+    the endpoint scheme, h2 multiplexing when the transport asked for
+    http2 (ALPN on TLS, prior-knowledge h2c on plaintext — reactor
+    only)."""
     t = inner.transport
     return engine.pool_create(
         threads=threads,
@@ -217,6 +282,7 @@ def _make_pool(engine, inner, threads: int, cap: int, mode: str = "reactor"):
         insecure=t.tls_insecure_skip_verify,
         mode=mode,
         loops=_reactor_loops(),
+        h2=bool(t.http2) and mode == "reactor",
     )
 
 
@@ -277,6 +343,7 @@ def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunRe
         return res
     pool = _make_pool(engine, inner, w.workers, max(4, 2 * w.workers),
                       mode=executor_mode(w.fetch_executor))
+    fellback = check_executor_engaged(pool, w.fetch_executor)
     native_stats0 = engine.stats()
     retry = RetryScheduler(cfg.transport.retry)
     bytes_total = 0
@@ -456,6 +523,8 @@ def run_read_native_executor(cfg: BenchConfig, backend: StorageBackend) -> RunRe
     )
     res.extra["fetch_executor"] = w.fetch_executor
     res.extra["executor_mode"] = pool.mode
+    if fellback:
+        res.extra["executor_fallback"] = True
     res.extra["executor_threads"] = w.workers
     bs = _wake_batch_stats(wake_batches)
     if bs is not None:
@@ -634,6 +703,7 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
 
     pool = _make_pool(engine, inner, w.workers, max(8, 2 * w.workers * depth),
                       mode=executor_mode(w.fetch_executor))
+    fellback = check_executor_engaged(pool, w.fetch_executor)
     native_stats0 = engine.stats()
     retry = RetryScheduler(cfg.transport.retry)
     wake_batches: list = []
@@ -873,6 +943,8 @@ def run_read_native_staged(cfg: BenchConfig, backend: StorageBackend) -> RunResu
     )
     res.extra["fetch_executor"] = w.fetch_executor
     res.extra["executor_mode"] = pool.mode
+    if fellback:
+        res.extra["executor_fallback"] = True
     res.extra["executor_threads"] = w.workers
     bs = _wake_batch_stats(wake_batches)
     if bs is not None:

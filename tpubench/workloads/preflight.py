@@ -206,12 +206,60 @@ def _engine_check(cfg: BenchConfig) -> dict:
     )
 
 
+def _executor_check(cfg: BenchConfig) -> dict:
+    """Honest-executor preflight (satellite of the reactor-completeness
+    work): when the config requests the reactor, predict whether it will
+    actually engage. An honest fallback under plain ``native`` gets the
+    one-line counted warning HERE, before any benchmark runs; a pinned
+    ``native-reactor`` that cannot engage is a preflight FAIL."""
+    fe = cfg.workload.fetch_executor
+    if not fe.startswith("native"):
+        return _check(
+            "fetch_executor", True, f"python orchestration path ({fe})",
+            skipped=True,
+        )
+    from tpubench.workloads.fetch_executor import executor_mode, warn_fallback
+
+    try:
+        from tpubench.native.engine import get_engine
+
+        eng = get_engine()
+    except Exception:  # noqa: BLE001
+        eng = None
+    if eng is None:
+        # the native_engine check already reports the load failure
+        return _check("fetch_executor", True, "see native_engine",
+                      skipped=True)
+    if executor_mode(fe) == "threads":
+        return _check("fetch_executor", True, "legacy thread pool (pinned)")
+    reason = ""
+    if not getattr(eng, "_has_pool_create2", False):
+        reason = "stale libtpubench.so without the reactor symbols"
+    else:
+        endpoint = cfg.transport.endpoint or "https://storage.googleapis.com"
+        if endpoint.startswith("https") and not eng.tls_available():
+            reason = "https endpoint but OpenSSL did not load"
+    if not reason:
+        return _check("fetch_executor", True, f"reactor engages ({fe})")
+    if fe == "native-reactor":
+        return _check(
+            "fetch_executor", False,
+            f"pinned native-reactor cannot engage: {reason}",
+        )
+    warn_fallback("reactor", "threads", reason)
+    return _check(
+        "fetch_executor", True,
+        f"requested reactor will fall back to legacy ({reason})",
+    )
+
+
 def run_preflight(cfg: BenchConfig, probe_timeout_s: float = 15.0) -> dict:
     checks = [
         _bounded("auth", lambda: _auth_check(cfg), probe_timeout_s),
         _bounded("bucket", lambda: _bucket_check(cfg), probe_timeout_s),
         _bounded("directpath", lambda: _directpath_check(cfg), probe_timeout_s),
         _engine_check(cfg),
+        _executor_check(cfg),
     ]
     t = cfg.transport
     endpoint = t.endpoint or (
