@@ -462,20 +462,22 @@ def _coop_cache_cell() -> dict:
 
 
 def _serve_knee_cell() -> dict:
-    """Open-loop serve load sweep on the hermetic fake backend
-    (BENCH_r06+): fixed seed, deterministic per-read service latency
-    (scaled, floored so the scale=0 smoke still has a finite service
-    rate), offered load stepped through multipliers of the base rate —
-    the latency-vs-offered-load curve with the saturation knee
-    identified (p99 inflection / goodput saturation). CPU-only and
-    jax-free, so it rides the quiet-CPU segment with the other A/Bs.
-    The smoke guard pins goodput monotone-nondecreasing below the
-    knee."""
+    """Open-loop serve load sweep under the VIRTUAL-TIME driver
+    (BENCH_r06+, converted from worker threads in the fleet PR): same
+    fixed seed, same deterministic service latency (scaled, floored so
+    the scale=0 smoke still has a finite service rate), same offered-
+    load ladder and knee detector — but the sweep runs through
+    ``run_fleet_sweep`` on the discrete-event scheduler, so the whole
+    five-point curve costs milliseconds of wall time instead of the
+    ~6 s the threaded sweep paid at scale 1 (the agreement gate in
+    tests/test_fleet.py pins threaded-vs-virtual knee equivalence).
+    CPU-only and jax-free, so it rides the quiet-CPU segment with the
+    other A/Bs. The smoke guard pins goodput monotone-nondecreasing
+    below the knee."""
     from tpubench.config import BenchConfig
-    from tpubench.workloads.serve import run_serve_sweep
+    from tpubench.fleet.driver import run_fleet_sweep
 
     cfg = BenchConfig()
-    cfg.transport.protocol = "fake"
     cfg.workload.workers = 4
     cfg.workload.object_size = 1 * MB
     cfg.workload.granule_bytes = 64 * 1024
@@ -483,18 +485,19 @@ def _serve_knee_cell() -> dict:
     cfg.obs.export = "none"
     cfg.pipeline.cache_bytes = 0  # every request pays real service time
     # Deterministic service floor: capacity ≈ workers / latency, so the
-    # sweep's upper multipliers land past the knee by construction.
-    cfg.transport.fault.per_read_latency_s = max(
-        0.001, 0.004 * _SLEEP_SCALE
-    )
-    cfg.transport.fault.seed = 7
+    # sweep's upper multipliers land past the knee by construction —
+    # the same scaled constant the threaded cell fed the fault plane,
+    # expressed as the simulator's origin service time.
+    cfg.fleet.origin_service_ms = max(0.001, 0.004 * _SLEEP_SCALE) * 1e3
+    cfg.fleet.hosts = 0  # inherit serve.hosts=1: the single-host plane
+    cfg.fleet.workers_per_host = 0  # serve.workers pod-wide, as threaded
     cfg.serve.seed = 7
     cfg.serve.duration_s = max(0.4, 1.0 * _SLEEP_SCALE)
     cfg.serve.rate_rps = 150.0
     cfg.serve.tenants = 30
     cfg.serve.workers = 2
     cfg.serve.sweep_points = [0.5, 1.0, 2.0, 4.0, 8.0]
-    res = run_serve_sweep(cfg)
+    res = run_fleet_sweep(cfg)
     sweep = res.extra["serve"]["sweep"]
     return {
         "points": [
@@ -505,6 +508,74 @@ def _serve_knee_cell() -> dict:
             for p in sweep["points"]
         ],
         "knee": sweep["knee"],
+        "sleep_scale": _SLEEP_SCALE,
+    }
+
+
+def _fleet_scale_cell() -> dict:
+    """Virtual-time fleet scaling ladder (BENCH_r06+): the SAME
+    correlated-failure scenario (fixed seed, diurnal arrivals, 5% of
+    the pod killed mid-run and rejoining cold) simulated at 64 / 256 /
+    1024 hosts, reporting simulated-hosts-per-wall-second — the fleet
+    engine's headline throughput number. Two guards ride along: the
+    1024-host rung must finish inside the cell budget (a sim that
+    stops being cheap has lost its reason to exist), and the scorecard
+    outputs (gold SLO, Jain fairness, completed count) must be
+    bit-identical across two reps at the same seed — the discrete-event
+    loop has no thread interleaving left to vary, so ANY drift is a
+    determinism bug, not noise. CPU-only and jax-free: quiet-CPU
+    segment."""
+    from tpubench.config import BenchConfig
+    from tpubench.fleet.driver import run_fleet
+
+    budget_s = 60.0  # the ISSUE acceptance bound for the 1024 rung
+
+    def one(hosts: int) -> dict:
+        cfg = BenchConfig()
+        cfg.workload.object_size = 1 * MB
+        cfg.workload.granule_bytes = 64 * 1024
+        cfg.obs.export = "none"
+        cfg.fleet.hosts = hosts
+        cfg.fleet.seed = 11
+        cfg.fleet.timeline = "correlated_failure"
+        cfg.fleet.fail_at_s = 0.5
+        cfg.fleet.fail_fraction = 0.05
+        cfg.fleet.recover_s = 0.4
+        cfg.serve.seed = 11
+        cfg.serve.arrival = "diurnal"
+        cfg.serve.duration_s = 1.0
+        cfg.serve.rate_rps = 40.0 * hosts  # load scales with the pod
+        cfg.serve.tenants = 200
+        res = run_fleet(cfg)
+        sv, fl = res.extra["serve"], res.extra["fleet"]
+        gold = min(
+            sv["classes"].values(), key=lambda c: c["priority"]
+        ) if sv["classes"] else {}
+        return {
+            "hosts": hosts,
+            "arrivals": fl["arrivals"],
+            "completed": sv["completed"],
+            "gold_slo_attainment": gold.get("slo_attainment"),
+            "jain_fairness": sv["jain_fairness"],
+            "virtual_s": fl["sim"]["virtual_s"],
+            "real_wall_s": fl["sim"]["real_wall_s"],
+            "hosts_per_wall_s": fl["sim"]["hosts_per_wall_s"],
+            "events_fired": fl["sim"]["events_fired"],
+        }
+
+    rungs = [one(h) for h in (64, 256, 1024)]
+    rep2 = one(1024)
+    top = rungs[-1]
+    deterministic = all(
+        top[k] == rep2[k]
+        for k in ("arrivals", "completed", "gold_slo_attainment",
+                  "jain_fairness")
+    )
+    return {
+        "rungs": rungs,
+        "budget_s": budget_s,
+        "within_budget": top["real_wall_s"] <= budget_s,
+        "deterministic_across_reps": deterministic,
         "sleep_scale": _SLEEP_SCALE,
     }
 
@@ -1330,6 +1401,15 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001 — the bench must not die here
         print(f"# serve knee sweep failed: {e}", file=sys.stderr)
 
+    # Virtual-time fleet scaling ladder (64/256/1024 simulated hosts,
+    # correlated-failure scenario): hermetic, CPU-only and jax-free —
+    # quiet-CPU segment like the serve knee.
+    fleet_scale: dict = {}
+    try:
+        fleet_scale = _fleet_scale_cell()
+    except Exception as e:  # noqa: BLE001 — the bench must not die here
+        print(f"# fleet scale ladder failed: {e}", file=sys.stderr)
+
     # Equal-CPU serve-knee executor A/B (threads vs reactor backend
     # fetches, same sweep/seed): quiet-CPU segment like the serve knee.
     serve_knee_executor: dict = {}
@@ -1663,6 +1743,7 @@ def main() -> int:
                 "coop_cache": coop_cache,
                 "trace_overhead": trace_overhead,
                 "serve_knee": serve_knee,
+                "fleet_scale": fleet_scale,
                 "serve_knee_executor": serve_knee_executor,
                 "elastic_resize": elastic_resize,
                 "ckpt_roundtrip": ckpt_roundtrip,

@@ -181,3 +181,13 @@ def pytest_configure(config):
         "markers", "drill: incident drill (restore-while-serving/"
                    "delta saves/drill scorecard)"
     )
+    # Fleet tests (virtual-time fleet engine: event-loop kernel,
+    # journal calibration, the threaded-vs-virtual agreement gate, the
+    # 1024-host correlated-failure acceptance) stay in tier-1 — the
+    # whole plane exists to be fast, so even the 1024-host scenario
+    # runs on every pass; the marker exists for selective runs
+    # (`-m fleet`).
+    config.addinivalue_line(
+        "markers", "fleet: virtual-time fleet simulation "
+                   "(event loop/calibration/agreement gate)"
+    )

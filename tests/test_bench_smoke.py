@@ -173,6 +173,26 @@ def test_bench_end_to_end_cpu():
         assert p["offered_rps"] > 0
     below = [p["goodput_gbps"] for p in sk["points"][:sk["knee"]["index"]]]
     assert all(b >= a * 0.85 for a, b in zip(below, below[1:])), below
+    # Fleet scaling ladder (fleet PR): the virtual-time driver ran the
+    # same correlated-failure scenario at 64/256/1024 simulated hosts —
+    # the 1024-host rung inside the cell budget, and the scorecard
+    # outputs bit-identical across two reps at the same seed (the
+    # discrete-event loop has no interleaving left to vary, so drift
+    # here is a determinism bug, not noise).
+    fs = d["fleet_scale"]
+    assert [r["hosts"] for r in fs["rungs"]] == [64, 256, 1024]
+    for r in fs["rungs"]:
+        assert r["arrivals"] > 0 and r["completed"] > 0
+        assert r["real_wall_s"] > 0 and r["hosts_per_wall_s"] > 0
+        assert r["events_fired"] > r["arrivals"]  # events ⊃ arrivals
+    assert fs["within_budget"], (
+        f"1024-host fleet rung took {fs['rungs'][-1]['real_wall_s']}s "
+        f"(budget {fs['budget_s']}s) — the simulator stopped being cheap"
+    )
+    assert fs["deterministic_across_reps"], (
+        "fleet scorecard outputs drifted across two same-seed reps — "
+        "a determinism bug in the event loop or the service sampling"
+    )
     # Serve-knee executor A/B (ISSUE 19): the same sweep once with
     # backend fetches on the legacy thread pool and once through the
     # reactor adapter, equal CPU — both arms swept every point, and the

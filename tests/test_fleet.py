@@ -1,0 +1,558 @@
+"""Virtual-time fleet engine tests (marker ``fleet``).
+
+Four layers, cheapest first:
+
+* the event-loop kernel (ordering, FIFO tie-break, condition waits,
+  horizon parking) — the contract every simulated worker rides on;
+* journal calibration (quantile-grid fits, the torn-journal degrade,
+  the MIN_SAMPLES fallback, the ``--fleet-profile`` round-trip);
+* the driver itself (seed determinism, multi-pod routing, generated
+  timelines, report rendering, CLI smoke);
+* the ISSUE acceptance pair: the threaded-vs-virtual agreement gate
+  (gold SLO within ±2 points, the sweep knee on the same rung) and the
+  1024-host / 100k-tenant correlated-failure scenario completing
+  hermetically in well under its 60 s budget.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from tpubench.config import BenchConfig, validate_fleet_config
+from tpubench.fleet.calibrate import (
+    MIN_SAMPLES,
+    FleetProfile,
+    ServiceDist,
+    fit_profile,
+    load_profile,
+    save_profile,
+)
+from tpubench.fleet.driver import (
+    build_fleet_timeline,
+    format_fleet_block,
+    run_fleet,
+    run_fleet_sweep,
+)
+from tpubench.fleet.vtime import EventLoop, VirtualClock
+
+pytestmark = pytest.mark.fleet
+
+MB = 1 << 20
+CHUNK = 64 * 1024
+
+
+# ---------------------------------------------------- vtime kernel ----------
+
+
+def test_event_loop_fires_in_time_order_with_fifo_ties():
+    loop = EventLoop()
+    fired = []
+    loop.call_at(2.0, lambda: fired.append(("b", loop.clock.now())))
+    loop.call_at(1.0, lambda: fired.append(("a", loop.clock.now())))
+    # Equal timestamps fire in schedule order, never heap/hash order.
+    loop.call_at(3.0, lambda: fired.append(("c1", loop.clock.now())))
+    loop.call_at(3.0, lambda: fired.append(("c2", loop.clock.now())))
+    end = loop.run()
+    assert [f[0] for f in fired] == ["a", "b", "c1", "c2"]
+    assert [f[1] for f in fired] == [1.0, 2.0, 3.0, 3.0]
+    assert end == 3.0 and loop.events_fired == 4 and loop.pending == 0
+
+
+def test_event_loop_callbacks_schedule_more_work_and_past_clamps():
+    loop = EventLoop()
+    fired = []
+
+    def first():
+        fired.append(loop.clock.now())
+        # Negative delay clamps to "this instant, after queued work".
+        loop.call_after(-5.0, lambda: fired.append(loop.clock.now()))
+        loop.call_after(0.5, lambda: fired.append(loop.clock.now()))
+
+    loop.call_at(1.0, first)
+    loop.run()
+    assert fired == [1.0, 1.0, 1.5]
+
+
+def test_wait_until_polls_predicate_and_honors_deadline():
+    loop = EventLoop()
+    state = {"ready": False, "ok": 0, "timeout": 0}
+    loop.call_at(0.3, lambda: state.__setitem__("ready", True))
+    loop.wait_until(lambda: state["ready"],
+                    lambda: state.__setitem__("ok", loop.clock.now()),
+                    poll_s=0.1)
+    loop.run()
+    # Satisfied at the first poll tick at/after the flip.
+    assert state["ok"] == pytest.approx(0.3, abs=0.11)
+
+    loop2 = EventLoop()
+    loop2.wait_until(lambda: False, lambda: pytest.fail("never true"),
+                     poll_s=0.05, deadline_s=0.2,
+                     on_timeout=lambda: state.__setitem__(
+                         "timeout", loop2.clock.now()))
+    loop2.run()
+    assert state["timeout"] == pytest.approx(0.2, abs=0.06)
+    with pytest.raises(ValueError, match="poll_s"):
+        loop2.wait_until(lambda: True, lambda: None, poll_s=0.0)
+
+
+def test_run_until_parks_at_horizon_and_resumes():
+    loop = EventLoop()
+    fired = []
+    loop.call_at(1.0, lambda: fired.append(1.0))
+    loop.call_at(5.0, lambda: fired.append(5.0))
+    assert loop.run(until_s=2.0) == 2.0
+    assert fired == [1.0] and loop.pending == 1
+    assert loop.run() == 5.0
+    assert fired == [1.0, 5.0]
+
+
+def test_virtual_clock_ns_rounds_not_truncates():
+    c = VirtualClock()
+    c._advance_to(0.123456789)
+    assert c.now_ns() == round(0.123456789 * 1e9)
+    # A completion scheduled exactly at a ms deadline must compare
+    # equal through the ns domain (the shed check is now > deadline).
+    c2 = VirtualClock()
+    c2._advance_to(0.080)
+    assert c2.now_ns() == 80_000_000
+    # Monotonic: advancing backwards clamps.
+    c2._advance_to(0.01)
+    assert c2.now() == 0.080
+
+
+# ---------------------------------------------------- calibration -----------
+
+
+def _journal_doc(records):
+    return {
+        "format": "tpubench-flight-v1",
+        "journal_schema": 2,
+        "host": 0,
+        "time": 0.0,
+        "dropped": 0,
+        "records": records,
+    }
+
+
+def _miss_record(t0_ns, dur_ns):
+    return {"phases": {"cache_miss": t0_ns, "body_complete": t0_ns + dur_ns}}
+
+
+def _peer_record(t0_ns, dur_ns):
+    return {"phases": {"peer_request": t0_ns, "peer_hit": t0_ns + dur_ns}}
+
+
+def test_fit_profile_fits_origin_and_peer_from_journal(tmp_path):
+    recs = [_miss_record(i * 10_000_000, 4_000_000) for i in range(20)]
+    recs += [_peer_record(i * 10_000_000, 1_000_000) for i in range(20)]
+    p = tmp_path / "j.json"
+    p.write_text(json.dumps(_journal_doc(recs)))
+    prof = fit_profile([str(p)], defaults={
+        "hit": 0.05, "peer": 0.5, "origin": 4.0, "cross_pod": 1.5,
+    })
+    assert prof.phases["origin"].source == "fitted"
+    assert prof.phases["origin"].count == 20
+    assert prof.phases["origin"].p_ms(0.5) == pytest.approx(4.0)
+    assert prof.phases["peer"].source == "fitted"
+    assert prof.phases["peer"].p_ms(0.99) == pytest.approx(1.0)
+    # hit / cross_pod are structurally never journal-fitted.
+    assert prof.phases["hit"].source == "constant"
+    assert prof.phases["cross_pod"].source == "constant"
+
+
+def test_fit_profile_too_few_samples_falls_back_with_warning(
+        tmp_path, capsys):
+    recs = [_miss_record(0, 2_000_000)] * (MIN_SAMPLES - 1)
+    p = tmp_path / "j.json"
+    p.write_text(json.dumps(_journal_doc(recs)))
+    prof = fit_profile([str(p)], defaults={
+        "hit": 0.05, "peer": 0.5, "origin": 4.0, "cross_pod": 1.5,
+    })
+    err = capsys.readouterr().err
+    assert "using the configured constant" in err
+    assert prof.phases["origin"].source == "constant"
+    assert prof.phases["origin"].p_ms(0.5) == pytest.approx(4.0)
+
+
+def test_fit_profile_degrades_on_torn_journal(tmp_path, capsys):
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_journal_doc(
+        [_miss_record(i, 3_000_000) for i in range(MIN_SAMPLES)]
+        + [_peer_record(i, 900_000) for i in range(MIN_SAMPLES)]
+    )))
+    torn = tmp_path / "torn.json"
+    torn.write_text('{"format": "tpubench-flight-v1", "records": [{')
+    empty = tmp_path / "empty.json"
+    empty.write_text("")
+    prof = fit_profile([str(good), str(torn), str(empty)], defaults={
+        "hit": 0.05, "peer": 0.5, "origin": 4.0, "cross_pod": 1.5,
+    })
+    err = capsys.readouterr().err
+    # One-line warnings per bad journal, the good one still fits.
+    assert "warning" in err and "skipped" in err
+    assert prof.phases["origin"].source == "fitted"
+    assert prof.phases["origin"].p_ms(0.5) == pytest.approx(3.0)
+
+
+def test_profile_round_trips_through_json(tmp_path):
+    prof = FleetProfile.from_constants(
+        hit_ms=0.05, peer_ms=0.5, origin_ms=4.0, cross_pod_ms=1.5)
+    prof.phases["origin"] = ServiceDist.fit([1.0, 2.0, 3.0, 4.0] * 4)
+    path = str(tmp_path / "profile.json")
+    save_profile(prof, path)
+    back = load_profile(path)
+    for name in prof.phases:
+        assert back.phases[name].grid_ms == prof.phases[name].grid_ms
+        assert back.phases[name].source == prof.phases[name].source
+    assert back.summary() == prof.summary()
+
+
+def test_load_profile_rejects_wrong_format_and_bad_json(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"format": "something-else/9"}))
+    with pytest.raises(SystemExit, match="not a fleet profile"):
+        load_profile(str(bad))
+    torn = tmp_path / "torn.json"
+    torn.write_text("{nope")
+    with pytest.raises(SystemExit, match="invalid JSON"):
+        load_profile(str(torn))
+
+
+def test_service_dist_sampling_is_seeded_and_bounded():
+    d = ServiceDist.fit(list(np.linspace(10.0, 20.0, 64)))
+    r1 = np.random.Generator(np.random.Philox(3))
+    r2 = np.random.Generator(np.random.Philox(3))
+    draws = [d.sample_s(r1) for _ in range(200)]
+    assert draws == [d.sample_s(r2) for _ in range(200)]
+    assert all(0.010 <= s <= 0.020 for s in draws)
+    assert d.mean_ms() == pytest.approx(15.0, rel=0.02)
+
+
+# ------------------------------------------------ config validation ---------
+
+
+def test_validate_fleet_config_rejections():
+    sc = BenchConfig().serve
+    for field, value, msg in (
+        ("hosts", 100_000, "hosts"),
+        ("timeline", "meteor_strike", "timeline"),
+        ("fail_fraction", 1.0, "someone has to survive"),
+        ("origin_service_ms", 0.0, "origin_service_ms"),
+        ("seed", -1, "seed"),
+    ):
+        fc = BenchConfig().fleet
+        setattr(fc, field, value)
+        with pytest.raises(SystemExit, match=msg):
+            validate_fleet_config(fc, sc)
+    fc = BenchConfig().fleet
+    fc.hosts, fc.pods = 8, 9
+    with pytest.raises(SystemExit, match="pods"):
+        validate_fleet_config(fc, sc)
+
+
+def test_build_fleet_timeline_correlated_failure_is_seeded():
+    fc = BenchConfig().fleet
+    fc.timeline = "correlated_failure"
+    fc.fail_fraction = 0.25
+    fc.fail_at_s = 0.5
+    fc.recover_s = 0.3
+    t1 = build_fleet_timeline(fc, 16)
+    t2 = build_fleet_timeline(fc, 16)
+    assert t1 == t2  # same seed, same blast
+    kills = [e for e in t1 if "kill_host" in e[2]]
+    rejoins = [e for e in t1 if "rejoin_host" in e[2]]
+    assert len(kills) == 4 and len(rejoins) == 4
+    assert all(e[0] == 0.5 for e in kills)
+    assert all(e[0] == pytest.approx(0.8) for e in rejoins)
+    fc.seed += 1
+    assert build_fleet_timeline(fc, 16) != t1
+
+
+def test_build_fleet_timeline_rolling_upgrade_staggers():
+    fc = BenchConfig().fleet
+    fc.timeline = "rolling_upgrade"
+    fc.fail_at_s = 0.2
+    fc.upgrade_pause_s = 0.1
+    tl = build_fleet_timeline(fc, 4)
+    assert len(tl) == 4
+    assert all("pause_host" in e[2] for e in tl)
+    starts = [e[0] for e in tl]
+    assert starts == sorted(starts) and len(set(starts)) == 4
+
+
+# ---------------------------------------------------------- driver ----------
+
+
+def _fleet_cfg(hosts=16, duration=0.8, rate=300.0, seed=9, tenants=60):
+    cfg = BenchConfig()
+    cfg.workload.object_size = MB
+    cfg.workload.granule_bytes = CHUNK
+    cfg.obs.export = "none"
+    cfg.fleet.hosts = hosts
+    cfg.fleet.seed = seed
+    cfg.serve.seed = seed
+    cfg.serve.duration_s = duration
+    cfg.serve.rate_rps = rate
+    cfg.serve.tenants = tenants
+    return cfg
+
+
+def test_fleet_run_is_deterministic_per_seed():
+    a = run_fleet(_fleet_cfg())
+    b = run_fleet(_fleet_cfg())
+    # Everything the scorecards say must be bit-identical: the event
+    # loop has no thread interleaving, service draws ride seeded
+    # Philox, and the only real clock measures the sim's own wall cost.
+    assert json.loads(json.dumps(a.extra["serve"])) == \
+        json.loads(json.dumps(b.extra["serve"]))
+    assert json.loads(json.dumps(a.extra["membership"])) == \
+        json.loads(json.dumps(b.extra["membership"]))
+    assert a.extra["fleet"]["arrivals"] == b.extra["fleet"]["arrivals"]
+    c = run_fleet(_fleet_cfg(seed=10))
+    assert json.loads(json.dumps(a.extra["serve"])) != \
+        json.loads(json.dumps(c.extra["serve"]))
+
+
+def test_fleet_multi_pod_topology_routes_cross_pod():
+    cfg = _fleet_cfg(hosts=32)
+    cfg.fleet.pods = 4
+    res = run_fleet(cfg)
+    fl = res.extra["fleet"]
+    assert fl["pods"] == 4
+    # With 4 pods, ~3/4 of misses home on a remote pod: the cross-pod
+    # tier must actually carry traffic.
+    assert fl["cross_pod"]["hits"] > 0
+    assert fl["cross_pod"]["bytes"] > 0
+    assert res.errors == 0
+
+
+def test_fleet_auto_pods_scale_with_hosts():
+    res = run_fleet(_fleet_cfg(hosts=256, duration=0.3, rate=200.0))
+    assert res.extra["fleet"]["pods"] == 2  # 256 // 128
+
+
+def test_fleet_rolling_upgrade_runs_through_membership():
+    cfg = _fleet_cfg(hosts=8, duration=1.0)
+    cfg.fleet.timeline = "rolling_upgrade"
+    cfg.fleet.fail_at_s = 0.2
+    cfg.fleet.upgrade_pause_s = 0.05
+    cfg.fleet.upgrade_stagger_s = 0.08
+    res = run_fleet(cfg)
+    mb = res.extra["membership"]
+    actions = [e["action"] for e in mb["events"]]
+    # Every host pauses and resumes, epoch-numbered through the real
+    # state machine.
+    assert actions.count("pause_host") == 8
+    assert actions.count("resume_host") == 8
+    assert mb["epoch"] == 16
+    assert res.errors == 0
+
+
+def test_fleet_scorecards_render_via_report(tmp_path):
+    from tpubench.workloads.report_cmd import summarize_run
+
+    cfg = _fleet_cfg(hosts=8, duration=0.6)
+    cfg.fleet.timeline = "correlated_failure"
+    cfg.fleet.fail_at_s = 0.3
+    cfg.obs.flight_journal = str(tmp_path / "fleet.json")
+    res = run_fleet(cfg)
+    out = summarize_run(json.loads(json.dumps(res.to_dict())))
+    assert "serve scorecard" in out
+    assert "membership resize scorecard" in out
+    assert "fleet simulation" in out
+    assert "kill_host" in out
+    text = format_fleet_block(res.extra["fleet"])
+    assert "virtual_s" in text and "hosts/wall-second" in text
+    # The journal carries the fleet span kind for report/top tooling.
+    doc = json.loads((tmp_path / "fleet.json").read_text())
+    assert any(r.get("kind") == "fleet" for r in doc["records"])
+
+
+def test_fleet_cli_smoke(tmp_path, capsys):
+    from tpubench.cli import main
+
+    rc = main([
+        "fleet", "--fleet-hosts", "8", "--serve-duration", "0.4",
+        "--serve-rate", "200", "--fleet-timeline", "correlated_failure",
+        "--fleet-fail-at", "0.2", "--results-dir", str(tmp_path),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "serve scorecard" in out
+    assert "membership resize scorecard" in out
+    assert "fleet simulation" in out
+    import os
+
+    files = [f for f in os.listdir(tmp_path) if f.startswith("fleet_")]
+    assert len(files) == 1
+    with open(tmp_path / files[0]) as f:
+        data = json.load(f)
+    assert data["workload"] == "fleet" and data["errors"] == 0
+
+
+# ------------------------------------------------- agreement gate -----------
+
+
+def _agreement_cfg(duration=1.2, rate=250.0, seed=11):
+    """The 4-host elastic serve scenario both arms run: threaded via
+    run_serve (real threads, fake backend with deterministic service
+    latency), virtual via run_fleet with fleet.hosts=0 /
+    workers_per_host=0 so the pod shape and worker count inherit the
+    serve plane's exactly."""
+    cfg = BenchConfig()
+    cfg.transport.protocol = "fake"
+    cfg.workload.workers = 4
+    cfg.workload.object_size = MB
+    cfg.workload.granule_bytes = CHUNK
+    cfg.staging.mode = "none"
+    cfg.obs.export = "none"
+    cfg.pipeline.cache_bytes = 64 * MB
+    cfg.transport.fault.per_read_latency_s = 0.004
+    cfg.transport.fault.seed = seed
+    sv = cfg.serve
+    sv.seed = seed
+    sv.duration_s = duration
+    sv.rate_rps = rate
+    sv.tenants = 24
+    sv.workers = 4
+    sv.hosts = 4
+    sv.resize_window_s = 0.4
+    t = duration * 0.45
+    sv.membership_timeline = [[t, t, {"kill_host": 1}]]
+    cfg.fleet.hosts = 0
+    cfg.fleet.workers_per_host = 0
+    return cfg
+
+
+def _gold(sv: dict) -> dict:
+    return min(sv["classes"].values(), key=lambda c: c["priority"])
+
+
+def test_agreement_gate_gold_slo_within_2_points(tmp_path):
+    """ISSUE acceptance: the same 4-host elastic serve scenario run
+    threaded and virtual — with the virtual arm's service times
+    CALIBRATED from the threaded arm's own flight journal — agrees on
+    gold SLO attainment within ±2 points."""
+    from tpubench.workloads.serve import run_serve
+
+    cfg = _agreement_cfg()
+    cfg.obs.flight_journal = str(tmp_path / "agree.json")
+    threaded = run_serve(cfg)
+    tsv = threaded.extra["serve"]
+
+    vcfg = _agreement_cfg()
+    prof = fit_profile([cfg.obs.flight_journal], defaults={
+        "hit": vcfg.fleet.hit_service_ms,
+        "peer": vcfg.fleet.peer_service_ms,
+        "origin": vcfg.fleet.origin_service_ms,
+        "cross_pod": vcfg.fleet.cross_pod_ms,
+    })
+    assert prof.phases["origin"].source == "fitted"
+    vcfg.fleet.profile = prof.to_dict()
+    virtual = run_fleet(vcfg)
+    vsv = virtual.extra["serve"]
+
+    # Same offered schedule both arms (seeded arrivals).
+    assert tsv["arrivals"] == vsv["arrivals"]
+    t_gold = _gold(tsv)["slo_attainment"]
+    v_gold = _gold(vsv)["slo_attainment"]
+    assert t_gold is not None and v_gold is not None
+    assert abs(t_gold - v_gold) <= 0.02, (
+        f"threaded gold SLO {t_gold:.3f} vs virtual {v_gold:.3f}: "
+        "the agreement gate allows ±2 points"
+    )
+    # Both arms applied the same membership event at the same epoch.
+    assert (threaded.extra["membership"]["events"][0]["action"]
+            == virtual.extra["membership"]["events"][0]["action"]
+            == "kill_host")
+
+
+def test_agreement_gate_knee_on_same_rung():
+    """ISSUE acceptance: the load sweep's saturation knee lands on the
+    same sweep rung threaded and virtual (capacity ≈ workers/service
+    both arms; the deterministic fake-backend latency IS the virtual
+    arm's origin constant).
+
+    The scenario is deliberately contention-robust: the threaded arm
+    shares the CPU with the rest of tier-1, so the service time is
+    long (20 ms — scheduler stalls are small relative to it) and the
+    rungs are far apart (15% / 40% utilization pre-knee, 320% at the
+    knee) so only the genuinely saturated rung can trip find_knee's
+    relative p99/goodput criteria."""
+    from tpubench.workloads.serve import run_serve_sweep
+
+    def arms_cfg():
+        cfg = BenchConfig()
+        cfg.transport.protocol = "fake"
+        cfg.workload.workers = 4
+        cfg.workload.object_size = MB
+        cfg.workload.granule_bytes = CHUNK
+        cfg.obs.export = "none"
+        cfg.pipeline.cache_bytes = 0  # every request pays service time
+        cfg.transport.fault.per_read_latency_s = 0.020
+        cfg.transport.fault.seed = 7
+        cfg.serve.seed = 7
+        cfg.serve.duration_s = 1.0
+        cfg.serve.rate_rps = 40.0
+        cfg.serve.tenants = 30
+        cfg.serve.workers = 2  # capacity ≈ 2 / 0.020 s = 100 rps
+        cfg.serve.sweep_points = [0.5, 1.0, 8.0]
+        cfg.fleet.hosts = 0
+        cfg.fleet.workers_per_host = 0
+        cfg.fleet.origin_service_ms = 20.0
+        return cfg
+
+    t_sweep = run_serve_sweep(arms_cfg()).extra["serve"]["sweep"]
+    v_sweep = run_fleet_sweep(arms_cfg()).extra["serve"]["sweep"]
+    assert t_sweep["knee"] is not None and v_sweep["knee"] is not None
+    assert t_sweep["knee"]["index"] == v_sweep["knee"]["index"], (
+        f"threaded knee at rung {t_sweep['knee']['index']}, virtual at "
+        f"{v_sweep['knee']['index']} — the agreement gate requires the "
+        "same rung"
+    )
+
+
+# ------------------------------------------------ scale acceptance ----------
+
+
+def test_fleet_1024_hosts_100k_tenants_under_budget():
+    """ISSUE acceptance: a 1024-host, 100k-tenant fleet scenario with a
+    correlated-failure membership timeline completes hermetically in
+    under 60 s wall-clock and renders the full scorecard set."""
+    from tpubench.workloads.report_cmd import summarize_run
+
+    cfg = BenchConfig()
+    cfg.workload.object_size = MB
+    cfg.workload.granule_bytes = CHUNK
+    cfg.obs.export = "none"
+    cfg.fleet.hosts = 1024
+    cfg.fleet.seed = 20
+    cfg.fleet.timeline = "correlated_failure"
+    cfg.fleet.fail_at_s = 0.5
+    cfg.fleet.fail_fraction = 0.05
+    cfg.fleet.recover_s = 0.4
+    cfg.serve.seed = 20
+    cfg.serve.arrival = "diurnal"
+    cfg.serve.duration_s = 1.0
+    cfg.serve.rate_rps = 30_000.0
+    cfg.serve.tenants = 100_000
+    t0 = time.perf_counter()
+    res = run_fleet(cfg)
+    wall = time.perf_counter() - t0
+    assert wall < 60.0, f"1024-host scenario took {wall:.1f}s (budget 60s)"
+    fl = res.extra["fleet"]
+    assert fl["hosts"] == 1024 and fl["tenants"] == 100_000
+    assert fl["pods"] == 8  # auto: one per 128 hosts
+    assert fl["arrivals"] > 10_000
+    mb = res.extra["membership"]
+    kills = [e for e in mb["events"] if e["action"] == "kill_host"]
+    assert len(kills) == 51  # round(0.05 * 1024)
+    assert all(e["applied"] for e in kills)
+    assert res.errors == 0
+    # The full scorecard set renders through `tpubench report`.
+    out = summarize_run(json.loads(json.dumps(res.to_dict())))
+    assert "serve scorecard" in out
+    assert "membership resize scorecard" in out
+    assert "fleet simulation" in out

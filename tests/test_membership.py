@@ -551,12 +551,21 @@ def test_elastic_acceptance_4host_cooperative_vs_killed(tmp_path):
 
 
 def test_elastic_serve_pause_window_degrades_and_recovers():
+    # Converted to the virtual-time driver (fleet PR): same 4-host
+    # scenario, same membership scorecard — the pause semantics under
+    # test (window bracketing, epoch math, degrade-to-origin) live in
+    # code the driver shares with the threaded pod, and virtual time
+    # cuts the test's wall cost from ~1.4s of real sleeps to
+    # milliseconds. The threaded pause path stays covered by
+    # test_elastic_acceptance_4host_cooperative_vs_killed's arms.
     cfg = _elastic_cfg("pause_host", duration=1.2)
     t0, t1 = 0.4, 0.8
     cfg.serve.membership_timeline = [[t0, t1, {"pause_host": 1}]]
-    from tpubench.workloads.serve import run_serve
+    cfg.fleet.hosts = 0  # inherit serve.hosts=4
+    cfg.fleet.workers_per_host = 0  # serve.workers pod-wide
+    from tpubench.fleet.driver import run_fleet
 
-    res = run_serve(cfg)
+    res = run_fleet(cfg)
     mb = res.extra["membership"]
     actions = [e["action"] for e in mb["events"]]
     assert actions == ["pause_host", "resume_host"]
@@ -572,14 +581,19 @@ def test_elastic_serve_pause_window_degrades_and_recovers():
 
 
 def test_elastic_serve_rejoin_after_kill_restores_the_pod():
+    # Converted to the virtual-time driver (fleet PR) — same rationale
+    # as the pause test above: the kill/rejoin ring+epoch semantics are
+    # shared code, and the ~1.8s of real sleeps become milliseconds.
     cfg = _elastic_cfg("kill_host", duration=1.6)
     cfg.serve.membership_timeline = [
         [0.4, 0.4, {"kill_host": 1}],
         [0.9, 0.9, {"rejoin_host": 1}],
     ]
-    from tpubench.workloads.serve import run_serve
+    cfg.fleet.hosts = 0  # inherit serve.hosts=4
+    cfg.fleet.workers_per_host = 0  # serve.workers pod-wide
+    from tpubench.fleet.driver import run_fleet
 
-    res = run_serve(cfg)
+    res = run_fleet(cfg)
     mb = res.extra["membership"]
     assert [e["action"] for e in mb["events"]] == [
         "kill_host", "rejoin_host",

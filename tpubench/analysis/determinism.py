@@ -68,6 +68,13 @@ CLOCK_MODULES = (
     "tpubench/storage/grpc_wire/framing.py",
     "tpubench/storage/grpc_wire/client.py",
     "tpubench/storage/fake_grpc_wire_server.py",
+    # Virtual-time fleet engine: the whole point is bit-identical
+    # replays at 4096 hosts — the event loop owns time, service draws
+    # ride seeded Philox, and the only real clock allowed is the
+    # perf_counter_ns pair that measures the sim's own wall cost.
+    "tpubench/fleet/vtime.py",
+    "tpubench/fleet/calibrate.py",
+    "tpubench/fleet/driver.py",
 )
 
 # Paths whose classes must bound every accumulator (obs/serve planes

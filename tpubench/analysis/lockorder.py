@@ -65,6 +65,11 @@ LOCK_ORDER_FILES = (
     # (the h2 frame loop is single-threaded per conn by design).
     "tpubench/storage/grpc_wire/client.py",
     "tpubench/storage/fake_grpc_wire_server.py",
+    # Fleet driver: single-threaded by design — no locks today. It
+    # composes over Membership (whose lock must stay a leaf) and the
+    # admission queue, so any lock it ever grows joins the ordering
+    # graph from day one (the replay-driver precedent).
+    "tpubench/fleet/driver.py",
 )
 
 _LOCK_CTORS = {"Lock", "RLock", "Condition"}

@@ -525,6 +525,70 @@ def _add_drill_flags(p: argparse.ArgumentParser) -> None:
                         "--drill-sweep (default 0.5,1,2)")
 
 
+def _add_fleet_flags(p: argparse.ArgumentParser) -> None:
+    """Flags owned by the ``fleet`` subcommand — the simulated topology,
+    the membership timeline generator, and the calibration plumbing."""
+    p.add_argument("--fleet-hosts", type=int, dest="fleet_hosts",
+                   help="simulated pod size, 64-4096 territory "
+                        "(default 64; 0 = inherit --serve-hosts, the "
+                        "agreement-gate arm)")
+    p.add_argument("--fleet-pods", type=int, dest="fleet_pods",
+                   help="partition the hosts into N pods with a "
+                        "cross-pod routing ring above the per-pod "
+                        "coop rings (default 0 = one pod per 128 "
+                        "hosts, minimum one)")
+    p.add_argument("--fleet-workers-per-host", type=int,
+                   dest="fleet_workers_per_host",
+                   help="simulated service slots per host (default 2; "
+                        "0 = --serve-workers pod-wide, the "
+                        "agreement-gate arm)")
+    p.add_argument("--fleet-objects", type=int, dest="fleet_objects",
+                   help="synthetic object population the Zipf tenant "
+                        "mix draws over (default 64)")
+    p.add_argument("--fleet-timeline",
+                   choices=("none", "correlated_failure",
+                            "rolling_upgrade"),
+                   dest="fleet_timeline",
+                   help="generated membership timeline: "
+                        "correlated_failure kills --fleet-fail-"
+                        "fraction of the hosts at --fleet-fail-at, "
+                        "rolling_upgrade pauses every host in "
+                        "staggered windows (default none)")
+    p.add_argument("--fleet-fail-at", type=float, dest="fleet_fail_at",
+                   help="correlated_failure: virtual second the blast "
+                        "lands (default 0.5)")
+    p.add_argument("--fleet-fail-fraction", type=float,
+                   dest="fleet_fail_fraction",
+                   help="correlated_failure: fraction of hosts killed "
+                        "together (default 0.1)")
+    p.add_argument("--fleet-recover", type=float, dest="fleet_recover",
+                   help="correlated_failure: seconds until the victims "
+                        "rejoin cold (default 0 = they stay dead)")
+    p.add_argument("--fleet-upgrade-pause", type=float,
+                   dest="fleet_upgrade_pause",
+                   help="rolling_upgrade: pause window per host in "
+                        "virtual seconds (default 0.2)")
+    p.add_argument("--fleet-seed", type=int, dest="fleet_seed",
+                   help="victim-selection seed (identical seeds replay "
+                        "identical blast patterns; default 20)")
+    p.add_argument("--calibrate-from", nargs="+", dest="calibrate_from",
+                   metavar="JOURNAL",
+                   help="fit per-phase service times from flight "
+                        "journal base paths (.p<idx> siblings and "
+                        ".gz variants discovered like `tpubench top`); "
+                        "phases with too few samples fall back to the "
+                        "configured constants with a warning")
+    p.add_argument("--fleet-profile", dest="fleet_profile",
+                   help="service-time profile JSON: written here after "
+                        "--calibrate-from, loaded from here otherwise "
+                        "(the --tune-profile round-trip shape)")
+    p.add_argument("--fleet-sweep", action="store_true",
+                   dest="fleet_sweep",
+                   help="step offered load through the serve sweep "
+                        "multipliers under the virtual driver and "
+                        "locate the knee (p99 inflection)")
+
+
 def build_config(args) -> BenchConfig:
     if args.config:
         with open(args.config) as f:
@@ -783,9 +847,36 @@ def build_config(args) -> BenchConfig:
                 f"{args.serve_sweep_points!r}: expected a comma list "
                 "of positive numbers"
             ) from None
+    fc = cfg.fleet
+    for attr, dest in (
+        ("fleet_hosts", "hosts"), ("fleet_pods", "pods"),
+        ("fleet_workers_per_host", "workers_per_host"),
+        ("fleet_objects", "objects"),
+        ("fleet_timeline", "timeline"),
+        ("fleet_fail_at", "fail_at_s"),
+        ("fleet_fail_fraction", "fail_fraction"),
+        ("fleet_recover", "recover_s"),
+        ("fleet_upgrade_pause", "upgrade_pause_s"),
+        ("fleet_seed", "seed"),
+        ("fleet_profile", "profile_path"),
+    ):
+        v = getattr(args, attr, None)
+        if v is not None:
+            setattr(fc, dest, v)
+    if getattr(args, "calibrate_from", None):
+        fc.calibrate_from = list(args.calibrate_from)
+    if getattr(args, "fleet_sweep", False):
+        fc.sweep = True
     from tpubench.config import validate_serve_config
 
     validate_serve_config(sv)
+    if getattr(args, "cmd", None) == "fleet":
+        # Only the fleet command pays fleet validation — any other
+        # command carrying a config file with default fleet values must
+        # not be refused (the drill-gating precedent above).
+        from tpubench.config import validate_fleet_config
+
+        validate_fleet_config(fc, sv)
     lc = cfg.lifecycle
     for attr, dest in (
         ("ckpt_objects", "objects"), ("ckpt_object_bytes", "object_bytes"),
@@ -1265,6 +1356,18 @@ def main(argv=None) -> int:
     _add_serve_flags(drill)
     _add_lifecycle_flags(drill)
     _add_drill_flags(drill)
+    fleet = add("fleet", "virtual-time fleet simulation: the SAME serve/"
+                         "qos/membership/coop code under a discrete-"
+                         "event driver instead of worker threads — "
+                         "64-4096 simulated hosts, multi-pod topologies "
+                         "with cross-pod routing, diurnal multi-tenant "
+                         "mixes and correlated-failure / rolling-"
+                         "upgrade membership timelines, scored by the "
+                         "real serve + membership scorecards; service "
+                         "times calibrate from flight journals via "
+                         "--calibrate-from")
+    _add_serve_flags(fleet)
+    _add_fleet_flags(fleet)
     for name, help_ in (
         ("ckpt-save", "storage lifecycle: save a sharded checkpoint "
                       "through resumable multi-part uploads (session -> "
@@ -1566,6 +1669,46 @@ def main(argv=None) -> int:
         with open(args.save_config, "w") as f:
             f.write(cfg.to_json())
         print(f"config written: {args.save_config}")
+        return 0
+
+    if args.cmd == "fleet":
+        # Pure simulation: jax-free, device-free — the point is a
+        # 1024-host fleet on one CPU in seconds, so it dispatches before
+        # pin_platform/_bringup like check/top/record.
+        from tpubench.fleet.calibrate import (
+            fit_profile,
+            load_profile,
+            save_profile,
+        )
+        from tpubench.fleet.driver import (
+            format_fleet_block,
+            run_fleet,
+            run_fleet_sweep,
+        )
+        from tpubench.workloads.serve import (
+            format_membership_scorecard,
+            format_serve_scorecard,
+        )
+
+        fc = cfg.fleet
+        if fc.calibrate_from:
+            profile = fit_profile(fc.calibrate_from, defaults={
+                "hit": fc.hit_service_ms, "peer": fc.peer_service_ms,
+                "origin": fc.origin_service_ms,
+                "cross_pod": fc.cross_pod_ms,
+            })
+            fc.profile = profile.to_dict()
+            if fc.profile_path:
+                print("fleet profile written: "
+                      f"{save_profile(profile, fc.profile_path)}")
+        elif fc.profile_path and not fc.profile:
+            fc.profile = load_profile(fc.profile_path).to_dict()
+        res = run_fleet_sweep(cfg) if fc.sweep else run_fleet(cfg)
+        print(format_serve_scorecard(res.extra["serve"]))
+        if res.extra.get("membership"):
+            print(format_membership_scorecard(res.extra["membership"]))
+        print(format_fleet_block(res.extra["fleet"]))
+        _finish(res, cfg)
         return 0
 
     if args.cmd == "info":

@@ -1,0 +1,23 @@
+"""Virtual-time fleet engine (``tpubench fleet``).
+
+The hermetic harnesses elsewhere in the tree pay wall-clock per host
+thread — a 4-host elastic pod is the practical ceiling. This package
+replaces the threads with a discrete-event scheduler running on the
+injectable-clock seam the serve, qos, arrivals and membership planes
+already expose (the PR-12 determinism gate enforces that seam), so the
+SAME admission queue, membership state machine, consistent-hash ring
+and scorecard math run at 64–4096 simulated hosts in seconds of wall
+time.
+
+* :mod:`tpubench.fleet.vtime` — the event-heap scheduler and the
+  ``Clock`` surface that drop-in replaces ``time.monotonic`` /
+  ``perf_counter_ns`` style injectables.
+* :mod:`tpubench.fleet.calibrate` — per-phase service-time
+  distributions fitted from flight journals (``--calibrate-from``),
+  round-tripped through ``--fleet-profile`` JSON.
+* :mod:`tpubench.fleet.driver` — the fleet workload: multi-pod
+  topologies, correlated-failure / rolling-upgrade timelines, scored
+  by the real ``serve_scorecard`` / ``membership_scorecard``.
+"""
+
+from tpubench.fleet.vtime import EventLoop, VirtualClock  # noqa: F401

@@ -478,6 +478,11 @@ GAUGE_METRICS = {
     "tpubench_membership_epoch":
         "current elastic-membership view epoch (bumps on every "
         "join/leave/fail/pause/resume)",
+    "tpubench_fleet_hosts":
+        "simulated host count of the last virtual-time fleet run",
+    "tpubench_fleet_virtual_seconds":
+        "virtual seconds the last fleet simulation covered (its "
+        "real wall cost is the run's wall_seconds)",
 }
 
 HISTOGRAM_METRICS = {
@@ -677,6 +682,13 @@ class FlightFeeder:
                 epoch = n.get("epoch")
                 if epoch is not None:
                     reg.get("tpubench_membership_epoch").set(epoch)
+            elif nk == "fleet":
+                hosts = n.get("hosts")
+                if hosts is not None:
+                    reg.get("tpubench_fleet_hosts").set(hosts)
+                virtual_s = n.get("virtual_s")
+                if virtual_s is not None:
+                    reg.get("tpubench_fleet_virtual_seconds").set(virtual_s)
             elif nk == "stage" and n.get("event") == "overlap":
                 reg.get("tpubench_stage_overlapped_total").inc()
 

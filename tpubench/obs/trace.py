@@ -66,6 +66,9 @@ SPAN_KINDS = {
               "to finalize; per-part detail rides its notes)",
     "meta": "one open-loop metadata operation (meta-storm "
             "list/stat/open)",
+    "fleet": "one virtual-time fleet simulation (tpubench fleet: "
+             "simulated topology + virtual-vs-real wall accounting "
+             "rides its note)",
 }
 
 # Annotation kinds synthesized into child spans (notes with a duration

@@ -217,6 +217,14 @@ def summarize_run(run: dict, label: str = "") -> str:
         from tpubench.workloads.drill import format_drill_sweep
 
         lines.append(format_drill_sweep(ds))
+    fl = extra.get("fleet")
+    if fl:
+        # Virtual-time fleet block: simulated topology, virtual-vs-real
+        # wall clock, the fitted service profile — printed after the
+        # serve/membership scorecards it was scored by.
+        from tpubench.fleet.driver import format_fleet_block
+
+        lines.append(format_fleet_block(fl))
     rp = extra.get("replay")
     if rp:
         # Replay-vs-original scorecard diff: the same body `tpubench
